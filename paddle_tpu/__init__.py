@@ -1,0 +1,130 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capability surface of
+PaddlePaddle (reference mounted at /root/reference; see SURVEY.md for the
+layer map). The eager API feels like paddle dygraph; the performance path is
+one jitted XLA step (paddle_tpu.jit), parallelism is mesh + GSPMD/shard_map
+(paddle_tpu.distributed), and hot kernels are Pallas (paddle_tpu.ops.pallas).
+"""
+__version__ = "0.1.0"
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import jit  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import ops  # noqa: F401
+from .core import random as _random_mod  # noqa: F401
+from .core.random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .core.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64, int8,
+    int16, int32, int64, uint8,
+)
+from .ops.registry import OPS as _OPS
+
+# re-export every registered op at top level (paddle.* flat namespace parity)
+_g = globals()
+for _name, _op in _OPS.items():
+    _g.setdefault(_name, _op)
+del _g
+
+
+def __getattr__(name):
+    # ops registered after import (e.g. distributed extensions)
+    if name in _OPS:
+        return _OPS[name]
+    if name == "distributed":  # canonical home is paddle_tpu.parallel
+        import importlib
+        mod = importlib.import_module(".parallel", __name__)
+        globals()[name] = mod
+        return mod
+    if name in ("parallel", "io", "hapi", "metric", "profiler", "vision",
+                "models", "utils", "incubate", "static", "device", "runtime"):
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def in_dynamic_mode():
+    return True
+
+
+def get_default_dtype():
+    return _dtype_mod.float32
+
+
+_default_dtype = [_dtype_mod.float32]
+
+
+def set_default_dtype(d):
+    _default_dtype[0] = _dtype_mod.convert_dtype(d)
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is trace-based; use paddle_tpu.jit.to_static")
+
+
+def grad(*args, **kwargs):
+    return autograd.grad(*args, **kwargs)
+
+
+def device_count():
+    import jax
+    return jax.device_count()
+
+
+def set_device(device):
+    return device
+
+
+def get_device():
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def synchronize():
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def save(obj, path, **kwargs):
+    from .io.save_load import save as _save
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .io.save_load import load as _load
+    return _load(path, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.summary import flops as _flops
+    return _flops(net, input_size)

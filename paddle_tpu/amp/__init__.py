@@ -1,0 +1,221 @@
+"""AMP: auto_cast + GradScaler (paddle.amp parity).
+
+Reference: python/paddle/amp/auto_cast.py:296 (white/black op lists),
+grad_scaler.py:38 (dynamic loss scaling). TPU-native notes: bf16 is the
+native mixed-precision dtype (MXU computes bf16×bf16→f32) and needs NO loss
+scaling; fp16 + GradScaler is kept for API/semantics parity. auto_cast works
+by making the eager dispatch cast op inputs by list membership — under jit
+the same lists are applied at trace time, so compiled steps get identical
+casting.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype, is_floating
+from ..core.tensor import Tensor, unwrap, wrap
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "white_list", "black_list", "amp_state"]
+
+# reference lists: python/paddle/amp/auto_cast.py WHITE_LIST/BLACK_LIST
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "sdp_attention",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "cos_sim",
+    "softmax", "log_softmax", "cross_entropy_with_softmax", "cross_entropy_soft",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "logsumexp", "norm", "cumsum", "cumprod", "var", "std", "erf", "erfinv",
+    "pow", "reciprocal", "rsqrt", "sqrt",
+}
+
+_state = threading.local()
+
+
+class AmpState:
+    __slots__ = ("enabled", "dtype", "level", "white", "black")
+
+    def __init__(self, enabled=False, dtype=jnp.bfloat16, level="O1",
+                 white=None, black=None):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.level = level
+        self.white = white or WHITE_LIST
+        self.black = black or BLACK_LIST
+
+
+def amp_state() -> AmpState:
+    st = getattr(_state, "amp", None)
+    if st is None:
+        st = AmpState()
+        _state.amp = st
+    return st
+
+
+def white_list():
+    return amp_state().white
+
+
+def black_list():
+    return amp_state().black
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = getattr(_state, "amp", None)
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    _state.amp = AmpState(enable, convert_dtype(dtype), level, white, black)
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def cast_inputs_for_op(op_name, vals, st: AmpState):
+    """Apply O1 casting rules to raw array vals (called from dispatch)."""
+    if op_name in st.white:
+        target = st.dtype
+    elif op_name in st.black:
+        target = jnp.float32
+    else:
+        return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and is_floating(v.dtype) and v.dtype != target \
+                and getattr(v, "ndim", 0) > 0:
+            out.append(v.astype(target))
+        else:
+            out.append(v)
+    return out
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the target dtype (paddle.amp.decorate:517)."""
+    d = convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.astype(d)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference grad_scaler.py:38).
+
+    On TPU with bf16 this is a near-no-op (scale stays 1 when disabled), but
+    full fp16 semantics (inf-check, growth/backoff) are implemented for parity.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameters:
+            if p.grad is not None:
+                g = unwrap(p.grad) * inv
+                found = found | bool(jnp.any(~jnp.isfinite(g)))
+                p.grad = wrap(g)
+        self._found_inf = found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+    # ------------------------------------------------ functional (jit) api
+    def functional_scale_and_check(self, grads_tree):
+        """Pure: (grads) -> (unscaled grads, found_inf flag array)."""
+        inv = 1.0 / self._scale
+        unscaled = jax.tree_util.tree_map(lambda g: g * inv, grads_tree)
+        finite = jnp.array(True)
+        for g in jax.tree_util.tree_leaves(unscaled):
+            finite = finite & jnp.all(jnp.isfinite(g))
+        return unscaled, ~finite

@@ -1,0 +1,210 @@
+"""paddle_tpu.autograd — backward(), functional grad/vjp/jvp, PyLayer.
+
+Reference: python/paddle/autograd/ (py_layer.py, backward_mode.py) +
+python/paddle/incubate/autograd/functional.py. The eager tape lives in
+core/tape.py; this module is the user-facing surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tape import Node, enable_grad, no_grad, set_grad_enabled, tape_enabled
+from ..core.tensor import Tensor, backward as _tensor_backward, unwrap, wrap
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext", "vjp", "jvp",
+           "jacobian", "hessian"]
+
+
+def is_grad_enabled():
+    return tape_enabled()
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _tensor_backward(t, g, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (first-order; create_graph uses jax re-trace)."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    saved = [(p, p.grad) for p in inputs]
+    for p in inputs:
+        p.grad = None
+    backward(outputs, grad_outputs, retain_graph=bool(retain_graph))
+    grads = []
+    for p, old in saved:
+        g = p.grad
+        if g is None and not allow_unused:
+            g = wrap(jnp.zeros_like(unwrap(p)))
+        grads.append(g)
+        p.grad = old
+    return grads
+
+
+# ------------------------------------------------------------------ PyLayer
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_extras"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (reference: python/paddle/autograd/py_layer.py,
+    C++ paddle/fluid/eager/pylayer/).
+
+    Eager: forward runs under no_grad, a tape Node is recorded whose vjp
+    calls ``backward``. Under a jit trace (functional_call), the op is wrapped
+    in ``jax.custom_vjp`` so the custom backward applies inside compiled
+    steps too.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import _subst_map, dispatch  # noqa: F401
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        diff_parents = [a for a in tensor_args if not a.stop_gradient]
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        single = isinstance(outs, Tensor)
+        flat_outs = [outs] if single else [o for o in outs
+                                           if isinstance(o, Tensor)]
+
+        if not tape_enabled() or not diff_parents:
+            return outs
+
+        node = Node(parents=diff_parents, n_outputs=len(flat_outs),
+                    name=cls.__name__)
+        node._out_avals = [(tuple(o.shape), o.dtype) for o in flat_outs]
+        node._treedef = None
+
+        tensor_positions = [i for i, a in enumerate(args)
+                            if isinstance(a, Tensor)]
+        diff_set = {id(a) for a in diff_parents}
+
+        def raw_vjp(cts):
+            ct_tensors = [wrap(c) for c in cts]
+            gs = cls.backward(ctx, *ct_tensors)
+            if isinstance(gs, Tensor) or gs is None:
+                gs = (gs,)
+            out = []
+            gi = 0
+            for pos in tensor_positions:
+                a = args[pos]
+                g = gs[gi] if gi < len(gs) else None
+                gi += 1
+                if id(a) in diff_set:
+                    out.append(unwrap(g) if g is not None
+                               else jnp.zeros_like(unwrap(a)))
+            return tuple(out)
+
+        # adapt: Node.backward calls _raw_vjp(tree_unflatten(treedef, cts));
+        # we bypass the treedef by storing flat cts directly
+        node._raw_vjp = lambda cts_tree: raw_vjp(
+            cts_tree if isinstance(cts_tree, (list, tuple)) else [cts_tree])
+        import jax.tree_util as jtu
+        node._treedef = jtu.tree_structure([0] * len(flat_outs)) \
+            if not single else jtu.tree_structure(0)
+
+        for i, o in enumerate(flat_outs):
+            o.stop_gradient = False
+            o._node = node
+            o._out_index = i
+        return outs
+
+
+# ------------------------------------------------------- functional autograd
+
+
+def _as_fn(func):
+    def fn(*vals):
+        outs = func(*[wrap(v, stop_gradient=True) for v in vals])
+        return jax.tree_util.tree_map(
+            lambda t: unwrap(t) if isinstance(t, Tensor) else t, outs,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return fn
+
+
+def vjp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [unwrap(x) for x in xs_list]
+    with no_grad():
+        out_vals, vjp_fn = jax.vjp(_as_fn(func), *vals)
+    if v is None:
+        cts = jax.tree_util.tree_map(jnp.ones_like, out_vals)
+    else:
+        cts = jax.tree_util.tree_map(
+            lambda t: unwrap(t) if isinstance(t, Tensor) else t, v,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    grads = vjp_fn(cts)
+    wrap_t = lambda tree: jax.tree_util.tree_map(wrap, tree)  # noqa: E731
+    return wrap_t(out_vals), wrap_t(grads if len(vals) > 1 else grads[0])
+
+
+def jvp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [unwrap(x) for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(val) for val in vals]
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [unwrap(t) for t in v_list]
+    with no_grad():
+        out, tan = jax.jvp(_as_fn(func), tuple(vals), tuple(tangents))
+    wrap_t = lambda tree: jax.tree_util.tree_map(wrap, tree)  # noqa: E731
+    return wrap_t(out), wrap_t(tan)
+
+
+def jacobian(func, xs, create_graph=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [unwrap(x) for x in xs_list]
+    with no_grad():
+        jac = jax.jacrev(_as_fn(func), argnums=tuple(range(len(vals))))(*vals)
+    wrapped = jax.tree_util.tree_map(wrap, jac)
+    return wrapped if isinstance(xs, (list, tuple)) else (
+        wrapped[0] if isinstance(wrapped, tuple) else wrapped)
+
+
+def hessian(func, xs, create_graph=False):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    vals = [unwrap(x) for x in xs_list]
+    with no_grad():
+        h = jax.hessian(_as_fn(func), argnums=tuple(range(len(vals))))(*vals)
+    wrapped = jax.tree_util.tree_map(wrap, h)
+    return wrapped if isinstance(xs, (list, tuple)) else (
+        wrapped[0] if isinstance(wrapped, tuple) else wrapped)

@@ -1,0 +1,3 @@
+from . import dtype, random, tape, tensor  # noqa: F401
+from .tape import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,49 @@
+"""Dtype aliases and conversion helpers.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h) with
+jax.numpy dtypes as the single source of truth.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR2DTYPE = {
+    "float16": float16, "fp16": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype-like (str / np dtype / jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _STR2DTYPE:
+            raise ValueError(f"unknown dtype string: {dtype!r}")
+        return _STR2DTYPE[dtype]
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name if dtype != bfloat16 else "bfloat16"
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)

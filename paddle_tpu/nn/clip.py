@@ -1,0 +1,76 @@
+"""Gradient clipping (paddle.nn.ClipGradBy* parity).
+
+Reference: python/paddle/fluid/clip.py. `clip_values` operates on raw arrays
+(used both by Optimizer.step eagerly and inside jitted train steps); the
+hybrid-parallel variant that all-reduces the global norm across mesh axes
+lives in parallel/hybrid_optimizer.py.
+"""
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_by_global_norm_tree"]
+
+
+class ClipGradBase:
+    def clip_values(self, grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads]
+        clipped = self.clip_values(grads)
+        return [(p, g) for (p, _), g in zip(params_grads, clipped)]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min
+
+    def clip_values(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def clip_values(self, grads):
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def global_norm(self, grads):
+        return jnp.sqrt(sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+
+    def clip_values(self, grads, extra_sq_norm=None):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        if extra_sq_norm is not None:
+            sq = sq + extra_sq_norm
+        gn = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]
+
+
+def clip_by_global_norm_tree(grads_tree, clip_norm, extra_sq_norm=None):
+    """Pytree version for jitted train steps. Returns (clipped, global_norm)."""
+    leaves = jax.tree_util.tree_leaves(grads_tree)
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    if extra_sq_norm is not None:
+        sq = sq + extra_sq_norm
+    gn = jnp.sqrt(sq)
+    scale = clip_norm / jnp.maximum(gn, clip_norm)
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads_tree)
+    return clipped, gn

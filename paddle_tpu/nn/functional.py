@@ -1,0 +1,993 @@
+"""nn.functional — neural net functional ops.
+
+Reference: python/paddle/nn/functional/ (activation.py, common.py, conv.py,
+norm.py, loss.py, pooling.py, input.py). Every function is a dispatch-wrapped
+JAX expression: eager calls record the autograd tape, jitted calls trace
+straight through. Convs/matmuls use lax conv_general_dilated / dot so XLA
+tiles them onto the MXU; attention routes to the Pallas flash kernel on TPU
+(ops/pallas/flash_attention.py) with a pure-XLA fallback elsewhere.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.tensor import Tensor, dispatch, unwrap
+from ..ops.registry import register
+
+# ------------------------------------------------------------- activations
+
+
+@register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@register("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@register("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@register("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@register("prelu")
+def prelu(x, weight, data_format="NCHW"):
+    if weight.size > 1:
+        axis = 1 if data_format == "NCHW" else -1
+        shape = [1] * x.ndim
+        shape[axis] = weight.size
+        weight = weight.reshape(shape)
+    return jnp.where(x > 0, x, weight * x)
+
+
+@register("rrelu")
+def rrelu(x, lower=0.125, upper=0.333, training=True):
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@register("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@register("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@register("hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@register("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@register("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(beta * x > threshold, x,
+                     (1.0 / beta) * jnp.log1p(jnp.exp(beta * x)))
+
+
+@register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@register("maxout")
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@register("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register("softmax")
+def softmax(x, axis=-1, dtype=None):
+    out = jax.nn.softmax(x.astype(dtype) if dtype else x, axis=axis)
+    return out
+
+
+@register("log_softmax")
+def log_softmax(x, axis=-1, dtype=None):
+    return jax.nn.log_softmax(x.astype(dtype) if dtype else x, axis=axis)
+
+
+@register("gumbel_softmax")
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(rnd.next_key(), x.shape, jnp.float32, 1e-10, 1.0)))
+    y = jax.nn.softmax((x + g.astype(x.dtype)) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y).at[
+            tuple(jnp.ogrid[tuple(map(slice, y.shape))][i] if i != (axis % y.ndim)
+                  else idx for i in range(y.ndim))].set(1.0)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+# ------------------------------------------------------------- linear/embed
+
+
+@register("linear")
+def linear(x, weight, bias=None):
+    # paddle convention: weight is [in, out]
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register("embedding", nondiff_args=(0,))
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+@register("bilinear")
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ------------------------------------------------------------- dropout
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch(lambda v: v * (1.0 - p), x, name="dropout_infer")
+        return x
+    key = rnd.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else axis
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return dispatch(fn, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    key = rnd.next_key()
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return dispatch(fn, x, name="alpha_dropout")
+
+
+# ------------------------------------------------------------- normalization
+
+
+@register("layer_norm")
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register("rms_norm_ref")
+def _rms_norm_ref(x, weight, epsilon=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    out = x.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, weight, epsilon=1e-6, use_pallas=None):
+    """RMSNorm; routes to the Pallas kernel on TPU (ops/pallas/rms_norm.py)."""
+    from ..ops.pallas import rms_norm as pallas_rms
+    if pallas_rms.available() if use_pallas is None else use_pallas:
+        return dispatch(lambda v, w: pallas_rms.rms_norm(v, w, epsilon),
+                        x, weight, name="rms_norm")
+    return dispatch(lambda v, w: _rms_norm_ref.__wrapped__(v, w, epsilon),
+                    x, weight, name="rms_norm")
+
+
+@register("batch_norm_func")
+def _batch_norm(x, running_mean, running_var, weight, bias, training=False,
+                momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    caxis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != caxis)
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    else:
+        mean, var = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    out = dispatch(_batch_norm.__wrapped__, x, running_mean, running_var,
+                   weight, bias, nondiff_args=(1, 2), training=training,
+                   momentum=momentum, epsilon=epsilon, data_format=data_format,
+                   name="batch_norm")
+    if training and isinstance(running_mean, Tensor):
+        caxis = 1 if data_format.startswith("NC") else -1
+        xv = unwrap(x)
+        axes = tuple(i for i in range(xv.ndim) if i != (caxis % xv.ndim))
+        m = jnp.mean(xv, axis=axes)
+        v = jnp.var(xv, axis=axes)
+        running_mean._replace_value(
+            momentum * unwrap(running_mean) + (1 - momentum) * m)
+        running_var._replace_value(
+            momentum * unwrap(running_var) + (1 - momentum) * v)
+    return out
+
+
+@register("instance_norm")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    caxis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if caxis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        shape = [1] * x.ndim
+        shape[caxis] = x.shape[caxis]
+        out = out * weight.reshape(shape) + (
+            bias.reshape(shape) if bias is not None else 0.0)
+    return out
+
+
+@register("group_norm")
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW"):
+    if data_format == "NHWC":
+        x_t = jnp.moveaxis(x, -1, 1)
+        out = group_norm.__wrapped__(x_t, num_groups, epsilon, weight, bias,
+                                     "NCHW")
+        return jnp.moveaxis(out, 1, -1)
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register("normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True),
+                    1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+@register("local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    caxis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    sq = jnp.moveaxis(sq, caxis, -1)
+    pad = (size - 1) // 2
+    padded = jnp.pad(sq, [(0, 0)] * (sq.ndim - 1) + [(pad, size - 1 - pad)])
+    win = sum(padded[..., i:i + sq.shape[-1]] for i in range(size))
+    win = jnp.moveaxis(win, -1, caxis)
+    return x / jnp.power(k + alpha * win, beta)
+
+
+# ------------------------------------------------------------- convolution
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, dims,
+             data_format):
+    channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if isinstance(stride, int):
+        stride = (stride,) * dims
+    if isinstance(dilation, int):
+        dilation = (dilation,) * dims
+    if isinstance(padding, str):
+        pad = padding.upper()
+    elif isinstance(padding, int):
+        pad = [(padding, padding)] * dims
+    else:
+        padding = list(padding)
+        if len(padding) == dims:
+            pad = [(p, p) if isinstance(p, int) else tuple(p) for p in padding]
+        else:  # [before0, after0, before1, after1, ...]
+            pad = [(padding[2 * i], padding[2 * i + 1]) for i in range(dims)]
+    if channels_last:
+        lhs_spec = "N" + "".join("DHW"[3 - dims:]) + "C"
+    else:
+        lhs_spec = "NC" + "".join("DHW"[3 - dims:])
+    rhs_spec = "OI" + "".join("DHW"[3 - dims:])
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, rhs_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[out.ndim - 1 if channels_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register("conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    data_format)
+
+
+@register("conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format)
+
+
+@register("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format)
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, dims, data_format):
+    channels_last = not data_format.startswith("NC")
+    if isinstance(stride, int):
+        stride = (stride,) * dims
+    if isinstance(dilation, int):
+        dilation = (dilation,) * dims
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * dims
+    elif isinstance(padding, (list, tuple)) and padding and isinstance(padding[0], int) \
+            and len(padding) == dims:
+        padding = [(p, p) for p in padding]
+    if isinstance(output_padding, int):
+        output_padding = (output_padding,) * dims
+    spatial = "DHW"[3 - dims:]
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    rhs_spec = "IO" + spatial  # paddle transpose-conv weight: [in, out/groups, *k]
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        # lax.conv_transpose pads the *output*; translate conv padding p to
+        # transpose padding (k-1)*d - p per edge, plus output_padding at end
+        ksizes = weight.shape[2:]
+        pad = []
+        for i in range(dims):
+            eff = (ksizes[i] - 1) * dilation[i]
+            lo = eff - padding[i][0]
+            hi = eff - padding[i][1] + output_padding[i]
+            pad.append((lo, hi))
+    out = jax.lax.conv_transpose(
+        x, weight, strides=stride, padding=pad, rhs_dilation=dilation,
+        dimension_numbers=dn, transpose_kernel=False)
+    if groups != 1:
+        raise NotImplementedError("grouped conv_transpose lands later")
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[out.ndim - 1 if channels_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+@register("conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, data_format="NCL"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, data_format)
+
+
+@register("conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format)
+
+
+@register("conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format)
+
+
+# ------------------------------------------------------------- pooling
+
+
+def _pool_nd(x, reducer, init, ksize, stride, padding, dims, data_format,
+             ceil_mode=False, count_include_pad=True, avg=False):
+    channels_last = not data_format.startswith("NC")
+    if isinstance(ksize, int):
+        ksize = (ksize,) * dims
+    if stride is None:
+        stride = ksize
+    if isinstance(stride, int):
+        stride = (stride,) * dims
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * dims
+    elif isinstance(padding, (list, tuple)) and padding and \
+            isinstance(padding[0], int):
+        padding = [(p, p) for p in padding]
+    elif isinstance(padding, str):
+        padding = padding.upper()
+    if channels_last:
+        window = (1,) + tuple(ksize) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        pad = ([(0, 0)] + list(padding) + [(0, 0)]) if not isinstance(padding, str) else padding
+    else:
+        window = (1, 1) + tuple(ksize)
+        strides = (1, 1) + tuple(stride)
+        pad = ([(0, 0), (0, 0)] + list(padding)) if not isinstance(padding, str) else padding
+    if avg:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pad)
+        if count_include_pad or isinstance(pad, str):
+            denom = math.prod(ksize)
+            return summed / denom
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                       pad)
+        return summed / counts
+    return jax.lax.reduce_window(x, init, reducer, window, strides, pad)
+
+
+@register("max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCL"):
+    return _pool_nd(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 1,
+                    data_format)
+
+
+@register("max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW", return_mask=False):
+    return _pool_nd(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 2,
+                    data_format)
+
+
+@register("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    return _pool_nd(x, jax.lax.max, -jnp.inf, kernel_size, stride, padding, 3,
+                    data_format)
+
+
+@register("avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    return _pool_nd(x, jax.lax.add, 0.0, kernel_size, stride, padding, 1,
+                    data_format, avg=True, count_include_pad=not exclusive)
+
+
+@register("avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCHW"):
+    return _pool_nd(x, jax.lax.add, 0.0, kernel_size, stride, padding, 2,
+                    data_format, avg=True, count_include_pad=not exclusive)
+
+
+@register("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool_nd(x, jax.lax.add, 0.0, kernel_size, stride, padding, 3,
+                    data_format, avg=True, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, dims, data_format, avg):
+    channels_last = not data_format.startswith("NC")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * dims
+    spatial_start = 1 if channels_last else 2
+    out = x
+    for d in range(dims):
+        in_size = out.shape[spatial_start + d]
+        o = output_size[d]
+        if o is None or o == in_size:
+            continue
+        assert in_size % o == 0, "adaptive pool needs divisible sizes on TPU"
+        k = in_size // o
+        shape = list(out.shape)
+        shape[spatial_start + d:spatial_start + d + 1] = [o, k]
+        r = out.reshape(shape)
+        out = jnp.mean(r, axis=spatial_start + d + 1) if avg else \
+            jnp.max(r, axis=spatial_start + d + 1)
+    return out
+
+
+@register("adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return _adaptive_pool(x, output_size, 1, data_format, avg=True)
+
+
+@register("adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, avg=True)
+
+
+@register("adaptive_avg_pool3d")
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, avg=True)
+
+
+@register("adaptive_max_pool1d")
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 1, "NCL", avg=False)
+
+
+@register("adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 2, "NCHW", avg=False)
+
+
+# ------------------------------------------------------------- losses
+
+
+@register("mse_loss")
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    loss = jnp.square(input - label)
+    return _reduce(loss, reduction)
+
+
+@register("l1_loss")
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@register("smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register("cross_entropy_with_softmax", nondiff_args=(1,))
+def _ce_hard(logits, label, ignore_index=-100, reduction="mean",
+             label_smoothing=0.0, axis=-1):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if axis != -1 and axis != logits.ndim - 1:
+        logp = jnp.moveaxis(logp, axis, -1)
+        label_m = label
+    else:
+        label_m = label
+    nclass = logp.shape[-1]
+    onehot = jax.nn.one_hot(label_m, nclass, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        onehot = onehot * (1 - label_smoothing) + label_smoothing / nclass
+    nll = -jnp.sum(onehot * logp, axis=-1)
+    mask = (label_m != ignore_index).astype(nll.dtype)
+    nll = nll * mask
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+@register("cross_entropy_soft")
+def _ce_soft(logits, label, reduction="mean", axis=-1):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    nll = -jnp.sum(label * logp, axis=axis)
+    return _reduce(nll, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    from ..ops.registry import OPS
+    if soft_label:
+        return OPS["cross_entropy_soft"](input, label, reduction=reduction,
+                                         axis=axis)
+    lbl = label
+    if isinstance(label, Tensor) and unwrap(label).ndim == input.ndim and \
+            unwrap(label).shape[-1] == 1:
+        lbl = label.squeeze(-1)
+    return OPS["cross_entropy_with_softmax"](
+        input, lbl, ignore_index=ignore_index, reduction=reduction,
+        label_smoothing=label_smoothing, axis=axis)
+
+
+@register("nll_loss", nondiff_args=(1,))
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    nll = -jnp.take_along_axis(input, label[..., None], axis=-1)[..., 0]
+    mask = (label != ignore_index).astype(nll.dtype)
+    nll = nll * mask
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(nll, reduction)
+
+
+@register("binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    loss = -(label * jnp.log(input + eps) + (1 - label) * jnp.log(1 - input + eps))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@register("binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val + \
+            jnp.log(jnp.exp(-max_val) + jnp.exp(-logit - max_val))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@register("kl_div")
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    return _reduce(loss, reduction)
+
+
+@register("cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+@register("cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean"):
+    cos = cosine_similarity.__wrapped__(input1, input2, axis=-1)
+    loss = jnp.where(label == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+    return _reduce(loss, reduction)
+
+
+@register("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.clip(-label * (input - other) + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+@register("hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    loss = jnp.where(label == 1, input, jnp.clip(margin - input, 0, None))
+    return _reduce(loss, reduction)
+
+
+@register("triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,  # noqa: A002
+                        eps=1e-6, swap=False, reduction="mean"):
+    def pdist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + eps, p), axis=-1),
+                         1.0 / p)
+    d_pos = pdist(input, positive)
+    d_neg = pdist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, pdist(positive, negative))
+    return _reduce(jnp.clip(d_pos - d_neg + margin, 0, None), reduction)
+
+
+@register("square_error_cost")
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@register("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits.__wrapped__(logit, label,
+                                                      reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+# ------------------------------------------------------------- attention
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+
+    Inputs [batch, seq, heads, head_dim] (paddle layout). Routes to the
+    Pallas flash-attention kernel on TPU; XLA composition elsewhere.
+    """
+    from ..ops.pallas import flash_attention as fa
+    kwargs = dict(causal=is_causal)
+    if fa.available() and attn_mask is None and dropout_p == 0.0:
+        return dispatch(lambda q, k, v: fa.flash_attention(q, k, v, **kwargs),
+                        query, key, value, name="flash_attention")
+
+    def ref(q, k, v, m=None):
+        # [B,S,H,D] -> [B,H,S,D]
+        q_, k_, v_ = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+        scale = 1.0 / math.sqrt(q_.shape[-1])
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * scale
+        if is_causal:
+            qs, ks = s.shape[-2], s.shape[-1]
+            causal = jnp.tril(jnp.ones((qs, ks), dtype=bool))
+            s = jnp.where(causal, s, -jnp.inf)
+        if m is not None:
+            s = s + m
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q_.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v_)
+        return jnp.swapaxes(o, 1, 2)
+
+    if attn_mask is not None:
+        out = dispatch(ref, query, key, value, attn_mask,
+                       name="sdp_attention")
+    else:
+        out = dispatch(ref, query, key, value, name="sdp_attention")
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True):
+    """paddle.nn.functional.flash_attention parity (flash_attention.py:20)."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+# ------------------------------------------------------------- misc
+
+
+@register("unfold")
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    # im2col: x [N,C,H,W] -> [N, C*kh*kw, L]
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = [kernel_sizes] * 2
+    if isinstance(strides, int):
+        strides = [strides] * 2
+    if isinstance(paddings, int):
+        paddings = [paddings] * 2
+    if isinstance(dilations, int):
+        dilations = [dilations] * 2
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    xp = jnp.pad(x, [(0, 0), (0, 0), (paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])])
+    oh = (xp.shape[2] - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (xp.shape[3] - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            si, sj = i * dilations[0], j * dilations[1]
+            patches.append(
+                xp[:, :, si:si + oh * strides[0]:strides[0],
+                   sj:sj + ow * strides[1]:strides[1]])
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        out = x.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    out = x.reshape(n, h, w, r, r, c // (r * r))
+    out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+    return out.reshape(n, h * r, w * r, c // (r * r))
+
+
+@register("interpolate_nearest")
+def _interp_nearest(x, scale=2, data_format="NCHW"):
+    if data_format == "NCHW":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return jnp.repeat(jnp.repeat(x, scale, axis=1), scale, axis=2)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    v = unwrap(x) if isinstance(x, Tensor) else x
+    spatial = v.shape[2:] if data_format.startswith("NC") else v.shape[1:-1]
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+            [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "bicubic": "cubic", "trilinear": "linear"}[mode]
+
+    def fn(v):
+        if data_format.startswith("NC"):
+            out_shape = v.shape[:2] + tuple(size)
+        else:
+            out_shape = (v.shape[0],) + tuple(size) + (v.shape[-1],)
+        return jax.image.resize(v, out_shape, method=method)
+
+    return dispatch(fn, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+@register("affine_grid")
+def affine_grid(theta, out_shape, align_corners=True):
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1, 1, h) if align_corners else \
+        jnp.linspace(-1 + 1 / h, 1 - 1 / h, h)
+    xs = jnp.linspace(-1, 1, w) if align_corners else \
+        jnp.linspace(-1 + 1 / w, 1 - 1 / w, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    grid = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H,W,3]
+    return jnp.einsum("nij,hwj->nhwi", theta, grid)
+
+
+@register("label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+@register("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])],
+                           axis=1)
+    right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                             v[:, :-1, fold:2 * fold]], axis=1)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    v = unwrap(lengths) if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    m = maxlen if maxlen is not None else int(v.max())
+
+    def fn(lv):
+        return (jnp.arange(m)[None, :] < lv[..., None]).astype(dtype)
+
+    return dispatch(fn, lengths, nondiff_args=(0,), name="sequence_mask")

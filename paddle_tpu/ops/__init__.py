@@ -1,0 +1,58 @@
+"""The op namespace: paddle-parity tensor ops re-exported flat.
+
+`import paddle_tpu as pt; pt.ops.matmul(...)` — and everything is also
+re-exported at the package top level (pt.matmul) plus as Tensor methods,
+matching the reference's `paddle.*` / `Tensor.*` dual surface
+(python/paddle/tensor/__init__.py tensor_method_func list).
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from . import creation, linalg, manipulation, math, random_ops  # noqa: F401
+from .registry import OPS, install_tensor_methods
+
+install_tensor_methods()
+
+# -- operator dunders ---------------------------------------------------------
+_b = OPS
+
+Tensor.__add__ = lambda s, o: _b["add"](s, o)
+Tensor.__radd__ = lambda s, o: _b["add"](s, o)
+Tensor.__sub__ = lambda s, o: _b["subtract"](s, o)
+Tensor.__rsub__ = lambda s, o: _b["subtract"](o, s)
+Tensor.__mul__ = lambda s, o: _b["multiply"](s, o)
+Tensor.__rmul__ = lambda s, o: _b["multiply"](s, o)
+Tensor.__truediv__ = lambda s, o: _b["divide"](s, o)
+Tensor.__rtruediv__ = lambda s, o: _b["divide"](o, s)
+Tensor.__floordiv__ = lambda s, o: _b["floor_divide"](s, o)
+Tensor.__mod__ = lambda s, o: _b["mod"](s, o)
+Tensor.__pow__ = lambda s, o: _b["pow"](s, o)
+Tensor.__rpow__ = lambda s, o: _b["pow"](o, s)
+Tensor.__neg__ = lambda s: _b["neg"](s)
+Tensor.__abs__ = lambda s: _b["abs"](s)
+Tensor.__matmul__ = lambda s, o: _b["matmul"](s, o)
+Tensor.__rmatmul__ = lambda s, o: _b["matmul"](o, s)
+Tensor.__eq__ = lambda s, o: _b["equal"](s, o)
+Tensor.__ne__ = lambda s, o: _b["not_equal"](s, o)
+Tensor.__lt__ = lambda s, o: _b["less_than"](s, o)
+Tensor.__le__ = lambda s, o: _b["less_equal"](s, o)
+Tensor.__gt__ = lambda s, o: _b["greater_than"](s, o)
+Tensor.__ge__ = lambda s, o: _b["greater_equal"](s, o)
+Tensor.__and__ = lambda s, o: _b["logical_and"](s, o)
+Tensor.__or__ = lambda s, o: _b["logical_or"](s, o)
+Tensor.__xor__ = lambda s, o: _b["logical_xor"](s, o)
+Tensor.__invert__ = lambda s: _b["logical_not"](s)
+
+Tensor.T = property(lambda s: _b["t"](s))
+Tensor.mT = property(lambda s: dispatch(lambda v: jnp.swapaxes(v, -1, -2), s))
+
+
+def __getattr__(name):
+    try:
+        return OPS[name]
+    except KeyError:
+        raise AttributeError(f"module 'paddle_tpu.ops' has no op {name!r}") from None
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(OPS)))

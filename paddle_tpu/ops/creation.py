@@ -1,0 +1,100 @@
+"""Tensor creation ops (paddle.zeros/ones/arange/... parity).
+
+Reference: python/paddle/tensor/creation.py.
+"""
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, unwrap, wrap
+from .registry import register_direct
+
+
+def _mk(value):
+    return wrap(value)
+
+
+def zeros(shape, dtype="float32"):
+    return _mk(jnp.zeros(shape, dtype=convert_dtype(dtype)))
+
+
+def ones(shape, dtype="float32"):
+    return _mk(jnp.ones(shape, dtype=convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32"):
+    if isinstance(fill_value, Tensor):
+        fill_value = unwrap(fill_value)
+    return _mk(jnp.full(shape, fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32"):
+    return _mk(jnp.zeros(shape, dtype=convert_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    return _mk(jnp.zeros_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return _mk(jnp.ones_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return _mk(jnp.full_like(unwrap(x), fill_value, dtype=convert_dtype(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return _mk(jnp.zeros_like(unwrap(x), dtype=convert_dtype(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start = unwrap(start) if isinstance(start, Tensor) else start
+    end = unwrap(end) if isinstance(end, Tensor) else end
+    return _mk(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return _mk(jnp.linspace(unwrap(start) if isinstance(start, Tensor) else start,
+                            unwrap(stop) if isinstance(stop, Tensor) else stop,
+                            num, dtype=convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return _mk(jnp.logspace(start, stop, num, base=base, dtype=convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return _mk(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype)))
+
+
+def tril_indices(row, col, offset=0):
+    return _mk(jnp.stack(jnp.tril_indices(row, offset, col)))
+
+
+def triu_indices(row, col=None, offset=0):
+    return _mk(jnp.stack(jnp.triu_indices(row, offset, col if col else row)))
+
+
+def clone(x):
+    from ..core.tensor import dispatch
+    return dispatch(lambda v: v + 0, x, name="clone")
+
+
+def assign(x, output=None):
+    v = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._replace_value(jnp.asarray(v))
+        return output
+    return _mk(jnp.asarray(v))
+
+
+def complex(real, imag):  # noqa: A001
+    from ..core.tensor import dispatch
+    import jax.lax as lax
+    return dispatch(lax.complex, real, imag, name="complex")
+
+
+for _n in ["zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+           "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+           "tril_indices", "triu_indices", "clone", "assign", "complex"]:
+    register_direct(_n, globals()[_n])

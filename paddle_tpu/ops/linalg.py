@@ -1,0 +1,248 @@
+"""Linear algebra ops (paddle.matmul/linalg.* parity).
+
+Reference: python/paddle/tensor/linalg.py; kernels paddle/phi/kernels/
+matmul_kernel.h etc. On TPU every matmul here lands on the MXU — keep
+inputs bf16-friendly and batched.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+@register("matmul", method=True)
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register("mm", method=True)
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register("bmm", method=True)
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register("dot", method=True)
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register("inner", method=True)
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@register("outer", method=True)
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register("cross", method=True)
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+@register("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register("mv", method=True)
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@register("t", method=True)
+def t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+@register("trace", method=True)
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("norm", method=True)
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = "fro" if axis is None or not isinstance(axis, int) else 2
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p
+    )
+
+
+@register("dist")
+def dist(x, y, p=2):
+    return norm.__wrapped__(x - y, p=p)
+
+
+@register("matrix_norm")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@register("vector_norm")
+def vector_norm(x, p=2, axis=None, keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@register("cond")
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@register("det", method=True)
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register("slogdet")
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@register("inverse", method=True)
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register("matrix_power", method=True)
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register("matrix_rank")
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register("qr")
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@register("svd")
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@register("eig")
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+@register("eigh")
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@register("eigvals")
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@register("eigvalsh")
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register("cholesky", method=True)
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@register("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@register("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@register("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register("lstsq")
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register("lu")
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv
+
+
+@register("multi_dot")
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@register("householder_product")
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+    q = eye
+    for i in range(n):
+        v = jnp.concatenate([jnp.zeros((i,), x.dtype), jnp.ones((1,), x.dtype),
+                             x[i + 1:, i]])
+        h = eye - tau[i] * jnp.outer(v, v)
+        q = q @ h
+    return q[:, :n]
+
+
+@register("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register("einsum_impl")
+def _einsum_vals(*operands, equation=None):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    from ..core.tensor import dispatch
+    return dispatch(lambda *vs: jnp.einsum(equation, *vs), *operands, name="einsum")
+
+
+from .registry import register_direct  # noqa: E402
+
+register_direct("einsum", einsum)

@@ -1,0 +1,568 @@
+"""Shape/layout manipulation + indexing + search ops.
+
+Parity source: python/paddle/tensor/manipulation.py, search.py in the
+reference. Static shapes everywhere — dynamic-shape ops (nonzero,
+masked_select, unique) are eager-only by construction, mirroring how XLA
+forbids them inside jit.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap, wrap
+from .registry import register, register_direct
+
+# ----------------------------------------------------------------- reshaping
+
+
+@register("reshape", method=True)
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+@register("flatten", method=True)
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = list(x.shape)
+    new_shape = shape[:start] + [int(np.prod(shape[start:stop + 1]))] + shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@register("squeeze", method=True)
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@register("unsqueeze", method=True)
+def unsqueeze(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register("transpose", method=True)
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=perm)
+
+
+@register("moveaxis", method=True)
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register("swapaxes", method=True)
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@register("broadcast_to", method=True)
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+@register("expand", method=True)
+def expand(x, shape):
+    shape = [s if s != -1 else x.shape[i - (len(shape) - x.ndim)]
+             for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+@register("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register("tile", method=True)
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+@register("repeat_interleave", method=True)
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("flip", method=True)
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@register("roll", method=True)
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register("as_complex")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+# ------------------------------------------------------------- join / split
+
+
+def concat(x, axis=0):
+    """paddle.concat(list_of_tensors, axis)."""
+    return dispatch(lambda *vs: jnp.concatenate(vs, axis=axis), *x, name="concat")
+
+
+register_direct("concat", concat)
+
+
+def stack(x, axis=0):
+    return dispatch(lambda *vs: jnp.stack(vs, axis=axis), *x, name="stack")
+
+
+register_direct("stack", stack)
+
+
+def vstack(x):
+    return dispatch(lambda *vs: jnp.vstack(vs), *x, name="vstack")
+
+
+register_direct("vstack", vstack)
+
+
+def hstack(x):
+    return dispatch(lambda *vs: jnp.hstack(vs), *x, name="hstack")
+
+
+register_direct("hstack", hstack)
+
+
+@register("split", method=True)
+def split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sizes = list(num_or_sections)
+    dim = x.shape[axis]
+    if any(s == -1 for s in sizes):
+        known = sum(s for s in sizes if s != -1)
+        sizes = [dim - known if s == -1 else s for s in sizes]
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@register("chunk", method=True)
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.split(x, chunks, axis=axis))
+
+
+@register("unbind", method=True)
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+@register("unstack")
+def unstack(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+# --------------------------------------------------------------- slicing
+
+
+@register("slice", nondiff_args=())
+def slice(x, axes, starts, ends):  # noqa: A001
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = jnp.s_[st:en]
+    return x[tuple(idx)]
+
+
+@register("strided_slice")
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = jnp.s_[st:en:sd]
+    return x[tuple(idx)]
+
+
+@register("crop")
+def crop(x, shape, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(jnp.s_[o:o + s] for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def _getitem(x, index):
+    if isinstance(index, Tensor):
+        return dispatch(lambda v, i: v[i], x, index, nondiff_args=(1,), name="getitem")
+    if isinstance(index, tuple):
+        has_tensor = any(isinstance(i, Tensor) for i in index)
+        if has_tensor:
+            tpos = [i for i, e in enumerate(index) if isinstance(e, Tensor)]
+            tens = [index[i] for i in tpos]
+
+            def fn(v, *idxs):
+                full = list(index)
+                for p, i in zip(tpos, idxs):
+                    full[p] = i
+                return v[tuple(full)]
+
+            return dispatch(fn, x, *tens,
+                            nondiff_args=tuple(range(1, len(tens) + 1)),
+                            name="getitem")
+    return dispatch(lambda v: v[index], x, name="getitem")
+
+
+def _setitem(self, index, value):
+    # Eager-only mutation (reference: __setitem__ via set_value op).
+    idx = unwrap(index) if isinstance(index, Tensor) else index
+    if isinstance(idx, tuple):
+        idx = tuple(unwrap(i) if isinstance(i, Tensor) else i for i in idx)
+    val = unwrap(value) if isinstance(value, Tensor) else value
+    self._replace_value(self._value.at[idx].set(val))
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+
+# --------------------------------------------------------------- gather etc
+
+
+@register("gather", method=True, nondiff_args=(1,))
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register("gather_nd", method=True, nondiff_args=(1,))
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register("take_along_axis", nondiff_args=(1,))
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@register("put_along_axis", nondiff_args=(1,))
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis, inplace=False)
+    if reduce == "add":
+        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(arr.ndim)])
+               for d, s in enumerate(indices.shape)]
+        idx[axis] = indices
+        return arr.at[tuple(idx)].add(values)
+    raise NotImplementedError(reduce)
+
+
+@register("scatter", nondiff_args=(1,))
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@register("scatter_nd_add", nondiff_args=(1,))
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register("index_select", method=True, nondiff_args=(1,))
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@register("index_add", nondiff_args=(1,))
+def index_add(x, index, axis, value):
+    idx = [jnp.s_[:]] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@register("index_put", nondiff_args=(1,))
+def index_put(x, indices, value, accumulate=False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+@register("where")
+def where(condition, x=None, y=None):
+    return jnp.where(condition, x, y)
+
+
+@register("select_scatter")
+def select_scatter(x, values, axis, index):
+    idx = [jnp.s_[:]] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@register("masked_fill", method=True)
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@register("diagonal", method=True)
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("diag")
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + builtins_abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return base + jnp.diag(x - padding_value, k=offset) \
+            if False else jnp.where(jnp.eye(n, k=offset, dtype=bool), jnp.diag(x, k=offset), base)
+    return jnp.diag(x, k=offset)
+
+
+builtins_abs = abs
+
+
+@register("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    out = jax.vmap(jnp.diag, in_axes=0)(x.reshape(-1, x.shape[-1])) if x.ndim > 1 \
+        else jnp.diag(x, k=offset)
+    if x.ndim > 1:
+        out = out.reshape(x.shape[:-1] + out.shape[-2:])
+    return out
+
+
+@register("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@register("tril", method=True)
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register("triu", method=True)
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register("pad")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):  # noqa: A002
+    if len(pad) == 2 * x.ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pad applies to last len(pad)//2 dims, reversed order
+        n = len(pad) // 2
+        width = [(0, 0)] * (x.ndim - n) + [
+            (pad[2 * i], pad[2 * i + 1]) for i in range(n)
+        ]
+    if mode == "constant":
+        return jnp.pad(x, width, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, width, mode=jmode)
+
+
+# --------------------------------------------------------------- search/sort
+
+
+@register("argmax", method=True)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype) if dtype else out
+
+
+@register("argmin", method=True)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype) if dtype else out
+
+
+@register("argsort", method=True)
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+@register("sort", method=True)
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+@register("topk", method=True)
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = jax.lax.top_k(xm if largest else -xm, k)
+        if not largest:
+            v = -v
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+    v, i = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        v = -v
+    return v, i
+
+
+@register("kthvalue", method=True)
+def kthvalue(x, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    vk = jnp.take(v, k - 1, axis=axis)
+    ik = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        vk, ik = jnp.expand_dims(vk, axis), jnp.expand_dims(ik, axis)
+    return vk, ik
+
+
+@register("mode", method=True)
+def mode(x, axis=-1, keepdim=False):
+    srt = jnp.sort(x, axis=axis)
+    # most frequent value via run-length on sorted values
+    eq = jnp.concatenate(
+        [jnp.ones_like(jnp.take(srt, jnp.array([0]), axis=axis), dtype=jnp.int32),
+         (jnp.diff(srt, axis=axis) != 0).astype(jnp.int32)], axis=axis)
+    run_id = jnp.cumsum(eq, axis=axis)
+    # count occurrences of each run id positionally
+    counts = jax.vmap(lambda r: jnp.sum(r[:, None] == r[None, :], axis=1),
+                      in_axes=0)(run_id.reshape(-1, run_id.shape[-1]))
+    counts = counts.reshape(run_id.shape)
+    best = jnp.argmax(counts, axis=axis, keepdims=True)
+    vals = jnp.take_along_axis(srt, best, axis=axis)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis=axis)
+    idx = jnp.argmax((x == (vals if keepdim else jnp.expand_dims(vals, axis))),
+                     axis=axis, keepdims=keepdim)
+    return vals, idx
+
+
+@register("searchsorted")
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32) if out_int32 else out.astype(jnp.int64)
+
+
+@register("bucketize")
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32) if out_int32 else out.astype(jnp.int64)
+
+
+@register("bincount")
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@register("histogram")
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=rng)
+    return h
+
+
+# ------------------------------------------------- dynamic-shape (eager only)
+
+
+def nonzero(x, as_tuple=False):
+    xv = unwrap(x) if isinstance(x, Tensor) else x
+    idx = np.nonzero(np.asarray(xv))
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i)) for i in idx)
+    return wrap(jnp.asarray(np.stack(idx, axis=-1)))
+
+
+register_direct("nonzero", nonzero, method=True)
+
+
+def masked_select(x, mask):
+    xv = np.asarray(unwrap(x))
+    mv = np.asarray(unwrap(mask) if isinstance(mask, Tensor) else mask)
+    return wrap(jnp.asarray(xv[mv]))
+
+
+register_direct("masked_select", masked_select, method=True)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    xv = np.asarray(unwrap(x))
+    res = np.unique(xv, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(wrap(jnp.asarray(r)) for r in res)
+    return wrap(jnp.asarray(res))
+
+
+register_direct("unique", unique, method=True)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    xv = np.asarray(unwrap(x))
+    vals = []
+    prev = object()
+    for v in xv.reshape(-1) if axis is None else xv:
+        if not np.array_equal(v, prev):
+            vals.append(v)
+        prev = v
+    return wrap(jnp.asarray(np.array(vals)))
+
+
+register_direct("unique_consecutive", unique_consecutive)
+
+
+# --------------------------------------------------------------- dtype/cast
+
+
+@register("cast", method=True)
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype))
+
+
+def astype(x, dtype):
+    return cast(x, dtype)
+
+
+register_direct("astype", astype, method=True)
+
+
+@register("numel", method=True)
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int64)
+
+
+@register("one_hot")
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+@register("meshgrid")
+def meshgrid(*args):
+    return tuple(jnp.meshgrid(*args, indexing="ij"))
+
+
+@register("atleast_1d")
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@register("atleast_2d")
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@register("atleast_3d")
+def atleast_3d(x):
+    return jnp.atleast_3d(x)

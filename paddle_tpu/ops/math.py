@@ -1,0 +1,580 @@
+"""Elementwise math, reductions, comparison and logic ops.
+
+Parity source: python/paddle/tensor/math.py + logic.py in the reference
+(thin wrappers over generated _C_ops); here each op is the jnp expression
+XLA fuses directly.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# ---------------------------------------------------------------- elementwise
+
+
+@register("add", method=True)
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@register("subtract", method=True)
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register("multiply", method=True)
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register("divide", method=True)
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@register("floor_divide", method=True)
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@register("mod", method=True)
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+@register("remainder", method=True)
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+@register("pow", method=True)
+def pow(x, y):  # noqa: A001
+    return jnp.power(x, y)
+
+
+@register("maximum", method=True)
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register("minimum", method=True)
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@register("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@register("neg", method=True)
+def neg(x):
+    return jnp.negative(x)
+
+
+@register("abs", method=True)
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+@register("sign", method=True)
+def sign(x):
+    return jnp.sign(x)
+
+
+@register("exp", method=True)
+def exp(x):
+    return jnp.exp(x)
+
+
+@register("expm1", method=True)
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@register("log", method=True)
+def log(x):
+    return jnp.log(x)
+
+
+@register("log2", method=True)
+def log2(x):
+    return jnp.log2(x)
+
+
+@register("log10", method=True)
+def log10(x):
+    return jnp.log10(x)
+
+
+@register("log1p", method=True)
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@register("sqrt", method=True)
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register("rsqrt", method=True)
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register("square", method=True)
+def square(x):
+    return jnp.square(x)
+
+
+@register("reciprocal", method=True)
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register("sin", method=True)
+def sin(x):
+    return jnp.sin(x)
+
+
+@register("cos", method=True)
+def cos(x):
+    return jnp.cos(x)
+
+
+@register("tan", method=True)
+def tan(x):
+    return jnp.tan(x)
+
+
+@register("asin", method=True)
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@register("acos", method=True)
+def acos(x):
+    return jnp.arccos(x)
+
+
+@register("atan", method=True)
+def atan(x):
+    return jnp.arctan(x)
+
+
+@register("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@register("sinh", method=True)
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@register("cosh", method=True)
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@register("tanh", method=True)
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@register("asinh", method=True)
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@register("acosh", method=True)
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@register("atanh", method=True)
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@register("erf", method=True)
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@register("erfinv", method=True)
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register("logsigmoid")
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register("floor", method=True)
+def floor(x):
+    return jnp.floor(x)
+
+
+@register("ceil", method=True)
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@register("round", method=True)
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+@register("trunc", method=True)
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@register("frac", method=True)
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@register("clip", method=True)
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@register("scale", method=True)
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@register("lerp", method=True)
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register("multiply_add")
+def multiply_add(x, y, z):
+    return x * y + z
+
+
+@register("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@register("logaddexp")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@register("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@register("nan_to_num", method=True)
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@register("conj", method=True)
+def conj(x):
+    return jnp.conj(x)
+
+
+@register("real", method=True)
+def real(x):
+    return jnp.real(x)
+
+
+@register("imag", method=True)
+def imag(x):
+    return jnp.imag(x)
+
+
+@register("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@register("lgamma")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@register("polygamma")
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@register("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@register("gcd")
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@register("lcm")
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+# ---------------------------------------------------------------- reductions
+
+
+@register("sum", method=True)
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+@register("mean", method=True)
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+@register("max", method=True)
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+@register("min", method=True)
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+@register("prod", method=True)
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+@register("std", method=True)
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register("var", method=True)
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register("median", method=True)
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+@register("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+@register("nansum")
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+@register("logsumexp", method=True)
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@register("all", method=True)
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+@register("any", method=True)
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+@register("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+@register("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+@register("count_nonzero")
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+# ---------------------------------------------------------------- cumulative
+
+
+@register("cumsum", method=True)
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@register("cumprod", method=True)
+def cumprod(x, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+@register("cummax")
+def cummax(x, axis=None):
+    xs = x.reshape(-1) if axis is None else x
+    ax = 0 if axis is None else axis
+    return jax.lax.cummax(xs, axis=ax)
+
+
+@register("cummin")
+def cummin(x, axis=None):
+    xs = x.reshape(-1) if axis is None else x
+    ax = 0 if axis is None else axis
+    return jax.lax.cummin(xs, axis=ax)
+
+
+@register("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    xs = x.reshape(-1) if axis is None else x
+    ax = 0 if axis is None else axis
+    return jax.lax.cumlogsumexp(xs, axis=ax)
+
+
+# ---------------------------------------------------------------- comparison
+
+
+@register("equal", method=True)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@register("not_equal", method=True)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@register("greater_than", method=True)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@register("greater_equal", method=True)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@register("less_than", method=True)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@register("less_equal", method=True)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@register("equal_all")
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+@register("allclose", method=True)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("isclose", method=True)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register("isnan", method=True)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@register("isinf", method=True)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@register("isfinite", method=True)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@register("logical_and", method=True)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@register("logical_or", method=True)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@register("logical_not", method=True)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@register("logical_xor", method=True)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@register("bitwise_and", method=True)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@register("bitwise_or", method=True)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@register("bitwise_xor", method=True)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@register("bitwise_not", method=True)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@register("bitwise_left_shift")
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+@register("bitwise_right_shift")
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)

@@ -1,0 +1,194 @@
+"""Pallas flash attention (TPU).
+
+Reference equivalent: paddle/phi/kernels/gpu/flash_attn_kernel.cu (dynloaded
+libflashattn; python surface python/paddle/nn/functional/flash_attention.py:20).
+TPU-native design: blockwise online-softmax forward entirely in VMEM with a
+(B·H, Q-blocks, KV-blocks) grid — the KV axis is the innermost ("arbitrary")
+grid dimension accumulating into VMEM scratch, so each Q block streams K/V
+tiles through VMEM exactly once. Layout is paddle's [batch, seq, heads, dim];
+internally [B,H,S,D].
+
+Backward currently differentiates a blockwise XLA recompute (O(S·block)
+memory via lax.scan) — the dedicated Pallas backward kernel is the M4 perf
+item. Forward returns the logsumexp needed for that backward.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import on_tpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def available() -> bool:
+    return on_tpu()
+
+
+# --------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q, block_k,
+                num_kv_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)          # [block_k, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s * sm_scale                           # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:]                          # [block_q, 128]
+        l_prev = l_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev[:, :1] - m_new[:, :1])   # [block_q,1]
+        p = jnp.exp(s - m_new[:, :1])              # [block_q, block_k]
+        l_new = corr * l_prev[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # skip fully-masked KV blocks above the diagonal
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        m_fin = m_scr[:]
+        l_fin = l_scr[:]
+        l = jnp.where(l_fin[:, :1] == 0.0, 1.0, l_fin[:, :1])
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_fin + jnp.log(jnp.maximum(l_fin, 1e-30))
+                      ).astype(lse_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, sm_scale, causal,
+                      block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                      interpret=False):
+    """q,k,v: [BH, S, D] (batch*heads flattened). Returns (o, lse[BH,S,128])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    nq = sq // block_q
+    nk = sk // block_k
+    grid = (bh, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_kv_blocks=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+# ----------------------------------------------------- XLA reference path
+
+
+def _ref_attention(q, k, v, sm_scale, causal):
+    """[B,H,S,D] reference; used for CPU tests and as backward recompute."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# --------------------------------------------------------------- public api
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, sm_scale, causal):
+    # q,k,v: [B,H,S,D]
+    if available():
+        b, h, s, d = q.shape
+        o, _ = _flash_fwd_pallas(q.reshape(b * h, s, d),
+                                 k.reshape(b * h, k.shape[2], d),
+                                 v.reshape(b * h, v.shape[2], d),
+                                 sm_scale, causal)
+        return o.reshape(b, h, s, d)
+    return _ref_attention(q, k, v, sm_scale, causal)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal):
+    return _flash(q, k, v, sm_scale, causal), (q, k, v)
+
+
+def _flash_bwd(sm_scale, causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _ref_attention(q_, k_, v_, sm_scale,
+                                                       causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """q,k,v: paddle layout [batch, seq, num_heads, head_dim]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    o = _flash(qt, kt, vt, sm_scale, causal)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None):
+    """Same kernel, [batch, heads, seq, dim] layout (no transposes)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, sm_scale, causal)

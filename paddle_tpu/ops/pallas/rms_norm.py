@@ -1,0 +1,87 @@
+"""Pallas RMSNorm kernel (+ custom VJP).
+
+Reference equivalent: rms_norm CUDA kernel named in the north star; in the
+reference snapshot RMSNorm is Python-composed (SURVEY §2.4). Here: one fused
+VMEM pass per row-block — x is read once, normalized on the VPU, scaled by
+the (broadcast) weight; backward recomputes the rstd instead of storing
+activations (bandwidth-bound op, recompute is free).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import on_tpu
+
+
+def available() -> bool:
+    return on_tpu()
+
+
+def _ref_fwd(x, w, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    return (xf * rstd * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _pallas_fwd(x, w, eps, block_rows=256):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    orig_shape = x.shape
+    d = x.shape[-1]
+    rows = x.size // d
+    x2 = x.reshape(rows, d)
+    br = min(block_rows, rows)
+    while rows % br:
+        br //= 2
+    br = max(br, 1)
+    out = pl.pallas_call(
+        functools.partial(_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((d,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+    )(x2, w)
+    return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, w, eps=1e-6):
+    if available():
+        return _pallas_fwd(x, w, eps)
+    return _ref_fwd(x, w, eps)
+
+
+def _fwd(x, w, eps):
+    return rms_norm(x, w, eps), (x, w)
+
+
+def _bwd(eps, res, g):
+    x, w = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xf * rstd
+    gw = gf * wf
+    d = x.shape[-1]
+    dx = rstd * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum((gf * xhat).reshape(-1, d), axis=0)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+rms_norm.defvjp(_fwd, _bwd)

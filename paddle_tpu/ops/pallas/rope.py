@@ -1,0 +1,48 @@
+"""Rotary position embedding.
+
+Reference: composed in Python in the snapshot (SURVEY §2.4 — the dedicated
+`fused_rotary_position_embedding` CUDA kernel landed later upstream). On TPU
+the rotate+mul fuses into neighbouring matmuls under XLA, so the jnp
+composition below *is* the fused kernel; a Pallas version only pays off fused
+into flash-attention's Q/K load, which is an M4+ item.
+"""
+import jax.numpy as jnp
+
+
+def available() -> bool:
+    return True
+
+
+def precompute_freqs(head_dim, max_seq_len, theta=10000.0, dtype=jnp.float32):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # [S, D/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x, cos, sin, position_ids=None):
+    """x: [B, S, H, D]; cos/sin: [S_max, D/2] (neox / llama interleave-half)."""
+    seq = x.shape[1]
+    if position_ids is not None:
+        c = jnp.take(cos, position_ids, axis=0)     # [B, S, D/2]
+        s = jnp.take(sin, position_ids, axis=0)
+        c = c[:, :, None, :]
+        s = s[:, :, None, :]
+    else:
+        c = cos[None, :seq, None, :]
+        s = sin[None, :seq, None, :]
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding parity."""
+    outs = [apply_rotary(q, cos, sin, position_ids),
+            apply_rotary(k, cos, sin, position_ids)]
+    outs.append(v if v is None else v)
+    return tuple(outs)

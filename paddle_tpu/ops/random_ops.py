@@ -1,0 +1,121 @@
+"""Random sampling ops over the global/scoped RNG (paddle.rand/randn/... parity).
+
+Reference: python/paddle/tensor/random.py. Keys come from
+core.random.next_key() so the same call sites work eagerly (global seed) and
+inside a jitted step (explicit rng_scope) — see core/random.py.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, unwrap, wrap
+from .registry import register_direct
+
+
+def rand(shape, dtype="float32"):
+    return wrap(jax.random.uniform(rnd.next_key(), shape,
+                                   dtype=convert_dtype(dtype)))
+
+
+def randn(shape, dtype="float32"):
+    return wrap(jax.random.normal(rnd.next_key(), shape,
+                                  dtype=convert_dtype(dtype)))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else rnd.next_key()
+    return wrap(jax.random.uniform(key, shape, dtype=convert_dtype(dtype),
+                                   minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return wrap(m + s * jax.random.normal(rnd.next_key(), shp))
+    return wrap(mean + std * jax.random.normal(rnd.next_key(), shape or ()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype="float32"):
+    return wrap(mean + std * jax.random.normal(
+        rnd.next_key(), shape, dtype=convert_dtype(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(rnd.next_key(), shape, low, high,
+                                   dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    v = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    return wrap(jax.random.randint(rnd.next_key(), v.shape, low, high,
+                                   dtype=convert_dtype(dtype) or v.dtype))
+
+
+def randperm(n, dtype="int64"):
+    return wrap(jax.random.permutation(rnd.next_key(), n).astype(
+        convert_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    v = unwrap(x) if isinstance(x, Tensor) else x
+    return wrap(jax.random.permutation(rnd.next_key(), v, axis=axis))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    v = unwrap(x) if isinstance(x, Tensor) else x
+    logits = jnp.log(v + 1e-30)
+    if replacement:
+        out = jax.random.categorical(rnd.next_key(), logits,
+                                     shape=v.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(rnd.next_key(), v.shape)
+        out = jnp.argsort(logits + g, axis=-1, descending=True)[..., :num_samples]
+    return wrap(out.astype(jnp.int64))
+
+
+def bernoulli(x):
+    v = unwrap(x) if isinstance(x, Tensor) else x
+    return wrap(jax.random.bernoulli(rnd.next_key(), v).astype(v.dtype))
+
+
+def poisson(x):
+    v = unwrap(x) if isinstance(x, Tensor) else x
+    return wrap(jax.random.poisson(rnd.next_key(), v).astype(v.dtype))
+
+
+def exponential_(x, lam=1.0):
+    v = unwrap(x)
+    x._replace_value(jax.random.exponential(rnd.next_key(), v.shape,
+                                            dtype=v.dtype) / lam)
+    return x
+
+
+def standard_normal(shape, dtype="float32"):
+    return wrap(jax.random.normal(rnd.next_key(), shape,
+                                  dtype=convert_dtype(dtype)))
+
+
+def rand_like(x, dtype=None):
+    v = unwrap(x)
+    return wrap(jax.random.uniform(rnd.next_key(), v.shape,
+                                   dtype=convert_dtype(dtype) or v.dtype))
+
+
+def randn_like(x, dtype=None):
+    v = unwrap(x)
+    return wrap(jax.random.normal(rnd.next_key(), v.shape,
+                                  dtype=convert_dtype(dtype) or v.dtype))
+
+
+for _n in ["rand", "randn", "uniform", "normal", "gaussian", "randint",
+           "randint_like", "randperm", "shuffle", "multinomial", "bernoulli",
+           "poisson", "standard_normal", "rand_like", "randn_like"]:
+    register_direct(_n, globals()[_n])

@@ -1,0 +1,56 @@
+"""Op registry: single source of truth for the generated op namespace.
+
+Reference analogue: paddle/phi/api/yaml/ops.yaml + the api_gen.py /
+python_c_gen.py code generators that produce the `_C_ops` namespace
+(python/paddle/_C_ops.py). Here an op is a JAX-traceable function registered
+once; `make_op` wraps it with the eager dispatch (tape recording) and
+`install_tensor_methods` attaches method variants to Tensor — replacing the
+reference's generated pybind methods (paddle/fluid/pybind/eager_method.cc).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..core.tensor import Tensor, dispatch
+
+OPS = {}            # name -> callable (public op)
+TENSOR_METHODS = {}  # method name -> callable
+
+
+def make_op(name, fn, nondiff_args=(), doc=None):
+    @functools.wraps(fn)
+    def op(*args, **kwargs):
+        return dispatch(fn, *args, name=name, nondiff_args=nondiff_args, **kwargs)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    if doc:
+        op.__doc__ = doc
+    OPS[name] = op
+    return op
+
+
+def register(name, *, method=None, nondiff_args=()):
+    """Decorator form. ``method``: also expose as Tensor method (True→same name)."""
+
+    def deco(fn):
+        op = make_op(name, fn, nondiff_args=nondiff_args)
+        if method:
+            TENSOR_METHODS[name if method is True else method] = op
+        return op
+
+    return deco
+
+
+def register_direct(name, fn, *, method=None):
+    """Register an already-dispatching callable (custom control flow inside)."""
+    OPS[name] = fn
+    if method:
+        TENSOR_METHODS[name if method is True else method] = fn
+    return fn
+
+
+def install_tensor_methods():
+    for mname, op in TENSOR_METHODS.items():
+        if not hasattr(Tensor, mname):
+            setattr(Tensor, mname, op)

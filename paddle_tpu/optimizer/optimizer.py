@@ -1,0 +1,365 @@
+"""Optimizer base + the standard zoo (SGD/Momentum/Adam/AdamW/Lamb/...).
+
+Reference: python/paddle/optimizer/optimizer.py (+adamw.py etc.) and the
+fused device kernels paddle/phi/kernels/gpu/adamw_kernel.cu,
+fused_adam_kernel.cu. TPU-native design: each optimizer is a *functional
+core* — ``init_state(params)`` and ``update(grads, params, state, lr)`` are
+pure pytree functions, so the whole update jits into the train step (XLA
+fuses the multi-tensor update; that IS the fused_adam equivalent). The
+paddle-style object API (``opt.step()`` on tape gradients) wraps the same
+core for eager parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._opt_state = None
+        self._step_count = 0
+
+    # ---------------------------------------------------------- functional
+    def init_state(self, params):
+        """params: pytree of arrays -> optimizer state pytree."""
+        return {}
+
+    def update(self, grads, params, state, lr, step):
+        """Pure: (grads, params, state, lr, step) -> (new_params, new_state).
+
+        ``step`` is 1-based. Implemented per-leaf by `_update_leaf`.
+        """
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- object
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    def step(self):
+        params = [p for p in self._parameters if p.trainable]
+        grads = [p.grad._value if p.grad is not None else None for p in params]
+        live = [(p, g) for p, g in zip(params, grads) if g is not None]
+        if not live:
+            return
+        if self._grad_clip is not None:
+            gs = self._grad_clip.clip_values([g for _, g in live])
+            live = [(p, g) for (p, _), g in zip(live, gs)]
+        tree_p = {str(i): p._value for i, (p, _) in enumerate(live)}
+        tree_g = {str(i): g for i, (_, g) in enumerate(live)}
+        if self._opt_state is None:
+            self._opt_state = self.init_state(
+                {str(i): p._value for i, p in enumerate(
+                    [p for p in self._parameters if p.trainable])})
+        # state keyed by global trainable-param index; map the live subset
+        all_params = [p for p in self._parameters if p.trainable]
+        index_of = {id(p): str(i) for i, p in enumerate(all_params)}
+        sub_state = jax.tree_util.tree_map(
+            lambda x: x, self._opt_state)  # shallow copy container
+        self._step_count += 1
+        lr = self.get_lr()
+        for key_live, (p, g) in zip(tree_p, live):
+            k = index_of[id(p)]
+            leaf_state = {name: st[k] for name, st in self._opt_state.items()}
+            new_p, new_leaf = self._update_leaf(
+                g, p._value, leaf_state, lr, self._step_count,
+                self._wd_for(p))
+            p._replace_value(new_p)
+            for name, v in new_leaf.items():
+                self._opt_state[name][k] = v
+
+    def _wd_for(self, p):
+        wd = self._weight_decay
+        if getattr(p, "no_weight_decay", False):
+            return 0.0
+        return wd
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameters:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        sd = {"step": self._step_count}
+        if self._opt_state is not None:
+            sd["state"] = self._opt_state
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = sd.get("step", 0)
+        if "state" in sd:
+            self._opt_state = sd["state"]
+        if "LR_Scheduler" in sd and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sd["LR_Scheduler"])
+
+    # ---------------------------------------------------- functional facade
+    def functional(self):
+        """Return (init_fn, update_fn) pure pytree functions for jit training.
+
+        update_fn(grads, params, state, lr=None, step=1, wd_mask=None)
+        -> (new_params, new_state). wd_mask: pytree of bool — True where
+        weight decay applies (defaults to everywhere).
+        """
+        def init_fn(params):
+            return self.init_state(params)
+
+        def update_fn(grads, params, state, lr=None, step=1, wd_mask=None):
+            lr_ = self.get_lr() if lr is None else lr
+            flat_g, treedef = jax.tree_util.tree_flatten(grads)
+            flat_p = jax.tree_util.tree_flatten(params)[0]
+            if wd_mask is None:
+                flat_m = [True] * len(flat_p)
+            else:
+                flat_m = jax.tree_util.tree_flatten(wd_mask)[0]
+            new_p, new_leafstates = [], []
+            for i, (g, p, m) in enumerate(zip(flat_g, flat_p, flat_m)):
+                leaf_state = {name: jax.tree_util.tree_flatten(st)[0][i]
+                              for name, st in state.items()}
+                np_, ns = self._update_leaf(
+                    g, p, leaf_state, lr_, step,
+                    self._weight_decay if m else 0.0)
+                new_p.append(np_)
+                new_leafstates.append(ns)
+            out_state = {}
+            for name in state:
+                leaves = [ls[name] for ls in new_leafstates]
+                out_state[name] = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(state[name]), leaves)
+            return jax.tree_util.tree_unflatten(treedef, new_p), out_state
+
+        return init_fn, update_fn
+
+
+def _zeros_tree(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+class SGD(Optimizer):
+    def init_state(self, params):
+        return {}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, params):
+        return {"velocity": _zeros_tree(params)}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._decoupled_wd = False  # Adam: L2-regularization style
+
+    def init_state(self, params):
+        return {"m": _zeros_tree(params), "v": _zeros_tree(params)}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd and not self._decoupled_wd:
+            g32 = g32 + wd * p32
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["v"] + (1 - self._beta2) * jnp.square(g32)
+        mhat = m / (1 - self._beta1 ** step)
+        vhat = v / (1 - self._beta2 ** step)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        if wd and self._decoupled_wd:
+            upd = upd + wd * p32
+        return (p32 - lr * upd).astype(p.dtype), {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference adamw_kernel.cu semantics)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _wd_for(self, p):
+        if self._apply_decay_param_fun is not None and p.name is not None:
+            if not self._apply_decay_param_fun(p.name):
+                return 0.0
+        return super()._wd_for(p)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": _zeros_tree(params), "u": _zeros_tree(params)}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["u"], jnp.abs(g))
+        upd = m / ((1 - self._beta1 ** step) * (u + self._eps))
+        return p - lr * upd, {"m": m, "u": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, params):
+        return {"moment": jax.tree_util.tree_map(
+            lambda p: jnp.full_like(p, self._init_acc), params)}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        acc = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._rho = rho
+
+    def init_state(self, params):
+        return {"avg_sq_grad": _zeros_tree(params),
+                "avg_sq_update": _zeros_tree(params)}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        asg = self._rho * state["avg_sq_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(state["avg_sq_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * state["avg_sq_update"] + (1 - self._rho) * \
+            jnp.square(upd)
+        return p - lr * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def init_state(self, params):
+        st = {"mean_square": _zeros_tree(params),
+              "momentum": _zeros_tree(params)}
+        if self._centered:
+            st["mean_grad"] = _zeros_tree(params)
+        return st
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        if wd:
+            g = g + wd * p
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        out = {"mean_square": ms}
+        denom = ms
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = ms - jnp.square(mg)
+            out["mean_grad"] = mg
+        mom = self._momentum * state["momentum"] + \
+            lr * g / jnp.sqrt(denom + self._eps)
+        out["momentum"] = mom
+        return p - mom, out
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, params):
+        return {"m": _zeros_tree(params), "v": _zeros_tree(params)}
+
+    def _wd_for(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._weight_decay
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["m"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["v"] + (1 - self._beta2) * jnp.square(g32)
+        mhat = m / (1 - self._beta1 ** step)
+        vhat = v / (1 - self._beta2 ** step)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * p32
+        p_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), {"m": m, "v": v}

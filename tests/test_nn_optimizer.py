@@ -1,0 +1,225 @@
+"""nn.Layer / functional / optimizer tests (numpy-oracle style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_layer_forward_backward():
+    layer = nn.Linear(4, 3)
+    x = pt.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    out = layer(x)
+    assert out.shape == [2, 3]
+    expected = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+    loss = out.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    np.testing.assert_allclose(layer.bias.grad.numpy(), [2.0, 2.0, 2.0],
+                               rtol=1e-6)
+
+
+def test_layer_containers_and_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    params = model.parameters()
+    assert len(params) == 4
+    # roundtrip
+    sd2 = {k: pt.to_tensor(v.numpy() * 0 + 1.0) for k, v in sd.items()}
+    model.set_state_dict(sd2)
+    np.testing.assert_allclose(model[0].weight.numpy(),
+                               np.ones((4, 8), np.float32))
+
+
+def test_layernorm_matches_numpy():
+    x = np.random.randn(2, 5, 8).astype(np.float32)
+    ln = nn.LayerNorm(8)
+    out = ln(pt.to_tensor(x)).numpy()
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    np.testing.assert_allclose(out, (x - mean) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rmsnorm_matches_numpy():
+    x = np.random.randn(2, 6, 16).astype(np.float32)
+    layer = nn.RMSNorm(16)
+    out = layer(pt.to_tensor(x)).numpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # grad flows
+    y = layer(pt.to_tensor(x, stop_gradient=False))
+    y.sum().backward()
+    assert layer.weight.grad is not None
+
+
+def test_embedding_and_grad():
+    emb = nn.Embedding(10, 4)
+    idx = pt.to_tensor(np.array([1, 3, 1]), dtype="int32")
+    out = emb(idx)
+    assert out.shape == [3, 4]
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 used twice
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0.0
+
+
+def test_conv2d_matches_scipy_like():
+    x = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    conv = nn.Conv2D(3, 5, 3, padding=1)
+    out = conv(pt.to_tensor(x))
+    assert out.shape == [1, 5, 8, 8]
+    out.sum().backward()
+    assert conv.weight.grad is not None
+
+
+def test_dropout_train_eval():
+    x = pt.ops.ones([1000])
+    drop = nn.Dropout(0.5)
+    y = drop(x)
+    frac = (y.numpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    drop.eval()
+    np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.randn(4, 7).astype(np.float32)
+    labels = np.array([0, 3, 6, 2])
+    out = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels)).numpy()
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_sgd_converges_linear_regression():
+    np.random.seed(0)
+    w_true = np.array([[2.0], [-3.0]], np.float32)
+    x = np.random.randn(64, 2).astype(np.float32)
+    y = x @ w_true
+    model = nn.Linear(2, 1)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    for _ in range(200):
+        pred = model(pt.to_tensor(x))
+        loss = F.mse_loss(pred, pt.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(model.weight.numpy(), w_true, atol=0.05)
+
+
+def test_adamw_step_and_state():
+    model = nn.Linear(3, 3)
+    opt = pt.optimizer.AdamW(learning_rate=0.01,
+                             parameters=model.parameters(),
+                             weight_decay=0.01)
+    w0 = model.weight.numpy().copy()
+    out = model(pt.to_tensor(np.ones((2, 3), np.float32)))
+    out.sum().backward()
+    opt.step()
+    assert not np.allclose(model.weight.numpy(), w0)
+    sd = opt.state_dict()
+    assert sd["step"] == 1 and "state" in sd
+
+
+def test_grad_clip_global_norm():
+    model = nn.Linear(4, 4)
+    clip = nn.ClipGradByGlobalNorm(0.001)
+    opt = pt.optimizer.SGD(learning_rate=1.0, parameters=model.parameters(),
+                           grad_clip=clip)
+    out = model(pt.to_tensor(np.ones((2, 4), np.float32) * 100))
+    (out * 1000).sum().backward()
+    w0 = model.weight.numpy().copy()
+    opt.step()
+    delta = np.abs(model.weight.numpy() - w0)
+    # update magnitude bounded by lr * clip_norm
+    assert np.sqrt((delta ** 2).sum()) <= 0.0011
+
+
+def test_lr_scheduler_with_optimizer():
+    sched = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                      gamma=0.5)
+    model = nn.Linear(2, 2)
+    opt = pt.optimizer.SGD(learning_rate=sched,
+                           parameters=model.parameters())
+    assert opt.get_lr() == pytest.approx(0.1)
+    sched.step()
+    sched.step()
+    assert opt.get_lr() == pytest.approx(0.05)
+
+
+def test_amp_autocast_bf16():
+    with pt.amp.auto_cast(dtype="bfloat16"):
+        a = pt.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        b = pt.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        out = a @ b
+        assert out.dtype == pt.bfloat16
+        s = pt.ops.softmax(out)  # blacklisted -> fp32
+        assert s.dtype == pt.float32
+
+
+def test_grad_scaler_fp16_semantics():
+    model = nn.Linear(2, 2)
+    scaler = pt.amp.GradScaler(init_loss_scaling=1024.0)
+    out = model(pt.to_tensor(np.ones((1, 2), np.float32)))
+    loss = out.sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    opt = pt.optimizer.SGD(learning_rate=0.0, parameters=model.parameters())
+    scaler.step(opt)
+    scaler.update()
+    # after unscale_, grads are back at true scale
+    np.testing.assert_allclose(model.bias.grad.numpy(), [1.0, 1.0], rtol=1e-5)
+
+
+def test_functional_call_and_jit_step():
+    import jax
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    params = model.raw_params()
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randn(8, 1).astype(np.float32)
+
+    from paddle_tpu.jit import functional_call
+
+    def loss_fn(ps):
+        pred = functional_call(model, ps, pt.to_tensor(x))
+        import jax.numpy as jnp
+        return jnp.mean((pred - y) ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    assert set(grads) == set(params)
+    # eager grads must match functional grads
+    pred = model(pt.to_tensor(x))
+    loss = F.mse_loss(pred, pt.to_tensor(y))
+    loss.backward()
+    eager_g = model[0].weight.grad.numpy()
+    np.testing.assert_allclose(grads["0.weight"], eager_g, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_train_step_fn_end_to_end():
+    model = nn.Sequential(nn.Linear(4, 16), nn.GELU(), nn.Linear(16, 1))
+    opt = pt.optimizer.AdamW(learning_rate=0.01,
+                             parameters=model.parameters())
+    import jax.numpy as jnp
+
+    def loss_fn(pred, label):
+        return jnp.mean((pred - label) ** 2)
+
+    step = pt.jit.train_step_fn(model, loss_fn, opt)
+    params = model.raw_params()
+    init_fn, _ = opt.functional()
+    state = init_fn(params)
+    x = np.random.randn(32, 4).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) * 0.5).astype(np.float32)
+    losses = []
+    for i in range(60):
+        loss, params, state = step(params, state,
+                                   {"inputs": (x,), "labels": (y,)}, i + 1)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1

@@ -1,0 +1,167 @@
+"""Tensor + eager autograd tape tests.
+
+Modeled on the reference's OpTest numpy-oracle pattern
+(python/paddle/fluid/tests/unittests/eager_op_test.py:313): outputs checked
+against numpy, grads checked against analytic/numeric references.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_roundtrip():
+    x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+    assert x.stop_gradient
+
+
+def test_basic_arith_matches_numpy():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    ta, tb = pt.to_tensor(a), pt.to_tensor(b)
+    np.testing.assert_allclose((ta + tb).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((ta * tb).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((ta - tb).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((ta / (tb + 10)).numpy(), a / (b + 10),
+                               rtol=1e-5)
+    np.testing.assert_allclose((ta @ tb.T).numpy(), a @ b.T, rtol=1e-5)
+
+
+def test_backward_simple():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0], rtol=1e-6)
+
+
+def test_backward_chain_and_accumulation():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3.0
+    z1 = (y * y).sum()
+    z1.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 9 * 2 * np.array([1.0, 2.0]),
+                               rtol=1e-6)
+    # second backward accumulates
+    z2 = (x * 2.0).sum()
+    z2.backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), 9 * 2 * np.array([1.0, 2.0]) + 2.0, rtol=1e-6)
+
+
+def test_backward_through_shared_subexpr():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x        # y = x^2
+    z = y + y        # z = 2x^2 -> dz/dx = 4x = 8
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0, rtol=1e-6)
+
+
+def test_matmul_grad_matches_numeric():
+    a = np.random.randn(4, 5).astype(np.float32)
+    b = np.random.randn(5, 3).astype(np.float32)
+    ta = pt.to_tensor(a, stop_gradient=False)
+    tb = pt.to_tensor(b, stop_gradient=False)
+    loss = (ta @ tb).sum()
+    loss.backward()
+    np.testing.assert_allclose(ta.grad.numpy(), np.ones((4, 3)) @ b.T,
+                               rtol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(), a.T @ np.ones((4, 3)),
+                               rtol=1e-5)
+
+
+def test_no_grad_blocks_tape():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 5.0
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_stop_gradient_cuts_graph():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2.0
+    d = y.detach()
+    z = (d * x).sum()
+    z.backward()
+    # d treated as constant: dz/dx = d = 2x
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0], rtol=1e-6)
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                     stop_gradient=False)
+    a, b, c = pt.ops.split(x, 3, axis=1)
+    loss = (a * 1.0 + b * 2.0 + c * 3.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               np.tile([1.0, 2.0, 3.0], (2, 1)), rtol=1e-6)
+
+
+def test_reductions_and_manip():
+    a = np.random.randn(2, 3, 4).astype(np.float32)
+    t = pt.to_tensor(a)
+    np.testing.assert_allclose(t.sum(axis=1).numpy(), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(t.mean().numpy(), a.mean(), rtol=1e-5)
+    np.testing.assert_allclose(t.reshape([6, 4]).numpy(), a.reshape(6, 4))
+    np.testing.assert_allclose(t.transpose([2, 0, 1]).numpy(),
+                               a.transpose(2, 0, 1))
+    np.testing.assert_allclose(
+        pt.ops.concat([t, t], axis=0).numpy(), np.concatenate([a, a], 0))
+
+
+def test_indexing_and_grad():
+    x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4),
+                     stop_gradient=False)
+    y = x[1]
+    y.sum().backward()
+    expected = np.zeros((3, 4), np.float32)
+    expected[1] = 1.0
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_comparison_and_logical():
+    a = pt.to_tensor([1.0, 2.0, 3.0])
+    b = pt.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).numpy(), [False, False, True])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+
+
+def test_pylayer_custom_backward():
+    class Double(pt.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 100.0  # deliberately wrong to prove custom path
+
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [100.0])
+
+
+def test_autograd_grad_api():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = pt.autograd.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [6.0], rtol=1e-6)
+
+
+def test_random_reproducible():
+    pt.seed(7)
+    a = pt.ops.randn([4])
+    pt.seed(7)
+    b = pt.ops.randn([4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+
+def test_cast_astype():
+    x = pt.to_tensor([1.5, 2.5])
+    assert str(x.astype("int32").numpy().dtype) == "int32"
+    assert x.astype(pt.bfloat16).dtype == pt.bfloat16
