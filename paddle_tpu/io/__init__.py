@@ -1,0 +1,8 @@
+from .checkpoint import CheckpointManager, load_sharded, save_sharded  # noqa: F401
+from .dataloader import (  # noqa: F401
+    BatchSampler, ChainDataset, ComposeDataset, DataLoader, Dataset,
+    DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
+    SequenceSampler, Subset, TensorDataset, default_collate_fn,
+    get_worker_info, random_split,
+)
+from .save_load import load, save  # noqa: F401

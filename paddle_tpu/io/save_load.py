@@ -1,0 +1,61 @@
+"""paddle.save/load parity.
+
+Reference: python/paddle/framework/io.py:639,881 — pickled nested state
+structures with a Tensor->numpy protocol. Identical wire idea here (Tensors
+pickle as numpy + dtype tag so bfloat16 round-trips), plus orbax-backed
+sharded checkpointing in io/checkpoint.py for the distributed path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor, unwrap
+
+__all__ = ["save", "load"]
+
+_BF16_TAG = "__bf16__"
+
+
+def _encode(obj):
+    if isinstance(obj, Tensor):
+        v = unwrap(obj)
+        if v.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True, "data": np.asarray(v.astype(jnp.float32))}
+        return np.asarray(v)
+    if isinstance(obj, jnp.ndarray):
+        if obj.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True, "data": np.asarray(obj.astype(jnp.float32))}
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _encode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_encode(v) for v in obj)
+    return obj
+
+
+def _decode(obj):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            return jnp.asarray(obj["data"]).astype(jnp.bfloat16)
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_encode(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _decode(pickle.load(f))

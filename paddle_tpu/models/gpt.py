@@ -1,0 +1,157 @@
+"""GPT-2/3 family (decoder-only, learned positions, LayerNorm+GELU).
+
+Reference parity target: the Fleet GPT hybrid-parallel example
+(BASELINE.json config 1 — GPT-2 345M). Built from paddle_tpu.nn + the TP
+layers, so one model definition serves single-chip, TP (GSPMD), and PP
+(via PipelineLayer segmentation in parallel/pipeline.py).
+"""
+from dataclasses import dataclass
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt2_345m", "gpt2_tiny"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    max_seq_len: int = 1024
+    intermediate_size: int = None
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    tensor_parallel: bool = False
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        h = cfg.hidden_size
+        if cfg.tensor_parallel:
+            self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+            self.proj = RowParallelLinear(h, h, input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.proj = nn.Linear(h, h)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        b = x.shape[0]
+        s = x.shape[1]
+        nh, hd = self.cfg.num_heads, self.cfg.hidden_size // self.cfg.num_heads
+        qkv = self.qkv(x)
+        qkv = qkv.reshape([b, s, 3, nh, hd])
+        q, k, v = qkv.unbind(axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        out = out.reshape([b, s, nh * hd])
+        return self.dropout(self.proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        if cfg.tensor_parallel:
+            self.fc1 = ColumnParallelLinear(h, m, gather_output=False)
+            self.fc2 = RowParallelLinear(m, h, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, m)
+            self.fc2 = nn.Linear(m, h)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        # GPT-2 init: N(0, 0.02) embeddings (keeps init CE near ln(V))
+        from paddle_tpu.nn.initializer import Normal
+        init = Normal(0.0, 0.02)
+        self.wte.weight._replace_value(
+            init(self.wte.weight.shape, self.wte.weight.dtype))
+        self.wpe.weight._replace_value(
+            init(self.wpe.weight.shape, self.wpe.weight.dtype))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        import paddle_tpu as pt
+        s = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = pt.ops.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        # tied output projection (weight reuse, like the reference example)
+        self.lm_head_weight = self.gpt.wte.weight
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        from ..ops.registry import OPS
+        return OPS["matmul"](h, self.lm_head_weight, transpose_y=True)
+
+    def loss(self, logits, labels):
+        """Shifted causal LM loss."""
+        lg = logits[:, :-1, :]
+        lb = labels[:, 1:]
+        return F.cross_entropy(lg, lb)
+
+
+def gpt2_345m(**kw):
+    return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
+                     num_heads=16, max_seq_len=1024, **kw)
+
+
+def gpt2_tiny(**kw):
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_seq_len", 128)
+    return GPTConfig(**kw)

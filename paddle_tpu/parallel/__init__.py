@@ -1,0 +1,32 @@
+"""paddle_tpu.parallel (exported as paddle_tpu.distributed) — the
+parallelism layer: mesh, topology, collectives, TP/PP/ZeRO/MoE, launch.
+
+Reference: python/paddle/distributed/ (SURVEY §2.2/§2.3)."""
+from . import api, collective, env, mesh, mp_layers, mp_ops, random, topology  # noqa: F401
+from .api import (  # noqa: F401
+    DataParallel, fused_allreduce_gradients, parallel_train_step,
+    param_shardings, shard_params,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all, alltoall,
+    barrier, broadcast, get_rank, get_world_size, in_shard_map, new_group,
+    recv, reduce, reduce_scatter, scatter, send, stream, wait,
+)
+from .env import ParallelEnv, init_parallel_env, is_initialized  # noqa: F401
+from .mesh import HybridMesh, P, get_mesh, init_mesh, mesh_scope, set_mesh  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("fleet", "pipeline", "sharding", "moe", "auto_parallel",
+                "launch", "checkpoint", "rpc"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu.parallel' has no attribute {name!r}")

@@ -1,0 +1,328 @@
+"""Collective communication API (paddle.distributed.* parity).
+
+Reference: python/paddle/distributed/communication/ (all_reduce.py etc.) over
+ProcessGroupNCCL (process_group_nccl.cc). TPU-native story (SURVEY §2.2
+mapping): a collective is an *in-program* XLA op over a named mesh axis —
+`jax.lax.psum/all_gather/ppermute/all_to_all` — legal only inside a
+`shard_map`/pjit trace. This module gives them the paddle signature:
+
+- inside shard_map: ops apply over the group's mesh axis name.
+- eager outside any mesh context: world is the single process; collectives
+  are identity (matching the reference when world_size == 1).
+
+`ReduceOp`, `new_group`, `get_rank`, `get_world_size`, barrier and the
+object-list helpers complete the surface for parity tests.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, dispatch, unwrap, wrap
+from .mesh import get_mesh
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "reduce_scatter", "broadcast", "reduce", "scatter", "alltoall",
+           "all_to_all", "send", "recv", "isend", "irecv", "barrier",
+           "get_rank", "get_world_size", "new_group", "wait", "stream",
+           "in_shard_map", "axis_or_none", "split_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Thin group handle: names a mesh axis (or explicit ranks for parity)."""
+
+    def __init__(self, axis_name=None, ranks=None, pg_id=0):
+        self.axis_name = axis_name
+        self.ranks = ranks or []
+        self.id = pg_id
+
+    @property
+    def nranks(self):
+        if self.axis_name:
+            m = get_mesh()
+            if m is not None:
+                return m.degree(self.axis_name)
+        return max(len(self.ranks), 1)
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else rank
+
+    process_group = property(lambda self: self)
+
+
+_DEFAULT_GROUP = Group(axis_name=None, ranks=[0])
+_GROUPS = {0: _DEFAULT_GROUP}
+
+
+def in_shard_map() -> bool:
+    """True when tracing inside shard_map (axis names bound)."""
+    try:
+        return bool(jax.core.nonempty_axis_env_DO_NOT_USE())
+    except Exception:
+        return False
+
+
+def _bound_axes():
+    try:
+        return set(jax.core.unsafe_get_axis_names_DO_NOT_USE())
+    except Exception:
+        return set()
+
+
+def axis_or_none(group):
+    """Resolve a group to a mesh-axis name if that axis is bound here."""
+    axis = None
+    if group is None:
+        axis = getattr(_DEFAULT_GROUP, "axis_name", None)
+    elif isinstance(group, Group):
+        axis = group.axis_name
+    elif isinstance(group, str):
+        axis = group
+    else:
+        axis = getattr(group, "axis_name", None)
+    if axis is not None and axis in _bound_axes():
+        return axis
+    return None
+
+
+def set_default_axis(axis_name):
+    _DEFAULT_GROUP.axis_name = axis_name
+
+
+def get_rank(group=None):
+    from . import env
+    return env.get_rank()
+
+
+def get_world_size(group=None):
+    from . import env
+    if group is not None and getattr(group, "axis_name", None):
+        return Group(group.axis_name).nranks
+    return env.get_world_size()
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """paddle.distributed.new_group parity (collective.py:185). On TPU the
+    meaningful identity of a group is its mesh axis."""
+    gid = max(_GROUPS) + 1
+    g = Group(axis_name=axis_name, ranks=ranks or [], pg_id=gid)
+    _GROUPS[gid] = g
+    return g
+
+
+def split_group(axis_name):
+    return new_group(axis_name=axis_name)
+
+
+# ----------------------------------------------------------- collectives
+
+
+def _reduce_fn(op):
+    return {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+            ReduceOp.MIN: jax.lax.pmin,
+            ReduceOp.AVG: jax.lax.pmean}[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = axis_or_none(group)
+    if axis is None:
+        if op == ReduceOp.AVG:
+            return tensor  # world of 1
+        return tensor
+
+    def fn(v):
+        return _reduce_fn(op)(v, axis)
+
+    out = dispatch(fn, tensor, name="all_reduce")
+    if isinstance(tensor, Tensor):
+        tensor._replace_value(unwrap(out))
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """Dual API: paddle (tensor_list out-param) or functional (returns array).
+
+    Functional form: all_gather(tensor, group=...) -> concatenated array.
+    """
+    if tensor is None or isinstance(tensor_list, (Tensor, jax.Array, np.ndarray)):
+        # functional: first arg is the tensor
+        t = tensor_list
+        ax = axis_or_none(group)
+        if ax is None:
+            return t
+        return dispatch(
+            lambda v: jax.lax.all_gather(v, ax, axis=axis, tiled=True),
+            t, name="all_gather")
+    ax = axis_or_none(group)
+    if ax is None:
+        tensor_list.append(tensor)
+        return
+    out = dispatch(lambda v: jax.lax.all_gather(v, ax, axis=0, tiled=False),
+                   tensor, name="all_gather")
+    n = Group(ax).nranks
+    for i in range(n):
+        tensor_list.append(out[i])
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)  # single-process parity
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True, axis=0):
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    ax = axis_or_none(group)
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat
+        src = concat(list(src), axis=axis)
+    if ax is None:
+        if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
+            tensor._replace_value(unwrap(src))
+            return tensor
+        return src
+    out = dispatch(
+        lambda v: jax.lax.psum_scatter(v, ax, scatter_dimension=axis,
+                                       tiled=True), src,
+        name="reduce_scatter")
+    if tensor_or_tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._replace_value(unwrap(out))
+        return tensor
+    return out
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = axis_or_none(group)
+    if ax is None:
+        return tensor
+    # value from axis-index src to all: gather the slice at src
+
+    def fn(v):
+        return jax.lax.all_gather(v, ax)[src]
+
+    out = dispatch(fn, tensor, name="broadcast")
+    if isinstance(tensor, Tensor):
+        tensor._replace_value(unwrap(out))
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on SPMD hardware reduce == all_reduce (every shard holds the result)
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = axis_or_none(group)
+    if ax is None:
+        if tensor_list:
+            val = tensor_list[0]
+            if isinstance(tensor, Tensor):
+                tensor._replace_value(unwrap(val))
+            return tensor
+        return tensor
+    raise NotImplementedError(
+        "in-shard_map scatter: express as slicing the source shard")
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    """paddle.distributed.alltoall parity. Functional form: pass a single
+    array with leading dim == group size -> returns exchanged array."""
+    if in_tensor_list is None or isinstance(
+            out_tensor_list, (Tensor, jax.Array, np.ndarray)):
+        t = out_tensor_list
+        ax = axis_or_none(group)
+        if ax is None:
+            return t
+        return dispatch(
+            lambda v: jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                         tiled=True), t, name="all_to_all")
+    ax = axis_or_none(group)
+    if ax is None:
+        out_tensor_list.extend(in_tensor_list)
+        return
+    from ..ops.manipulation import stack
+    stacked = stack(list(in_tensor_list), axis=0)
+    out = dispatch(
+        lambda v: jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0),
+        stacked, name="all_to_all")
+    n = len(in_tensor_list)
+    for i in range(n):
+        out_tensor_list.append(out[i])
+
+
+alltoall = all_to_all
+
+
+def ppermute(tensor, perm, group=None):
+    """Point-to-point ring shift (reference: partial_send/recv for PP)."""
+    ax = axis_or_none(group)
+    if ax is None:
+        return tensor
+    return dispatch(lambda v: jax.lax.ppermute(v, ax, perm),
+                    tensor, name="ppermute")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "TPU-native p2p is expressed as ppermute inside the pipeline "
+        "schedule (parallel/pipeline.py); free-form send/recv has no XLA "
+        "equivalent")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError("see send()")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+class _Task:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        unwrap(tensor).block_until_ready()
+
+
+def barrier(group=None):
+    from . import env
+    env.barrier()
+
+
+class stream:
+    """paddle.distributed.stream.* namespace parity — on TPU comm/compute
+    overlap is XLA's latency-hiding scheduler, so these alias the sync ops."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(all_to_all)

@@ -1,0 +1,89 @@
+"""Process-level distributed environment.
+
+Reference: python/paddle/distributed/parallel.py:919 init_parallel_env —
+TCPStore rendezvous + ProcessGroup bootstrap from PADDLE_TRAINER_ENDPOINTS.
+TPU-native: `jax.distributed.initialize` (one call; the TPU runtime already
+knows the slice topology) — the env-var protocol is kept for launcher parity
+(parallel/launch) and multi-host CPU testing.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "barrier",
+           "is_initialized", "ParallelEnv"]
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env(strategy=None):
+    """Initialize multi-process JAX. Single-process (the common TPU-slice
+    driver model and all tests) is a no-op."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                os.environ.get("WORLD_SIZE", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                             os.environ.get("RANK", "0")))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    return jax.process_count()
+
+
+def barrier(group=None):
+    import jax.numpy as jnp
+    # device-level sync; cross-process sync comes free with any collective
+    jnp.zeros(()).block_until_ready()
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv (parallel.py)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return jax.devices()[0].id
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

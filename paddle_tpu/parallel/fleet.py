@@ -1,0 +1,183 @@
+"""Fleet facade: init / distributed_model / distributed_optimizer.
+
+Reference: python/paddle/distributed/fleet/fleet.py:168 (init),
+:1044 (distributed_optimizer), fleet/model.py:30 (distributed_model),
+base/distributed_strategy.py (the protobuf-backed strategy object
+paddle/fluid/framework/distributed_strategy.proto).
+
+TPU-native: `init` builds the HybridMesh from hybrid_configs and the
+CommunicateTopology/HybridCommunicateGroup query objects over it;
+`distributed_model`/`distributed_optimizer` return wrappers whose real work
+happens when a train step is jitted (parallel/api.py) — there are no
+process groups to boot.
+"""
+from __future__ import annotations
+
+import jax
+
+from . import env as env_mod
+from .mesh import get_mesh, init_mesh
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["DistributedStrategy", "init", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "fleet",
+           "worker_index", "worker_num", "is_first_worker"]
+
+
+class DistributedStrategy:
+    """Dataclass twin of the reference's protobuf DistributedStrategy
+    (distributed_strategy.proto:26-104). Unknown keys are stored verbatim so
+    user configs round-trip."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.nccl_comm_num = 1
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._topology = None
+        self._is_initialized = False
+        self._user_defined_optimizer = None
+
+    # ------------------------------------------------------------- init
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        dp = hc.get("dp_degree", 1)
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sh = hc.get("sharding_degree", 1)
+        sp = hc.get("sep_degree", 1)
+        env_mod.init_parallel_env()
+        n = len(jax.devices())
+        if dp * mp * pp * sh * sp != n:
+            if dp == 1 and mp * pp * sh * sp <= n and \
+                    n % (mp * pp * sh * sp) == 0:
+                dp = n // (mp * pp * sh * sp)
+            else:
+                raise ValueError(
+                    f"hybrid degrees {hc} do not match {n} devices")
+        init_mesh(dp=dp, mp=mp, pp=pp, sharding=sh, sp=sp)
+        self._topology = CommunicateTopology(
+            ["data", "pipe", "sharding", "model"], [dp, pp, sh, mp])
+        self._hcg = HybridCommunicateGroup(self._topology,
+                                           global_rank=env_mod.get_rank())
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_index(self):
+        return env_mod.get_rank()
+
+    @property
+    def worker_num(self):
+        return env_mod.get_world_size()
+
+    def is_first_worker(self):
+        return env_mod.get_rank() == 0
+
+    def barrier_worker(self):
+        env_mod.barrier()
+
+    # ------------------------------------------------------- model/optimizer
+    def distributed_model(self, model):
+        """Reference fleet/model.py:30 — wrap by parallel mode. With GSPMD the
+        wrapper's job is annotation, which TP layers already did; DP/sharding
+        happen in the jitted step. Returns the model (optionally wrapped for
+        API parity)."""
+        from .api import DataParallel
+        if self._hcg and self._hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._user_defined_optimizer = optimizer
+        from .hybrid_optimizer import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       self._strategy)
+
+    # ------------------------------------------------------------ save/load
+    def save(self, state, path, **kw):
+        from ..io.checkpoint import save_sharded
+        save_sharded(state, path)
+
+    def save_persistables(self, exe_or_model, dirname, main_program=None,
+                          mode=0):
+        from ..io.save_load import save
+        if hasattr(exe_or_model, "state_dict"):
+            save(exe_or_model.state_dict(), f"{dirname}/model.pdparams")
+
+    def load(self, path, target=None):
+        from ..io.checkpoint import load_sharded
+        return load_sharded(path, target=target)
+
+    def state_dict(self):
+        return {}
+
+    def shrink(self, threshold=None):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet = _Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    return fleet.init(role_maker, is_collective, strategy)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_index():
+    return fleet.worker_index
+
+
+def worker_num():
+    return fleet.worker_num
+
+
+def is_first_worker():
+    return fleet.is_first_worker()

@@ -1,0 +1,113 @@
+"""Global device mesh management — the TPU-native 'communication backend'.
+
+Reference analogue: the entire ProcessGroup/NCCL stack
+(paddle/fluid/distributed/collective/process_group_nccl.cc, TCPStore
+rendezvous, per-ring comm caches — SURVEY §2.2). On TPU all of that
+collapses into one `jax.sharding.Mesh` whose named axes carry the hybrid
+topology: ("dp", "pp", "sp", "mp") + optional "ep". Collectives become
+XLA ops over ICI; multi-host wiring is `jax.distributed.initialize` and the
+DCN axis is the leading mesh dim.
+
+Axis order chosen so the *innermost* (fastest-varying, best ICI locality)
+axis is "mp" — matching the reference's topology order
+["data","pipe","sharding","model"] (fleet/base/topology.py:54) where model
+ranks are nearest neighbours.
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["HybridMesh", "init_mesh", "get_mesh", "set_mesh", "mesh_scope",
+           "P", "NamedSharding"]
+
+_GLOBAL_MESH: "HybridMesh | None" = None
+
+# canonical axis names, outermost to innermost
+AXES = ("dp", "pp", "sharding", "sp", "mp")
+
+
+@dataclass
+class HybridMesh:
+    """A jax Mesh + hybrid-parallel degree bookkeeping (fleet hybrid_configs)."""
+
+    mesh: Mesh
+    degrees: dict = field(default_factory=dict)
+
+    @property
+    def axis_names(self):
+        return self.mesh.axis_names
+
+    def degree(self, axis) -> int:
+        return self.degrees.get(axis, 1)
+
+    @property
+    def size(self):
+        return int(np.prod(list(self.degrees.values()))) if self.degrees else 1
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def __enter__(self):
+        self._ctx = self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self.mesh.__exit__(*a)
+
+
+def init_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=None, devices=None,
+              axis_order=None) -> HybridMesh:
+    """Build the hybrid mesh (fleet.init hybrid_configs equivalent).
+
+    Degrees of 1 are kept as size-1 axes so sharding specs can always name
+    them. `ep` (expert parallel) reuses a reshape of dp×sp when set.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    want = dp * mp * pp * sharding * sp
+    if want != n:
+        if dp == -1:
+            dp = n // (mp * pp * sharding * sp)
+            want = dp * mp * pp * sharding * sp
+        if want != n:
+            raise ValueError(
+                f"mesh degrees {dict(dp=dp, pp=pp, sharding=sharding, sp=sp, mp=mp)} "
+                f"!= {n} devices")
+    shape = (dp, pp, sharding, sp, mp)
+    arr = np.array(devices).reshape(shape)
+    names = axis_order or AXES
+    mesh = Mesh(arr, names)
+    hm = HybridMesh(mesh, dict(zip(names, shape)))
+    if ep:
+        hm.degrees["ep"] = ep
+    set_mesh(hm)
+    return hm
+
+
+def set_mesh(mesh: HybridMesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> HybridMesh | None:
+    return _GLOBAL_MESH
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: HybridMesh):
+    global _GLOBAL_MESH
+    prev = _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    try:
+        with mesh.mesh:
+            yield mesh
+    finally:
+        _GLOBAL_MESH = prev

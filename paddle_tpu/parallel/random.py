@@ -1,0 +1,84 @@
+"""Per-mesh-axis RNG state tracking.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/random.py:35
+RNGStatesTracker — separate CUDA RNG streams per parallel axis so TP ranks
+share init but draw distinct dropout masks. TPU-native: fold the mesh
+coordinates of the named axes into the key (`jax.random.fold_in`), which is
+exactly the per-rank stream semantics, works identically under jit/shard_map,
+and needs no state snapshots.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..core import random as rnd
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "determinate_seed"]
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_.clear()
+        self.seeds_.clear()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        """Route paddle_tpu random ops to this tracker's stream, folded with
+        the local mesh coordinates of any bound axes (distinct per mp rank)."""
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        key = self.states_[name]
+        from .collective import _bound_axes
+        for ax in sorted(_bound_axes()):
+            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+        with rnd.rng_scope(key):
+            yield
+        # advance the stream so successive uses differ (paddle state update)
+        self.states_[name] = jax.random.fold_in(self.states_[name], 1)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Reference: mpu/random.py model_parallel_random_seed."""
+    import random as pyrandom
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024
+    _TRACKER.reset()
+    _TRACKER.add("global_seed", global_seed)
+    _TRACKER.add("local_seed", local_seed)
+    rnd.seed(global_seed)
+
+
+def determinate_seed(name):
+    tracker = get_rng_state_tracker()
+    if name not in tracker.states_:
+        tracker.add(name, hash(name) % (2 ** 31))
+    return tracker.states_[name]

@@ -1,0 +1,185 @@
+"""CommunicateTopology / HybridCommunicateGroup parity.
+
+Reference: python/paddle/distributed/fleet/base/topology.py:54 (topology
+cartesian-product rank math) and :251 (per-axis comm group construction).
+TPU-native: groups are *views over mesh axes* — no NCCL communicators to
+build; the query API (ranks, prev/next in pipe ring, axis-local rank) is
+preserved because PP schedules and checkpoint sharding consume it.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "AxisGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in dims]))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args):
+        coord = tuple(args[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coord along axis == index."""
+        axis = self._parallel_names.index(axis_name)
+        return [r for c, r in self._coord2rank.items() if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along axis (each group varies only that axis)."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for fixed in itertools.product(*[range(self._dims[i]) for i in other]):
+            group = []
+            for v in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in zip(other, fixed):
+                    coord[i] = o
+                coord[axis] = v
+                group.append(self._coord2rank[tuple(coord)])
+            groups.append(group)
+        return groups
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class AxisGroup:
+    """ProcessGroup-shaped view of one mesh axis (reference: the per-axis
+    groups built by _set_comm_group, topology.py:251)."""
+
+    def __init__(self, axis_name, ranks, my_rank):
+        self.axis_name = axis_name
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self._my_global_rank = my_rank
+
+    @property
+    def rank(self):
+        return self.ranks.index(self._my_global_rank) \
+            if self._my_global_rank in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def id(self):
+        return hash((self.axis_name, tuple(self.ranks))) & 0x7FFFFFFF
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank)
+
+    def __repr__(self):
+        return f"AxisGroup({self.axis_name}, ranks={self.ranks})"
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:140. Mesh-axis group queries for hybrid parallel."""
+
+    # reference axis name -> our mesh axis name
+    NAME_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                "model": "mp", "sep": "sp"}
+
+    def __init__(self, topology: CommunicateTopology, global_rank=0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+
+        names = topology.get_hybrid_group_names()
+        self._degrees = {n: topology.get_dim(n) for n in names}
+        coord = topology.get_coord(global_rank)
+        self._coord = dict(zip(names, coord))
+
+        self._groups = {}
+        for name in names:
+            groups = topology.get_comm_list(name)
+            mine = next(g for g in groups if global_rank in g)
+            self._groups[name] = AxisGroup(self.NAME_MAP.get(name, name),
+                                           mine, global_rank)
+
+    # --- degree queries (reference API names) ---
+    def get_data_parallel_world_size(self):
+        return self._degrees.get("data", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._degrees.get("model", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees.get("pipe", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees.get("sharding", 1)
+
+    # --- rank queries ---
+    def get_data_parallel_rank(self):
+        return self._coord.get("data", 0)
+
+    def get_model_parallel_rank(self):
+        return self._coord.get("model", 0)
+
+    def get_stage_id(self):
+        return self._coord.get("pipe", 0)
+
+    def get_sharding_parallel_rank(self):
+        return self._coord.get("sharding", 0)
+
+    # --- group objects ---
+    def get_data_parallel_group(self):
+        return self._groups.get("data")
+
+    def get_model_parallel_group(self):
+        return self._groups.get("model")
+
+    def get_pipe_parallel_group(self):
+        return self._groups.get("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._groups.get("sharding")
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._groups.get("model")
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank_from_stage(self.global_rank,
+                                              pipe=stage_id, **kwargs)
+
+    # --- p2p neighbours in the pipe ring ---
+    def get_p2p_next_rank(self):
+        pp = self._degrees.get("pipe", 1)
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=(self._coord.get("pipe", 0) + 1) % pp)
+
+    def get_p2p_prev_rank(self):
+        pp = self._degrees.get("pipe", 1)
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=(self._coord.get("pipe", 0) - 1) % pp)
+
+    def topology(self):
+        return self._topo
