@@ -70,17 +70,18 @@ def main():
         np.int32)
     ids = jax.device_put(ids)
 
-    # warmup / compile
+    # warmup / compile (float() forces a host fetch — robust under the
+    # remote-execution relay where block_until_ready alone is unreliable)
     loss, params, state = step(params, state, ids, 1)
-    loss.block_until_ready()
+    float(loss)
     loss, params, state = step(params, state, ids, 2)
-    loss.block_until_ready()
+    float(loss)
 
     iters = 10
     t0 = time.perf_counter()
     for i in range(iters):
         loss, params, state = step(params, state, ids, i + 3)
-    loss.block_until_ready()
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
@@ -95,7 +96,7 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
     }))
-    print(f"  loss={float(loss):.4f} mfu={mfu:.3f} "
+    print(f"  loss={final_loss:.4f} mfu={mfu:.3f} "
           f"params={n_params/1e6:.1f}M step_time={dt/iters*1000:.1f}ms",
           file=sys.stderr)
 

@@ -50,6 +50,16 @@ def __getattr__(name):
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
+def Model(*args, **kwargs):
+    from .hapi.model import Model as _M
+    return _M(*args, **kwargs)
+
+
+def DataParallel(*args, **kwargs):
+    from .parallel.api import DataParallel as _DP
+    return _DP(*args, **kwargs)
+
+
 def is_compiled_with_cuda():
     return False
 

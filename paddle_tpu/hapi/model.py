@@ -1,0 +1,227 @@
+"""paddle.Model — Keras-like high-level API.
+
+Reference: python/paddle/hapi/model.py:1732 (Model.fit), callbacks.py.
+TPU-native: prepare() builds ONE jitted train step (and eval/predict steps)
+instead of per-batch dygraph dispatch; the mesh (if initialized) shards the
+whole loop via parallel/api.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+from ..io.dataloader import DataLoader, Dataset
+from .callbacks import CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._step_fn = None
+        self._eval_fn = None
+        self._params = None
+        self._opt_state = None
+        self._step_count = 0
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        return self
+
+    # ------------------------------------------------------------- build
+    def _build_steps(self):
+        if self._step_fn is not None:
+            return
+        from ..jit import functional_call
+        net = self.network
+        loss_layer = self._loss
+        init_fn, update_fn = self._optimizer.functional()
+        self._params = net.raw_params()
+        self._opt_state = init_fn(self._params)
+
+        def loss_of(ps, inputs, labels, rng):
+            out = functional_call(net, ps, *inputs, rng=rng)
+            l = loss_layer(Tensor(out), *[Tensor(x) for x in labels])
+            return unwrap(l) if isinstance(l, Tensor) else l
+
+        def step(ps, st, inputs, labels, i, rng):
+            loss, grads = jax.value_and_grad(loss_of)(ps, inputs, labels, rng)
+            new_p, new_s = update_fn(grads, ps, st, step=i)
+            return loss, new_p, new_s
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+        def eval_step(ps, inputs, labels):
+            out = functional_call(net, ps, *inputs)
+            l = loss_layer(Tensor(out), *[Tensor(x) for x in labels])
+            return unwrap(l) if isinstance(l, Tensor) else l, out
+
+        self._eval_fn = jax.jit(eval_step)
+
+        def pred_step(ps, inputs):
+            return functional_call(net, ps, *inputs)
+
+        self._pred_fn = jax.jit(pred_step)
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)):
+            arrs = [b.numpy() if hasattr(b, "numpy") else np.asarray(b)
+                    for b in batch]
+            if len(arrs) == 1:
+                return tuple(arrs), ()
+            return tuple(arrs[:-1]), (arrs[-1],)
+        return (np.asarray(batch),), ()
+
+    # ------------------------------------------------------------- train
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        self._build_steps()
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        cbs = CallbackList(callbacks or [ProgBarLogger(log_freq,
+                                                       verbose=verbose)])
+        cbs.set_model(self)
+        cbs.on_train_begin()
+        rng = jax.random.PRNGKey(0)
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            logs = {}
+            for it, batch in enumerate(loader):
+                if num_iters is not None and self._step_count >= num_iters:
+                    break
+                cbs.on_train_batch_begin(it)
+                inputs, labels = self._split(batch)
+                self._step_count += 1
+                rng, sub = jax.random.split(rng)
+                loss, self._params, self._opt_state = self._step_fn(
+                    self._params, self._opt_state, inputs, labels,
+                    self._step_count, sub)
+                logs = {"loss": float(loss), "step": it}
+                cbs.on_train_batch_end(it, logs)
+                if self.stop_training:
+                    break
+            if isinstance(self._optimizer._lr, object) and hasattr(
+                    self._optimizer._lr, "step"):
+                try:
+                    self._optimizer._lr.step()
+                except TypeError:
+                    pass
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training:
+                break
+        self.network.load_raw_params(self._params)
+        cbs.on_train_end()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        self._build_steps()
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for it, batch in enumerate(loader):
+            if num_iters is not None and it >= num_iters:
+                break
+            inputs, labels = self._split(batch)
+            loss, out = self._eval_fn(self._params, inputs, labels)
+            losses.append(float(loss))
+            for m in self._metrics:
+                m.update(m.compute(np.asarray(out), *labels)) \
+                    if m.__class__.__name__ == "Accuracy" else \
+                    m.update(np.asarray(out), *labels)
+        res = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            res[m.name() if isinstance(m.name(), str) else m.name()[0]] = \
+                m.accumulate()
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        self._build_steps()
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            inputs, _ = self._split(batch)
+            outs.append(np.asarray(self._pred_fn(self._params, inputs)))
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self._build_steps()
+        inputs = tuple(np.asarray(i.numpy() if hasattr(i, "numpy") else i)
+                       for i in (inputs if isinstance(inputs, (list, tuple))
+                                 else [inputs]))
+        labels = tuple(np.asarray(l.numpy() if hasattr(l, "numpy") else l)
+                       for l in (labels if isinstance(labels, (list, tuple))
+                                 else [labels] if labels is not None else []))
+        self._step_count += 1
+        loss, self._params, self._opt_state = self._step_fn(
+            self._params, self._opt_state, inputs, labels, self._step_count,
+            jax.random.PRNGKey(self._step_count))
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self._build_steps()
+        inputs = tuple(np.asarray(i) for i in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs]))
+        labels = tuple(np.asarray(l) for l in (
+            labels if isinstance(labels, (list, tuple)) else [labels]))
+        loss, _ = self._eval_fn(self._params, inputs, labels)
+        return [float(loss)]
+
+    def predict_batch(self, inputs):
+        self._build_steps()
+        inputs = tuple(np.asarray(i) for i in (
+            inputs if isinstance(inputs, (list, tuple)) else [inputs]))
+        return [np.asarray(self._pred_fn(self._params, inputs))]
+
+    # ---------------------------------------------------------------- io
+    def save(self, path, training=True):
+        from ..io.save_load import save
+        if self._params is not None:
+            self.network.load_raw_params(self._params)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict() if self._opt_state is None
+                 else {"state": self._opt_state, "step": self._step_count},
+                 path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..io.save_load import load
+        sd = load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        self._params = None
+        self._step_fn = None
+        return self
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size)

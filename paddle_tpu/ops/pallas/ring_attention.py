@@ -1,0 +1,111 @@
+"""Ring attention over the "sp" (sequence/context parallel) mesh axis.
+
+Capability the reference LACKS (SURVEY §5.7: no sequence/context
+parallelism in the snapshot) but the north star requires for long-context.
+TPU-native design: sequence is sharded over "sp"; each step every rank
+attends its local Q block against the K/V block it currently holds, merges
+with running online-softmax stats, then `ppermute`s K/V around the ring so
+compute overlaps the neighbour-to-neighbour ICI transfer. Expressed as a
+`lax.scan` so reverse-mode AD yields the reverse ring for the backward pass
+automatically.
+
+Used inside shard_map (parallel/sp.py wires it into models); single-rank
+call degrades to ordinary causal attention.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, sm_scale, mask=None):
+    """One blockwise attention contribution with stats.
+
+    q: [B,H,Sq,D], k/v: [B,H,Sk,D] -> (numer [B,H,Sq,D], m, l).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                          # [B,H,Sq]
+    # avoid -inf - -inf
+    m_safe = jnp.maximum(m, NEG_INF)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)
+    numer = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return numer, m_safe, l
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None):
+    """q,k,v: LOCAL shards [B, H, S_local, D] inside shard_map over
+    `axis_name`. Returns local attention output [B, H, S_local, D]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    sq = q.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]  # kv travels to next rank
+
+    def seq_mask(src_rank):
+        """Causal mask for local q rows vs kv from src_rank."""
+        if not causal:
+            return None
+        q_pos = my * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
+        k_pos = src_rank * sq + jax.lax.broadcasted_iota(jnp.int32,
+                                                         (sq, sq), 1)
+        return (q_pos >= k_pos)[None, None]
+
+    def step(carry, i):
+        kv, acc, m_run, l_run = carry
+        k_i, v_i = kv
+        # kv currently held originated at rank (my - i) mod n
+        src = (my - i) % n
+        numer, m_blk, l_blk = _block_attn(q, k_i, v_i, sm_scale,
+                                          seq_mask(src))
+        m_new = jnp.maximum(m_run, m_blk)
+        c_run = jnp.exp(m_run - m_new)
+        c_blk = jnp.exp(m_blk - m_new)
+        acc = acc * c_run[..., None] + numer * c_blk[..., None]
+        l_new = l_run * c_run + l_blk * c_blk
+        k_n = jax.lax.ppermute(k_i, axis_name, perm)
+        v_n = jax.lax.ppermute(v_i, axis_name, perm)
+        return ((k_n, v_n), acc, m_new, l_new), None
+
+    b, h, _, d = q.shape
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (kv_f, acc, m_f, l_f), _ = jax.lax.scan(
+        step, ((k, v), acc0, m0, l0), jnp.arange(n))
+    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None,
+                      attn_fn=None):
+    """DeepSpeed-Ulysses alternative: all_to_all heads<->sequence so each
+    rank holds ALL tokens for H/n heads, runs full (flash) attention
+    locally, then all_to_alls back. Needs heads % axis_size == 0."""
+    n = jax.lax.axis_size(axis_name)
+    # [B, H, S_loc, D] -> gather seq, split heads
+    q_ = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                            tiled=True)
+    k_ = jax.lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                            tiled=True)
+    v_ = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                            tiled=True)
+    if attn_fn is None:
+        from .flash_attention import _ref_attention
+        if sm_scale is None:
+            sm_scale = 1.0 / math.sqrt(q.shape[-1])
+        out = _ref_attention(q_, k_, v_, sm_scale, causal)
+    else:
+        out = attn_fn(q_, k_, v_)
+    # back: split seq, gather heads
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)

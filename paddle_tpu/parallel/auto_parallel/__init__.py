@@ -1,0 +1,5 @@
+from .engine import Engine, Strategy  # noqa: F401
+from .interface import (  # noqa: F401
+    dtensor_from_fn, reshard, shard_layer, shard_op, shard_tensor,
+)
+from .process_mesh import ProcessMesh  # noqa: F401

@@ -1,0 +1,94 @@
+"""shard_tensor / shard_op / reshard — semi-auto annotation API.
+
+Reference: python/paddle/distributed/auto_parallel/interface.py:28
+(shard_tensor attaches TensorDistAttr), reshard inserted by Resharder
+(reshard.py:1007). TPU-native: an annotation is `jax.device_put` (eager) or
+`with_sharding_constraint` (traced) with the NamedSharding derived from
+(ProcessMesh, shard_spec) — GSPMD *is* the Completer/Partitioner/Resharder.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec
+
+from ...core.tensor import Parameter, Tensor, dispatch, unwrap, wrap
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+__all__ = ["shard_tensor", "shard_op", "reshard", "dtensor_from_fn",
+           "shard_layer"]
+
+
+def _to_spec(shard_spec):
+    if shard_spec is None:
+        return PartitionSpec()
+    return PartitionSpec(*[s for s in shard_spec])
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, mesh=None,
+                 placements=None, stop_gradient=None):
+    """Annotate + place a tensor. shard_spec: list of dim names or None per
+    tensor dim (reference semantics)."""
+    process_mesh = process_mesh or mesh or get_current_process_mesh()
+    if process_mesh is None:
+        raise ValueError("no ProcessMesh given or active")
+    spec = _to_spec(shard_spec)
+    sharding = process_mesh.sharding(*spec)
+    if isinstance(x, Tensor):
+        try:
+            v = jax.device_put(unwrap(x), sharding)
+        except Exception:
+            v = unwrap(x)  # under trace: constraint instead
+            v = jax.lax.with_sharding_constraint(v, sharding)
+        x._replace_value(v) if isinstance(x, Parameter) else None
+        out = x if isinstance(x, Parameter) else wrap(
+            v, stop_gradient=x.stop_gradient)
+        out._sharding_axes = spec
+        return out
+    v = jax.device_put(x, sharding)
+    return v
+
+
+def reshard(x, process_mesh=None, shard_spec=None, mesh=None,
+            placements=None):
+    """Change an existing dist tensor's layout (Resharder parity)."""
+    return shard_tensor(x, process_mesh=process_mesh, shard_spec=shard_spec,
+                        mesh=mesh, placements=placements)
+
+
+def dtensor_from_fn(fn, process_mesh, shard_spec=None, *args, **kwargs):
+    out = fn(*args, **kwargs)
+    return shard_tensor(out, process_mesh, shard_spec)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Annotate an op's outputs (reference interface.shard_op)."""
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        pm = process_mesh or get_current_process_mesh()
+        if pm is None or out_shard_specs is None:
+            return out
+        specs = out_shard_specs if isinstance(out_shard_specs, (list, tuple)) \
+            else [out_shard_specs]
+        if isinstance(out, (list, tuple)):
+            return type(out)(shard_tensor(o, pm, s)
+                             for o, s in zip(out, specs))
+        return shard_tensor(out, pm, specs[0])
+
+    return wrapped
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Annotate every parameter of `layer` via shard_fn(name, layer, mesh)
+    (paddle.distributed.shard_layer parity)."""
+    for name, sub in layer.named_sublayers(include_self=True):
+        if shard_fn is not None:
+            shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inp, out: output_fn(out, process_mesh))
+    return layer

@@ -1,0 +1,90 @@
+"""ProcessMesh (semi-auto parallel annotation mesh).
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py:45 +
+C++ dist_attr (paddle/fluid/distributed/auto_parallel/process_mesh.h:32).
+TPU-native: a ProcessMesh IS a jax.sharding.Mesh view — process ids map to
+devices; dim_names map to mesh axis names. The reference's
+Completer/Partitioner/Resharder pipeline (completion.py:107,
+partitioner.py:38, reshard.py:1007) is GSPMD itself, so annotation lowers
+straight to NamedSharding.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ProcessMesh", "get_current_process_mesh"]
+
+_CURRENT = []
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        self._dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def processes(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            arr = np.asarray([devices[i] for i in self._process_ids]
+                             ).reshape(self._shape)
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.jax_mesh(), PartitionSpec(*spec))
+
+    def __enter__(self):
+        _CURRENT.append(self)
+        return self
+
+    def __exit__(self, *a):
+        _CURRENT.pop()
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_current_process_mesh():
+    return _CURRENT[-1] if _CURRENT else None

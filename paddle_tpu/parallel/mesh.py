@@ -77,6 +77,9 @@ def init_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=None, devices=None,
         if dp == -1:
             dp = n // (mp * pp * sharding * sp)
             want = dp * mp * pp * sharding * sp
+        if want < n:
+            devices = devices[:want]  # sub-mesh on the leading devices
+            n = want
         if want != n:
             raise ValueError(
                 f"mesh degrees {dict(dp=dp, pp=pp, sharding=sharding, sp=sp, mp=mp)} "

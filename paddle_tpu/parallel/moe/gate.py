@@ -1,0 +1,153 @@
+"""MoE gate zoo: naive / gshard / switch.
+
+Reference: python/paddle/incubate/distributed/models/moe/gate/
+(naive_gate.py, gshard_gate.py, switch_gate.py). TPU-native: gates return
+dense dispatch tensors (combine weights + dispatch mask) — the sort-free
+einsum formulation that maps onto MXU instead of scatter kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as rnd
+from ...nn import functional as Fn
+from ...nn.layer import Layer
+from ...nn.layers_basic import Linear
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate", "TopKGateOutput"]
+
+
+class TopKGateOutput:
+    def __init__(self, combine, dispatch_mask, aux_loss, indices=None):
+        self.combine = combine          # [tokens, experts, capacity]
+        self.dispatch_mask = dispatch_mask
+        self.aux_loss = aux_loss
+        self.indices = indices
+
+
+def _top2_dense_dispatch(logits, capacity, second_policy="random",
+                         noise_eps=0.0):
+    """GShard top-2 dispatch to (combine, mask) dense tensors.
+
+    logits: [T, E] raw gate scores. Returns combine [T, E, C] and
+    bool mask [T, E, C] plus the load-balance aux loss.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    g1 = jnp.max(probs, axis=-1)
+    i1 = jnp.argmax(probs, axis=-1)
+    probs_wo1 = probs * (1.0 - jax.nn.one_hot(i1, E, dtype=probs.dtype))
+    g2 = jnp.max(probs_wo1, axis=-1)
+    i2 = jnp.argmax(probs_wo1, axis=-1)
+
+    # aux loss (GShard eq.4): E * sum_e f_e * p_e
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(i1, E, dtype=probs.dtype), axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    mask1 = jax.nn.one_hot(i1, E, dtype=jnp.int32)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - 1          # position in expert
+    mask2 = jax.nn.one_hot(i2, E, dtype=jnp.int32)
+    pos2 = (jnp.cumsum(mask2, axis=0) + jnp.sum(mask1, axis=0,
+                                                keepdims=True)) * mask2 - 1
+
+    keep1 = (pos1 < capacity) & (mask1 > 0)
+    keep2 = (pos2 < capacity) & (mask2 > 0)
+
+    denom = g1 + g2 + 1e-9
+    w1 = (g1 / denom)[:, None, None]
+    w2 = (g2 / denom)[:, None, None]
+
+    oh_pos1 = jax.nn.one_hot(jnp.clip(pos1, 0, capacity - 1), capacity,
+                             dtype=jnp.float32) * keep1[..., None]
+    oh_pos2 = jax.nn.one_hot(jnp.clip(pos2, 0, capacity - 1), capacity,
+                             dtype=jnp.float32) * keep2[..., None]
+    combine = w1 * oh_pos1 + w2 * oh_pos2                 # [T, E, C]
+    mask = combine > 0
+    return combine, mask, aux
+
+
+def _top1_dense_dispatch(logits, capacity, jitter_eps=0.0, training=True):
+    """Switch-style top-1 dispatch."""
+    T, E = logits.shape
+    if jitter_eps > 0.0 and training:
+        noise = jax.random.uniform(rnd.next_key(), logits.shape,
+                                   jnp.float32, 1.0 - jitter_eps,
+                                   1.0 + jitter_eps)
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    g1 = jnp.max(probs, axis=-1)
+    i1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(i1, E, dtype=probs.dtype), axis=0)
+    aux = jnp.sum(me * ce) * E
+    mask1 = jax.nn.one_hot(i1, E, dtype=jnp.int32)
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - 1
+    keep1 = (pos1 < capacity) & (mask1 > 0)
+    oh_pos1 = jax.nn.one_hot(jnp.clip(pos1, 0, capacity - 1), capacity,
+                             dtype=jnp.float32) * keep1[..., None]
+    combine = g1[:, None, None] * oh_pos1
+    return combine, combine > 0, aux
+
+
+class _GateBase(Layer):
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = Linear(d_model, num_experts, bias_attr=False)
+
+    def capacity(self, num_tokens):
+        import math
+        return max(4, int(math.ceil(
+            num_tokens * self.top_k * self.capacity_factor
+            / self.num_experts)))
+
+
+class NaiveGate(_GateBase):
+    """Reference naive_gate.py: plain top-k softmax, no capacity drops."""
+
+    def forward(self, x):
+        from ...core.tensor import dispatch
+        cap = self.capacity(x.shape[0] if hasattr(x, "shape") else len(x))
+
+        def fn(xv, wv):
+            logits = xv @ wv
+            return _top2_dense_dispatch(logits, cap)
+
+        combine, mask, aux = dispatch(fn, x, self.gate.weight,
+                                      name="naive_gate")
+        return TopKGateOutput(combine, mask, aux)
+
+
+class GShardGate(_GateBase):
+    """Reference gshard_gate.py: top-2 + capacity + aux load-balance loss."""
+
+    forward = NaiveGate.forward
+
+
+class SwitchGate(_GateBase):
+    """Reference switch_gate.py: top-1 + jitter."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.25,
+                 jitter=0.01):
+        super().__init__(d_model, num_experts, top_k=1,
+                         capacity_factor=capacity_factor)
+        self.jitter = jitter
+
+    def forward(self, x):
+        from ...core.tensor import dispatch
+        cap = self.capacity(x.shape[0])
+        training = self.training
+
+        def fn(xv, wv):
+            logits = xv @ wv
+            return _top1_dense_dispatch(logits, cap, self.jitter, training)
+
+        combine, mask, aux = dispatch(fn, x, self.gate.weight,
+                                      name="switch_gate")
+        return TopKGateOutput(combine, mask, aux)

@@ -1,0 +1,43 @@
+"""MoE-aware global-norm clip.
+
+Reference: python/paddle/incubate/distributed/models/moe/grad_clip.py:23
+ClipGradForMOEByGlobalNorm — expert grads (is_expert=True params) contribute
+a norm term psum'd over the expert-parallel group before global scaling.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.clip import ClipGradByGlobalNorm
+from ..collective import axis_or_none
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None, moe_group=None,
+                 group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name)
+        self.is_expert_fn = is_expert_param_func or (
+            lambda p: getattr(p, "is_expert", False))
+        self.moe_group = moe_group
+
+    def clip_values(self, grads, params=None):
+        if params is None:
+            return super().clip_values(grads)
+        sq_norm = jnp.asarray(0.0, jnp.float32)
+        sq_exp = jnp.asarray(0.0, jnp.float32)
+        for g, p in zip(grads, params):
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if self.is_expert_fn(p):
+                sq_exp = sq_exp + s
+            else:
+                sq_norm = sq_norm + s
+        ep = axis_or_none("ep") or axis_or_none("mp")
+        if ep is not None:
+            sq_exp = jax.lax.psum(sq_exp, ep)
+        gn = jnp.sqrt(sq_norm + sq_exp)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]

@@ -1,0 +1,130 @@
+"""MoE layer with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:261
+(MoELayer: gate -> MoEScatter/MoEGather PyLayers over global_scatter/
+global_gather CUDA all-to-all, moe_utils.py:20) and grad_clip.py:23
+(MoE-aware global-norm clip).
+
+TPU-native: the einsum dispatch (combine/dispatch dense tensors from the
+gate) turns scatter into MXU matmuls; expert parallelism is
+`lax.all_to_all` over the "ep" mesh axis inside shard_map, or pure GSPMD
+expert-dim sharding of the stacked expert weights (default). Capacity-bucket
+shapes are static, as XLA requires.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import dispatch as _dispatch
+from ...nn import functional as Fn
+from ...nn.layer import Layer, LayerList
+from ..collective import axis_or_none
+from ..mesh import P
+from .gate import GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertMLP"]
+
+
+class ExpertMLP(Layer):
+    """Stacked experts: weights carry a leading expert dim so one einsum
+    computes all local experts (GSPMD shards dim 0 over 'ep'/'mp')."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        from ...nn.initializer import XavierNormal
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter((num_experts, d_model, d_hidden),
+                                        default_initializer=XavierNormal())
+        self.b1 = self.create_parameter((num_experts, 1, d_hidden),
+                                        is_bias=True)
+        self.w2 = self.create_parameter((num_experts, d_hidden, d_model),
+                                        default_initializer=XavierNormal())
+        self.b2 = self.create_parameter((num_experts, 1, d_model),
+                                        is_bias=True)
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            p._sharding_axes = P("mp")  # expert dim over the model axis
+        self.activation = activation
+
+    def forward(self, x):
+        """x: [E, C, D] capacity buckets -> [E, C, D]."""
+        def fn(xv, w1, b1, w2, b2):
+            h = jnp.einsum("ecd,edh->ech", xv, w1) + b1
+            h = jax.nn.gelu(h) if self.activation == "gelu" else \
+                jax.nn.relu(h)
+            return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+        return _dispatch(fn, x, self.w1, self.b1, self.w2, self.b2,
+                         name="expert_mlp")
+
+
+class MoELayer(Layer):
+    """Reference moe_layer.py:261 MoELayer(d_model, experts, gate, ...).
+
+    gate: "naive" | "gshard" | "switch" | Layer instance.
+    """
+
+    GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+    def __init__(self, d_model, experts=None, gate="gshard", num_experts=None,
+                 d_hidden=None, top_k=2, capacity_factor=1.25,
+                 moe_group=None, mp_group=None, recompute_interval=0):
+        super().__init__()
+        self.d_model = d_model
+        if experts is not None and isinstance(experts, (list, LayerList)):
+            # reference-style per-expert module list -> stack into ExpertMLP
+            num_experts = len(experts)
+            self.experts = experts if isinstance(experts, LayerList) else \
+                LayerList(experts)
+            self._stacked = None
+        else:
+            self.experts = ExpertMLP(num_experts, d_model,
+                                     d_hidden or 4 * d_model)
+            self._stacked = True
+        self.num_experts = num_experts
+        if isinstance(gate, str):
+            gate_cls = self.GATES[gate]
+            kw = dict(capacity_factor=capacity_factor)
+            if gate != "switch":
+                kw["top_k"] = top_k
+            self.gate = gate_cls(d_model, num_experts, **kw)
+        else:
+            self.gate = gate
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [B, S, D] -> [B, S, D]; stores aux_loss for the trainer."""
+        shape = x.shape
+        d = shape[-1]
+        tokens = 1
+        for s in shape[:-1]:
+            tokens *= s
+        xf = x.reshape([tokens, d])
+        gate_out = self.gate(xf)
+        self.aux_loss = gate_out.aux_loss
+
+        combine = gate_out.combine            # [T, E, C]
+
+        def dispatch_tokens(xv, comb):
+            disp = (comb > 0).astype(xv.dtype)
+            buckets = jnp.einsum("tec,td->ecd", disp, xv)   # [E, C, D]
+            ep_axis = axis_or_none("ep")
+            if ep_axis is not None:
+                # expert-parallel exchange: split expert dim across ranks
+                buckets = jax.lax.all_to_all(buckets, ep_axis, split_axis=0,
+                                             concat_axis=1, tiled=True)
+            return buckets
+
+        buckets = _dispatch(dispatch_tokens, xf, combine, name="moe_dispatch")
+        out_buckets = self.experts(buckets)                  # [E, C, D]
+
+        def gather_tokens(ob, comb):
+            ep_axis = axis_or_none("ep")
+            if ep_axis is not None:
+                ob = jax.lax.all_to_all(ob, ep_axis, split_axis=1,
+                                        concat_axis=0, tiled=True)
+            return jnp.einsum("tec,ecd->td", comb.astype(ob.dtype), ob)
+
+        out = _dispatch(gather_tokens, out_buckets, combine,
+                        name="moe_gather")
+        return out.reshape(shape)

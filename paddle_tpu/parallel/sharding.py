@@ -1,0 +1,116 @@
+"""Group-sharded (ZeRO) API parity.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py:37
+(group_sharded_parallel entry), sharding/group_sharded_optimizer_stage2.py:53
+(greedy param partition), group_sharded_stage3.py:59 (per-param slicing with
+gather-on-use forward hooks), group_sharded_storage.py (flat buffers).
+
+TPU-native (SURVEY §7 M6): stages are *layouts*, not runtime machinery —
+- stage 1: optimizer state sharded over the "sharding" axis;
+- stage 2: + gradients sharded (XLA reduce-scatters automatically when the
+  grad layout is sharded);
+- stage 3: + parameters sharded, XLA inserts the gather-on-use all-gathers
+  that the reference implements as forward pre-hooks.
+All three are expressed by `parallel/api.parallel_train_step(zero_stage=N)`.
+This module keeps the reference's user API shape and the rank-partition
+bookkeeping (used by save/load of rank-local shards).
+"""
+from __future__ import annotations
+
+import jax
+
+from .api import parallel_train_step
+from .mesh import get_mesh
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "ShardingStage", "GroupShardedPartition"]
+
+
+class ShardingStage:
+    OS = "os"          # stage 1: optimizer state
+    OS_G = "os_g"      # stage 2: + gradients
+    P_G_OS = "p_g_os"  # stage 3: + parameters
+
+
+_LEVEL_TO_STAGE = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+class GroupShardedPartition:
+    """Greedy size-balanced param->rank assignment (reference
+    group_sharded_optimizer_stage2.py:53 _partition_parameters)."""
+
+    def __init__(self, parameters, degree):
+        self.degree = max(degree, 1)
+        sizes = [0] * self.degree
+        self.rank2params = {i: [] for i in range(self.degree)}
+        for p in sorted(parameters, key=lambda p: -p.size):
+            r = sizes.index(min(sizes))
+            self.rank2params[r].append(p)
+            sizes[r] += p.size
+
+    def param_rank(self, param):
+        for r, ps in self.rank2params.items():
+            if any(q is param for q in ps):
+                return r
+        return -1
+
+
+class _GroupShardedModel:
+    """Wrapper returned by group_sharded_parallel: behaves like the layer,
+    and exposes `build_train_step` — the jit boundary where the stage's
+    layout is realized."""
+
+    def __init__(self, layer, optimizer, level, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                 sync_comm=False, offload=False):
+        self._layer = layer
+        self._optimizer = optimizer
+        self._stage = _LEVEL_TO_STAGE[level]
+        mesh = get_mesh()
+        degree = mesh.degree("sharding") if mesh else 1
+        self.partition = GroupShardedPartition(
+            [p for p in layer.parameters() if p.trainable], degree)
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    @property
+    def stage(self):
+        return self._stage
+
+    def build_train_step(self, loss_fn, mesh=None, **kw):
+        mesh = mesh or get_mesh()
+        return parallel_train_step(self._layer, loss_fn, self._optimizer,
+                                   mesh, zero_stage=self._stage, **kw)
+
+    def state_dict(self, *a, **k):
+        return self._layer.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layer.set_state_dict(*a, **k)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Reference group_sharded.py:37 signature. Returns (model, optimizer,
+    scaler) with the sharded wrapper installed."""
+    if level not in _LEVEL_TO_STAGE:
+        raise ValueError(f"level must be one of {list(_LEVEL_TO_STAGE)}")
+    wrapped = _GroupShardedModel(model, optimizer, level, group=group,
+                                 sync_buffers=sync_buffers, offload=offload)
+    return wrapped, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference sharding/group_sharded.py save_group_sharded_model."""
+    from ..io.save_load import save
+    layer = model._layer if isinstance(model, _GroupShardedModel) else model
+    save(layer.state_dict(), f"{output}/model.pdparams")
+    if optimizer is not None:
+        save(optimizer.state_dict(), f"{output}/model.pdopt")

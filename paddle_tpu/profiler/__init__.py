@@ -1,0 +1,205 @@
+"""paddle.profiler parity over jax.profiler.
+
+Reference: python/paddle/profiler/profiler.py:79 (ProfilerTarget/states
+CLOSED/READY/RECORD), :215 export_chrome_tracing, :650 scheduler; C++ side
+host_tracer.cc + CUPTI (SURVEY §5.1). TPU-native: device+host timelines come
+from `jax.profiler` (XPlane -> Perfetto/TensorBoard); the scheduler/step API
+and RecordEvent are preserved, and a lightweight step timer reports ips like
+fleet's timer.py.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "profiler_step_timer",
+           "StepTimer"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom_device"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Reference profiler.py:650 — returns state per step index."""
+
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if period <= 0:
+            return ProfilerState.RECORD
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof.export(dir_name)
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._dir = None
+        self._active = False
+        self.timer = StepTimer()
+
+    def start(self):
+        self.timer.start()
+        if self._timer_only:
+            return
+        if self._scheduler is None:
+            self._begin_trace()
+
+    def _begin_trace(self):
+        if not self._active:
+            import tempfile
+            self._dir = self._dir or tempfile.mkdtemp(prefix="pt_prof_")
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+
+    def stop(self):
+        self.timer.stop()
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self._step += 1
+        self.timer.step(num_samples)
+        if self._timer_only or self._scheduler is None:
+            return
+        state = self._scheduler(self._step)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._begin_trace()
+        elif self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def export(self, path=None, format=None):  # noqa: A002
+        return self._dir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        t = self.timer
+        if t.count:
+            return (f"steps={t.count} avg_step_ms="
+                    f"{1000*t.total_time/max(t.count,1):.2f} "
+                    f"ips={t.ips():.1f}")
+        return "no steps recorded"
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class RecordEvent:
+    """Host-side named range (reference platform/profiler RecordEvent RAII).
+    Maps to jax.profiler.TraceAnnotation so it lands in the device trace."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+
+
+class StepTimer:
+    """Throughput reporter (reference python/paddle/profiler/timer.py used
+    by fleet to report ips)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.samples = 0
+        self.total_time = 0.0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            self.total_time += time.perf_counter() - self._t0
+            self._t0 = None
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self.total_time += now - self._t0
+        self._t0 = now
+        self.count += 1
+        if num_samples:
+            self.samples += num_samples
+
+    def ips(self):
+        if self.total_time <= 0:
+            return 0.0
+        base = self.samples if self.samples else self.count
+        return base / self.total_time
+
+
+@contextlib.contextmanager
+def profiler_step_timer():
+    t = StepTimer()
+    t.start()
+    yield t
+    t.stop()

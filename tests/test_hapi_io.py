@@ -1,0 +1,135 @@
+"""hapi Model.fit / io / metrics / checkpoint tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.io.dataloader import DistributedBatchSampler
+
+
+def _toy_dataset(n=64):
+    x = np.random.randn(n, 4).astype(np.float32)
+    y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+    return TensorDataset([x, y])
+
+
+def test_model_fit_loss_decreases(capsys):
+    ds = _toy_dataset(128)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.Adam(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.BCEWithLogitsLoss())
+    model.fit(ds, batch_size=16, epochs=3, verbose=0)
+    res = model.evaluate(ds, batch_size=16)
+    assert res["loss"][0] < 0.6
+
+
+def test_model_save_load(tmp_path):
+    net = nn.Linear(3, 2)
+    model = pt.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    w0 = net.weight.numpy().copy()
+    net.weight.set_value(np.zeros_like(w0))
+    model.load(p)
+    np.testing.assert_allclose(net.weight.numpy(), w0)
+
+
+def test_paddle_save_load_bf16(tmp_path):
+    t = pt.to_tensor(np.random.randn(4, 4).astype(np.float32)).astype(
+        pt.bfloat16)
+    path = str(tmp_path / "t.pd")
+    pt.save({"w": t, "meta": {"step": 3}}, path)
+    back = pt.load(path)
+    assert back["meta"]["step"] == 3
+    assert back["w"].dtype == pt.bfloat16
+
+
+def test_dataloader_batching_and_workers():
+    ds = _toy_dataset(30)
+    dl = DataLoader(ds, batch_size=8, drop_last=False, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (8, 4)
+    assert batches[-1][0].shape == (6, 4)
+
+
+def test_distributed_batch_sampler_shards():
+    ds = _toy_dataset(32)
+    s0 = DistributedBatchSampler(ds, batch_size=4, num_replicas=4, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=4, num_replicas=4, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 8
+    assert not set(i0) & set(i1)
+
+
+def test_metrics_accuracy():
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy()
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = np.array([1, 0, 0])
+    m.update(m.compute(pred, label))
+    assert m.accumulate() == pytest.approx(2 / 3)
+
+
+def test_metrics_auc_precision_recall():
+    from paddle_tpu.metric import Auc, Precision, Recall
+    preds = np.array([0.9, 0.8, 0.2, 0.1])
+    labels = np.array([1, 1, 0, 0])
+    p = Precision()
+    p.update(preds, labels)
+    assert p.accumulate() == 1.0
+    r = Recall()
+    r.update(preds, labels)
+    assert r.accumulate() == 1.0
+    a = Auc()
+    a.update(preds, labels)
+    assert a.accumulate() > 0.9
+
+
+def test_profiler_timer_and_events():
+    import paddle_tpu.profiler as prof
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        with prof.RecordEvent("work"):
+            _ = pt.ops.ones([10]).sum()
+        p.step(num_samples=4)
+    p.stop()
+    assert p.timer.count == 3
+    assert "steps=3" in p.summary()
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    import jax
+    from paddle_tpu.io.checkpoint import save_sharded, load_sharded
+    state = {"w": jax.numpy.arange(16.0).reshape(4, 4),
+             "b": jax.numpy.ones((4,))}
+    path = str(tmp_path / "ckpt_dir")
+    save_sharded(state, path)
+    back = load_sharded(path)
+    np.testing.assert_allclose(np.asarray(back["w"]),
+                               np.asarray(state["w"]))
+
+
+def test_engine_fit_auto_parallel():
+    from paddle_tpu.parallel.auto_parallel import Engine, Strategy
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    strategy = Strategy()
+    engine = Engine(model=net, loss=nn.MSELoss(),
+                    optimizer=pt.optimizer.Adam(
+                        learning_rate=0.01, parameters=net.parameters()),
+                    strategy=strategy)
+    ds = _toy_dataset(64)
+    logs = engine.fit(ds, batch_size=8, epochs=2, verbose=0)
+    assert "loss" in logs
+    ev = engine.evaluate(ds, batch_size=8)
+    assert ev["eval_loss"] is not None
