@@ -1,0 +1,190 @@
+"""Native runtime: ctypes bindings over libpaddle_tpu_rt.so.
+
+The C++ pieces the reference keeps native stay native here (SURVEY §7 M1):
+TCPStore rendezvous (tcp_store.cc) and the FLAGS_ registry (flags.cc).
+Built on first use via CMake+ninja (falls back to direct g++), mirroring the
+reference's JIT cpp_extension toolchain
+(python/paddle/utils/cpp_extension/).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "build", "libpaddle_tpu_rt.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def build_library(force=False):
+    """CMake+ninja build of the runtime library (g++ direct fallback)."""
+    if os.path.exists(_LIB_PATH) and not force:
+        return _LIB_PATH
+    build_dir = os.path.join(_HERE, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    try:
+        subprocess.run(["cmake", "-G", "Ninja", "-S", _HERE, "-B", build_dir],
+                       check=True, capture_output=True)
+        subprocess.run(["cmake", "--build", build_dir], check=True,
+                       capture_output=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        srcs = [os.path.join(_HERE, "csrc", f)
+                for f in ("tcp_store.cc", "flags.cc")]
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", _LIB_PATH,
+             *srcs, "-lpthread"], check=True)
+    return _LIB_PATH
+
+
+def lib():
+    global _lib
+    with _lock:
+        if _lib is None:
+            path = build_library()
+            L = ctypes.CDLL(path)
+            L.pt_store_server_start.restype = ctypes.c_void_p
+            L.pt_store_server_start.argtypes = [ctypes.c_int]
+            L.pt_store_server_port.restype = ctypes.c_int
+            L.pt_store_server_port.argtypes = [ctypes.c_void_p]
+            L.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+            L.pt_store_client_connect.restype = ctypes.c_void_p
+            L.pt_store_client_connect.argtypes = [ctypes.c_char_p,
+                                                  ctypes.c_int, ctypes.c_int]
+            L.pt_store_set.restype = ctypes.c_int
+            L.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_int]
+            L.pt_store_get.restype = ctypes.c_long
+            L.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_long]
+            L.pt_store_add.restype = ctypes.c_longlong
+            L.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_longlong]
+            L.pt_store_check.restype = ctypes.c_int
+            L.pt_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            L.pt_store_del.restype = ctypes.c_int
+            L.pt_store_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            L.pt_store_client_close.argtypes = [ctypes.c_void_p]
+            L.pt_flags_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+            L.pt_flags_get.restype = ctypes.c_char_p
+            L.pt_flags_get.argtypes = [ctypes.c_char_p]
+            L.pt_flags_has.restype = ctypes.c_int
+            L.pt_flags_has.argtypes = [ctypes.c_char_p]
+            L.pt_flags_list.restype = ctypes.c_char_p
+            _lib = L
+    return _lib
+
+
+class TCPStoreServer:
+    """Rank-0 side of the rendezvous (reference tcp_store.cc MasterDaemon)."""
+
+    def __init__(self, port=0):
+        self._h = lib().pt_store_server_start(port)
+        if not self._h:
+            raise RuntimeError(f"failed to bind TCPStore on port {port}")
+
+    @property
+    def port(self):
+        return lib().pt_store_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            lib().pt_store_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client (reference phi TCPStore API: set/get/add/wait)."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30):
+        self._server = None
+        if is_master:
+            self._server = TCPStoreServer(port)
+            port = self._server.port
+        self.host = host
+        self.port = port
+        self._h = lib().pt_store_client_connect(host.encode(), port,
+                                                int(timeout * 1000))
+        if not self._h:
+            raise TimeoutError(f"cannot reach TCPStore at {host}:{port}")
+
+    def set(self, key, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if lib().pt_store_set(self._h, key.encode(), data, len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key, max_len=1 << 20):
+        buf = ctypes.create_string_buffer(max_len)
+        n = lib().pt_store_get(self._h, key.encode(), buf, max_len)
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    wait = get
+
+    def add(self, key, delta=1):
+        out = lib().pt_store_add(self._h, key.encode(), delta)
+        if out == -1:
+            raise RuntimeError("TCPStore.add failed")
+        return int(out)
+
+    def check(self, key):
+        return bool(lib().pt_store_check(self._h, key.encode()))
+
+    def delete_key(self, key):
+        return lib().pt_store_del(self._h, key.encode()) == 0
+
+    def barrier(self, name, world_size, timeout=60):
+        """Counter barrier over the store (launcher sync primitive)."""
+        import time
+        n = self.add(f"__barrier__{name}", 1)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            cur = self.add(f"__barrier__{name}", 0)
+            if cur >= world_size:
+                return True
+            time.sleep(0.02)
+        raise TimeoutError(f"barrier {name} timed out at {n}/{world_size}")
+
+    def close(self):
+        if self._h:
+            lib().pt_store_client_close(self._h)
+            self._h = None
+        if self._server:
+            self._server.stop()
+
+
+# ------------------------------------------------------------------- flags
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity (framework.py:7736)."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        lib().pt_flags_set(name.encode(), str(v).encode())
+
+
+def get_flags(names):
+    """paddle.get_flags parity."""
+    single = isinstance(names, str)
+    names_list = [names] if single else list(names)
+    out = {}
+    for k in names_list:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        v = lib().pt_flags_get(name.encode())
+        out[k] = v.decode() if v is not None else None
+    return out
+
+
+def list_flags():
+    raw = lib().pt_flags_list().decode()
+    return dict(line.split("=", 1) for line in raw.splitlines() if "=" in line)

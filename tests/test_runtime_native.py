@@ -1,0 +1,63 @@
+"""Native C++ runtime tests: TCPStore rendezvous + flags registry."""
+import threading
+
+import pytest
+
+
+def test_build_and_flags():
+    from paddle_tpu import runtime
+    runtime.set_flags({"FLAGS_check_nan_inf": "1",
+                       "allocator_strategy": "auto_growth"})
+    out = runtime.get_flags(["FLAGS_check_nan_inf", "FLAGS_missing"])
+    assert out["FLAGS_check_nan_inf"] == "1"
+    assert out["FLAGS_missing"] is None
+    assert runtime.list_flags()["allocator_strategy"] == "auto_growth"
+
+
+def test_tcp_store_set_get_add():
+    from paddle_tpu.runtime import TCPStore
+    master = TCPStore(is_master=True, port=0)
+    client = TCPStore(host="127.0.0.1", port=master.port)
+    master.set("unique_id", b"\x01\x02\x03nccl-equivalent")
+    assert client.get("unique_id") == b"\x01\x02\x03nccl-equivalent"
+    assert client.add("counter", 5) == 5
+    assert master.add("counter", 2) == 7
+    assert client.check("unique_id")
+    assert not client.check("nope")
+    client.delete_key("unique_id")
+    assert not master.check("unique_id")
+    client.close()
+    master.close()
+
+
+def test_tcp_store_blocking_get_and_barrier():
+    from paddle_tpu.runtime import TCPStore
+    master = TCPStore(is_master=True, port=0)
+    results = {}
+
+    def waiter():
+        c = TCPStore(port=master.port)
+        results["v"] = c.get("late_key")  # blocks until set
+        c.close()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.2)
+    master.set("late_key", b"arrived")
+    t.join(timeout=5)
+    assert results["v"] == b"arrived"
+
+    # 3-party barrier
+    def member(i):
+        c = TCPStore(port=master.port)
+        c.barrier("b0", 3)
+        c.close()
+
+    ts = [threading.Thread(target=member, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    master.barrier("b0", 3)
+    for t in ts:
+        t.join(timeout=5)
+    master.close()
