@@ -37,18 +37,25 @@ def main():
     seq = 1024
     batch = 8
 
-    cfg = gpt2_345m(dropout=0.0)
-    model = GPTForCausalLM(cfg)
-    model.astype("bfloat16")
-    model.eval()  # dropout off; still training math
-    opt = pt.optimizer.AdamW(learning_rate=1e-4,
-                             parameters=model.parameters())
-    init_fn, update_fn = opt.functional()
-    params = model.raw_params()
-    n_params = sum(int(np.prod(v.shape)) for v in params.values())
-    state = init_fn(params)
-    # master fp32 moments for stability (cheap on HBM at 345M)
-    state = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), state)
+    # Build params on the CPU backend: on remote-execution TPU setups each
+    # device-side init op would pay a separate remote compile.
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        cfg = gpt2_345m(dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.astype("bfloat16")
+        model.eval()  # dropout off; still training math
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        init_fn, update_fn = opt.functional()
+        params = model.raw_params()
+        n_params = sum(int(np.prod(v.shape)) for v in params.values())
+        state = init_fn(params)
+        # master fp32 moments for stability (cheap on HBM at 345M)
+        state = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), state)
+    dev = jax.devices()[0]
+    params = jax.device_put(params, dev)
+    state = jax.device_put(state, dev)
 
     def loss_fn(logits, labels):
         lg = logits[:, :-1]
@@ -56,7 +63,6 @@ def main():
         logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
         return -jnp.take_along_axis(logp, lb[..., None], -1).mean()
 
-    @jax.jit
     def step(params, state, ids, i):
         def compute(ps):
             logits = functional_call(model, ps, ids)
@@ -66,9 +72,11 @@ def main():
         new_p, new_s = update_fn(grads, params, state, step=i)
         return loss, new_p, new_s
 
+    step = jax.jit(step, donate_argnums=(0, 1))
+
     ids = np.random.randint(0, cfg.vocab_size, size=(batch, seq)).astype(
         np.int32)
-    ids = jax.device_put(ids)
+    ids = jax.device_put(ids, dev)
 
     # warmup / compile (float() forces a host fetch — robust under the
     # remote-execution relay where block_until_ready alone is unreliable)

@@ -1,0 +1,127 @@
+"""paddle.device parity (set_device/get_device/cuda namespace-alikes).
+
+Reference: python/paddle/device/. TPU-native: device selection is JAX's
+(platform + ordinal); streams/events collapse into XLA's async dispatch, so
+Stream/Event keep API shape with barrier semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "is_compiled_with_cinn", "cuda",
+           "Stream", "Event", "synchronize", "device_count", "memory_stats"]
+
+
+def set_device(device):
+    return device
+
+
+def get_device():
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def device_count():
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+def memory_stats(device=None):
+    d = jax.devices()[0]
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+class Stream:
+    """API-shape parity: XLA orders work itself; wait_* are barriers."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        synchronize()
+
+    def wait_stream(self, stream):
+        synchronize()
+
+    def record_event(self, event=None):
+        e = event or Event()
+        e.record(self)
+        return e
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda shim — reports absence of CUDA, maps memory APIs
+    to the TPU device where meaningful."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = memory_stats()
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = memory_stats()
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+
+cuda = _CudaNamespace()

@@ -1,0 +1,333 @@
+"""paddle.distribution parity (Normal/Uniform/Bernoulli/Categorical/...).
+
+Reference: python/paddle/distribution/. Math via jax.scipy; sampling via the
+global/scoped RNG (core/random.py).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as rnd
+from ..core.tensor import Tensor, unwrap, wrap
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel", "Laplace",
+           "LogNormal", "Multinomial", "Poisson", "kl_divergence"]
+
+
+def _v(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = batch_shape
+        self._event_shape = event_shape
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return wrap(jnp.exp(unwrap(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(self.loc + self.scale * jax.random.normal(
+            rnd.next_key(), shp))
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale ** 2
+        return wrap(-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return wrap(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                    + jnp.zeros(self.batch_shape))
+
+    @property
+    def mean(self):
+        return wrap(self.loc + jnp.zeros(self.batch_shape))
+
+    @property
+    def variance(self):
+        return wrap(self.scale ** 2 + jnp.zeros(self.batch_shape))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return wrap(jnp.exp(unwrap(super().sample(shape))))
+
+    def log_prob(self, value):
+        v = _v(value)
+        lv = jnp.log(v)
+        return wrap(unwrap(super().log_prob(lv)) - lv)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rnd.next_key(), shp)
+        return wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        return wrap(jnp.where(inside, -jnp.log(self.high - self.low),
+                              -jnp.inf))
+
+    def entropy(self):
+        return wrap(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = _v(probs)
+            self.logits = jnp.log(self.probs / (1 - self.probs))
+        else:
+            self.logits = _v(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.bernoulli(rnd.next_key(), self.probs,
+                                         shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return wrap(v * jnp.log(self.probs + 1e-12)
+                    + (1 - v) * jnp.log(1 - self.probs + 1e-12))
+
+    def entropy(self):
+        p = self.probs
+        return wrap(-(p * jnp.log(p + 1e-12)
+                      + (1 - p) * jnp.log(1 - p + 1e-12)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _v(logits)
+        else:
+            self.logits = jnp.log(_v(probs) + 1e-12)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return wrap(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.categorical(rnd.next_key(), self.logits,
+                                           shape=shp))
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return wrap(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return wrap(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.beta(rnd.next_key(), self.alpha, self.beta,
+                                    shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _v(value)
+        return wrap((self.alpha - 1) * jnp.log(v)
+                    + (self.beta - 1) * jnp.log1p(-v)
+                    - betaln(self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.dirichlet(rnd.next_key(), self.concentration,
+                                         shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        v = _v(value)
+        return wrap(jnp.sum((a - 1) * jnp.log(v), -1)
+                    + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.exponential(rnd.next_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _v(value)
+        return wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return wrap(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.gamma(rnd.next_key(), self.concentration,
+                                     shp) / self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a, b = self.concentration, self.rate
+        v = _v(value)
+        return wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - gammaln(a))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(self.loc + self.scale * jax.random.gumbel(
+            rnd.next_key(), shp))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(self.loc + self.scale * jax.random.laplace(
+            rnd.next_key(), shp))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return wrap(-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(self.probs_ + 1e-12)
+        draws = jax.random.categorical(
+            rnd.next_key(), logits,
+            shape=tuple(shape) + (self.total_count,) + self.batch_shape)
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return wrap(jnp.sum(onehot, axis=len(shape)))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.poisson(rnd.next_key(), self.rate,
+                                       shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        return wrap(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, -1)
+        logq = jax.nn.log_softmax(q.logits, -1)
+        return wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp, qq = p.probs, q.probs
+        return wrap(pp * (jnp.log(pp + 1e-12) - jnp.log(qq + 1e-12))
+                    + (1 - pp) * (jnp.log(1 - pp + 1e-12)
+                                  - jnp.log(1 - qq + 1e-12)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,1 @@
+from . import autograd, nn  # noqa: F401

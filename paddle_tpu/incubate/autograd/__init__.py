@@ -1,0 +1,23 @@
+"""paddle.incubate.autograd parity — functional transforms.
+
+Reference: python/paddle/incubate/autograd/ (functional.py vjp/jvp/Jacobian/
+Hessian, primapi.py forward_grad/grad). TPU-native: these ARE jax transforms.
+"""
+from ...autograd import hessian, jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "jacobian", "hessian",
+           "forward_grad", "grad"]
+
+Jacobian = jacobian
+Hessian = hessian
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grad (reference primapi.forward_grad)."""
+    raise NotImplementedError(
+        "use paddle_tpu.autograd.jvp (jax.jvp) for forward-mode AD")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ...autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs)

@@ -1,0 +1,5 @@
+from . import functional  # noqa: F401
+from .fused_transformer import (  # noqa: F401
+    FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

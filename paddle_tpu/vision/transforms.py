@@ -1,0 +1,261 @@
+"""paddle.vision.transforms parity (numpy host-side pipeline).
+
+Reference: python/paddle/vision/transforms/. Host-side image preprocessing
+stays numpy (feeding device_put once per batch); geometric ops use jax.image
+when run on device tensors.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..core.tensor import Tensor, wrap
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "ContrastTransform", "Pad",
+           "RandomResizedCrop", "to_tensor", "normalize", "resize",
+           "hflip", "vflip", "center_crop", "crop", "pad"]
+
+
+def _chw(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[None]
+    elif a.ndim == 3 and a.shape[-1] in (1, 3, 4):
+        a = a.transpose(2, 0, 1)
+    return a
+
+
+def to_tensor(img, data_format="CHW"):
+    a = np.asarray(img).astype(np.float32)
+    if a.max() > 1.5:
+        a = a / 255.0
+    if data_format == "CHW":
+        a = _chw(a)
+    return wrap(__import__("jax.numpy", fromlist=["asarray"]).asarray(a))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = img.numpy() if isinstance(img, Tensor) else np.asarray(img,
+                                                              np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    out = (a - mean) / std
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        return wrap(jnp.asarray(out))
+    return out
+
+
+def resize(img, size, interpolation="bilinear"):
+    a = np.asarray(img)
+    import jax
+    import jax.numpy as jnp
+    if isinstance(size, int):
+        h, w = a.shape[0], a.shape[1]
+        if h < w:
+            size = (size, int(size * w / h))
+        else:
+            size = (int(size * h / w), size)
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[interpolation]
+    out_shape = tuple(size) + a.shape[2:]
+    return np.asarray(jax.image.resize(jnp.asarray(a, jnp.float32),
+                                       out_shape, method=method))
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = np.asarray(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = a.shape[0], a.shape[1]
+    th, tw = output_size
+    return crop(a, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = np.asarray(img)
+    if isinstance(padding, int):
+        padding = (padding,) * 4
+    left, top, right, bottom = padding if len(padding) == 4 else \
+        (padding[0], padding[1], padding[0], padding[1])
+    width = [(top, bottom), (left, right)] + [(0, 0)] * (a.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(a, width, constant_values=fill)
+    return np.pad(a, width, mode=padding_mode)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = mean
+        self.std = std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        if self.padding:
+            a = pad(a, self.padding)
+        h, w = a.shape[0], a.shape[1]
+        th, tw = self.size
+        top = np.random.randint(0, max(h - th, 0) + 1)
+        left = np.random.randint(0, max(w - tw, 0) + 1)
+        return crop(a, top, left, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[0], a.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return resize(crop(a, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(a, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return np.asarray(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(a * factor, 0, 255 if a.max() > 1.5 else 1.0)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = a.mean()
+        return np.clip((a - mean) * factor + mean,
+                       0, 255 if a.max() > 1.5 else 1.0)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
