@@ -983,6 +983,14 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
 
 
+# activations that live in the core op table but are part of F's surface
+from ..ops.registry import OPS as _OPS  # noqa: E402
+
+tanh = _OPS["tanh"]
+sigmoid = _OPS["sigmoid"]
+log_sigmoid = _OPS["logsigmoid"]
+
+
 def sequence_mask(lengths, maxlen=None, dtype="int64"):
     v = unwrap(lengths) if isinstance(lengths, Tensor) else jnp.asarray(lengths)
     m = maxlen if maxlen is not None else int(v.max())

@@ -138,7 +138,7 @@ class PipelineLayer(Layer):
         self._shared = {}
         self.stages = LayerList()
         for s in range(self._num_stages):
-            stage = LayerList()
+            stage = _Stage()
             for i in range(self.segment_parts[s], self.segment_parts[s + 1]):
                 desc = self._layers_desc[i]
                 if isinstance(desc, SharedLayerDesc):
@@ -170,6 +170,23 @@ class PipelineLayer(Layer):
 
     def loss(self, out, label):
         return self._loss_fn(out, label) if self._loss_fn else out
+
+
+class _Stage(LayerList):
+    """One pipeline stage: sequential block list with a real forward (the
+    stacked-stage SPMD schedule calls it as the uniform stage function)."""
+
+    def append(self, layer):
+        super().append(layer)
+        return self
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
 
 
 class _SharedWrapper(Layer):

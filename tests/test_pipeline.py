@@ -1,0 +1,130 @@
+"""Pipeline parallel tests: segmentation, local schedule parity, SPMD GPipe.
+
+Mirrors reference tests hybrid_parallel_pp_transformer.py (loss parity
+between pipelined and dense execution).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.parallel as dist
+from paddle_tpu.parallel.pipeline import (
+    LayerDesc, LocalPipelineRunner, PipelineLayer, SegmentLayers,
+)
+from paddle_tpu.parallel.pp_schedule import (
+    pipeline_train_step, spmd_pipeline_forward, stack_stage_params,
+)
+from paddle_tpu.parallel.mesh import P
+
+
+def test_segment_layers_uniform():
+    segs = SegmentLayers([None] * 10, num_parts=4).do_segment()
+    assert segs == [0, 3, 6, 8, 10]
+    assert SegmentLayers.uniform(8, 4) == [0, 2, 4, 6, 8]
+
+
+class _Block(nn.Layer):
+    def __init__(self, width=8):
+        super().__init__()
+        self.fc = nn.Linear(width, width)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return x + F.tanh(self.fc(x))
+
+
+def test_pipeline_layer_builds_stages():
+    pipe = PipelineLayer([LayerDesc(_Block, 8) for _ in range(6)],
+                         num_stages=3)
+    assert len(pipe.stages) == 3
+    assert len(pipe.stages[0]) == 2
+    x = pt.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    out = pipe(x)
+    assert out.shape == [2, 8]
+
+
+def test_local_pipeline_runner_matches_full_batch():
+    pt.seed(3)
+    loss_fn = lambda out, y: ((out - y) ** 2).mean()  # noqa: E731
+    pipe = PipelineLayer([LayerDesc(_Block, 8) for _ in range(4)],
+                         num_stages=2, loss_fn=loss_fn)
+    opt = pt.optimizer.SGD(learning_rate=0.0,
+                           parameters=pipe.parameters())
+    runner = LocalPipelineRunner(pipe, opt)
+    x = np.random.randn(4, 8).astype(np.float32)
+    y = np.random.randn(4, 8).astype(np.float32)
+    avg_loss = runner.train_batch(x, y, num_microbatches=2)
+    full = float(loss_fn(pipe(pt.to_tensor(x)), pt.to_tensor(y)).numpy())
+    # microbatch-mean of MSE == full-batch MSE for equal splits
+    np.testing.assert_allclose(avg_loss, full, rtol=1e-5)
+
+
+def test_spmd_pipeline_forward_matches_sequential():
+    """The scan+ppermute wave must equal running stages sequentially."""
+    pt.seed(11)
+    S = 4
+    pipe = PipelineLayer([LayerDesc(_Block, 16) for _ in range(S)],
+                         num_stages=S)
+    mesh = dist.init_mesh(dp=1, pp=S, mp=1)
+    stacked, template = stack_stage_params(pipe)
+    from paddle_tpu.jit import functional_call
+
+    def stage_fn(params_one, x):
+        return functional_call(template, params_one, x)
+
+    M, mb, d = 3, 2, 16
+    x_micro = np.random.randn(M, mb, d).astype(np.float32)
+
+    def body(stk, xm):
+        return spmd_pipeline_forward(stage_fn, stk, xm, S)
+
+    outs = jax.shard_map(
+        body, mesh=mesh.mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), stacked), P()),
+        out_specs=P(), check_vma=False,
+    )(stacked, jnp.asarray(x_micro))
+
+    # sequential reference
+    ref = []
+    for m in range(M):
+        h = pt.to_tensor(x_micro[m])
+        h = pipe(h)
+        ref.append(h.numpy())
+    ref = np.stack(ref)
+    np.testing.assert_allclose(np.asarray(outs), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_train_step_loss_decreases():
+    pt.seed(1)
+    S = 2
+    width = 16
+    pipe = PipelineLayer([LayerDesc(_Block, width) for _ in range(S * 2)],
+                         num_stages=S)
+    mesh = dist.init_mesh(dp=1, pp=S, mp=1)
+    opt = pt.optimizer.AdamW(learning_rate=5e-3,
+                             parameters=pipe.parameters())
+
+    w_out = np.random.randn(width, 4).astype(np.float32) * 0.1
+
+    def embed_fn(extra, ids):
+        return ids  # identity embedding: inputs are already features
+
+    def head_loss_fn(extra, hidden, labels):
+        logits = hidden @ w_out
+        return jnp.mean((logits - labels) ** 2)
+
+    with mesh:
+        step, stacked, extra, states = pipeline_train_step(
+            pipe, embed_fn, head_loss_fn, opt, mesh, num_micro=2,
+            remat=False)
+        x = np.random.randn(4, width).astype(np.float32)
+        y = np.random.randn(4, 4).astype(np.float32)
+        losses = []
+        for i in range(12):
+            loss, stacked, extra, states = step(stacked, extra, states,
+                                                jnp.asarray(x),
+                                                jnp.asarray(y), i + 1)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
