@@ -5,9 +5,14 @@ from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa:
 from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .layers_basic import *  # noqa: F401,F403
 from .layers_basic import __all__ as _basic_all
+from .rnn import *  # noqa: F401,F403
+from .rnn import __all__ as _rnn_all
+from .transformer import *  # noqa: F401,F403
+from .transformer import __all__ as _transformer_all
 
 __all__ = (
     ["Layer", "LayerList", "Sequential", "ParameterList", "LayerDict",
      "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
-     "functional", "initializer"] + list(_basic_all)
+     "functional", "initializer"] + list(_basic_all) + list(_rnn_all)
+    + list(_transformer_all)
 )

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from ..core import random as rnd
 from ..core.tensor import Tensor, dispatch, unwrap
-from ..ops.registry import register
+from ..ops.registry import OPS as _OPS, register
 
 # ------------------------------------------------------------- activations
 
@@ -984,8 +984,6 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
 
 
 # activations that live in the core op table but are part of F's surface
-from ..ops.registry import OPS as _OPS  # noqa: E402
-
 tanh = _OPS["tanh"]
 sigmoid = _OPS["sigmoid"]
 log_sigmoid = _OPS["logsigmoid"]

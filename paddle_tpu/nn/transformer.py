@@ -1,0 +1,370 @@
+"""Transformer layers — MultiHeadAttention, encoder/decoder stacks.
+
+Reference parity: python/paddle/nn/layer/transformer.py
+(MultiHeadAttention:88, TransformerEncoderLayer:440,
+TransformerEncoder:614, TransformerDecoderLayer:683,
+TransformerDecoder:895, Transformer:983). TPU-native: attention is a
+single batched einsum pipeline ([B,S,H,D] layout) routed through
+F.scaled_dot_product_attention so it picks up the Pallas flash kernel
+when no explicit mask/weights are requested; masks follow the reference
+convention (bool keep-mask or additive float).
+"""
+import collections
+import copy
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import dispatch
+from . import functional as F
+from .layer import Layer, LayerList
+from .layers_basic import Dropout, LayerNorm, Linear
+
+__all__ = [
+    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+    "TransformerDecoderLayer", "TransformerDecoder", "Transformer",
+]
+
+
+def _convert_attention_mask(attn_mask, dtype):
+    """bool keep-mask → additive float; float passes through
+    (transformer.py:36 _convert_attention_mask)."""
+    if attn_mask is None:
+        return None
+    from .. import ops as _ops  # noqa: F401
+    import paddle_tpu as pt
+    if str(attn_mask.dtype) in ("bool", "paddle.bool"):
+        return dispatch(
+            lambda m: jnp.where(m, jnp.zeros([], dtype),
+                                jnp.full([], -1e9, dtype)),
+            attn_mask, name="convert_attn_mask")
+    return attn_mask
+
+
+class MultiHeadAttention(Layer):
+    """transformer.py:88. Layout [batch, seq, embed]; heads split inside."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _split_heads(self, x):
+        B, S = x.shape[0], x.shape[1]
+        return x.reshape([B, S, self.num_heads, self.head_dim])
+
+    def gen_cache(self, key, value=None, type=None):
+        if type == MultiHeadAttention.StaticCache or (
+                value is not None and type is None):
+            if value is None:
+                k = self._split_heads(self.k_proj(key))
+                v = self._split_heads(self.v_proj(key))
+                return self.StaticCache(k, v)
+            return self.StaticCache(key, value)
+        # incremental decode cache seeded empty
+        import paddle_tpu as pt
+        B = key.shape[0]
+        k = pt.zeros([B, 0, self.num_heads, self.head_dim], dtype="float32")
+        v = pt.zeros([B, 0, self.num_heads, self.head_dim], dtype="float32")
+        return self.Cache(k, v)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split_heads(self.q_proj(query))  # [B,S,H,D]
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value))
+            if isinstance(cache, self.Cache):
+                import paddle_tpu as pt
+                k = pt.concat([cache.k, k], axis=1)
+                v = pt.concat([cache.v, v], axis=1)
+                cache = self.Cache(k, v)
+
+        mask = _convert_attention_mask(attn_mask, jnp.float32)
+        if self.need_weights or mask is not None:
+            out, weights = self._attn_with_weights(q, k, v, mask)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=self.dropout, training=self.training)
+            weights = None
+        B, S = out.shape[0], out.shape[1]
+        out = out.reshape([B, S, self.embed_dim])
+        out = self.out_proj(out)
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if cache is not None and not isinstance(cache, self.StaticCache):
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
+
+    def _attn_with_weights(self, q, k, v, mask):
+        import math as _m
+        drop = self.dropout
+        training = self.training
+
+        def fn(q, k, v, *m):
+            qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / _m.sqrt(qh.shape[-1])
+            if m:
+                s = s + m[0]
+            p = jax.nn.softmax(s.astype(jnp.float32), -1).astype(qh.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+            return jnp.swapaxes(o, 1, 2), p
+
+        args = (q, k, v) + ((mask,) if mask is not None else ())
+        out, p = dispatch(fn, *args, name="mha_attention")
+        if drop > 0.0 and training:
+            out = F.dropout(out, p=drop, training=training)
+        return out, p
+
+
+def _get_activation(name):
+    return {"relu": F.relu, "gelu": F.gelu}.get(name, F.relu)
+
+
+class TransformerEncoderLayer(Layer):
+    """transformer.py:440 — self-attn + FFN with pre/post-norm."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                              bias_attr)
+        self.dropout = Dropout(act_dropout)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                              bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.activation = _get_activation(activation)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            src, cache = self.self_attn(src, src, src, src_mask, cache)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+class TransformerEncoder(Layer):
+    """transformer.py:614 — clones of one encoder layer."""
+
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        self.layers = LayerList(
+            [encoder_layer] +
+            [copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, src_mask=src_mask)
+            else:
+                output, c = mod(output, src_mask=src_mask, cache=cache[i])
+                new_caches.append(c)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoderLayer(Layer):
+    """transformer.py:683 — self-attn + cross-attn + FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                              bias_attr)
+        self.dropout = Dropout(act_dropout)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                              bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.activation = _get_activation(activation)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+            inc_cache, static_cache = None, None
+        else:
+            inc_cache, static_cache = cache
+            tgt, inc_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
+                                            inc_cache)
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if static_cache is not None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask,
+                                  static_cache)
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt if cache is None else (tgt, (inc_cache, static_cache))
+
+    def gen_cache(self, memory):
+        inc = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(
+            memory, type=MultiHeadAttention.StaticCache)
+        return inc, static
+
+
+class TransformerDecoder(Layer):
+    """transformer.py:895."""
+
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        self.layers = LayerList(
+            [decoder_layer] +
+            [copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        output = tgt
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, memory, tgt_mask, memory_mask)
+            else:
+                output, c = mod(output, memory, tgt_mask, memory_mask,
+                                cache[i])
+                new_caches.append(c)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip=False):
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            caches = list(zip(*caches))
+        return caches
+
+
+class Transformer(Layer):
+    """transformer.py:983 — full encoder-decoder."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers,
+                                              enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr,
+                bias_attr)
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers,
+                                              dec_norm)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        output = self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                              memory_mask=memory_mask)
+        return output
+
+    def generate_square_subsequent_mask(self, length):
+        """Additive causal mask [length, length] (transformer.py:1080)."""
+        import paddle_tpu as pt
+        import numpy as np
+        m = np.triu(np.full((length, length), -np.inf, dtype=np.float32), 1)
+        return pt.to_tensor(m)

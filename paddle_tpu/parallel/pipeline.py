@@ -23,17 +23,13 @@ TPU-native design — two schedules behind one API:
 """
 from __future__ import annotations
 
-import functools
-
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import Layer, LayerList
-from .mesh import P, get_mesh
+from .mesh import get_mesh
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
-           "pipeline_train_step", "LocalPipelineRunner"]
+           "LocalPipelineRunner"]
 
 
 class LayerDesc:
@@ -164,8 +160,7 @@ class PipelineLayer(Layer):
 
     def forward(self, x):
         for stage in self.stages:
-            for layer in stage:
-                x = layer(x)
+            x = stage(x)
         return x
 
     def loss(self, out, label):
@@ -176,17 +171,10 @@ class _Stage(LayerList):
     """One pipeline stage: sequential block list with a real forward (the
     stacked-stage SPMD schedule calls it as the uniform stage function)."""
 
-    def append(self, layer):
-        super().append(layer)
-        return self
-
     def forward(self, x):
         for layer in self._sub_layers.values():
             x = layer(x)
         return x
-
-    def __iter__(self):
-        return iter(self._sub_layers.values())
 
 
 class _SharedWrapper(Layer):
@@ -225,21 +213,3 @@ class LocalPipelineRunner:
             self.optimizer.step()
             self.optimizer.clear_grad()
         return total / num_microbatches
-
-
-def pipeline_train_step(pipe: PipelineLayer, optimizer, mesh, loss_fn=None,
-                        num_microbatches=None, donate=True):
-    """Build the GSPMD 1F1B-wave train step.
-
-    Strategy: stack per-stage params along a leading 'stage' dim (all stages
-    must be structurally identical, which `LayerDesc` segmentation of a
-    uniform transformer gives — the reference makes the same uniformity
-    assumption for interleave). shard the stage dim over the pp axis and run
-    microbatches through a lax.scan whose carry ring-permutes activations to
-    the next stage. Startup/cooldown bubbles fall out of the scan naturally
-    (stage s computes garbage for ticks < s; masked out of the loss).
-
-    Returns (step_fn, params, opt_state).
-    """
-    raise NotImplementedError(
-        "landing with the stage-stacked scan in parallel/pp_schedule.py")
