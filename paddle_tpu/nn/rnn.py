@@ -333,7 +333,8 @@ class _RNNBase(Layer):
             init_per = [None] * (L * D)
         else:
             # paddle shape: each state [L*D, B, H]
-            sts = initial_states if isinstance(initial_states, tuple) \
+            sts = tuple(initial_states) \
+                if isinstance(initial_states, (tuple, list)) \
                 else (initial_states,)
             init_per = []
             for i in range(L * D):

@@ -31,8 +31,6 @@ def _convert_attention_mask(attn_mask, dtype):
     (transformer.py:36 _convert_attention_mask)."""
     if attn_mask is None:
         return None
-    from .. import ops as _ops  # noqa: F401
-    import paddle_tpu as pt
     if str(attn_mask.dtype) in ("bool", "paddle.bool"):
         return dispatch(
             lambda m: jnp.where(m, jnp.zeros([], dtype),
@@ -69,18 +67,21 @@ class MultiHeadAttention(Layer):
         return x.reshape([B, S, self.num_heads, self.head_dim])
 
     def gen_cache(self, key, value=None, type=None):
-        if type == MultiHeadAttention.StaticCache or (
-                value is not None and type is None):
-            if value is None:
-                k = self._split_heads(self.k_proj(key))
-                v = self._split_heads(self.v_proj(key))
-                return self.StaticCache(k, v)
-            return self.StaticCache(key, value)
-        # incremental decode cache seeded empty
+        """Reference transformer.py:88 gen_cache: type=StaticCache projects
+        the (encoder) key once; (key, value) pair seeds an incremental
+        Cache; key alone seeds an empty incremental Cache."""
+        if type == MultiHeadAttention.StaticCache:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(key))
+            return self.StaticCache(k, v)
+        if value is not None:
+            return self.Cache(key, value)
+        # empty incremental decode cache in the layer's compute dtype
         import paddle_tpu as pt
         B = key.shape[0]
-        k = pt.zeros([B, 0, self.num_heads, self.head_dim], dtype="float32")
-        v = pt.zeros([B, 0, self.num_heads, self.head_dim], dtype="float32")
+        dt = str(self.k_proj.weight.dtype)
+        k = pt.zeros([B, 0, self.num_heads, self.head_dim], dtype=dt)
+        v = pt.zeros([B, 0, self.num_heads, self.head_dim], dtype=dt)
         return self.Cache(k, v)
 
     def forward(self, query, key=None, value=None, attn_mask=None,
@@ -100,11 +101,12 @@ class MultiHeadAttention(Layer):
                 cache = self.Cache(k, v)
 
         mask = _convert_attention_mask(attn_mask, jnp.float32)
-        if self.need_weights or mask is not None:
+        if self.need_weights:
             out, weights = self._attn_with_weights(q, k, v, mask)
         else:
             out = F.scaled_dot_product_attention(
-                q, k, v, dropout_p=self.dropout, training=self.training)
+                q, k, v, attn_mask=mask, dropout_p=self.dropout,
+                training=self.training)
             weights = None
         B, S = out.shape[0], out.shape[1]
         out = out.reshape([B, S, self.embed_dim])
@@ -138,7 +140,10 @@ class MultiHeadAttention(Layer):
 
 
 def _get_activation(name):
-    return {"relu": F.relu, "gelu": F.gelu}.get(name, F.relu)
+    fn = getattr(F, name, None)
+    if fn is None:
+        raise ValueError(f"unknown activation {name!r}")
+    return fn
 
 
 class TransformerEncoderLayer(Layer):

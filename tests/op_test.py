@@ -1,0 +1,151 @@
+"""OpTest — numpy-oracle operator test harness.
+
+Mirrors the reference's single most load-bearing fixture
+(python/paddle/fluid/tests/unittests/eager_op_test.py:313 OpTest):
+each case declares an op, inputs, and a numpy reference; `check_output`
+runs the op through BOTH execution modes — eager (tape-recording
+dispatch) and the jitted functional path (`jax.jit` over raw arrays,
+the static-graph analogue) — and compares each against the oracle.
+`check_grad` compares tape-analytic gradients against central finite
+differences, like the reference's check_grad (:1937).
+"""
+import numpy as np
+
+import jax
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import unwrap
+
+
+class OpTest:
+    """Subclass and define setup() assigning:
+      self.op       — callable taking Tensors (e.g. pt.add)
+      self.inputs   — dict name → np.ndarray (positional order preserved)
+      self.attrs    — dict of keyword attrs (default {})
+      self.outputs  — np.ndarray or tuple of arrays: the numpy oracle
+    """
+
+    atol = 1e-5
+    rtol = 1e-5
+    grad_eps = 1e-3
+    grad_atol = 5e-3
+    grad_rtol = 5e-3
+
+    def setup(self):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ helpers
+    def _prep(self):
+        self.attrs = {}
+        self.setup()
+        if not hasattr(self, "attrs"):
+            self.attrs = {}
+
+    def _run_eager(self):
+        tensors = [pt.to_tensor(v) for v in self.inputs.values()]
+        out = self.op(*tensors, **self.attrs)
+        return out
+
+    def _run_jit(self):
+        """Static-mode analogue: trace the op over raw jax arrays."""
+        vals = [unwrap(pt.to_tensor(v)) for v in self.inputs.values()]
+
+        def fn(*args):
+            outs = self.op(*[pt.to_tensor(a) for a in args], **self.attrs)
+            return jax.tree_util.tree_map(
+                unwrap, outs, is_leaf=lambda x: isinstance(x, pt.Tensor))
+
+        return jax.jit(fn)(*vals)
+
+    @staticmethod
+    def _flat(out):
+        if isinstance(out, (tuple, list)):
+            return [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+                    for o in out]
+        return [np.asarray(out.numpy() if hasattr(out, "numpy") else out)]
+
+    # ------------------------------------------------------------ checks
+    def check_output(self, atol=None, rtol=None):
+        self._prep()
+        refs = self.outputs if isinstance(self.outputs, (tuple, list)) \
+            else (self.outputs,)
+        atol = atol or self.atol
+        rtol = rtol or self.rtol
+        got_eager = self._flat(self._run_eager())
+        got_jit = self._flat(self._run_jit())
+        assert len(got_eager) >= len(refs), (
+            f"{self.op}: produced {len(got_eager)} outputs, oracle has "
+            f"{len(refs)}")
+        for i, ref in enumerate(refs):
+            np.testing.assert_allclose(
+                got_eager[i], ref, atol=atol, rtol=rtol,
+                err_msg=f"eager output {i} mismatch for {self.op}")
+            np.testing.assert_allclose(
+                got_jit[i], ref, atol=atol, rtol=rtol,
+                err_msg=f"jit output {i} mismatch for {self.op}")
+
+    def check_grad(self, inputs_to_check=None, output_index=0, eps=None,
+                   atol=None, rtol=None):
+        """Analytic (tape) vs central finite-difference gradients of
+        sum(op(x) * W) for fixed random W (reference check_grad pattern)."""
+        self._prep()
+        eps = eps or self.grad_eps
+        atol = atol or self.grad_atol
+        rtol = rtol or self.grad_rtol
+        names = list(self.inputs.keys())
+        inputs_to_check = inputs_to_check or [
+            n for n in names
+            if np.issubdtype(np.asarray(self.inputs[n]).dtype, np.floating)]
+
+        def scalar_from(arrs, weight):
+            tensors = [pt.to_tensor(a) for a in arrs]
+            for t, n in zip(tensors, names):
+                if n in inputs_to_check:
+                    t.stop_gradient = False
+            out = self.op(*tensors, **self.attrs)
+            if isinstance(out, (tuple, list)):
+                out = out[output_index]
+            s = (out * pt.to_tensor(weight.astype(np.float64)
+                                    .astype(str(out.dtype)))).sum()
+            return s, tensors
+
+        # analytic
+        arrs = [np.asarray(v, dtype=np.float64).astype(np.float32)
+                if np.issubdtype(np.asarray(v).dtype, np.floating)
+                else np.asarray(v) for v in self.inputs.values()]
+        probe = self.op(*[pt.to_tensor(a) for a in arrs], **self.attrs)
+        if isinstance(probe, (tuple, list)):
+            probe = probe[output_index]
+        rng = np.random.RandomState(0)
+        weight = rng.uniform(0.5, 1.5, size=probe.shape).astype(np.float32)
+
+        s, tensors = scalar_from(arrs, weight)
+        s.backward()
+        analytic = {}
+        for t, n in zip(tensors, names):
+            if n in inputs_to_check:
+                assert t.grad is not None, f"no grad for input {n}"
+                analytic[n] = np.asarray(t.grad.numpy(), dtype=np.float64)
+
+        # numeric central difference
+        for idx, n in enumerate(names):
+            if n not in inputs_to_check:
+                continue
+            # ascontiguousarray: an F-ordered input (e.g. built from a
+            # transpose) would make reshape(-1) below return copies, not
+            # views, silently dropping the accumulated numeric grads
+            base = np.ascontiguousarray(arrs[idx], dtype=np.float64)
+            num = np.zeros_like(base)
+            flat = base.reshape(-1)
+            gnum = num.reshape(-1)
+            for j in range(flat.size):
+                for sgn in (+1, -1):
+                    pert = flat.copy()
+                    pert[j] += sgn * eps
+                    trial = list(arrs)
+                    trial[idx] = pert.reshape(base.shape).astype(np.float32)
+                    val, _ = scalar_from(trial, weight)
+                    gnum[j] += sgn * float(val.numpy())
+                gnum[j] /= (2 * eps)
+            np.testing.assert_allclose(
+                analytic[n], num, atol=atol, rtol=rtol,
+                err_msg=f"grad mismatch for input {n} of {self.op}")

@@ -195,3 +195,28 @@ class TestTransformer:
         out.sum().backward()
         for n, p in enc.named_parameters():
             assert p.grad is not None, n
+
+
+class TestReviewRegressions:
+    def test_lstm_list_initial_states(self):
+        lstm = nn.LSTM(4, 8)
+        x = pt.randn([2, 5, 4])
+        h0, c0 = pt.zeros([1, 2, 8]), pt.zeros([1, 2, 8])
+        out_t, _ = lstm(x, (h0, c0))
+        out_l, _ = lstm(x, [h0, c0])
+        np.testing.assert_allclose(out_t.numpy(), out_l.numpy())
+
+    def test_gen_cache_seeded_with_kv(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = pt.randn([1, 3, 8])
+        k0 = pt.randn([1, 2, 2, 4])
+        v0 = pt.randn([1, 2, 2, 4])
+        cache = mha.gen_cache(k0, v0)
+        assert isinstance(cache, nn.MultiHeadAttention.Cache)
+        o, cache2 = mha(x, x, x, None, cache)
+        assert list(cache2.k.shape) == [1, 5, 2, 4]
+
+    def test_unknown_activation_raises(self):
+        with pytest.raises(ValueError):
+            nn.TransformerEncoderLayer(8, 2, 16, activation="not_an_act")
