@@ -42,9 +42,15 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     if name in ("parallel", "io", "hapi", "metric", "profiler", "vision",
-                "models", "utils", "incubate", "static", "device", "runtime"):
+                "models", "utils", "incubate", "static", "device", "runtime",
+                "inference", "sparse", "text", "audio", "geometric",
+                "quantization", "distribution"):
         import importlib
-        mod = importlib.import_module(f".{name}", __name__)
+        try:
+            mod = importlib.import_module(f".{name}", __name__)
+        except ImportError as e:  # keep hasattr() working for probes
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

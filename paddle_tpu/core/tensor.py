@@ -81,6 +81,18 @@ def _is_diff(a):
     return isinstance(a, Tensor) and not a.stop_gradient
 
 
+# Static-graph recorder hook (installed by paddle_tpu.static.graph). When a
+# program_guard is active and any arg is a symbolic Variable, the op is
+# recorded into the Program instead of executed (reference: OpDesc appended to
+# BlockDesc by the static API, paddle/fluid/framework/framework.proto).
+_static_recorder = None
+
+
+def set_static_recorder(recorder):
+    global _static_recorder
+    _static_recorder = recorder
+
+
 def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
     """Execute ``fn(*values, **kwargs)``; record a vjp node if needed.
 
@@ -90,6 +102,8 @@ def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
     raw value(s) otherwise (so the same code path serves jit tracing).
     """
     global _amp
+    if _static_recorder is not None and _static_recorder.active(args):
+        return _static_recorder.record(fn, args, kwargs, name=name)
     any_tensor = any(isinstance(a, Tensor) for a in args)
     vals = [unwrap(a) for a in args]
     # AMP O1: cast inputs by white/black list membership (amp/__init__.py)

@@ -1,0 +1,147 @@
+"""paddle_tpu.inference — the deployment/inference engine.
+
+Reference analogue: paddle_infer C++/Python API (Config, create_predictor,
+Predictor with zero-copy handles) over AnalysisPredictor
+(paddle/fluid/inference/api/analysis_predictor.h:95, ~102K LoC of IR passes
+and subgraph engines). TPU-native: the artifact is a serialized StableHLO
+program (see export.py); "analysis + optimization" is XLA's own compiler, so
+the predictor is a thin, fast handle around a deserialized jax.export call
+with host-pinned input/output buffers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .export import (ExportedProgram, export_layer, export_program,  # noqa: F401
+                     load_exported)
+
+__all__ = ["Config", "Predictor", "create_predictor", "Tensor",
+           "export_program", "export_layer", "load_exported",
+           "convert_to_mixed_precision", "get_version"]
+
+
+def get_version():
+    import paddle_tpu
+    return paddle_tpu.__version__
+
+
+class Config:
+    """paddle_infer.Config parity: model path + execution switches. GPU/IR
+    switches are accepted for API compatibility; device choice maps to the
+    JAX default device and optimization is always on (XLA)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # paddle convention: Config("path/prefix") or Config(model, params)
+        self._path_prefix = None
+        if prog_file is not None:
+            self._path_prefix = str(prog_file)
+            for suf in (".pdmodel", ".pdiparams"):
+                if self._path_prefix.endswith(suf):
+                    self._path_prefix = self._path_prefix[: -len(suf)]
+        self._use_tpu = True
+        self._memory_pool_mb = None
+        self._enable_profile = False
+
+    def set_model(self, prog_file, params_file=None):
+        self.__init__(prog_file, params_file)
+
+    def model_dir(self):
+        return self._path_prefix
+
+    def prog_file(self):
+        return (self._path_prefix or "") + ".pdmodel"
+
+    # accepted-for-parity switches --------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def summary(self):
+        return {"model": self.prog_file(), "backend": "xla"}
+
+
+class Tensor:
+    """Zero-copy style IO handle (paddle_infer.Tensor parity)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, data):
+        self._value = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    def __init__(self, config):
+        self.config = config
+        prog, feeds, fetches = load_exported(config._path_prefix)
+        self._prog = prog
+        self._inputs = {n: Tensor(n) for n in feeds}
+        self._outputs = {n: Tensor(n) for n in fetches}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_input_tensor(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def get_output_tensor(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Either positional list of np arrays (returns list) or via handles."""
+        if inputs is not None:
+            outs = self._prog(*inputs)
+            return [np.asarray(o) for o in outs]
+        vals = [self._inputs[n]._value for n in self._inputs]
+        outs = self._prog(*vals)
+        flat = outs if isinstance(outs, (list, tuple)) else [outs]
+        for t, v in zip(self._outputs.values(), flat):
+            t._value = np.asarray(v)
+        return True
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(src_prefix, dst_prefix, mixed_precision="bf16",
+                               backend=None, **kwargs):
+    """Re-export an inference archive with inputs/constants cast to bf16/fp16
+    (reference: paddle.inference.convert_to_mixed_precision)."""
+    raise NotImplementedError(
+        "re-export the source program under paddle_tpu.amp.auto_cast "
+        "instead; StableHLO archives are precision-final")

@@ -1,0 +1,141 @@
+"""StableHLO export/import: the serialization format of the inference engine.
+
+Reference analogue: paddle.static.save_inference_model writes __model__
+(ProgramDesc protobuf) + params; AnalysisPredictor reloads and optimizes it
+(paddle/fluid/inference/api/analysis_predictor.cc). TPU-native: the artifact
+is a `jax.export` archive — StableHLO serialized with multi-platform
+(cpu+tpu) lowering, weights baked as constants — plus a JSON meta sidecar.
+XLA replays the role of the 253-pass analysis pipeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+from jax import export as jax_export
+import jax.numpy as jnp
+import numpy as np
+
+_PLATFORMS = ("cpu", "tpu")
+
+
+def _spec_aval(spec, scope=None):
+    """InputSpec → aval; dynamic dims (None/-1) become jax.export symbolic
+    dimensions so the archive serves any batch size (reference: -1 dims in
+    save_inference_model feed targets)."""
+    from ..core.dtype import convert_dtype
+    dims = list(spec.shape)
+    if not any(d is None or d == -1 for d in dims):
+        return spec.to_aval()
+    names = []
+    sym_src = []
+    for i, d in enumerate(dims):
+        if d is None or d == -1:
+            sym_src.append(f"_dyn{i}")
+        else:
+            sym_src.append(str(int(d)))
+    shape = jax_export.symbolic_shape(",".join(sym_src), scope=scope)
+    return jax.ShapeDtypeStruct(tuple(shape), convert_dtype(spec.dtype))
+
+
+def _export_fn(fn, example_avals):
+    jitted = jax.jit(fn)
+    try:
+        return jax_export.export(jitted, platforms=_PLATFORMS)(*example_avals)
+    except Exception:
+        # some primitives lack multi-platform lowering; fall back to native
+        return jax_export.export(jitted)(*example_avals)
+
+
+def _write(path_prefix, exported, feed_names, fetch_names, feed_specs):
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)), exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    meta = {
+        "format": "paddle_tpu-stablehlo-v1",
+        "feed_names": feed_names,
+        "fetch_names": fetch_names,
+        "feed_specs": feed_specs,
+    }
+    with open(path_prefix + ".pdmeta", "w") as f:
+        json.dump(meta, f)
+
+
+def export_program(path_prefix, program, feed_names, fetch_names, scope):
+    """Export a static Program's inference function (weights from scope)."""
+    from ..static import _program_infer_fn
+    fn = _program_infer_fn(program, feed_names, fetch_names, scope)
+    avals = [program.global_block.vars[n]._value for n in feed_names]
+    exported = _export_fn(fn, avals)
+    specs = [{"name": n, "shape": [int(d) for d in a.shape],
+              "dtype": str(a.dtype)} for n, a in zip(feed_names, avals)]
+    _write(path_prefix, exported, feed_names, fetch_names, specs)
+
+
+def export_layer(path_prefix, layer, input_spec):
+    """Export an eager Layer (jit.save path): params baked as constants."""
+    from ..jit import functional_call
+
+    params = layer.raw_params()
+    buffers = {n: b._value for n, b in layer.named_buffers()}
+    # eval() recurses into sublayers; snapshot every flag so export can't
+    # leave dropout/BN sublayers stuck in eval mode mid-training
+    modules = [layer] + [m for _, m in getattr(layer, "named_sublayers",
+                                               lambda: [])()]
+    was_training = [(m, m.training) for m in modules]
+    layer.eval()
+
+    def fn(*inputs):
+        return functional_call(layer, params, *inputs, buffers=buffers or None)
+
+    avals = []
+    feed_names = []
+    sym_scope = jax_export.SymbolicScope()
+    for i, spec in enumerate(input_spec):
+        if hasattr(spec, "to_aval"):
+            avals.append(_spec_aval(spec, scope=sym_scope))
+            feed_names.append(spec.name or f"input_{i}")
+        else:  # a concrete example array/tensor
+            v = np.asarray(getattr(spec, "numpy", lambda: spec)())
+            avals.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+            feed_names.append(f"input_{i}")
+    try:
+        exported = _export_fn(fn, avals)
+    finally:
+        for m, flag in was_training:
+            m.training = flag
+    specs = [{"name": n,
+              "shape": [int(d) if isinstance(d, int) else -1
+                        for d in a.shape],
+              "dtype": str(a.dtype)} for n, a in zip(feed_names, avals)]
+    _write(path_prefix, exported, feed_names, ["output_0"], specs)
+
+
+class ExportedProgram:
+    """Callable handle over a deserialized jax.export archive."""
+
+    def __init__(self, exported, meta):
+        self._exported = exported
+        self.meta = meta
+        self.feed_names = meta["feed_names"]
+        self.fetch_names = meta["fetch_names"]
+        self.feed_specs = meta["feed_specs"]
+
+    def __call__(self, *inputs):
+        vals = [jnp.asarray(np.asarray(x)) for x in inputs]
+        out = self._exported.call(*vals)
+        return out
+
+    def run(self, feed):
+        vals = [feed[n] for n in self.feed_names]
+        return self(*vals)
+
+
+def load_exported(path_prefix):
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdmeta") as f:
+        meta = json.load(f)
+    prog = ExportedProgram(exported, meta)
+    return prog, prog.feed_names, prog.fetch_names

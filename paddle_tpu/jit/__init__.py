@@ -170,12 +170,23 @@ def train_step_fn(layer, loss_fn, optimizer, donate=True):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: persist params + StableHLO export when possible."""
+    """paddle.jit.save parity: persist params; with input_spec also export a
+    StableHLO inference archive loadable by paddle_tpu.inference."""
     from ..io.save_load import save as _save
     state = layer.state_dict() if hasattr(layer, "state_dict") else layer
     _save(state, path + ".pdparams")
+    if input_spec is not None and hasattr(layer, "raw_params"):
+        from ..inference.export import export_layer
+        export_layer(path, layer, input_spec)
 
 
 def load(path, **configs):
+    """Returns a callable ExportedProgram when a StableHLO archive exists at
+    ``path`` (jit.save with input_spec); otherwise the pickled state dict."""
+    import os
+    if os.path.exists(path + ".pdmodel"):
+        from ..inference.export import load_exported
+        prog, _, _ = load_exported(path)
+        return prog
     from ..io.save_load import load as _load
     return _load(path + ".pdparams")

@@ -71,8 +71,9 @@ class MultiHeadAttention(Layer):
         the (encoder) key once; (key, value) pair seeds an incremental
         Cache; key alone seeds an empty incremental Cache."""
         if type == MultiHeadAttention.StaticCache:
+            value = key if value is None else value
             k = self._split_heads(self.k_proj(key))
-            v = self._split_heads(self.v_proj(key))
+            v = self._split_heads(self.v_proj(value))
             return self.StaticCache(k, v)
         if value is not None:
             return self.Cache(key, value)

@@ -90,6 +90,27 @@ class Optimizer:
             for name, v in new_leaf.items():
                 self._opt_state[name][k] = v
 
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """paddle parity: backward + apply. In static-graph mode registers
+        the train spec on the program; Executor.run then compiles one XLA
+        train step (forward+grads+update) per feed signature."""
+        from ..static.graph import Variable as StaticVar
+        if isinstance(loss, StaticVar):
+            from .. import static as st
+            prog = st.default_main_program()
+            pg = st.append_backward(loss, parameter_list=parameter_list,
+                                    no_grad_set=no_grad_set)
+            # restrict training to the requested subset: the compiled train
+            # step differentiates/updates exactly these names
+            pnames = [p.name for p, _ in pg]
+            prog._train_spec = (self, loss.name, pnames)
+            prog._version += 1
+            return [], pg
+        loss.backward()
+        self.step()
+        return [], []
+
     def _wd_for(self, p):
         wd = self._weight_decay
         if getattr(p, "no_weight_decay", False):

@@ -1,0 +1,284 @@
+"""paddle_tpu.static — the define-and-run (static graph) API.
+
+Reference analogue: python/paddle/static (Program/Executor/program_guard/
+data/InputSpec, save/load_inference_model) over ProgramDesc + C++
+InterpreterCore (SURVEY.md L2/L4/L6). TPU-native: a Program records the
+JAX callables the eager ops would run; Executor jit-replays them as one XLA
+program; inference export is StableHLO via jax.export (see
+paddle_tpu.inference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype as to_jax_dtype
+from ..utils import unique_name
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
+                    default_startup_program, in_static_build, program_guard)
+from . import nn  # noqa: F401
+
+__all__ = [
+    "Program", "Variable", "Executor", "Scope", "global_scope",
+    "scope_guard", "program_guard", "default_main_program",
+    "default_startup_program", "data", "InputSpec", "create_parameter",
+    "create_global_var", "append_backward", "gradients",
+    "save_inference_model", "load_inference_model", "save", "load",
+    "CompiledProgram", "cpu_places", "device_guard", "name_scope", "nn",
+]
+
+
+class InputSpec:
+    """Shape/dtype/name spec (python/paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(list(tensor.shape), str(tensor.dtype), name)
+
+    def to_aval(self):
+        shape = [1 if (d is None or d == -1) else int(d) for d in self.shape]
+        return jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(self.dtype))
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed Variable in the default main program."""
+    prog = default_main_program()
+    spec = InputSpec(shape, dtype, name)
+    v = prog.global_block.create_var(spec.to_aval(), name=name, is_data=True)
+    if name not in prog._feed_names:
+        prog._feed_names.append(name)
+    return v
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Persistable trainable var; its init op is recorded into the startup
+    program (paddle.static.create_parameter)."""
+    from ..nn import initializer as I
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierUniform())
+    name = name or unique_name.generate("param")
+    value = init(list(shape), dtype)
+    from ..core.tensor import unwrap
+    raw = unwrap(value)
+
+    main, startup = default_main_program(), default_startup_program()
+    v = main.global_block.create_var(
+        jax.ShapeDtypeStruct(raw.shape, raw.dtype), name=name,
+        persistable=True, trainable=True)
+    if name not in main._param_names:
+        main._param_names.append(name)
+    from .graph import OpDesc
+    startup.global_block.append_op(OpDesc(
+        "fill_parameter", lambda _v=raw: _v, [], {}, [name],
+        jax.tree_util.tree_structure(raw)))
+    sv = startup.global_block.create_var(
+        jax.ShapeDtypeStruct(raw.shape, raw.dtype), name=name,
+        persistable=True)
+    startup.global_block.vars[name] = sv
+    startup._version += 1
+    return v
+
+
+def create_global_var(shape, value, dtype="float32", persistable=True,
+                      name=None):
+    name = name or unique_name.generate("global_var")
+    raw = jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+    main = default_main_program()
+    v = main.global_block.create_var(
+        jax.ShapeDtypeStruct(raw.shape, raw.dtype), name=name,
+        persistable=persistable)
+    global_scope()._vars[name] = raw
+    return v
+
+
+def run_startup(exe=None, startup_program=None):
+    """Materialize startup-program vars into the scope (Executor.run(startup))."""
+    prog = startup_program or default_startup_program()
+    from .executor import _replay
+    env = _replay(list(prog.global_block.ops), {})
+    scope = global_scope()
+    for n, v in env.items():
+        var = prog.global_block.vars.get(n)
+        if var is None or var.persistable:
+            scope._vars[n] = jnp.asarray(v)
+
+
+# Executor.run(startup_program) path: startup programs have no feeds/fetches,
+# so Executor.run special-cases them via this hook.
+_orig_exe_run = Executor.run
+
+
+def _exe_run(self, program=None, feed=None, fetch_list=None, **kwargs):
+    prog = program or default_main_program()
+    if (not fetch_list and not feed and prog._train_spec is None
+            and any(op.op_type == "fill_parameter"
+                    for op in prog.global_block.ops)):
+        run_startup(self, prog)
+        return []
+    return _orig_exe_run(self, program=program, feed=feed,
+                         fetch_list=fetch_list, **kwargs)
+
+
+Executor.run = _exe_run
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Register grad computation for trainable params; returns
+    [(param_var, grad_var)] (paddle.static.append_backward). The actual
+    jax.grad happens at Executor compile time."""
+    prog = default_main_program()
+    block = prog.global_block
+    if parameter_list:
+        wrt = [p if isinstance(p, str) else p.name for p in parameter_list]
+    else:
+        wrt = list(prog._param_names)
+    if no_grad_set:
+        drop = {p if isinstance(p, str) else p.name for p in no_grad_set}
+        wrt = [n for n in wrt if n not in drop]
+    gnames = [f"{n}@GRAD" for n in wrt]
+    for n, g in zip(wrt, gnames):
+        src = block.vars[n]
+        block.vars[g] = Variable(src._value, name=g, block=block)
+    prog._grad_requests.append((loss.name, wrt, gnames))
+    prog._version += 1
+    return [(block.vars[n], block.vars[g]) for n, g in zip(wrt, gnames)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients: d(sum(targets))/d(inputs) as new vars."""
+    prog = default_main_program()
+    block = prog.global_block
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = []
+    for t in targets:
+        wrt = [v.name for v in inputs]
+        gnames = [unique_name.generate(f"{n}@GRAD") for n in wrt]
+        for v, g in zip(inputs, gnames):
+            block.vars[g] = Variable(v._value, name=g, block=block)
+        prog._grad_requests.append((t.name, wrt, gnames))
+        outs.extend(block.vars[g] for g in gnames)
+    prog._version += 1
+    return outs
+
+
+def _prune_ops(ops, fetch_names):
+    """Backward slice: keep only ops that contribute to the fetch targets
+    (reference: Program.prune on save_inference_model)."""
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(ops):
+        if any(o in needed for o in op.outputs):
+            kept.append(op)
+            needed.update(i.name for i in op.inputs if isinstance(i, VarRef))
+    return list(reversed(kept))
+
+
+def _program_infer_fn(program, feed_names, fetch_names, scope):
+    """Pure (feed…) -> fetches closure over scope values, for export."""
+    from .executor import _replay
+    ops = _prune_ops(program.global_block.ops, fetch_names)
+    scope_vals = {n: scope._vars[n]
+                  for op in ops for n in
+                  [i.name for i in op.inputs if isinstance(i, VarRef)]
+                  if n in scope._vars}
+
+    def fn(*feed_vals):
+        env = dict(scope_vals)
+        env.update(zip(feed_names, feed_vals))
+        _replay(ops, env)
+        return [env[n] for n in fetch_names]
+
+    return fn
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize an inference function as StableHLO + params
+    (reference: paddle.static.save_inference_model → __model__ ProgramDesc;
+    here the artifact is a jax.export archive consumed by
+    paddle_tpu.inference.create_predictor)."""
+    from ..inference.export import export_program
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    if program is None:
+        owner = getattr(feed_vars[0], "block", None)
+        program = owner.program if owner is not None else default_main_program()
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    export_program(path_prefix, program, [v.name for v in feed_vars],
+                   [v.name for v in fetch_vars], global_scope())
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program_like, feed_names, fetch_names); the returned object
+    is directly callable via Executor.run-compatible predictor."""
+    from ..inference.export import load_exported
+    return load_exported(path_prefix)
+
+
+def save(program, path_prefix):
+    """Persist all persistable vars of ``program`` (paddle.static.save)."""
+    from ..io.save_load import save as _save
+    scope = global_scope()
+    names = [n for n, v in program.global_block.vars.items()
+             if v.persistable and n in scope._vars]
+    _save({n: np.asarray(scope._vars[n]) for n in names},
+          path_prefix + ".pdparams")
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    from ..io.save_load import load as _load
+    state = _load(path_prefix + ".pdparams")
+    scope = global_scope()
+    for n, v in state.items():
+        scope._vars[n] = jnp.asarray(np.asarray(v))
+
+
+class CompiledProgram:
+    """Parity shim: compilation happens in Executor's cache already."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [f"cpu:{i}" for i in range(n)]
+
+
+def xpu_places(device_count=None):
+    return cpu_places(device_count)
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@_ctx.contextmanager
+def name_scope(prefix=None):
+    with unique_name.guard(prefix or ""):
+        yield
+
+
+def set_program_state(program, state_dict):
+    scope = global_scope()
+    for n, v in state_dict.items():
+        scope._vars[n] = jnp.asarray(np.asarray(v))

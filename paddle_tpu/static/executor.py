@@ -1,0 +1,241 @@
+"""Static-graph execution: Scope + Executor over jit-replayed Programs.
+
+Reference analogue: python/paddle/fluid/executor.py:921 (Executor.run →
+_ExecutorCache → StandaloneExecutor) backed by C++ InterpreterCore
+(paddle/fluid/framework/new_executor/interpretercore.h:62). TPU-native
+design: the recorded op list is replayed inside ONE ``jax.jit`` — XLA's
+scheduler replaces InterpreterCore's instruction queue/stream analysis, and
+the whole train step (forward + grads + optimizer update) compiles to a
+single donated XLA program. Results are cached per (program version, feed
+signature, fetch list) like _ExecutorCache.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .graph import Program, Variable, VarRef, default_main_program
+
+
+class _VarHolder:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return np.asarray(self._scope._vars[self._name])
+
+    def set(self, value, place=None):
+        self._scope._vars[self._name] = jnp.asarray(value)
+
+
+class Scope:
+    """Name → value store for persistable vars (paddle::framework::Scope)."""
+
+    def __init__(self):
+        self._vars = {}     # name -> jnp array
+        self._params = {}   # name -> eager Parameter (for write-back interop)
+
+    def var(self, name):
+        return _VarHolder(self, name)
+
+    def find_var(self, name):
+        return _VarHolder(self, name) if name in self._vars else None
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):
+        pass
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def _replay(ops, env):
+    for op in ops:
+        vals = [env[i.name] if isinstance(i, VarRef) else i
+                for i in op.inputs]
+        out = op.fn(*vals, **op.attrs)
+        flat, _ = jax.tree_util.tree_flatten(out)
+        for n, v in zip(op.outputs, flat):
+            env[n] = v
+    return env
+
+
+def _referenced_scope_names(program, scope):
+    names = []
+    for op in program.global_block.ops:
+        for i in op.inputs:
+            if isinstance(i, VarRef) and i.name in scope._vars \
+                    and i.name not in names:
+                names.append(i.name)
+    return names
+
+
+class Executor:
+    """paddle.static.Executor parity; ``place`` is accepted and ignored
+    (device placement is jax's default-device / sharding concern)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+        self._opt_states = {}   # prog cache key -> (opt_state, step_count)
+
+    def close(self):
+        self._cache.clear()
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, scope=None, **kwargs):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        feed_names = sorted(feed.keys())
+        feed_vals = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        feed_sig = tuple((n, v.shape, str(v.dtype))
+                         for n, v in zip(feed_names, feed_vals))
+
+        key = (id(program), program._version, feed_sig, tuple(fetch_names))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(program, scope, feed_names, fetch_names,
+                                  key)
+            self._cache[key] = entry
+        # entry holds the Program strongly so id(program) can't be reused by
+        # a collected-and-reallocated Program hitting a stale cache slot
+        fn, scope_in_names, train, _prog_ref = entry
+
+        scope_vals = {n: scope._vars[n] for n in scope_in_names}
+        if train:
+            opt, loss_name, pnames = program._train_spec
+            # optimizer state is per-program (not per feed-signature): a new
+            # batch shape or fetch list must not reset Adam moments
+            opt_key = id(program)
+            st = self._opt_states.get(opt_key)
+            if st is None:
+                init_fn, _ = opt.functional()
+                pvals = {n: scope._vars[n] for n in pnames}
+                st = (init_fn(pvals), 0)
+            opt_state, step_count = st
+            lr = jnp.asarray(float(opt.get_lr()), jnp.float32)
+            fetches, new_persist, new_opt_state = fn(
+                feed_vals, scope_vals, opt_state,
+                jnp.asarray(step_count + 1, jnp.int32), lr)
+            self._opt_states[opt_key] = (new_opt_state, step_count + 1)
+            sched = getattr(opt, "_learning_rate", None)
+            if hasattr(sched, "step"):
+                sched.step()
+        else:
+            fetches, new_persist = fn(feed_vals, scope_vals)
+
+        for n, v in new_persist.items():
+            scope._vars[n] = v
+            p = scope._params.get(n)
+            if p is not None:
+                p._replace_value(v)
+        if return_numpy:
+            fetches = [np.asarray(v) for v in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    def _compile(self, program, scope, feed_names, fetch_names, key):
+        ops = list(program.global_block.ops)
+        block_vars = program.global_block.vars
+        scope_in_names = _referenced_scope_names(program, scope)
+        persist_out = [n for n in block_vars
+                       if block_vars[n].persistable and n in scope._vars]
+        train = program._train_spec is not None
+        grad_requests = list(program._grad_requests)
+
+        needed_grads = set()
+        for tgt, wrt, gnames in grad_requests:
+            if any(g in fetch_names for g in gnames):
+                needed_grads.update(gnames)
+
+        def build_env(feed_vals, scope_vals):
+            env = dict(scope_vals)
+            env.update(zip(feed_names, feed_vals))
+            return env
+
+        def add_grads(env):
+            for tgt, wrt, gnames in grad_requests:
+                if not any(g in needed_grads for g in gnames):
+                    continue
+                base = dict(env)
+
+                def target_of(wrt_vals, _tgt=tgt, _wrt=wrt, _base=base):
+                    e = dict(_base)
+                    e.update(zip(_wrt, wrt_vals))
+                    _replay(ops, e)
+                    return e[_tgt].sum()
+
+                gs = jax.grad(target_of)([env[n] for n in wrt])
+                for g, gname in zip(gs, gnames):
+                    env[gname] = g
+
+        if not train:
+            def fn(feed_vals, scope_vals):
+                env = build_env(feed_vals, scope_vals)
+                _replay(ops, env)
+                add_grads(env)
+                fetches = [env[n] for n in fetch_names]
+                new_persist = {n: env[n] for n in persist_out}
+                return fetches, new_persist
+
+            return jax.jit(fn), scope_in_names, False, program
+
+        opt, loss_name, pnames = program._train_spec
+        _, update_fn = opt.functional()
+        pnames = list(pnames)
+
+        def train_fn(feed_vals, scope_vals, opt_state, step_i, lr):
+            env = build_env(feed_vals, scope_vals)
+
+            def loss_of(pvals):
+                e = dict(env)
+                e.update(pvals)
+                _replay(ops, e)
+                return e[loss_name].sum(), e
+
+            (loss, env2), grads = jax.value_and_grad(
+                loss_of, has_aux=True)({n: env[n] for n in pnames})
+            if opt._grad_clip is not None:
+                from ..nn.clip import clip_by_global_norm_tree
+                grads, _ = clip_by_global_norm_tree(
+                    grads, opt._grad_clip.clip_norm)
+            pvals = {n: env[n] for n in pnames}
+            new_p, new_state = update_fn(grads, pvals, opt_state, lr=lr,
+                                         step=step_i)
+            env2.update(new_p)
+            for (tgt, wrt, gnames) in grad_requests:
+                for w, gname in zip(wrt, gnames):
+                    if w in grads:
+                        env2[gname] = grads[w]
+            fetches = [env2[n] for n in fetch_names]
+            new_persist = {n: env2[n] for n in persist_out}
+            return fetches, new_persist, new_state
+
+        return (jax.jit(train_fn, donate_argnums=(2,)), scope_in_names,
+                True, program)

@@ -1,0 +1,268 @@
+"""Static graph IR: Program / Variable / OpDesc, built by op interception.
+
+Reference analogue: ProgramDesc/BlockDesc/OpDesc/VarDesc
+(paddle/fluid/framework/framework.proto) populated by the Python static API
+(python/paddle/static). TPU-native design: instead of a protobuf op graph
+interpreted by InterpreterCore, a Program records the exact JAX-traceable
+callables the eager ops would have run, with shapes inferred via
+``jax.eval_shape``; the Executor jit-replays the op list as ONE XLA program
+(paddle_tpu/static/executor.py) — the 253-pass IR optimization layer
+(paddle/fluid/framework/ir/) collapses into XLA's own pipeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import numpy as np
+
+from ..core.tensor import (Parameter, Tensor, set_static_recorder, unwrap,
+                           wrap)
+from ..utils import unique_name
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (VarDesc analog). ``_value`` holds a
+    jax.ShapeDtypeStruct, so shape/dtype introspection and Tensor methods
+    (which route through dispatch and get intercepted) both work."""
+
+    def __init__(self, aval, name=None, persistable=False, trainable=False,
+                 is_data=False, block=None):
+        self._value = aval
+        self.name = name or unique_name.generate("tmp_var")
+        self.persistable = persistable
+        self.trainable = trainable
+        self.is_data = is_data
+        self.block = block
+        self.stop_gradient = not trainable
+        self.grad = None
+        self._node = None
+        self._out_index = 0
+
+    @property
+    def desc(self):
+        return self
+
+    def numpy(self):
+        scope = _find_scope_value(self.name)
+        if scope is not None:
+            return np.asarray(scope)
+        raise RuntimeError(
+            f"Variable {self.name!r} is symbolic; run the program through a "
+            "static.Executor and fetch it instead of calling .numpy()")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={list(self._value.shape)},"
+                f" dtype={self._value.dtype}, persistable={self.persistable})")
+
+
+def _find_scope_value(name):
+    from .executor import global_scope
+    return global_scope()._vars.get(name)
+
+
+class VarRef:
+    """Reference to a named var in the execution environment (vs a literal)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"VarRef({self.name})"
+
+
+class OpDesc:
+    """One recorded op: a JAX-traceable fn + input refs/literals + attrs."""
+
+    __slots__ = ("op_type", "fn", "inputs", "attrs", "outputs", "out_treedef")
+
+    def __init__(self, op_type, fn, inputs, attrs, outputs, out_treedef):
+        self.op_type = op_type
+        self.fn = fn
+        self.inputs = inputs      # list of VarRef | literal (python/np/jnp)
+        self.attrs = attrs        # kwargs dict (static attributes)
+        self.outputs = outputs    # list of output var names
+        self.out_treedef = out_treedef
+
+    def __repr__(self):
+        ins = [i.name if isinstance(i, VarRef) else type(i).__name__
+               for i in self.inputs]
+        return f"{{Op({self.op_type}) inputs={ins} outputs={self.outputs}}}"
+
+
+class Block:
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.ops = []
+        self.vars = {}
+
+    def var(self, name):
+        if name not in self.vars:
+            raise ValueError(f"var {name} not in block {self.idx}")
+        return self.vars[name]
+
+    def create_var(self, aval, name=None, **kwargs):
+        v = Variable(aval, name=name, block=self, **kwargs)
+        self.vars[v.name] = v
+        return v
+
+    def append_op(self, op):
+        self.ops.append(op)
+
+
+class Program:
+    """ProgramDesc analog: blocks of recorded ops + feed/fetch metadata."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.random_seed = 0
+        self._feed_names = []       # data vars, in declaration order
+        self._param_names = []      # persistable trainable vars
+        self._grad_requests = []    # (target_name, [wrt names], [grad names])
+        self._train_spec = None     # (optimizer, loss_name) from minimize()
+        self._version = 0
+
+    @property
+    def global_block(self):
+        return self.blocks[0]
+
+    # paddle parity: method form
+    def current_block(self):
+        return self.blocks[0]
+
+    def all_parameters(self):
+        return [self.global_block.vars[n] for n in self._param_names]
+
+    def list_vars(self):
+        return list(self.global_block.vars.values())
+
+    @property
+    def num_ops(self):
+        return len(self.global_block.ops)
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program()
+        p.blocks[0].ops = list(self.global_block.ops)
+        p.blocks[0].vars = dict(self.global_block.vars)
+        p._feed_names = list(self._feed_names)
+        p._param_names = list(self._param_names)
+        p._grad_requests = [] if for_test else copy.copy(self._grad_requests)
+        p._train_spec = None if for_test else self._train_spec
+        p.random_seed = self.random_seed
+        return p
+
+    def __str__(self):
+        lines = [f"Program(ops={self.num_ops}, feeds={self._feed_names}, "
+                 f"params={self._param_names})"]
+        for op in self.global_block.ops:
+            lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+    __repr__ = __str__
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_depth = 0
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Route op recording into the given programs (paddle.static.program_guard)."""
+    global _default_main, _default_startup, _guard_depth
+    old_main, old_startup = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    _guard_depth += 1
+    _install()
+    try:
+        yield
+    finally:
+        _guard_depth -= 1
+        _default_main, _default_startup = old_main, old_startup
+        if _guard_depth == 0:
+            set_static_recorder(None)
+
+
+def in_static_build():
+    return _guard_depth > 0
+
+
+class _Recorder:
+    """dispatch() hook: records ops touching symbolic Variables."""
+
+    def active(self, args):
+        return _guard_depth > 0 and any(
+            isinstance(a, Variable) for a in args)
+
+    def record(self, fn, args, kwargs, name=None):
+        block = _default_main.global_block
+        inputs, avals = [], []
+        for a in args:
+            if isinstance(a, Variable):
+                inputs.append(VarRef(a.name))
+                avals.append(a._value)
+            elif isinstance(a, Parameter):
+                ref = _intern_parameter(a, block)
+                inputs.append(ref)
+                avals.append(jax.ShapeDtypeStruct(
+                    a._value.shape, a._value.dtype))
+            elif isinstance(a, Tensor):
+                v = unwrap(a)
+                inputs.append(v)
+                avals.append(v)
+            else:
+                inputs.append(a)
+                avals.append(a)
+        out_avals = jax.eval_shape(functools.partial(fn, **kwargs), *avals)
+        flat, treedef = jax.tree_util.tree_flatten(out_avals)
+        op_type = name or getattr(fn, "__name__", "op")
+        out_vars = [block.create_var(av, name=unique_name.generate(op_type))
+                    for av in flat]
+        block.append_op(OpDesc(op_type, fn, inputs, dict(kwargs),
+                               [v.name for v in out_vars], treedef))
+        _default_main._version += 1
+        outs = jax.tree_util.tree_unflatten(treedef, out_vars)
+        return outs
+
+
+def _intern_parameter(param, block):
+    """A concrete Parameter used under program_guard becomes a persistable
+    scope var, so nn.Layer works in static mode and minimize() can find and
+    update the weights (reference: parameters live in the Scope)."""
+    from .executor import global_scope
+    pname = getattr(param, "name", None) or unique_name.generate("param")
+    param.name = pname
+    prog = _default_main
+    if pname not in block.vars:
+        v = Variable(
+            jax.ShapeDtypeStruct(param._value.shape, param._value.dtype),
+            name=pname, persistable=True,
+            trainable=not param.stop_gradient, block=block)
+        block.vars[pname] = v
+        if v.trainable and pname not in prog._param_names:
+            prog._param_names.append(pname)
+        global_scope()._vars[pname] = unwrap(param)
+        global_scope()._params[pname] = param
+    return VarRef(pname)
+
+
+_recorder = _Recorder()
+
+
+def _install():
+    set_static_recorder(_recorder)

@@ -1,0 +1,107 @@
+"""paddle_tpu.static.nn — layer functions for static-graph programs.
+
+Reference analogue: python/paddle/static/nn (fc, embedding, conv2d,
+batch_norm, …). Each creates its parameters via static.create_parameter
+(init recorded into the startup program) and records the compute op into the
+default main program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _F():
+    from ..nn import functional
+    return functional
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from . import create_parameter
+    from ..nn import initializer as I
+    in_dim = int(np.prod(x._value.shape[num_flatten_dims:]))
+    w = create_parameter([in_dim, size], str(x._value.dtype),
+                         default_initializer=weight_attr)
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([size], str(x._value.dtype), is_bias=True,
+                             default_initializer=bias_attr or I.Constant(0.0))
+    F = _F()
+    if len(x._value.shape) > num_flatten_dims + 1:
+        import paddle_tpu as pt
+        lead = list(x._value.shape[:num_flatten_dims])
+        x = pt.reshape(x, lead + [in_dim])
+    out = F.linear(x, w, b)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from . import create_parameter
+    from ..nn import initializer as I
+    w = create_parameter(list(size), dtype,
+                         default_initializer=param_attr or I.Normal(0, 0.02))
+    return _F().embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, data_format="NCHW"):
+    from . import create_parameter
+    from ..nn import initializer as I
+    ks = ([filter_size, filter_size] if isinstance(filter_size, int)
+          else list(filter_size))
+    in_ch = (input._value.shape[1] if data_format == "NCHW"
+             else input._value.shape[-1])
+    w = create_parameter([num_filters, in_ch // groups] + ks,
+                         str(input._value.dtype),
+                         default_initializer=param_attr or I.KaimingUniform())
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], str(input._value.dtype),
+                             is_bias=True,
+                             default_initializer=bias_attr or I.Constant(0.0))
+    return _F().conv2d(input, w, b, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       data_format=data_format)
+
+
+def batch_norm(input, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_format="NCHW", is_test=False):
+    """Static BN: in inference-style static programs runs with the recorded
+    running statistics (created as persistable vars)."""
+    from . import create_parameter, create_global_var
+    from ..nn import initializer as I
+    ch = (input._value.shape[1] if data_format in ("NCHW", "NCL")
+          else input._value.shape[-1])
+    dt = str(input._value.dtype)
+    scale = create_parameter([ch], dt,
+                             default_initializer=param_attr or I.Constant(1.0))
+    bias = create_parameter([ch], dt, is_bias=True,
+                            default_initializer=bias_attr or I.Constant(0.0))
+    mean = create_global_var([ch], 0.0, dt, name=None)
+    var = create_global_var([ch], 1.0, dt, name=None)
+    return _F().batch_norm(input, mean, var, scale, bias, training=False,
+                           momentum=momentum, epsilon=epsilon,
+                           data_format=data_format)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None):
+    from . import create_parameter
+    from ..nn import initializer as I
+    shape = [int(d) for d in input._value.shape[begin_norm_axis:]]
+    dt = str(input._value.dtype)
+    w = create_parameter(shape, dt,
+                         default_initializer=param_attr or I.Constant(1.0)) \
+        if scale else None
+    b = create_parameter(shape, dt, is_bias=True,
+                         default_initializer=bias_attr or I.Constant(0.0)) \
+        if shift else None
+    return _F().layer_norm(input, normalized_shape=shape, weight=w, bias=b,
+                           epsilon=epsilon)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False):
+    return _F().dropout(x, p=dropout_prob, training=not is_test)

@@ -1,0 +1,64 @@
+"""paddle_tpu.utils — framework utilities.
+
+Reference analogue: python/paddle/utils (unique_name, deprecated decorator,
+install_check, cpp_extension custom-op toolchain).
+"""
+from . import unique_name  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Parity decorator (python/paddle/utils/deprecated.py): warn once."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"package {module_name} is required but not installed")
+
+
+def run_check():
+    """Smoke-check the install (reference:
+    python/paddle/utils/install_check.py): tiny train step, and a 2+-device
+    sharded matmul when more than one device is visible."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as pt
+
+    x = pt.to_tensor(np.random.rand(4, 8).astype("float32"))
+    w = pt.Parameter(np.random.rand(8, 2).astype("float32"))
+    y = pt.matmul(x, w)
+    loss = pt.mean(y)
+    loss.backward()
+    assert w.grad is not None and w.grad.shape == [8, 2]
+
+    ndev = jax.local_device_count()
+    if ndev > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("x",))
+        a = jax.device_put(jnp.ones((ndev * 2, 8)),
+                           NamedSharding(mesh, P("x", None)))
+        out = jax.jit(lambda v: (v @ v.T).sum())(a)
+        assert bool(jnp.isfinite(out))
+    print(f"PaddleTPU is installed successfully! "
+          f"({ndev} device(s) available)")

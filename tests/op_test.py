@@ -37,8 +37,6 @@ class OpTest:
     def _prep(self):
         self.attrs = {}
         self.setup()
-        if not hasattr(self, "attrs"):
-            self.attrs = {}
 
     def _run_eager(self):
         tensors = [pt.to_tensor(v) for v in self.inputs.values()]
@@ -68,8 +66,8 @@ class OpTest:
         self._prep()
         refs = self.outputs if isinstance(self.outputs, (tuple, list)) \
             else (self.outputs,)
-        atol = atol or self.atol
-        rtol = rtol or self.rtol
+        atol = self.atol if atol is None else atol
+        rtol = self.rtol if rtol is None else rtol
         got_eager = self._flat(self._run_eager())
         got_jit = self._flat(self._run_jit())
         assert len(got_eager) >= len(refs), (
@@ -89,8 +87,8 @@ class OpTest:
         sum(op(x) * W) for fixed random W (reference check_grad pattern)."""
         self._prep()
         eps = eps or self.grad_eps
-        atol = atol or self.grad_atol
-        rtol = rtol or self.grad_rtol
+        atol = self.grad_atol if atol is None else atol
+        rtol = self.grad_rtol if rtol is None else rtol
         names = list(self.inputs.keys())
         inputs_to_check = inputs_to_check or [
             n for n in names

@@ -1,0 +1,264 @@
+"""Static-graph API + inference engine tests.
+
+Mirrors the reference's static-mode unit tests (Program/Executor feed-fetch,
+append_backward, minimize training, save/load_inference_model) and the
+paddle_infer Predictor API surface.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.static as st
+
+
+def fresh_programs():
+    return st.Program(), st.Program()
+
+
+def test_program_build_and_run():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [4, 3], "float32")
+        y = pt.add(pt.multiply(x, x), x)
+        z = pt.mean(y)
+    assert main.num_ops == 3
+    assert x.shape == [4, 3]
+    exe = st.Executor()
+    xv = np.random.rand(4, 3).astype("float32")
+    (zv,) = exe.run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(zv, (xv * xv + xv).mean(), rtol=1e-6)
+
+
+def test_tensor_methods_record():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [2, 5], "float32")
+        y = (x + 1.0) * 2.0
+        s = y.sum()
+    exe = st.Executor()
+    xv = np.ones((2, 5), np.float32)
+    (sv,) = exe.run(main, feed={"x": xv}, fetch_list=[s])
+    assert float(sv) == pytest.approx(40.0)
+
+
+def test_executor_cache_reuse():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [2, 2], "float32")
+        y = pt.exp(x)
+    exe = st.Executor()
+    exe.run(main, feed={"x": np.zeros((2, 2), np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == 1
+    exe.run(main, feed={"x": np.ones((2, 2), np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == 1  # same signature → cached
+    exe.run(main, feed={"x": np.ones((3, 2), np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == 2  # new shape → new entry
+
+
+def test_static_nn_fc_train_minimize():
+    main, startup = fresh_programs()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 4).astype("float32")
+    w_true = rng.rand(4, 1).astype("float32")
+    ys = xs @ w_true
+    with st.program_guard(main, startup):
+        x = st.data("x", [16, 4], "float32")
+        label = st.data("label", [16, 1], "float32")
+        pred = st.nn.fc(x, 1)
+        loss = pt.mean(pt.square(pred - label))
+        opt = pt.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = st.Executor()
+    exe.run(startup)  # materialize params
+    losses = []
+    for _ in range(200):
+        (lv,) = exe.run(main, feed={"x": xs, "label": ys},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_append_backward_grad_fetch():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [3, 2], "float32")
+        w = st.create_parameter([2, 2], "float32")
+        y = pt.matmul(x, w)
+        loss = pt.sum(y)
+        grads = st.append_backward(loss)
+    exe = st.Executor()
+    exe.run(startup)
+    xv = np.random.rand(3, 2).astype("float32")
+    gname = grads[0][1].name
+    (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gname])
+    # d(sum(x@w))/dw = x^T @ ones
+    np.testing.assert_allclose(gv, xv.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_eager_layer_under_program_guard():
+    """A dygraph nn.Layer works inside program_guard: its concrete params
+    are interned as persistable scope vars (paddle 2.x dual-mode parity)."""
+    main, startup = fresh_programs()
+    layer = pt.nn.Linear(6, 3)
+    with st.program_guard(main, startup):
+        x = st.data("x", [2, 6], "float32")
+        out = layer(x)
+        loss = pt.mean(out)
+        opt = pt.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    assert len(main._param_names) == 2
+    exe = st.Executor()
+    before = layer.weight.numpy().copy()
+    exe.run(main, feed={"x": np.ones((2, 6), np.float32)},
+            fetch_list=["mean_0"] if "mean_0" in main.global_block.vars
+            else [loss])
+    after = layer.weight.numpy()
+    assert not np.allclose(before, after)  # write-back reached eager param
+
+
+def test_program_clone_for_test():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [2, 2], "float32")
+        y = pt.relu(x)
+        loss = pt.mean(y)
+        pt.optimizer.SGD(0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog._train_spec is None
+    assert main._train_spec is not None
+
+
+def test_gradients_api():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [4], "float32")
+        y = pt.sum(pt.square(x))
+        (gx,) = st.gradients(y, x)
+    exe = st.Executor()
+    xv = np.arange(4, dtype=np.float32)
+    (gv,) = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 2 * xv, rtol=1e-6)
+
+
+def test_save_load_inference_model_predictor(tmp_path):
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [1, 4], "float32")
+        out = st.nn.fc(x, 2, activation="relu")
+    exe = st.Executor()
+    exe.run(startup)
+    prefix = str(tmp_path / "model" / "infer")
+    st.save_inference_model(prefix, [x], [out], exe)
+
+    # direct load
+    prog, feeds, fetches = st.load_inference_model(prefix)
+    xv = np.random.rand(1, 4).astype("float32")
+    (ov,) = prog(xv)
+
+    # paddle_infer-style Predictor
+    from paddle_tpu import inference as paddle_infer
+    cfg = paddle_infer.Config(prefix)
+    pred = paddle_infer.create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    pred.run()
+    out_np = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out_np, np.asarray(ov), rtol=1e-5)
+    assert (out_np >= 0).all()
+
+
+def test_jit_save_export_layer(tmp_path):
+    layer = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                             pt.nn.Linear(16, 4))
+    xv = np.random.rand(2, 8).astype("float32")
+    ref = layer(pt.to_tensor(xv)).numpy()
+    prefix = str(tmp_path / "seq")
+    pt.jit.save(layer, prefix,
+                input_spec=[st.InputSpec([2, 8], "float32", "x")])
+    loaded = pt.jit.load(prefix)
+    out = loaded(xv)
+    flat = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(np.asarray(flat), ref, rtol=1e-5)
+
+
+def test_jit_save_dynamic_batch(tmp_path):
+    """Dynamic (-1) batch dim exports symbolically: one archive serves any
+    batch size (reference: -1 feed dims in save_inference_model)."""
+    layer = pt.nn.Linear(8, 3)
+    prefix = str(tmp_path / "dyn")
+    pt.jit.save(layer, prefix,
+                input_spec=[st.InputSpec([-1, 8], "float32", "x")])
+    loaded = pt.jit.load(prefix)
+    for bs in (1, 4, 7):
+        xv = np.random.rand(bs, 8).astype("float32")
+        out = loaded(xv)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        ref = layer(pt.to_tensor(xv)).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_export_restores_sublayer_training(tmp_path):
+    layer = pt.nn.Sequential(pt.nn.Linear(4, 4), pt.nn.Dropout(0.5))
+    layer.train()
+    pt.jit.save(layer, str(tmp_path / "m"),
+                input_spec=[st.InputSpec([2, 4], "float32", "x")])
+    assert layer.training
+    assert all(m.training for _, m in layer.named_sublayers())
+
+
+def test_opt_state_survives_fetch_and_shape_change():
+    """Adam moments must not reset when the fetch list or batch size
+    changes between runs of the same program."""
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [8, 4], "float32")
+        w = st.create_parameter([4, 1], "float32")
+        loss = pt.mean(pt.square(pt.matmul(x, w)))
+        opt = pt.optimizer.Adam(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = st.Executor()
+    exe.run(startup)
+    xv = np.random.rand(8, 4).astype("float32")
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    st0 = exe._opt_states[id(main)][1]
+    exe.run(main, feed={"x": xv}, fetch_list=[loss, "x"])  # new fetch sig
+    assert exe._opt_states[id(main)][1] == st0 + 1  # state continued
+
+
+def test_minimize_parameter_list_freezes_others():
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [4, 2], "float32")
+        w = st.create_parameter([2, 2], "float32", name="w_train")
+        b = st.create_parameter([2], "float32", name="b_frozen",
+                                is_bias=True)
+        loss = pt.mean(pt.square(pt.matmul(x, w) + b + 1.0))
+        pt.optimizer.SGD(0.1).minimize(loss, parameter_list=["w_train"])
+    exe = st.Executor()
+    exe.run(startup)
+    b_before = np.asarray(st.global_scope()._vars["b_frozen"]).copy()
+    w_before = np.asarray(st.global_scope()._vars["w_train"]).copy()
+    exe.run(main, feed={"x": np.ones((4, 2), np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_allclose(
+        np.asarray(st.global_scope()._vars["b_frozen"]), b_before)
+    assert not np.allclose(
+        np.asarray(st.global_scope()._vars["w_train"]), w_before)
+
+
+def test_static_save_load_params(tmp_path):
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [2, 3], "float32")
+        out = st.nn.fc(x, 2)
+    exe = st.Executor()
+    exe.run(startup)
+    pname = main._param_names[0]
+    orig = np.asarray(st.global_scope()._vars[pname]).copy()
+    prefix = str(tmp_path / "ckpt")
+    st.save(main, prefix)
+    st.global_scope()._vars[pname] = np.zeros_like(orig)
+    st.load(main, prefix)
+    np.testing.assert_allclose(
+        np.asarray(st.global_scope()._vars[pname]), orig)
