@@ -1,0 +1,201 @@
+"""paddle.geometric parity: graph message passing + segment ops + sampling.
+
+Reference: python/paddle/geometric/ (math.py segment ops, message_passing/
+send_u_recv & friends, reindex.py, sampling/neighbors.py; CUDA kernels
+paddle/phi/kernels/gpu/graph_send_recv_kernel.cu etc.).
+
+TPU-native design: everything is jax.ops.segment_* — XLA lowers these to
+sorted-scatter which the TPU vectorises; no hand-written gather/scatter
+kernels needed. `sample_neighbors`/`reindex_graph` are host-side graph prep
+(numpy), matching their role as dataloader-adjacent utilities.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap, wrap
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "reindex_heter_graph", "sample_neighbors",
+]
+
+
+def _arr(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(segment_ids, out_size=None):
+    if out_size is not None:
+        return int(out_size)
+    ids = np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+# -- segment ops (reference python/paddle/geometric/math.py) -------------
+
+def segment_sum(data, segment_ids, name=None):
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(segment_ids)
+    return wrap(jax.ops.segment_sum(d, ids, num_segments=n),
+                stop_gradient=False)
+
+
+def segment_mean(data, segment_ids, name=None):
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(segment_ids)
+    s = jax.ops.segment_sum(d, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
+                              num_segments=n)
+    cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
+    return wrap(s / cnt, stop_gradient=False)
+
+
+def _zero_empty(out, ids, n):
+    # empty segments: reference returns 0; jax returns the reduction
+    # identity (+/-inf for floats, INT_MIN/MAX for ints)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids,
+                              num_segments=n)
+    mask = cnt.reshape((-1,) + (1,) * (out.ndim - 1)) > 0
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
+def segment_min(data, segment_ids, name=None):
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(segment_ids)
+    out = jax.ops.segment_min(d, ids, num_segments=n)
+    return wrap(_zero_empty(out, ids, n), stop_gradient=False)
+
+
+def segment_max(data, segment_ids, name=None):
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(segment_ids)
+    out = jax.ops.segment_max(d, ids, num_segments=n)
+    return wrap(_zero_empty(out, ids, n), stop_gradient=False)
+
+
+# -- message passing (reference message_passing/send_recv.py) ------------
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled specially
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _reduce(msg, dst, n, reduce_op):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (msg.ndim - 1))
+    out = _REDUCERS[reduce_op](msg, dst, num_segments=n)
+    if reduce_op in ("min", "max"):
+        out = _zero_empty(out, dst, n)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and segment-reduce onto dst (graph_send_recv kernel)."""
+    xd = _arr(x)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    n = out_size if out_size is not None else xd.shape[0]
+    return wrap(_reduce(xd[src], dst, int(n), reduce_op),
+                stop_gradient=False)
+
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """message = x[src] (op) y[edge]; then segment-reduce onto dst."""
+    xd, yd = _arr(x), _arr(y)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    msg = _MSG_OPS[message_op](xd[src], yd)
+    n = out_size if out_size is not None else xd.shape[0]
+    return wrap(_reduce(msg, dst, int(n), reduce_op), stop_gradient=False)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (graph_send_uv kernel)."""
+    xd, yd = _arr(x), _arr(y)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    return wrap(_MSG_OPS[message_op](xd[src], yd[dst]), stop_gradient=False)
+
+
+# -- graph prep, host-side (reference reindex.py / sampling) -------------
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference
+    python/paddle/geometric/reindex.py reindex_graph)."""
+    xn = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors.numpy() if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cnt = np.asarray(count.numpy() if isinstance(count, Tensor) else count)
+    uniq, first_idx = np.unique(np.concatenate([xn, nb]), return_index=True)
+    # preserve first-appearance order (x nodes first), like the reference
+    order = np.argsort(first_idx)
+    nodes = uniq[order]
+    remap = {int(g): i for i, g in enumerate(nodes)}
+    reindex_src = np.asarray([remap[int(g)] for g in nb], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xn), dtype=np.int64), cnt)
+    return (wrap(jnp.asarray(reindex_src)), wrap(jnp.asarray(reindex_dst)),
+            wrap(jnp.asarray(nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    nbs = [np.asarray(n.numpy() if isinstance(n, Tensor) else n)
+           for n in neighbors]
+    cnts = [np.asarray(c.numpy() if isinstance(c, Tensor) else c)
+            for c in count]
+    src, dst, nodes = reindex_graph(x, np.concatenate(nbs),
+                                    np.concatenate(cnts))
+    return src, dst, nodes
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on a CSC graph (host-side; reference
+    python/paddle/geometric/sampling/neighbors.py)."""
+    r = np.asarray(row.numpy() if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr.numpy() if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes.numpy()
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    e = np.asarray(eids.numpy() if isinstance(eids, Tensor) else eids) \
+        if eids is not None else None
+    rng = np.random.RandomState()
+    out_nb, out_cnt, out_eids = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_nb.append(r[pick])
+        out_cnt.append(len(pick))
+        if return_eids and e is not None:
+            out_eids.append(e[pick])
+    neighbors = np.concatenate(out_nb) if out_nb else np.empty(0, r.dtype)
+    counts = np.asarray(out_cnt, np.int32)
+    if return_eids:
+        ee = np.concatenate(out_eids) if out_eids else np.empty(0)
+        return wrap(jnp.asarray(neighbors)), wrap(jnp.asarray(counts)), \
+            wrap(jnp.asarray(ee))
+    return wrap(jnp.asarray(neighbors)), wrap(jnp.asarray(counts))

@@ -66,10 +66,22 @@ def export_program(path_prefix, program, feed_names, fetch_names, scope):
     """Export a static Program's inference function (weights from scope)."""
     from ..static import _program_infer_fn
     fn = _program_infer_fn(program, feed_names, fetch_names, scope)
-    avals = [program.global_block.vars[n]._value for n in feed_names]
+    # honor dynamic (-1/None) feed dims declared via st.data: export with
+    # symbolic dims, not the placeholder-1 avals baked into the Variable
+    sym_scope = jax_export.SymbolicScope()
+    avals = []
+    for n in feed_names:
+        var = program.global_block.vars[n]
+        spec = getattr(var, "_input_spec", None)
+        if spec is not None:
+            avals.append(_spec_aval(spec, scope=sym_scope))
+        else:
+            avals.append(var._value)
     exported = _export_fn(fn, avals)
-    specs = [{"name": n, "shape": [int(d) for d in a.shape],
-              "dtype": str(a.dtype)} for n, a in zip(feed_names, avals)]
+    specs = []
+    for n, a in zip(feed_names, avals):
+        dims = [d if isinstance(d, int) else -1 for d in a.shape]
+        specs.append({"name": n, "shape": dims, "dtype": str(a.dtype)})
     _write(path_prefix, exported, feed_names, fetch_names, specs)
 
 

@@ -98,7 +98,10 @@ class Optimizer:
         from ..static.graph import Variable as StaticVar
         if isinstance(loss, StaticVar):
             from .. import static as st
-            prog = st.default_main_program()
+            # the loss carries its program: minimize() may legally be called
+            # after the program_guard block exits (reference semantics)
+            prog = loss.block.program if loss.block is not None \
+                else st.default_main_program()
             pg = st.append_backward(loss, parameter_list=parameter_list,
                                     no_grad_set=no_grad_set)
             # restrict training to the requested subset: the compiled train

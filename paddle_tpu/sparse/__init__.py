@@ -1,0 +1,410 @@
+"""paddle.sparse parity: COO/CSR sparse tensors + ops.
+
+Reference: python/paddle/sparse/__init__.py (__all__ at :53),
+paddle/phi/core/sparse_coo_tensor.h / sparse_csr_tensor.h and the phi sparse
+kernels (paddle/phi/kernels/sparse/).
+
+TPU-native design: storage rides `jax.experimental.sparse` (BCOO/BCSR), whose
+ops lower to XLA gather/scatter/segment-sum — the TPU has no sparse MXU path,
+so ops where sparsity buys nothing (elementwise multiply/divide of two
+sparse operands, conv3d) deliberately round-trip through dense XLA ops and
+re-sparsify; that IS the fast path on this hardware. Value-wise unary math,
+add/subtract (index concat + sum_duplicates) and matmul/masked_matmul
+(bcoo_dot_general / bcoo_dot_general_sampled) stay in sparse form.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor, unwrap, wrap
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "sin", "tan", "asin", "atan", "sinh", "tanh",
+    "asinh", "atanh", "sqrt", "square", "log1p", "abs", "pow", "cast", "neg",
+    "deg2rad", "rad2deg", "expm1", "mv", "matmul", "masked_matmul", "addmm",
+    "add", "subtract", "transpose", "multiply", "divide", "coalesce",
+    "is_same_shape", "reshape", "to_sparse_coo", "to_sparse_csr", "to_dense",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return unwrap(x)
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor. Indices are [sparse_ndim, nnz] (reference layout,
+    phi::SparseCooTensor paddle/phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._b = bcoo
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def from_dense(cls, dense, sparse_dim=None):
+        d = _arr(dense)
+        n = sparse_dim if sparse_dim is not None else d.ndim
+        return cls(jsparse.BCOO.fromdense(d, n_dense=d.ndim - n))
+
+    # -- reference accessors --------------------------------------------
+    def indices(self):
+        return wrap(self._b.indices.T)  # [sparse_ndim, nnz]
+
+    def values(self):
+        return wrap(self._b.data, stop_gradient=False)
+
+    def to_dense(self):
+        return wrap(self._b.todense(), stop_gradient=False)
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor.from_dense(self._b.todense())
+
+    @property
+    def shape(self):
+        return list(self._b.shape)
+
+    @property
+    def dtype(self):
+        return self._b.dtype
+
+    @property
+    def ndim(self):
+        return self._b.ndim
+
+    def nnz(self):
+        return int(self._b.nse)
+
+    @property
+    def stop_gradient(self):
+        return True
+
+    def numpy(self):
+        return np.asarray(self._b.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._b.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def _map_values(self, fn, dtype=None):
+        data = fn(self._b.data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        return SparseCooTensor(jsparse.BCOO((data, self._b.indices),
+                                            shape=self._b.shape))
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (2-D, or batched 3-D like the reference).
+    Reference: paddle/phi/core/sparse_csr_tensor.h."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._b = bcsr
+
+    @classmethod
+    def from_dense(cls, dense):
+        d = _arr(dense)
+        if d.ndim not in (2, 3):
+            raise ValueError("SparseCsrTensor supports 2-D/3-D only, got "
+                             f"{d.ndim}-D")
+        if d.ndim == 3:
+            b = jsparse.BCSR.fromdense(d, n_batch=1)
+        else:
+            b = jsparse.BCSR.fromdense(d)
+        return cls(b)
+
+    def crows(self):
+        return wrap(self._b.indptr)
+
+    def cols(self):
+        return wrap(self._b.indices)
+
+    def values(self):
+        return wrap(self._b.data, stop_gradient=False)
+
+    def to_dense(self):
+        return wrap(self._b.todense(), stop_gradient=False)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor.from_dense(self._b.todense(), sparse_dim)
+
+    @property
+    def shape(self):
+        return list(self._b.shape)
+
+    @property
+    def dtype(self):
+        return self._b.dtype
+
+    @property
+    def ndim(self):
+        return self._b.ndim
+
+    def nnz(self):
+        return int(self._b.nse)
+
+    def numpy(self):
+        return np.asarray(self._b.todense())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def _map_values(self, fn, dtype=None):
+        data = fn(self._b.data)
+        if dtype is not None:
+            data = data.astype(dtype)
+        return SparseCsrTensor(jsparse.BCSR(
+            (data, self._b.indices, self._b.indptr), shape=self._b.shape))
+
+
+def _is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+# -- creation ------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build COO from [sparse_ndim, nnz] indices + values.
+    Reference: python/paddle/sparse/creation.py sparse_coo_tensor."""
+    idx = _arr(indices).astype(jnp.int32).T  # -> [nnz, ndim]
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        upper = jnp.max(idx, axis=0) + 1
+        shape = tuple(int(u) for u in np.asarray(upper)) + vals.shape[1:]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(convert_dtype(dtype))
+    return SparseCsrTensor(jsparse.BCSR(
+        (vals, _arr(cols).astype(jnp.int32), _arr(crows).astype(jnp.int32)),
+        shape=tuple(shape)))
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    return SparseCooTensor.from_dense(x, sparse_dim)
+
+
+def to_sparse_csr(x):
+    return SparseCsrTensor.from_dense(x)
+
+
+def to_dense(x):
+    return x.to_dense() if _is_sparse(x) else wrap(_arr(x))
+
+
+# -- unary value math (0 -> 0 preserving; applied to stored values) ------
+
+def _unary(name, fn):
+    def op(x, name=None):
+        if not _is_sparse(x):
+            return wrap(fn(_arr(x)))
+        return x._map_values(fn)
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001 - reference name
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):  # noqa: A001 - reference name
+    return x._map_values(lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    out = x
+    if value_dtype is not None:
+        out = out._map_values(lambda v: v, dtype=convert_dtype(value_dtype))
+    # index_dtype: BCOO/BCSR keep int32 internally; accepted for API parity.
+    return out
+
+
+# -- binary --------------------------------------------------------------
+
+def add(x, y, name=None):
+    """Sparse+sparse via index concat + sum_duplicates (stays sparse)."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        bx, by = x._b, y._b
+        data = jnp.concatenate([bx.data, by.data])
+        idx = jnp.concatenate([bx.indices, by.indices])
+        out = jsparse.BCOO((data, idx), shape=bx.shape).sum_duplicates()
+        return SparseCooTensor(out)
+    if isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor):
+        s = add(x.to_sparse_coo(), y.to_sparse_coo())
+        return s.to_sparse_csr()
+    raise TypeError("sparse.add expects two sparse tensors of the same kind")
+
+
+def subtract(x, y, name=None):
+    return add(x, neg(y))
+
+
+def _dense_binary(x, y, fn):
+    # No sparse advantage on the MXU — dense XLA op, then re-sparsify with
+    # the union sparsity (matches reference elementwise kernel semantics).
+    xd, yd = x.to_dense(), y.to_dense()
+    out = fn(unwrap(xd), unwrap(yd))
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor.from_dense(out)
+    return SparseCooTensor.from_dense(out, x._b.n_sparse)
+
+
+def multiply(x, y, name=None):
+    return _dense_binary(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    return _dense_binary(x, y, lambda a, b: jnp.where(
+        b == 0, jnp.zeros_like(a), a / jnp.where(b == 0, 1, b)))
+
+
+# -- linalg --------------------------------------------------------------
+
+def _to_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._b
+    if isinstance(x, SparseCsrTensor):
+        return x._b.to_bcoo()
+    return None
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (bcoo_dot_general), dense @ sparse likewise,
+    sparse @ sparse -> sparse. Reference: sparse/binary.py matmul."""
+    bx, by = _to_bcoo(x), _to_bcoo(y)
+    if bx is not None and by is None:
+        yd = _arr(y)
+        nb = bx.ndim - 2
+        dn = (((bx.ndim - 1,), (yd.ndim - 2,)),
+              (tuple(range(nb)), tuple(range(nb))))
+        out = jsparse.bcoo_dot_general(bx, yd, dimension_numbers=dn)
+        return wrap(out, stop_gradient=False)
+    if bx is None and by is not None:
+        xd = _arr(x)
+        nb = by.ndim - 2
+        dn = (((by.ndim - 2,), (xd.ndim - 1,)),
+              (tuple(range(nb)), tuple(range(nb))))
+        out = jsparse.bcoo_dot_general(by, xd, dimension_numbers=dn)
+        # result axes: batch..., by_row? -> need transpose of last two
+        out = jnp.swapaxes(out, -1, -2)
+        return wrap(out, stop_gradient=False)
+    if bx is not None and by is not None:
+        out = jnp.matmul(bx.todense(), by.todense())
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor.from_dense(out)
+        return SparseCooTensor.from_dense(out, 2)
+    return wrap(jnp.matmul(_arr(x), _arr(y)), stop_gradient=False)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense x dense) sampled at mask's sparsity — XLA's
+    bcoo_dot_general_sampled (reference: phi sparse masked_matmul_kernel)."""
+    xd, yd = _arr(x), _arr(y)
+    mb = _to_bcoo(mask)
+    dn = (((xd.ndim - 1,), (yd.ndim - 2,)), ((), ()))
+    out = jsparse.bcoo_dot_general_sampled(xd, yd, mb.indices,
+                                           dimension_numbers=dn)
+    res = jsparse.BCOO((out, mb.indices), shape=mb.shape)
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCooTensor(res).to_sparse_csr()
+    return SparseCooTensor(res)
+
+
+def mv(x, vec, name=None):
+    b = _to_bcoo(x)
+    v = _arr(vec)
+    out = jsparse.bcoo_dot_general(
+        b, v, dimension_numbers=(((1,), (0,)), ((), ())))
+    return wrap(out, stop_gradient=False)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    prod = matmul(x, y)
+    pd = prod.to_dense() if _is_sparse(prod) else prod
+    inp = input.to_dense() if _is_sparse(input) else wrap(_arr(input))
+    out = beta * unwrap(inp) + alpha * unwrap(pd)
+    if _is_sparse(input):
+        if isinstance(input, SparseCsrTensor):
+            return SparseCsrTensor.from_dense(out)
+        return SparseCooTensor.from_dense(out, input._b.n_sparse)
+    return wrap(out, stop_gradient=False)
+
+
+# -- shape ---------------------------------------------------------------
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(jsparse.bcoo_transpose(
+            x._b, permutation=tuple(perm)))
+    return SparseCsrTensor.from_dense(
+        jnp.transpose(x._b.todense(), tuple(perm)))
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(s) for s in shape)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(jsparse.bcoo_reshape(
+            x._b.sum_duplicates(), new_sizes=shape))
+    return SparseCsrTensor.from_dense(jnp.reshape(x._b.todense(), shape))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def is_same_shape(x, y):
+    sx = x.shape if _is_sparse(x) else list(_arr(x).shape)
+    sy = y.shape if _is_sparse(y) else list(_arr(y).shape)
+    return sx == sy
+
+
+# dense Tensor bridge methods (reference: paddle.Tensor.to_sparse_coo)
+if not hasattr(Tensor, "to_sparse_coo"):
+    Tensor.to_sparse_coo = lambda self, sparse_dim=None: \
+        SparseCooTensor.from_dense(self, sparse_dim)
+    Tensor.to_sparse_csr = lambda self: SparseCsrTensor.from_dense(self)
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,162 @@
+"""paddle.sparse.nn parity layers.
+
+Reference: python/paddle/sparse/nn/layer/ (activation.py, conv.py, norm.py,
+pooling.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from ...core.tensor import unwrap, wrap
+from .. import SparseCooTensor, _is_sparse
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv3D", "SubmConv3D",
+           "BatchNorm", "SyncBatchNorm", "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class _Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self._subm = subm
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self.weight = self.create_parameter(
+            tuple(kernel_size) + (in_channels // groups, out_channels),
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        fn = F.subm_conv3d if self._subm else F.conv3d
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._dilation, self._groups)
+
+
+class Conv3D(_Conv3D):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_Conv3D):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, key=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) axis of a sparse NDHWC tensor,
+    computed over stored values (reference sparse/nn/layer/norm.py)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self._mean = self.create_buffer("_mean_buf",
+                                        jnp.zeros((num_features,)))
+        self._variance = self.create_buffer("_var_buf",
+                                            jnp.ones((num_features,)))
+
+    def create_buffer(self, name, value):
+        self.register_buffer(name, wrap(value))
+        return getattr(self, name)
+
+    def forward(self, x):
+        sparse_in = _is_sparse(x)
+        vals = unwrap(x.values()) if sparse_in else unwrap(x)
+        flat = vals.reshape(-1, vals.shape[-1])
+        if self.training:
+            mean = flat.mean(0)
+            var = flat.var(0)
+            m = self._momentum  # paddle: running = m*running + (1-m)*batch
+            rm = unwrap(getattr(self, "_mean_buf"))
+            rv = unwrap(getattr(self, "_var_buf"))
+            getattr(self, "_mean_buf").set_value(m * rm + (1 - m) * mean)
+            getattr(self, "_var_buf").set_value(m * rv + (1 - m) * var)
+        else:
+            mean = unwrap(getattr(self, "_mean_buf"))
+            var = unwrap(getattr(self, "_var_buf"))
+        w, b = unwrap(self.weight), unwrap(self.bias)
+        norm = (vals - mean) / jnp.sqrt(var + self._epsilon) * w + b
+        if sparse_in:
+            return x._map_values(lambda v: norm)
+        return wrap(norm, stop_gradient=False)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica stats come free under pjit (XLA computes global batch
+    stats when the batch axis is sharded) — alias of BatchNorm here."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride,
+                            self._padding, self._ceil_mode)

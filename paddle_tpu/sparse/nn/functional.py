@@ -1,0 +1,165 @@
+"""paddle.sparse.nn.functional parity.
+
+Reference: python/paddle/sparse/nn/functional/ (activation.py, conv.py,
+pooling.py, transformer.py attention).
+
+TPU-native notes: activations are value-maps on stored values. conv3d /
+max_pool3d densify and use lax.conv_general_dilated / reduce_window — on TPU
+the MXU conv path beats any gather-based sparse conv at the densities the
+reference targets, and XLA fuses the re-sparsification; SubmConv3D masks the
+output back to the input's sparsity pattern (submanifold semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor, unwrap, wrap
+from .. import (SparseCooTensor, SparseCsrTensor, _arr, _is_sparse)
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "conv3d", "subm_conv3d",
+           "max_pool3d", "attention"]
+
+
+def relu(x, name=None):
+    return x._map_values(jax.nn.relu) if _is_sparse(x) else \
+        wrap(jax.nn.relu(_arr(x)))
+
+
+def relu6(x, name=None):
+    return x._map_values(jax.nn.relu6) if _is_sparse(x) else \
+        wrap(jax.nn.relu6(_arr(x)))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    fn = lambda v: jax.nn.leaky_relu(v, negative_slope)
+    return x._map_values(fn) if _is_sparse(x) else wrap(fn(_arr(x)))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over stored values only (reference:
+    phi sparse softmax_kernel — softmax over the nonzeros of each row)."""
+    if isinstance(x, SparseCsrTensor):
+        b = x._b
+        if b.ndim != 2:
+            d = b.todense()
+            mask = d != 0
+            e = jnp.where(mask, d, -jnp.inf)
+            s = jax.nn.softmax(e, axis=-1)
+            return SparseCsrTensor.from_dense(jnp.where(mask, s, 0))
+        # per-row segment softmax on values
+        nrows = b.shape[0]
+        row_id = jnp.cumsum(
+            jnp.zeros(b.nse, jnp.int32).at[b.indptr[1:-1]].add(1))
+        vals = b.data
+        rmax = jax.ops.segment_max(vals, row_id, num_segments=nrows)
+        ex = jnp.exp(vals - rmax[row_id])
+        rsum = jax.ops.segment_sum(ex, row_id, num_segments=nrows)
+        out = ex / rsum[row_id]
+        return SparseCsrTensor(jsparse.BCSR((out, b.indices, b.indptr),
+                                            shape=b.shape))
+    if isinstance(x, SparseCooTensor):
+        out = softmax(x.to_sparse_csr(), axis)
+        return SparseCooTensor.from_dense(out._b.todense(), x._b.n_sparse)
+    return wrap(jax.nn.softmax(_arr(x), axis=axis))
+
+
+def _dense_ndhwc(x):
+    if isinstance(x, SparseCooTensor):
+        return x._b.todense()
+    return _arr(x)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse conv3d: densify -> MXU conv -> re-sparsify.
+    Reference: python/paddle/sparse/nn/functional/conv.py conv3d (phi
+    sparse conv3d gather-gemm-scatter kernel)."""
+    d = _dense_ndhwc(x)
+    w = _arr(weight)  # [kd, kh, kw, in/groups, out]
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(dilation, int):
+        dilation = (dilation,) * 3
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    elif padding and isinstance(padding[0], int):
+        padding = [(p, p) for p in padding]
+    dn = lax.conv_dimension_numbers(d.shape, w.shape,
+                                    ("NDHWC", "DHWIO", "NDHWC"))
+    out = lax.conv_general_dilated(
+        d.astype(w.dtype), w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + _arr(bias)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor.from_dense(out, 4)  # sparse over N,D,H,W
+    return wrap(out, stop_gradient=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: output sparsity == input sparsity (reference
+    SubmConv3D). Computed dense, then masked to input's active sites."""
+    out = conv3d(x, weight, bias, stride, padding, dilation, groups,
+                 data_format)
+    if isinstance(x, SparseCooTensor) and isinstance(out, SparseCooTensor):
+        d = x._b.todense()
+        active = jnp.any(d != 0, axis=-1, keepdims=True)
+        od = out._b.todense()
+        if od.shape[:4] == active.shape[:4]:
+            od = jnp.where(active, od, 0)
+            return SparseCooTensor.from_dense(od, 4)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    d = _dense_ndhwc(x)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * 3
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    window = (1,) + tuple(kernel_size) + (1,)
+    strides = (1,) + tuple(stride) + (1,)
+    pads = [(0, 0)] + list(padding) + [(0, 0)]
+    out = lax.reduce_window(d, -jnp.inf, lax.max, window, strides, pads)
+    out = jnp.where(jnp.isneginf(out), 0, out)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor.from_dense(out, 4)
+    return wrap(out, stop_gradient=False)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-mask attention (reference:
+    python/paddle/sparse/nn/functional/transformer.py attention — softmax of
+    QK^T restricted to a CSR mask's sparsity, then @ V)."""
+    q, k, v = _arr(query), _arr(key), _arr(value)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    md = sparse_mask.to_dense() if _is_sparse(sparse_mask) else sparse_mask
+    md = unwrap(md) if isinstance(md, Tensor) else jnp.asarray(md)
+    md = jnp.broadcast_to(md.reshape((-1,) + md.shape[-2:])
+                          .reshape(scores.shape[0], -1, *md.shape[-2:])
+                          if md.ndim > 2 else md, scores.shape)
+    neg = jnp.asarray(-1e9, scores.dtype)
+    if key_padding_mask is not None:
+        kp = unwrap(key_padding_mask) if isinstance(key_padding_mask, Tensor)\
+            else jnp.asarray(key_padding_mask)
+        scores = scores + jnp.where(kp[:, None, None, :] != 0, 0., neg)
+    if attn_mask is not None:
+        am = unwrap(attn_mask) if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+        scores = scores + jnp.where(am != 0, 0., neg)
+    scores = jnp.where(md != 0, scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(md != 0, probs, 0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return wrap(out, stop_gradient=False)

@@ -56,6 +56,7 @@ def data(name, shape, dtype="float32", lod_level=0):
     prog = default_main_program()
     spec = InputSpec(shape, dtype, name)
     v = prog.global_block.create_var(spec.to_aval(), name=name, is_data=True)
+    v._input_spec = spec  # original (possibly dynamic) dims, for export
     if name not in prog._feed_names:
         prog._feed_names.append(name)
     return v
@@ -138,7 +139,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     """Register grad computation for trainable params; returns
     [(param_var, grad_var)] (paddle.static.append_backward). The actual
     jax.grad happens at Executor compile time."""
-    prog = default_main_program()
+    prog = loss.block.program if getattr(loss, "block", None) is not None \
+        else default_main_program()
     block = prog.global_block
     if parameter_list:
         wrt = [p if isinstance(p, str) else p.name for p in parameter_list]
@@ -158,9 +160,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """paddle.static.gradients: d(sum(targets))/d(inputs) as new vars."""
-    prog = default_main_program()
-    block = prog.global_block
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    t0 = targets[0]
+    prog = t0.block.program if getattr(t0, "block", None) is not None \
+        else default_main_program()
+    block = prog.global_block
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     outs = []
     for t in targets:
@@ -274,7 +278,16 @@ def device_guard(device=None):
 
 @_ctx.contextmanager
 def name_scope(prefix=None):
-    with unique_name.guard(prefix or ""):
+    # Prefix names but keep the *global* uniqueness counters (reference
+    # fluid name_scope semantics): two models built under the same scope
+    # prefix must not collide in the process-global scope.
+    outer = unique_name._generator
+
+    class _Prefixed(unique_name.UniqueNameGenerator):
+        def __call__(self, key):
+            return outer(f"{prefix or ''}{key}")
+
+    with unique_name.guard(_Prefixed()):
         yield
 
 

@@ -188,7 +188,13 @@ class Executor:
                 def target_of(wrt_vals, _tgt=tgt, _wrt=wrt, _base=base):
                     e = dict(_base)
                     e.update(zip(_wrt, wrt_vals))
-                    _replay(ops, e)
+                    # treat wrt vars as leaves: skip their producing ops so
+                    # the injected values aren't overwritten by the replay
+                    # (grad w.r.t. an intermediate would otherwise be 0)
+                    wset = set(_wrt)
+                    live = [op for op in ops
+                            if not (set(op.outputs) & wset)]
+                    _replay(live, e)
                     return e[_tgt].sum()
 
                 gs = jax.grad(target_of)([env[n] for n in wrt])
@@ -201,7 +207,8 @@ class Executor:
                 _replay(ops, env)
                 add_grads(env)
                 fetches = [env[n] for n in fetch_names]
-                new_persist = {n: env[n] for n in persist_out}
+                # a persistable var no op references never enters env
+                new_persist = {n: env[n] for n in persist_out if n in env}
                 return fetches, new_persist
 
             return jax.jit(fn), scope_in_names, False, program
@@ -234,7 +241,7 @@ class Executor:
                     if w in grads:
                         env2[gname] = grads[w]
             fetches = [env2[n] for n in fetch_names]
-            new_persist = {n: env2[n] for n in persist_out}
+            new_persist = {n: env2[n] for n in persist_out if n in env2}
             return fetches, new_persist, new_state
 
         return (jax.jit(train_fn, donate_argnums=(2,)), scope_in_names,

@@ -1,0 +1,297 @@
+"""Sparse + geometric package tests (numpy-oracle style, reference test
+pattern: python/paddle/fluid/tests/unittests/test_sparse_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse
+
+
+def _rand_sparse(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    d = rng.randn(*shape).astype(np.float32)
+    mask = rng.rand(*shape) < density
+    return d * mask
+
+
+class TestSparseCreation:
+    def test_coo_roundtrip(self):
+        d = _rand_sparse((4, 5))
+        s = sparse.to_sparse_coo(pt.to_tensor(d))
+        assert s.is_sparse_coo()
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+        assert s.shape == [4, 5]
+
+    def test_coo_from_indices(self):
+        idx = [[0, 1, 2], [1, 2, 0]]
+        vals = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        dense = np.zeros((3, 3), np.float32)
+        dense[0, 1], dense[1, 2], dense[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+        assert s.nnz() == 3
+        np.testing.assert_array_equal(s.indices().numpy(), np.array(idx))
+
+    def test_csr_roundtrip(self):
+        d = _rand_sparse((4, 6))
+        s = sparse.to_sparse_csr(pt.to_tensor(d))
+        assert s.is_sparse_csr()
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+
+    def test_csr_from_parts(self):
+        crows = [0, 2, 3, 5]
+        cols = [1, 3, 2, 0, 1]
+        vals = [1., 2., 3., 4., 5.]
+        s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+        dense = np.zeros((3, 4), np.float32)
+        dense[0, 1], dense[0, 3], dense[1, 2] = 1, 2, 3
+        dense[2, 0], dense[2, 1] = 4, 5
+        np.testing.assert_allclose(s.to_dense().numpy(), dense)
+
+    def test_coo_csr_convert(self):
+        d = _rand_sparse((5, 5))
+        s = sparse.to_sparse_coo(pt.to_tensor(d))
+        np.testing.assert_allclose(s.to_sparse_csr().to_dense().numpy(), d)
+
+    def test_coalesce(self):
+        idx = [[0, 0], [1, 1]]
+        s = sparse.sparse_coo_tensor(idx, [1.0, 2.0], shape=[2, 2])
+        c = s.coalesce()
+        np.testing.assert_allclose(c.to_dense().numpy()[0, 1], 3.0)
+
+
+class TestSparseMath:
+    @pytest.mark.parametrize("name", ["sin", "tanh", "sqrt", "square",
+                                      "log1p", "abs", "neg", "expm1"])
+    def test_unary(self, name):
+        d = np.abs(_rand_sparse((4, 5))) * 0.5  # sqrt/log1p domain
+        s = sparse.to_sparse_coo(pt.to_tensor(d))
+        out = getattr(sparse, name)(s)
+        ref = getattr(np, {"neg": "negative", "abs": "abs"}.get(name, name))(d)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-6)
+
+    def test_add_subtract(self):
+        a, b = _rand_sparse((3, 4), seed=1), _rand_sparse((3, 4), seed=2)
+        sa = sparse.to_sparse_coo(pt.to_tensor(a))
+        sb = sparse.to_sparse_coo(pt.to_tensor(b))
+        np.testing.assert_allclose(
+            sparse.add(sa, sb).to_dense().numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(
+            sparse.subtract(sa, sb).to_dense().numpy(), a - b, rtol=1e-6)
+
+    def test_multiply_divide(self):
+        a, b = _rand_sparse((3, 4), seed=1), _rand_sparse((3, 4), seed=2)
+        sa = sparse.to_sparse_coo(pt.to_tensor(a))
+        sb = sparse.to_sparse_coo(pt.to_tensor(b))
+        np.testing.assert_allclose(
+            sparse.multiply(sa, sb).to_dense().numpy(), a * b, rtol=1e-6)
+        got = sparse.divide(sa, sb).to_dense().numpy()
+        ref = np.where(b == 0, 0, a / np.where(b == 0, 1, b))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_matmul_sparse_dense(self):
+        a = _rand_sparse((4, 6))
+        b = np.random.RandomState(3).randn(6, 5).astype(np.float32)
+        s = sparse.to_sparse_coo(pt.to_tensor(a))
+        out = sparse.matmul(s, pt.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+    def test_matmul_csr(self):
+        a = _rand_sparse((4, 6))
+        b = np.random.RandomState(3).randn(6, 5).astype(np.float32)
+        s = sparse.to_sparse_csr(pt.to_tensor(a))
+        out = sparse.matmul(s, pt.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(6, 4).astype(np.float32)
+        mask = _rand_sparse((4, 4), seed=5)
+        sm = sparse.to_sparse_coo(pt.to_tensor(mask))
+        out = sparse.masked_matmul(pt.to_tensor(a), pt.to_tensor(b), sm)
+        ref = (a @ b) * (mask != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_mv(self):
+        a = _rand_sparse((4, 6))
+        v = np.random.RandomState(1).randn(6).astype(np.float32)
+        s = sparse.to_sparse_coo(pt.to_tensor(a))
+        np.testing.assert_allclose(sparse.mv(s, pt.to_tensor(v)).numpy(),
+                                   a @ v, rtol=1e-5, atol=1e-5)
+
+    def test_addmm(self):
+        rng = np.random.RandomState(0)
+        inp = rng.randn(4, 5).astype(np.float32)
+        x = _rand_sparse((4, 6))
+        y = rng.randn(6, 5).astype(np.float32)
+        s = sparse.to_sparse_coo(pt.to_tensor(x))
+        out = sparse.addmm(pt.to_tensor(inp), s, pt.to_tensor(y),
+                           beta=2.0, alpha=0.5)
+        np.testing.assert_allclose(out.numpy(), 2.0 * inp + 0.5 * (x @ y),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_transpose_reshape(self):
+        d = _rand_sparse((3, 4))
+        s = sparse.to_sparse_coo(pt.to_tensor(d))
+        np.testing.assert_allclose(
+            sparse.transpose(s, [1, 0]).to_dense().numpy(), d.T)
+        np.testing.assert_allclose(
+            sparse.reshape(s, [4, 3]).to_dense().numpy(), d.reshape(4, 3))
+
+    def test_is_same_shape_cast(self):
+        d = _rand_sparse((3, 4))
+        s = sparse.to_sparse_coo(pt.to_tensor(d))
+        assert sparse.is_same_shape(s, s)
+        c = sparse.cast(s, value_dtype="float16")
+        assert c.dtype == np.float16
+
+
+class TestSparseNN:
+    def test_relu_softmax(self):
+        d = _rand_sparse((4, 5))
+        s = sparse.to_sparse_csr(pt.to_tensor(d))
+        r = sparse.nn.functional.relu(s)
+        np.testing.assert_allclose(r.to_dense().numpy(), np.maximum(d, 0))
+        sm = sparse.nn.functional.softmax(s)
+        got = sm.to_dense().numpy()
+        for i in range(4):
+            nz = d[i] != 0
+            if nz.any():
+                e = np.exp(d[i][nz] - d[i][nz].max())
+                np.testing.assert_allclose(got[i][nz], e / e.sum(),
+                                           rtol=1e-5)
+
+    def test_conv3d(self):
+        rng = np.random.RandomState(0)
+        x = _rand_sparse((1, 4, 4, 4, 2), density=0.4)
+        s = sparse.to_sparse_coo(pt.to_tensor(x), 4)
+        conv = sparse.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(s)
+        assert out.shape == [1, 4, 4, 4, 3]
+
+    def test_subm_conv3d_preserves_sparsity(self):
+        x = _rand_sparse((1, 4, 4, 4, 2), density=0.3)
+        s = sparse.to_sparse_coo(pt.to_tensor(x), 4)
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(s).to_dense().numpy()
+        inactive = ~np.any(x != 0, axis=-1)
+        assert np.all(out[inactive] == 0)
+
+    def test_maxpool3d(self):
+        x = np.abs(_rand_sparse((1, 4, 4, 4, 2), density=0.5))
+        s = sparse.to_sparse_coo(pt.to_tensor(x), 4)
+        out = sparse.nn.MaxPool3D(kernel_size=2, stride=2)(s)
+        assert out.shape == [1, 2, 2, 2, 2]
+        import jax.numpy as jnp  # oracle via strided max
+        ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(2, 4, 6))
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-6)
+
+    def test_batchnorm(self):
+        x = _rand_sparse((1, 4, 4, 4, 3), density=0.5)
+        s = sparse.to_sparse_coo(pt.to_tensor(x), 4)
+        bn = sparse.nn.BatchNorm(3)
+        bn.train()
+        out = bn(s)
+        assert out.shape == [1, 4, 4, 4, 3]
+
+    def test_attention(self):
+        rng = np.random.RandomState(0)
+        q = rng.randn(2, 2, 8, 4).astype(np.float32)
+        k = rng.randn(2, 2, 8, 4).astype(np.float32)
+        v = rng.randn(2, 2, 8, 4).astype(np.float32)
+        mask = (rng.rand(8, 8) < 0.6).astype(np.float32)
+        mask[:, 0] = 1  # every query attends to something
+        sm = sparse.to_sparse_csr(pt.to_tensor(mask))
+        out = sparse.nn.functional.attention(
+            pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v), sm)
+        assert out.shape == [2, 2, 8, 4]
+        # oracle
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(4)
+        scores = np.where(mask != 0, scores, -1e9)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        p = np.where(mask != 0, p, 0)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        from paddle_tpu import geometric as G
+        data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+        ids = np.array([0, 0, 1, 2])
+        d, i = pt.to_tensor(data), pt.to_tensor(ids)
+        np.testing.assert_allclose(G.segment_sum(d, i).numpy(),
+                                   [[4, 6], [5, 6], [7, 8]])
+        np.testing.assert_allclose(G.segment_mean(d, i).numpy(),
+                                   [[2, 3], [5, 6], [7, 8]])
+        np.testing.assert_allclose(G.segment_min(d, i).numpy(),
+                                   [[1, 2], [5, 6], [7, 8]])
+        np.testing.assert_allclose(G.segment_max(d, i).numpy(),
+                                   [[3, 4], [5, 6], [7, 8]])
+
+    def test_send_u_recv(self):
+        from paddle_tpu import geometric as G
+        x = np.array([[0., 2., 3.], [1., 4., 5.], [2., 6., 7.]], np.float32)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        out = G.send_u_recv(pt.to_tensor(x), pt.to_tensor(src),
+                            pt.to_tensor(dst), reduce_op="sum")
+        ref = np.zeros_like(x)
+        for s, d in zip(src, dst):
+            ref[d] += x[s]
+        np.testing.assert_allclose(out.numpy(), ref)
+        out = G.send_u_recv(pt.to_tensor(x), pt.to_tensor(src),
+                            pt.to_tensor(dst), reduce_op="max")
+        ref = np.full_like(x, -np.inf)
+        for s, d in zip(src, dst):
+            ref[d] = np.maximum(ref[d], x[s])
+        ref[np.isinf(ref)] = 0
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_send_ue_recv_and_uv(self):
+        from paddle_tpu import geometric as G
+        x = np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+        y = np.array([[10., 10.], [20., 20.], [30., 30.], [40., 40.]],
+                     np.float32)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 0, 2])
+        out = G.send_ue_recv(pt.to_tensor(x), pt.to_tensor(y),
+                             pt.to_tensor(src), pt.to_tensor(dst),
+                             message_op="add", reduce_op="sum")
+        ref = np.zeros_like(x)
+        for e, (s, d) in enumerate(zip(src, dst)):
+            ref[d] += x[s] + y[e]
+        np.testing.assert_allclose(out.numpy(), ref)
+        out = G.send_uv(pt.to_tensor(x), pt.to_tensor(x), pt.to_tensor(src),
+                        pt.to_tensor(dst), message_op="mul")
+        np.testing.assert_allclose(out.numpy(), x[src] * x[dst])
+
+    def test_reindex_graph(self):
+        from paddle_tpu import geometric as G
+        x = np.array([0, 5, 8])
+        neighbors = np.array([8, 9, 0, 4, 7, 6, 7], dtype=np.int64)
+        count = np.array([2, 3, 2], dtype=np.int32)
+        src, dst, nodes = G.reindex_graph(pt.to_tensor(x),
+                                          pt.to_tensor(neighbors),
+                                          pt.to_tensor(count))
+        nodes_np = nodes.numpy()
+        assert list(nodes_np[:3]) == [0, 5, 8]
+        # src maps each neighbor to its local id
+        np.testing.assert_array_equal(
+            nodes_np[src.numpy()], neighbors)
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+
+    def test_sample_neighbors(self):
+        from paddle_tpu import geometric as G
+        # CSC: col j's rows at row[colptr[j]:colptr[j+1]]
+        row = np.array([1, 2, 3, 0, 2, 0, 1], dtype=np.int64)
+        colptr = np.array([0, 3, 5, 7, 7], dtype=np.int64)
+        nodes = np.array([0, 1, 3], dtype=np.int64)
+        nb, cnt = G.sample_neighbors(pt.to_tensor(row), pt.to_tensor(colptr),
+                                     pt.to_tensor(nodes), sample_size=2)
+        cnt_np = cnt.numpy()
+        assert cnt_np[0] == 2 and cnt_np[1] == 2 and cnt_np[2] == 0
+        assert set(nb.numpy()[:2]).issubset({1, 2, 3})

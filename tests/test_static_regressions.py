@@ -1,0 +1,111 @@
+"""Regression tests for static-graph/executor/export/sparse findings
+(code-review round: persist-var KeyError, grad-wrt-intermediate, minimize
+outside program_guard, dynamic-batch export, name_scope uniqueness, sparse
+BatchNorm running stats, int segment_max empty segments)."""
+import os.path as osp
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import static as st
+from paddle_tpu.ops.registry import OPS
+
+
+def test_unused_persistable_var_does_not_crash():
+    main, sp = st.Program(), st.Program()
+    with st.program_guard(main, sp):
+        x = st.data("x", [2, 3])
+        w_used = st.create_parameter([3, 2], name="w_used_reg")
+        st.create_parameter([2, 2], name="w_unused_reg")
+        y = OPS["matmul"](x, w_used)
+    exe = st.Executor()
+    exe.run(sp)
+    out = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                  fetch_list=[y])
+    assert out[0].shape == (2, 2)
+
+
+def test_gradients_wrt_intermediate():
+    prog, sprog = st.Program(), st.Program()
+    with st.program_guard(prog, sprog):
+        x = st.data("x2", [4])
+        y = OPS["square"](x)
+        z = OPS["sum"](y)
+        (gy,) = st.gradients(z, y)
+    exe = st.Executor()
+    exe.run(sprog)
+    out = exe.run(prog, feed={"x2": np.arange(4, dtype=np.float32)},
+                  fetch_list=[gy])
+    np.testing.assert_allclose(out[0], np.ones(4))
+
+
+def test_minimize_outside_program_guard():
+    prog, sprog = st.Program(), st.Program()
+    with st.program_guard(prog, sprog):
+        x = st.data("x3", [2, 3])
+        w = st.create_parameter([3, 1], name="w3_min_reg")
+        pred = OPS["matmul"](x, w)
+        loss = OPS["mean"](pred)
+    opt = pt.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)  # after the guard exits
+    assert prog._train_spec is not None
+    exe = st.Executor()
+    exe.run(sprog)
+    before = np.asarray(st.global_scope()._vars["w3_min_reg"]).copy()
+    exe.run(prog, feed={"x3": np.ones((2, 3), np.float32)},
+            fetch_list=[loss])
+    after = np.asarray(st.global_scope()._vars["w3_min_reg"])
+    assert not np.allclose(before, after)
+
+
+def test_dynamic_batch_export():
+    prog, sprog = st.Program(), st.Program()
+    with st.program_guard(prog, sprog):
+        x = st.data("x4", [-1, 4])
+        w = st.create_parameter([4, 2], name="w4_exp_reg")
+        y = OPS["matmul"](x, w)
+    exe = st.Executor()
+    exe.run(sprog)
+    d = tempfile.mkdtemp()
+    st.save_inference_model(osp.join(d, "m"), [x], [y], exe, program=prog)
+    from paddle_tpu import inference as infer
+    cfg = infer.Config(osp.join(d, "m") + ".pdmodel",
+                       osp.join(d, "m") + ".pdmeta")
+    pred = infer.create_predictor(cfg)
+    ih = pred.get_input_handle(pred.get_input_names()[0])
+    ih.copy_from_cpu(np.ones((8, 4), np.float32))
+    pred.run()
+    oh = pred.get_output_handle(pred.get_output_names()[0])
+    assert oh.copy_to_cpu().shape == (8, 2)
+
+
+def test_name_scope_no_collision():
+    pa, sa = st.Program(), st.Program()
+    with st.program_guard(pa, sa):
+        with st.name_scope("blk"):
+            st.nn.fc(st.data("xa", [1, 2]), 2)
+    pb, sb = st.Program(), st.Program()
+    with st.program_guard(pb, sb):
+        with st.name_scope("blk"):
+            st.nn.fc(st.data("xb", [1, 2]), 2)
+    assert not (set(pa._param_names) & set(pb._param_names))
+
+
+def test_sparse_batchnorm_running_stats():
+    from paddle_tpu import sparse
+    x = np.random.RandomState(0).randn(1, 2, 2, 2, 3).astype(np.float32) \
+        * 2 + 10
+    s = sparse.to_sparse_coo(pt.to_tensor(x), 4)
+    bn = sparse.nn.BatchNorm(3, momentum=0.0)  # running <- batch directly
+    bn.train()
+    bn(s)
+    rm = np.asarray(bn._mean_buf.numpy())
+    assert abs(rm.mean() - 10) < 2
+
+
+def test_int_segment_max_empty_segment():
+    from paddle_tpu import geometric as G
+    out = G.segment_max(pt.to_tensor(np.array([5, 7, 9], np.int32)),
+                        pt.to_tensor(np.array([0, 0, 2])))
+    np.testing.assert_array_equal(out.numpy(), [7, 0, 9])
