@@ -1,0 +1,9 @@
+"""paddle.audio parity: signal-processing functional + feature layers.
+
+Reference: python/paddle/audio/ (functional/functional.py, window.py,
+features/layers.py).
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+
+__all__ = ["functional", "features"]

@@ -1,0 +1,120 @@
+"""paddle.audio.features parity: Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py. STFT is framing +
+windowed rfft in jnp — XLA turns the batch of FFTs into one fused kernel,
+which is the TPU-idiomatic version of the reference's paddle.signal.stft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap, wrap
+from ..nn.layer import Layer
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft(x, n_fft, hop_length, win, center, pad_mode):
+    """x: [..., T] -> complex [..., n_fft//2+1, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]  # [frames, n_fft]
+    frames = x[..., idx]                                # [..., frames, n_fft]
+    spec = jnp.fft.rfft(frames * win, axis=-1)          # [..., frames, bins]
+    return jnp.swapaxes(spec, -1, -2)                   # [..., bins, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.win_length = win_length or n_fft
+        self.hop_length = hop_length or self.win_length // 4
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = unwrap(F.get_window(window, self.win_length, dtype=dtype))
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self.register_buffer("window", wrap(w))
+
+    def forward(self, x):
+        xv = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+        spec = _stft(xv, self.n_fft, self.hop_length, unwrap(self.window),
+                     self.center, self.pad_mode)
+        mag = jnp.abs(spec)
+        if self.power == 1.0:
+            out = mag
+        elif self.power == 2.0:
+            out = mag * mag
+        else:
+            out = mag ** self.power
+        return wrap(out, stop_gradient=False)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        fb = unwrap(F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                           htk, norm, dtype))
+        self.register_buffer("fbank_matrix", wrap(fb))
+
+    def forward(self, x):
+        spec = unwrap(self._spectrogram(x))
+        mel = jnp.matmul(unwrap(self.fbank_matrix), spec)
+        return wrap(mel, stop_gradient=False)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        dct = unwrap(F.create_dct(n_mfcc, n_mels, dtype=dtype))
+        self.register_buffer("dct_matrix", wrap(dct))
+
+    def forward(self, x):
+        logmel = unwrap(self._log_melspectrogram(x))
+        # [..., n_mels, frames] x [n_mels, n_mfcc] -> [..., n_mfcc, frames]
+        out = jnp.einsum("...mf,mc->...cf", logmel,
+                         unwrap(self.dct_matrix))
+        return wrap(out, stop_gradient=False)

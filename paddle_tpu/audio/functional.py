@@ -1,0 +1,205 @@
+"""paddle.audio.functional parity (mel scales, fbank, dct, windows, dB).
+
+Reference: python/paddle/audio/functional/functional.py:22-355 and
+window.py:328 (get_window). Math follows the slaney/librosa conventions the
+reference uses; everything is jnp so it fuses into jitted feature pipelines.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap, wrap
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _val(x):
+    return unwrap(x) if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk=False):
+    f = _val(freq)
+    is_tensor = isinstance(freq, Tensor)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + jnp.asarray(f) / 700.0)
+        return wrap(out) if is_tensor else float(out)
+    # slaney: linear below 1 kHz, log above
+    f = jnp.asarray(f, jnp.float32)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    mels = jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+    return wrap(mels) if is_tensor else float(mels)
+
+
+def mel_to_hz(mel, htk=False):
+    m = _val(mel)
+    is_tensor = isinstance(mel, Tensor)
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        return wrap(out) if is_tensor else float(out)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    freqs = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return wrap(freqs) if is_tensor else float(freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = _val(hz_to_mel(f_min, htk))
+    high = _val(hz_to_mel(f_max, htk))
+    mels = jnp.linspace(low, high, n_mels)
+    return wrap(unwrap(mel_to_hz(wrap(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return wrap(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = unwrap(fft_frequencies(sr, n_fft))
+    melfreqs = unwrap(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]   # [n_mels+2, n_bins]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        n = jnp.sum(jnp.abs(weights) ** norm, axis=1,
+                    keepdims=True) ** (1.0 / norm)
+        weights = weights / jnp.where(n == 0, 1, n)
+    return wrap(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) with amin floor + top_db clipping (reference
+    functional.py:259)."""
+    s = _val(spect)
+    s = jnp.asarray(s)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return wrap(log_spec) if isinstance(spect, Tensor) else \
+        wrap(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:303)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].multiply(1.0 / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return wrap(dct.astype(dtype))
+
+
+def _sym_to_periodic(win_length, fftbins):
+    # periodic windows are symmetric windows of length N+1 minus last sample
+    return (win_length + 1, True) if fftbins else (win_length, False)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """'hann'/'hamming'/'blackman'/'cosine'/'triang'/('kaiser', beta)/
+    ('gaussian', std)/('exponential', None, tau)/('tukey', alpha) →
+    window tensor (reference window.py:328)."""
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    n, trunc = _sym_to_periodic(win_length, fftbins)
+    t = jnp.arange(n, dtype=jnp.float32)
+    if name == "hann":
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * t / (n - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * t / (n - 1))
+             + 0.08 * jnp.cos(4 * math.pi * t / (n - 1)))
+    elif name == "cosine":
+        w = jnp.sin(math.pi / n * (t + 0.5))
+    elif name == "triang":
+        if n % 2 == 0:
+            w = (2 * t + 1) / n
+            w = jnp.where(t >= n // 2, 2 - (2 * t + 1) / n, w)
+        else:
+            w = 2 * (t + 1) / (n + 1)
+            w = jnp.where(t >= (n + 1) // 2, 2 - 2 * (t + 1) / (n + 1), w)
+    elif name == "bohman":
+        x = jnp.abs(2 * t / (n - 1) - 1)
+        w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        from jax.scipy.special import i0
+        r = 2 * t / (n - 1) - 1
+        w = i0(beta * jnp.sqrt(jnp.maximum(1 - r * r, 0))) / i0(
+            jnp.asarray(beta))
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = jnp.exp(-0.5 * ((t - (n - 1) / 2) / std) ** 2)
+    elif name == "exponential":
+        center = args[0] if args else None
+        tau = args[1] if len(args) > 1 else 1.0
+        c = (n - 1) / 2 if center is None else center
+        w = jnp.exp(-jnp.abs(t - c) / tau)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        edge = alpha * (n - 1) / 2
+        w = jnp.ones_like(t)
+        rise = t < edge
+        fall = t > (n - 1) - edge
+        w = jnp.where(rise, 0.5 * (1 + jnp.cos(
+            math.pi * (2 * t / (alpha * (n - 1)) - 1))), w)
+        w = jnp.where(fall, 0.5 * (1 + jnp.cos(
+            math.pi * (2 * t / (alpha * (n - 1)) - 2 / alpha + 1))), w)
+    elif name == "taylor":
+        # 4-term Taylor window, -30 dB sidelobes (scipy default)
+        nbar, sll = 4, 30.0
+        B = 10 ** (sll / 20)
+        A = math.acosh(B) / math.pi
+        s2 = nbar ** 2 / (A ** 2 + (nbar - 0.5) ** 2)
+        ma = jnp.arange(1, nbar, dtype=jnp.float32)
+        Fm = []
+        for mi in range(1, nbar):
+            numer = (-1) ** (mi + 1)
+            for m2 in range(1, nbar):
+                numer = numer * (1 - mi ** 2 / s2 / (
+                    A ** 2 + (m2 - 0.5) ** 2))
+            denom = 2.0
+            for m2 in range(1, nbar):
+                if m2 != mi:
+                    denom = denom * (1 - mi ** 2 / m2 ** 2)
+            Fm.append(numer / denom)
+        Fm = jnp.asarray(Fm)
+        w = jnp.ones_like(t)
+        for mi in range(1, nbar):
+            w = w + 2 * Fm[mi - 1] * jnp.cos(
+                2 * math.pi * mi * (t - (n - 1) / 2 + 0.5) / n)
+    else:
+        raise ValueError(f"unsupported window: {window!r}")
+    if trunc:
+        w = w[:-1]
+    return wrap(w.astype(dtype))

@@ -48,11 +48,7 @@ def segment_sum(data, segment_ids, name=None):
 def segment_mean(data, segment_ids, name=None):
     d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
     n = _num_segments(segment_ids)
-    s = jax.ops.segment_sum(d, ids, num_segments=n)
-    cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
-                              num_segments=n)
-    cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
-    return wrap(s / cnt, stop_gradient=False)
+    return wrap(_reduce(d, ids, n, "mean"), stop_gradient=False)
 
 
 def _zero_empty(out, ids, n):
@@ -160,13 +156,24 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
 
 def reindex_heter_graph(x, neighbors, count, value_buffer=None,
                         index_buffer=None, name=None):
+    """Heterogeneous variant: per-edge-type neighbor/count lists share one
+    node remap; dst is rebuilt per type (each count_i has len(x) entries)."""
+    xn = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
     nbs = [np.asarray(n.numpy() if isinstance(n, Tensor) else n)
            for n in neighbors]
     cnts = [np.asarray(c.numpy() if isinstance(c, Tensor) else c)
             for c in count]
-    src, dst, nodes = reindex_graph(x, np.concatenate(nbs),
-                                    np.concatenate(cnts))
-    return src, dst, nodes
+    all_nb = np.concatenate(nbs) if nbs else np.empty(0, np.int64)
+    uniq, first_idx = np.unique(np.concatenate([xn, all_nb]),
+                                return_index=True)
+    nodes = uniq[np.argsort(first_idx)]
+    remap = {int(g): i for i, g in enumerate(nodes)}
+    reindex_src = np.asarray([remap[int(g)] for g in all_nb], np.int64)
+    reindex_dst = np.concatenate([
+        np.repeat(np.arange(len(xn), dtype=np.int64), c) for c in cnts]) \
+        if cnts else np.empty(0, np.int64)
+    return (wrap(jnp.asarray(reindex_src)), wrap(jnp.asarray(reindex_dst)),
+            wrap(jnp.asarray(nodes)))
 
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,

@@ -20,10 +20,11 @@ import numpy as np
 _PLATFORMS = ("cpu", "tpu")
 
 
-def _spec_aval(spec, scope=None):
+def _spec_aval(spec, scope=None, prefix=""):
     """InputSpec → aval; dynamic dims (None/-1) become jax.export symbolic
     dimensions so the archive serves any batch size (reference: -1 dims in
-    save_inference_model feed targets)."""
+    save_inference_model feed targets). ``prefix`` keeps symbols distinct
+    per feed — otherwise two feeds' dim-0 would be unified into one symbol."""
     from ..core.dtype import convert_dtype
     dims = list(spec.shape)
     if not any(d is None or d == -1 for d in dims):
@@ -32,7 +33,7 @@ def _spec_aval(spec, scope=None):
     sym_src = []
     for i, d in enumerate(dims):
         if d is None or d == -1:
-            sym_src.append(f"_dyn{i}")
+            sym_src.append(f"{prefix}_dyn{i}")
         else:
             sym_src.append(str(int(d)))
     shape = jax_export.symbolic_shape(",".join(sym_src), scope=scope)
@@ -70,11 +71,12 @@ def export_program(path_prefix, program, feed_names, fetch_names, scope):
     # symbolic dims, not the placeholder-1 avals baked into the Variable
     sym_scope = jax_export.SymbolicScope()
     avals = []
-    for n in feed_names:
+    for fi, n in enumerate(feed_names):
         var = program.global_block.vars[n]
         spec = getattr(var, "_input_spec", None)
         if spec is not None:
-            avals.append(_spec_aval(spec, scope=sym_scope))
+            avals.append(_spec_aval(spec, scope=sym_scope,
+                                    prefix=f"f{fi}"))
         else:
             avals.append(var._value)
     exported = _export_fn(fn, avals)
@@ -106,7 +108,7 @@ def export_layer(path_prefix, layer, input_spec):
     sym_scope = jax_export.SymbolicScope()
     for i, spec in enumerate(input_spec):
         if hasattr(spec, "to_aval"):
-            avals.append(_spec_aval(spec, scope=sym_scope))
+            avals.append(_spec_aval(spec, scope=sym_scope, prefix=f"f{i}"))
             feed_names.append(spec.name or f"input_{i}")
         else:  # a concrete example array/tensor
             v = np.asarray(getattr(spec, "numpy", lambda: spec)())

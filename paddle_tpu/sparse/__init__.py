@@ -311,13 +311,26 @@ def _to_bcoo(x):
     return None
 
 
+def _with_batch(b, nb):
+    """Relayout a BCOO so its leading nb dims are batch dims (needed for
+    batched dot_general; from_dense builds everything fully sparse)."""
+    if nb and b.n_batch < nb:
+        # batch-dim storage is padded-dense per batch; acceptable here (the
+        # TPU path densifies for the MXU anyway)
+        return jsparse.bcoo_update_layout(b, n_batch=nb,
+                                          on_inefficient=None)
+    return b
+
+
 def matmul(x, y, name=None):
     """sparse @ dense -> dense (bcoo_dot_general), dense @ sparse likewise,
-    sparse @ sparse -> sparse. Reference: sparse/binary.py matmul."""
+    sparse @ sparse -> sparse. Reference: sparse/binary.py matmul.
+    Batched (3-D) operands relayout leading dims as BCOO batch dims."""
     bx, by = _to_bcoo(x), _to_bcoo(y)
     if bx is not None and by is None:
         yd = _arr(y)
         nb = bx.ndim - 2
+        bx = _with_batch(bx, nb)
         dn = (((bx.ndim - 1,), (yd.ndim - 2,)),
               (tuple(range(nb)), tuple(range(nb))))
         out = jsparse.bcoo_dot_general(bx, yd, dimension_numbers=dn)
@@ -325,6 +338,7 @@ def matmul(x, y, name=None):
     if bx is None and by is not None:
         xd = _arr(x)
         nb = by.ndim - 2
+        by = _with_batch(by, nb)
         dn = (((by.ndim - 2,), (xd.ndim - 1,)),
               (tuple(range(nb)), tuple(range(nb))))
         out = jsparse.bcoo_dot_general(by, xd, dimension_numbers=dn)
@@ -341,13 +355,24 @@ def matmul(x, y, name=None):
 
 def masked_matmul(x, y, mask, name=None):
     """(dense x dense) sampled at mask's sparsity — XLA's
-    bcoo_dot_general_sampled (reference: phi sparse masked_matmul_kernel)."""
+    bcoo_dot_general_sampled (reference: phi sparse masked_matmul_kernel).
+    Batched operands take the dense-product-then-gather path: on TPU the
+    MXU computes the full product faster than any sampled kernel, and XLA
+    fuses the gather."""
     xd, yd = _arr(x), _arr(y)
     mb = _to_bcoo(mask)
-    dn = (((xd.ndim - 1,), (yd.ndim - 2,)), ((), ()))
-    out = jsparse.bcoo_dot_general_sampled(xd, yd, mb.indices,
-                                           dimension_numbers=dn)
-    res = jsparse.BCOO((out, mb.indices), shape=mb.shape)
+    if mb.n_batch:  # batched CSR masks: flatten to fully-sparse indices
+        mb = jsparse.bcoo_update_layout(mb, n_batch=0)
+    if xd.ndim == 2:
+        dn = (((xd.ndim - 1,), (yd.ndim - 2,)), ((), ()))
+        out = jsparse.bcoo_dot_general_sampled(xd, yd, mb.indices,
+                                               dimension_numbers=dn)
+        res = jsparse.BCOO((out, mb.indices), shape=mb.shape)
+    else:
+        prod = jnp.matmul(xd, yd)                     # [..., m, n]
+        idx = tuple(mb.indices[:, i] for i in range(mb.indices.shape[1]))
+        out = prod[idx]                               # sample at mask nnz
+        res = jsparse.BCOO((out, mb.indices), shape=mb.shape)
     if isinstance(mask, SparseCsrTensor):
         return SparseCooTensor(res).to_sparse_csr()
     return SparseCooTensor(res)

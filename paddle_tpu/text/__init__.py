@@ -1,0 +1,98 @@
+"""paddle.text parity: viterbi_decode/ViterbiDecoder + NLP datasets.
+
+Reference: python/paddle/text/__init__.py (__all__: Conll05st, Imdb,
+Imikolov, Movielens, UCIHousing, WMT14, WMT16, ViterbiDecoder,
+viterbi_decode), viterbi kernel paddle/phi/kernels/cpu/viterbi_decode_kernel.cc:156.
+
+TPU-native design: the CRF decode is two `lax.scan`s (forward max-product +
+reverse backtrace) over static-length sequences with length masking — the
+reference's per-step mask/gather loop maps 1:1 onto scan carries.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, unwrap, wrap
+from ..nn.layer import Layer
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+def _arr(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _viterbi_scan(pot, trans, lengths, include_bos_eos_tag):
+    B, L, T = pot.shape
+    left = lengths.astype(jnp.int32)[:, None]  # [B,1]
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        start, stop = trans[-1], trans[-2]
+        alpha = alpha + start[None]
+        alpha = alpha + jnp.where(left == 1, stop[None], 0.0)
+    else:
+        stop = None
+    left = left - 1
+
+    def fwd(carry, logit):
+        alpha, left = carry
+        trn_sum = alpha[:, :, None] + trans[None]      # [B, prev, curr]
+        idx = jnp.argmax(trn_sum, axis=1)              # backpointers [B,T]
+        nxt = jnp.max(trn_sum, axis=1) + logit
+        alpha2 = jnp.where(left > 0, nxt, alpha)
+        if stop is not None:
+            alpha2 = alpha2 + jnp.where(left == 1, stop[None], 0.0)
+        return (alpha2, left - 1), idx
+
+    (alpha, left), hist = lax.scan(
+        fwd, (alpha, left), jnp.swapaxes(pot[:, 1:], 0, 1))
+    scores = jnp.max(alpha, axis=-1)
+    last_ids = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+    leftb = left[:, 0]                                  # lengths - L
+
+    path_last = last_ids * (leftb >= 0)
+
+    def bwd(carry, h):
+        last_ids, leftb = carry
+        leftb2 = leftb + 1
+        upd = jnp.take_along_axis(h, last_ids[:, None], 1)[:, 0]
+        upd = upd * (leftb2 > 0)
+        upd = jnp.where(leftb2 == 0, last_ids, upd)
+        new_last = jnp.where(leftb2 < 0, last_ids, upd)
+        return (new_last, leftb2), upd
+
+    _, rev = lax.scan(bwd, (last_ids, leftb), hist.astype(jnp.int32),
+                      reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(rev, 0, 1), path_last[:, None]], 1)
+    return scores, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path. potentials [B, L, T], transitions [T, T],
+    lengths [B] → (scores [B], paths [B, max(lengths)])."""
+    pot = _arr(potentials).astype(jnp.float32)
+    trans = _arr(transition_params).astype(jnp.float32)
+    lens = _arr(lengths)
+    scores, path = _viterbi_scan(pot, trans, lens, include_bos_eos_tag)
+    max_len = int(np.asarray(lens).max())
+    return (wrap(scores, stop_gradient=False),
+            wrap(path[:, :max_len]))
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -295,3 +295,63 @@ class TestGeometric:
         cnt_np = cnt.numpy()
         assert cnt_np[0] == 2 and cnt_np[1] == 2 and cnt_np[2] == 0
         assert set(nb.numpy()[:2]).issubset({1, 2, 3})
+
+
+class TestReviewRegressions2:
+    def test_reindex_heter_graph_two_edge_types(self):
+        from paddle_tpu import geometric as G
+        x = np.array([0, 5])
+        nb1, c1 = np.array([5, 0], np.int64), np.array([1, 1], np.int32)
+        nb2, c2 = np.array([7, 0], np.int64), np.array([1, 1], np.int32)
+        src, dst, nodes = G.reindex_heter_graph(
+            pt.to_tensor(x), [pt.to_tensor(nb1), pt.to_tensor(nb2)],
+            [pt.to_tensor(c1), pt.to_tensor(c2)])
+        nodes_np = nodes.numpy()
+        assert list(nodes_np[:2]) == [0, 5]
+        np.testing.assert_array_equal(
+            nodes_np[src.numpy()], np.concatenate([nb1, nb2]))
+        np.testing.assert_array_equal(dst.numpy(), [0, 1, 0, 1])
+
+    def test_batched_sparse_matmul(self):
+        rng = np.random.RandomState(0)
+        a = _rand_sparse((2, 4, 6))
+        b = rng.randn(2, 6, 5).astype(np.float32)
+        s = sparse.to_sparse_coo(pt.to_tensor(a))
+        out = sparse.matmul(s, pt.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5, atol=1e-5)
+
+    def test_batched_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 4, 6).astype(np.float32)
+        b = rng.randn(2, 6, 4).astype(np.float32)
+        mask = _rand_sparse((2, 4, 4), seed=5)
+        sm = sparse.to_sparse_coo(pt.to_tensor(mask))
+        out = sparse.masked_matmul(pt.to_tensor(a), pt.to_tensor(b), sm)
+        ref = (a @ b) * (mask != 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_export_independent_dynamic_dims(self):
+        import os.path as osp
+        import tempfile
+        from paddle_tpu import static as st
+        from paddle_tpu.ops.registry import OPS
+        prog, sprog = st.Program(), st.Program()
+        with st.program_guard(prog, sprog):
+            x = st.data("xd1", [-1, 4])
+            z = st.data("xd2", [-1, 4])
+            w = st.create_parameter([4, 2], name="w_dyn2")
+            y1 = OPS["matmul"](x, w)
+            y2 = OPS["matmul"](z, w)
+        exe = st.Executor()
+        exe.run(sprog)
+        d = tempfile.mkdtemp()
+        st.save_inference_model(osp.join(d, "m"), [x, z], [y1, y2], exe,
+                                program=prog)
+        from paddle_tpu.inference.export import load_exported
+        prog2, feeds, _ = load_exported(osp.join(d, "m"))
+        # different batch sizes per feed must be accepted (independent dims)
+        out = prog2(np.ones((8, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+        assert np.asarray(out[0]).shape == (8, 2)
+        assert np.asarray(out[1]).shape == (3, 2)

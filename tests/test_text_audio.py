@@ -1,0 +1,196 @@
+"""text (viterbi, datasets) + audio (mel/stft features) tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# ---------------------------------------------------------------- viterbi
+def _viterbi_oracle(pot, trans, lengths, tag):
+    """Brute-force per-batch oracle."""
+    B, L, T = pot.shape
+    scores, paths = [], []
+    maxlen = lengths.max()
+    for b in range(B):
+        n = lengths[b]
+        best, best_path = -np.inf, None
+        import itertools
+        for comb in itertools.product(range(T), repeat=int(n)):
+            s = pot[b, 0, comb[0]]
+            if tag:
+                s += trans[-1, comb[0]]
+            for i in range(1, n):
+                s += trans[comb[i - 1], comb[i]] + pot[b, i, comb[i]]
+            if tag:
+                # reference kernel adds the stop ROW (viterbi_decode_kernel.cc
+                # splits transitions along rows: stop = trans[-2, :])
+                s += trans[-2, comb[n - 1]]
+            if s > best:
+                best, best_path = s, comb
+        scores.append(best)
+        paths.append(list(best_path) + [0] * (maxlen - n))
+    return np.asarray(scores, np.float32), np.asarray(paths)
+
+
+@pytest.mark.parametrize("tag", [False, True])
+def test_viterbi_decode_matches_bruteforce(tag):
+    from paddle_tpu.text import viterbi_decode
+    rng = np.random.RandomState(0)
+    B, L, T = 3, 4, 4
+    pot = rng.randn(B, L, T).astype(np.float32)
+    trans = rng.randn(T, T).astype(np.float32)
+    lengths = np.array([4, 2, 3])
+    scores, path = viterbi_decode(pt.to_tensor(pot), pt.to_tensor(trans),
+                                  pt.to_tensor(lengths),
+                                  include_bos_eos_tag=tag)
+    ref_s, ref_p = _viterbi_oracle(pot, trans, lengths, tag)
+    np.testing.assert_allclose(scores.numpy(), ref_s, rtol=1e-5)
+    np.testing.assert_array_equal(path.numpy(), ref_p)
+
+
+def test_viterbi_decoder_layer():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(1)
+    pot = rng.randn(2, 3, 3).astype(np.float32)
+    trans = rng.randn(3, 3).astype(np.float32)
+    dec = ViterbiDecoder(pt.to_tensor(trans), include_bos_eos_tag=False)
+    scores, path = dec(pt.to_tensor(pot), pt.to_tensor(np.array([3, 3])))
+    assert scores.shape == [2] and path.shape == [2, 3]
+
+
+# ---------------------------------------------------------------- datasets
+def test_uci_housing_dataset():
+    from paddle_tpu.text import UCIHousing
+    rng = np.random.RandomState(0)
+    data = rng.rand(50, 14).astype(np.float32)
+    with tempfile.NamedTemporaryFile("w", suffix=".data",
+                                     delete=False) as f:
+        np.savetxt(f, data)
+        path = f.name
+    tr = UCIHousing(data_file=path, mode="train")
+    te = UCIHousing(data_file=path, mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    os.unlink(path)
+
+
+def test_imikolov_dataset():
+    from paddle_tpu.text import Imikolov
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write("the cat sat on the mat\nthe dog sat on the log\n")
+        path = f.name
+    ds = Imikolov(data_file=path, window_size=3, min_word_freq=1)
+    assert len(ds) > 0
+    ex = ds[0]
+    assert len(ex) == 3  # 3-gram
+    os.unlink(path)
+
+
+def test_wmt_dataset():
+    from paddle_tpu.text import WMT14
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write("hello world\tbonjour monde\ngood day\tbonne journee\n")
+        path = f.name
+    ds = WMT14(data_file=path)
+    assert len(ds) == 2
+    src, trg, lbl = ds[0]
+    assert trg[0] == ds.trg_ids["<s>"] and lbl[-1] == ds.trg_ids["<e>"]
+    os.unlink(path)
+
+
+def test_dataset_missing_file_raises():
+    from paddle_tpu.text import Imdb
+    with pytest.raises(RuntimeError, match="no network access"):
+        Imdb(data_file="/nonexistent/imdb.tar.gz")
+
+
+# ---------------------------------------------------------------- audio
+def test_mel_scale_roundtrip():
+    from paddle_tpu.audio import functional as AF
+    for htk in (False, True):
+        hz = 440.0
+        mel = AF.hz_to_mel(hz, htk=htk)
+        back = AF.mel_to_hz(mel, htk=htk)
+        assert abs(back - hz) < 1e-2
+    # slaney reference values (librosa convention)
+    assert abs(AF.hz_to_mel(1000.0) - 15.0) < 1e-4
+
+
+def test_fft_frequencies():
+    from paddle_tpu.audio import functional as AF
+    f = AF.fft_frequencies(16000, 512).numpy()
+    assert f.shape == (257,)
+    assert f[0] == 0 and abs(f[-1] - 8000) < 1e-3
+
+
+def test_fbank_matrix_shape_and_norm():
+    from paddle_tpu.audio import functional as AF
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter non-empty
+
+
+def test_power_to_db():
+    from paddle_tpu.audio import functional as AF
+    s = np.array([1.0, 0.1, 1e-12], np.float32)
+    db = AF.power_to_db(pt.to_tensor(s), top_db=None).numpy()
+    np.testing.assert_allclose(db[:2], [0.0, -10.0], atol=1e-4)
+    assert db[2] == -100.0  # amin floor
+    db = AF.power_to_db(pt.to_tensor(s), top_db=5.0).numpy()
+    assert db.min() >= db.max() - 5.0
+
+
+def test_create_dct_ortho():
+    from paddle_tpu.audio import functional as AF
+    d = AF.create_dct(13, 40).numpy()
+    assert d.shape == (40, 13)
+    # ortho columns have unit norm
+    np.testing.assert_allclose(np.linalg.norm(d, axis=0), np.ones(13),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("win", ["hann", "hamming", "blackman", "triang",
+                                 "cosine", ("kaiser", 12.0),
+                                 ("gaussian", 7.0), ("tukey", 0.5)])
+def test_get_window(win):
+    from paddle_tpu.audio import functional as AF
+    w = AF.get_window(win, 64).numpy()
+    assert w.shape == (64,)
+    assert w.max() <= 1.0 + 1e-6 and w.min() >= -1e-6
+
+
+def test_spectrogram_parseval():
+    from paddle_tpu.audio.features import Spectrogram
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2048).astype(np.float32)
+    spec = Spectrogram(n_fft=256, hop_length=128)(pt.to_tensor(x))
+    n_frames = 1 + 2048 // 128
+    assert spec.shape == [2, 129, n_frames]
+    # pure tone concentrates energy at its bin
+    t = np.arange(2048) / 16000
+    tone = np.sin(2 * np.pi * 1000 * t).astype(np.float32)
+    s = Spectrogram(n_fft=256, hop_length=128)(
+        pt.to_tensor(tone[None])).numpy()[0]
+    peak_bin = s.mean(axis=1).argmax()
+    expect_bin = round(1000 / (16000 / 256))
+    assert abs(int(peak_bin) - expect_bin) <= 1
+
+
+def test_mel_mfcc_pipeline():
+    from paddle_tpu.audio.features import (LogMelSpectrogram, MelSpectrogram,
+                                           MFCC)
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(1, 4096).astype(np.float32))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert mel.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert logmel.shape == mel.shape
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert mfcc.shape[1] == 13
