@@ -100,8 +100,7 @@ def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
     log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
     if top_db is not None:
         log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
-    return wrap(log_spec) if isinstance(spect, Tensor) else \
-        wrap(log_spec)
+    return wrap(log_spec)
 
 
 def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):

@@ -164,16 +164,14 @@ def reindex_heter_graph(x, neighbors, count, value_buffer=None,
     cnts = [np.asarray(c.numpy() if isinstance(c, Tensor) else c)
             for c in count]
     all_nb = np.concatenate(nbs) if nbs else np.empty(0, np.int64)
-    uniq, first_idx = np.unique(np.concatenate([xn, all_nb]),
-                                return_index=True)
-    nodes = uniq[np.argsort(first_idx)]
-    remap = {int(g): i for i, g in enumerate(nodes)}
-    reindex_src = np.asarray([remap[int(g)] for g in all_nb], np.int64)
-    reindex_dst = np.concatenate([
-        np.repeat(np.arange(len(xn), dtype=np.int64), c) for c in cnts]) \
-        if cnts else np.empty(0, np.int64)
-    return (wrap(jnp.asarray(reindex_src)), wrap(jnp.asarray(reindex_dst)),
-            wrap(jnp.asarray(nodes)))
+    # one flat count vector reusing reindex_graph's remap/src logic: the
+    # concatenated neighbors belong to num_types repetitions of x
+    flat_counts = np.concatenate(cnts) if cnts else np.empty(0, np.int64)
+    rep_x = np.tile(np.arange(len(xn)), len(nbs))
+    src, _, nodes = reindex_graph(xn, all_nb,
+                                  np.zeros(len(xn), np.int64))
+    reindex_dst = np.repeat(rep_x, flat_counts)
+    return (src, wrap(jnp.asarray(reindex_dst.astype(np.int64))), nodes)
 
 
 def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,

@@ -1,0 +1,374 @@
+"""paddle.quantization parity: observers, fake quanters, QuantConfig,
+QAT/PTQ pipelines.
+
+Reference: python/paddle/quantization/ (base_quanter.py, base_observer.py,
+config.py, qat.py, ptq.py, quantize.py, observers/abs_max.py,
+quanters/abs_max.py) and python/paddle/nn/quant/quant_layers.py.
+
+TPU-native design: fake-quant is a pure function with a straight-through
+estimator (`x + stop_gradient(q(x) - x)`), so QAT graphs stay fully
+jittable — no per-op Python hooks in the hot path. Scales live as layer
+buffers; `convert` bakes them for inference (int8 simulation in bf16/fp32
+compute, which is what the MXU wants).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, dispatch, unwrap, wrap
+from ..nn.layer import Layer
+from ..nn import functional as F
+
+__all__ = [
+    "fake_quant", "quant_dequant", "BaseQuanter", "BaseObserver",
+    "QuanterFactory", "quanter", "AbsmaxObserver",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
+    "QuantConfig", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
+]
+
+
+def _v(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Symmetric round-to-nearest: q = round(x/scale * qmax) clamped, then
+    dequantized. Scale broadcasts (per-tensor scalar or per-channel)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def quant_dequant(x, scale, bit_length=8):
+    """fake_quant with a straight-through gradient (QAT trainable)."""
+    return x + lax.stop_gradient(fake_quant(x, scale, bit_length) - x)
+
+
+class BaseQuanter(Layer):
+    """Layer that simulates quantization in forward (reference
+    base_quanter.py). Subclasses implement forward + scales()."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter):
+    """Calibration-only quanter: observes ranges, passes data through
+    (reference base_observer.py). convert() freezes observation so serving
+    traffic can no longer move the calibrated scales."""
+
+    def __init__(self):
+        super().__init__()
+        self._frozen = False
+
+    def observe(self, x):
+        raise NotImplementedError
+
+    def forward(self, x):
+        if not self._frozen:
+            self.observe(x)
+        return x
+
+
+class _WithArgs:
+    def __init__(self, *args, **kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+
+class QuanterFactory(_WithArgs):
+    """Partial-application handle: holds ctor args, instantiated per layer
+    (reference factory.py QuanterFactory)."""
+    _layer_cls = None
+
+    def _instance(self, layer):
+        return self._layer_cls(layer, *self.args, **self.kwargs)
+
+
+def quanter(name):
+    """Decorator registering a quanter layer class under a factory with
+    the given name (reference factory.py quanter)."""
+    def deco(layer_cls):
+        factory = type(name, (QuanterFactory,), {"_layer_cls": layer_cls})
+        globals()[name] = factory
+        return layer_cls
+    return deco
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    """Running abs-max calibration observer (reference
+    observers/abs_max.py)."""
+
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._max = 0.0
+        del layer  # factory protocol passes the wrapped layer; unused here
+
+    def observe(self, x):
+        v = float(jnp.max(jnp.abs(_v(x))))
+        self._max = max(self._max, v)
+
+    def scales(self):
+        return wrap(jnp.asarray(self._max, jnp.float32))
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def cal_thresholds(self):
+        pass
+
+
+class AbsmaxObserver(QuanterFactory):
+    _layer_cls = AbsmaxObserverLayer
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """Moving-average abs-max fake quanter (reference quanters/abs_max.py,
+    nn/quant FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self.register_buffer("_scale", wrap(jnp.asarray(1.0, jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            cur = jnp.max(jnp.abs(_v(x))).astype(jnp.float32)
+            r = self._moving_rate
+            new_scale = r * unwrap(self._scale) + (1 - r) * cur
+            self._scale.set_value(new_scale)
+            scale = new_scale
+        else:
+            scale = unwrap(self._scale)
+        bits = self._bit_length
+        # dispatch records the STE vjp on the eager tape
+        return dispatch(
+            lambda v: quant_dequant(v, lax.stop_gradient(scale), bits),
+            x, name="fake_quant_moving_absmax")
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver(QuanterFactory):
+    _layer_cls = FakeQuanterWithAbsMaxObserverLayer
+
+
+class FakeQuanterChannelWiseAbsMaxLayer(BaseQuanter):
+    """Per-output-channel abs-max weight quanter (reference
+    FakeQuantChannelWiseAbsMax)."""
+
+    def __init__(self, layer=None, quant_axis=1, bit_length=8):
+        super().__init__()
+        self._quant_axis = quant_axis
+        self._bit_length = bit_length
+        self._scale_val = None
+
+    def forward(self, w):
+        bits = self._bit_length
+        wv = _v(w)
+        axes = tuple(i for i in range(wv.ndim) if i != self._quant_axis)
+        scale = jnp.max(jnp.abs(wv), axis=axes, keepdims=True)
+        self._scale_val = scale
+        # scale enters fn as a closure constant: STE treats it as constant
+        # anyway, and this avoids recomputing the reduction in the trace
+        return dispatch(
+            lambda v: quant_dequant(v, scale, bits),
+            w, name="fake_quant_channelwise_absmax")
+
+    def scales(self):
+        return wrap(self._scale_val)
+
+    def quant_axis(self):
+        return self._quant_axis
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterChannelWiseAbsMax(QuanterFactory):
+    _layer_cls = FakeQuanterChannelWiseAbsMaxLayer
+
+
+# ---------------------------------------------------------------- config
+
+class SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Maps layers → quanter factories (reference config.py QuantConfig:
+    add_layer_config / add_name_config / add_type_config / default)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._default = SingleLayerConfig(activation, weight)
+        self._by_layer = {}     # id(layer) -> cfg
+        self._by_name = {}      # layer full name -> cfg
+        self._by_type = {}      # type -> cfg
+        self._qat_mapping = dict(_DEFAULT_QAT_MAPPING)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, name, activation=None, weight=None):
+        names = name if isinstance(name, (list, tuple)) else [name]
+        for n in names:
+            self._by_name[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._by_type[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_mapping[source] = target
+
+    def _config_for(self, layer, name):
+        if id(layer) in self._by_layer:
+            return self._by_layer[id(layer)]
+        if name in self._by_name:
+            return self._by_name[name]
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._default.activation or self._default.weight:
+            return self._default
+        return None
+
+
+# ------------------------------------------------------- quantized layers
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake quant (reference
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, layer, q_config: SingleLayerConfig):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = (
+            q_config.activation._instance(layer)
+            if q_config.activation else None)
+        self.weight_quanter = (
+            q_config.weight._instance(layer) if q_config.weight else None)
+
+    def forward(self, x):
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, q_config: SingleLayerConfig):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        # copy conv config as plain attrs: keeping `layer` as a sublayer
+        # would leave the raw Conv2D visible to named_sublayers and let a
+        # second quantize() pass double-wrap it
+        self._stride = layer.stride
+        self._padding = layer.padding
+        self._dilation = layer.dilation
+        self._groups = layer.groups
+        self._data_format = layer.data_format
+        self.activation_quanter = (
+            q_config.activation._instance(layer)
+            if q_config.activation else None)
+        self.weight_quanter = (
+            q_config.weight._instance(layer) if q_config.weight else None)
+
+    def forward(self, x):
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return F.conv2d(x, w, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups, data_format=self._data_format)
+
+
+def _default_qat_mapping():
+    from ..nn.layers_basic import Linear
+    mapping = {Linear: QuantedLinear}
+    try:
+        from ..nn.layers_basic import Conv2D
+        mapping[Conv2D] = QuantedConv2D
+    except ImportError:
+        pass
+    return mapping
+
+
+_DEFAULT_QAT_MAPPING = _default_qat_mapping()
+
+
+# ---------------------------------------------------------------- engines
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _transform(self, model, wrap_fn):
+        for name, sub in list(model.named_sublayers()):
+            cfg = self._config._config_for(sub, name)
+            target = self._config._qat_mapping.get(type(sub))
+            if cfg is not None and target is not None:
+                replacement = wrap_fn(sub, cfg, target)
+                _set_sublayer(model, name, replacement)
+        return model
+
+    def convert(self, model, inplace=False):
+        """Freeze: eval-mode scales baked; observers stop updating."""
+        model.eval()
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, BaseObserver):
+                sub._frozen = True
+        return model
+
+
+class QAT(Quantization):
+    """Quantization-aware training (reference qat.py). quantize() swaps
+    matched layers for Quanted* wrappers with trainable-through STE."""
+
+    def quantize(self, model, inplace=False):
+        return self._transform(model, lambda sub, cfg, tgt: tgt(sub, cfg))
+
+
+class PTQ(Quantization):
+    """Post-training quantization (reference ptq.py): wrap with observers,
+    run calibration batches, then convert()."""
+
+    def quantize(self, model, inplace=False):
+        return self._transform(model, lambda sub, cfg, tgt: tgt(sub, cfg))
+
+
+def _set_sublayer(root, dotted, new):
+    parts = dotted.split(".")
+    obj = root
+    for p in parts[:-1]:
+        obj = getattr(obj, p)
+    setattr(obj, parts[-1], new)

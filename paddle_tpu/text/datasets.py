@@ -93,12 +93,9 @@ class Imikolov(_LocalDataset):
                     win = ids[i:i + window_size]
                     self.examples.append(tuple(
                         np.array([t], np.int64) for t in win))
-            else:  # SEQ
-                for i in range(len(ids) - 1):
-                    self.examples.append(
-                        (np.asarray(ids[:-1], np.int64),
-                         np.asarray(ids[1:], np.int64)))
-                    break
+            else:  # SEQ: one (input, shifted-target) pair per line
+                self.examples.append((np.asarray(ids[:-1], np.int64),
+                                      np.asarray(ids[1:], np.int64)))
 
 
 class Imdb(_LocalDataset):
@@ -124,8 +121,9 @@ class Imdb(_LocalDataset):
                 labels.append(0 if g.group(1) == "pos" else 1)
                 for t in toks:
                     freq[t] = freq.get(t, 0) + 1
+        # reference imdb.py build_dict: keep words with freq > cutoff
         vocab = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
-                 if c > 0][:cutoff]
+                 if c > cutoff]
         self.word_idx = {w: i for i, w in enumerate(vocab)}
         self.word_idx["<unk>"] = len(self.word_idx)
         unk = self.word_idx["<unk>"]
@@ -228,13 +226,15 @@ class Conll05st(_LocalDataset):
         if cur:
             sents.append(cur)
         words = sorted({t[0] for s in sents for t in s})
+        preds = sorted({t[1] for s in sents for t in s})
         labels = sorted({t[-1] for s in sents for t in s})
         self.word_dict = {w: i for i, w in enumerate(words)}
+        self.predicate_dict = {p: i for i, p in enumerate(preds)}
         self.label_dict = {l: i for i, l in enumerate(labels)}
-        self.predicate_dict = self.word_dict
         self.examples = []
         for s in sents:
             wid = np.asarray([self.word_dict[t[0]] for t in s], np.int64)
-            pid = np.asarray([self.word_dict[t[1]] for t in s], np.int64)
+            pid = np.asarray([self.predicate_dict[t[1]] for t in s],
+                             np.int64)
             lid = np.asarray([self.label_dict[t[-1]] for t in s], np.int64)
             self.examples.append((wid, pid, lid))

@@ -1,0 +1,133 @@
+"""Quantization package tests: fake quant math oracle, QAT swap + STE
+training, PTQ observer calibration."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+
+
+def test_fake_quant_oracle():
+    import jax.numpy as jnp
+    x = np.array([-1.0, -0.5, 0.0, 0.3, 1.0], np.float32)
+    scale = 1.0
+    out = np.asarray(Q.fake_quant(jnp.asarray(x), scale, 8))
+    ref = np.clip(np.round(x / scale * 127), -127, 127) * scale / 127
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_quant_dequant_straight_through_grad():
+    import jax
+    import jax.numpy as jnp
+    g = jax.grad(lambda x: Q.quant_dequant(x, 1.0).sum())(
+        jnp.asarray([0.3, -0.7]))
+    np.testing.assert_allclose(np.asarray(g), [1.0, 1.0])
+
+
+def test_qat_quantize_swaps_linear():
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMaxObserver(),
+                        weight=Q.FakeQuanterChannelWiseAbsMax())
+    qat = Q.QAT(cfg)
+    qmodel = qat.quantize(model)
+    kinds = [type(m).__name__ for _, m in qmodel.named_sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+
+
+def test_qat_model_trains():
+    pt.seed(0)
+    rng = np.random.RandomState(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMaxObserver(),
+                        weight=Q.FakeQuanterChannelWiseAbsMax())
+    qmodel = Q.QAT(cfg).quantize(model)
+    qmodel.train()
+    opt = pt.optimizer.AdamW(learning_rate=5e-2,
+                             parameters=qmodel.parameters())
+    x = pt.to_tensor(rng.randn(32, 8).astype(np.float32))
+    y = pt.to_tensor(rng.randint(0, 2, size=(32,)))
+    losses = []
+    for _ in range(25):
+        loss = nn.functional.cross_entropy(qmodel(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_qat_output_is_quantized():
+    """Quantized forward differs from fp forward but stays close."""
+    pt.seed(0)
+    rng = np.random.RandomState(0)
+    lin = nn.Linear(8, 8)
+    x = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+    ref = lin(x).numpy()
+    cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax())
+    q = Q.QuantedLinear(lin, cfg._default)
+    out = q(x).numpy()
+    assert not np.allclose(out, ref)
+    assert np.abs(out - ref).max() < 0.1  # 8-bit error bound
+
+
+def test_ptq_observer_calibration():
+    rng = np.random.RandomState(0)
+    obs_factory = Q.AbsmaxObserver()
+    cfg = Q.QuantConfig(activation=obs_factory)
+    model = nn.Sequential(nn.Linear(4, 4))
+    pmodel = Q.PTQ(cfg).quantize(model)
+    # calibration pass
+    for _ in range(3):
+        pmodel(pt.to_tensor(rng.randn(8, 4).astype(np.float32) * 3))
+    (name, quanted), = [kv for kv in pmodel.named_sublayers()
+                        if type(kv[1]).__name__ == "QuantedLinear"]
+    scale = float(quanted.activation_quanter.scales().numpy())
+    assert scale > 2.0  # saw abs values around 3*|randn|
+
+
+def test_type_and_name_config():
+    model = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    cfg = Q.QuantConfig()
+    cfg.add_type_config(nn.Linear,
+                        weight=Q.FakeQuanterChannelWiseAbsMax())
+    qmodel = Q.QAT(cfg).quantize(model)
+    kinds = [type(m).__name__ for _, m in qmodel.named_sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+
+
+def test_convert_freezes_observer():
+    rng = np.random.RandomState(0)
+    cfg = Q.QuantConfig(activation=Q.AbsmaxObserver())
+    model = Q.PTQ(cfg).quantize(nn.Sequential(nn.Linear(4, 4)))
+    model(pt.to_tensor(rng.randn(8, 4).astype(np.float32)))
+    ptq = Q.PTQ(cfg)
+    ptq.convert(model)
+    (_, quanted), = [kv for kv in model.named_sublayers()
+                     if type(kv[1]).__name__ == "QuantedLinear"]
+    before = float(quanted.activation_quanter.scales().numpy())
+    model(pt.to_tensor(rng.randn(8, 4).astype(np.float32) * 100))
+    after = float(quanted.activation_quanter.scales().numpy())
+    assert before == after  # outlier serving batch must not move scales
+
+
+def test_double_quantize_does_not_double_wrap():
+    model = nn.Sequential(nn.Conv2D(3, 4, 3), nn.Linear(4, 4))
+    cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax())
+    qat = Q.QAT(cfg)
+    qmodel = qat.quantize(model)
+    qmodel2 = qat.quantize(qmodel)
+    kinds = [type(m).__name__ for _, m in qmodel2.named_sublayers()]
+    assert kinds.count("QuantedConv2D") == 1
+    assert kinds.count("QuantedLinear") == 1
+    assert kinds.count("Conv2D") == 0
+
+
+def test_quanted_conv2d_matches_unquantized_closely():
+    pt.seed(0)
+    rng = np.random.RandomState(0)
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = pt.to_tensor(rng.randn(1, 2, 6, 6).astype(np.float32))
+    ref = conv(x).numpy()
+    cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax(quant_axis=0))
+    out = Q.QuantedConv2D(conv, cfg._default)(x).numpy()
+    assert np.abs(out - ref).max() < 0.15
