@@ -65,11 +65,14 @@ class _Fleet:
         self._topology = None
         self._is_initialized = False
         self._user_defined_optimizer = None
+        self._ps_runtime = None
 
     # ------------------------------------------------------------- init
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
         self._strategy = strategy or DistributedStrategy()
+        if not is_collective or self._env_is_ps():
+            return self._init_ps(role_maker)
         hc = self._strategy.hybrid_configs
         dp = hc.get("dp_degree", 1)
         mp = hc.get("mp_degree", 1)
@@ -92,6 +95,54 @@ class _Fleet:
                                            global_rank=env_mod.get_rank())
         self._is_initialized = True
         return self
+
+    # ------------------------------------------------------------- PS mode
+    def _env_is_ps(self):
+        import os
+        return os.environ.get("TRAINING_ROLE", "").upper() in (
+            "PSERVER", "SERVER")
+
+    def _init_ps(self, role_maker=None):
+        """Parameter-server mode bring-up (reference fleet.init with a
+        non-collective role maker → TheOnePSRuntime)."""
+        import os
+        from .ps import TheOnePSRuntime
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        srv_list = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        n_srv = len(srv_list.split(",")) if srv_list else 0
+        n_wrk = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        idx = int(os.environ.get(
+            "PADDLE_PSERVER_ID" if role in ("PSERVER", "SERVER")
+            else "PADDLE_TRAINER_ID", 0))
+        self._ps_runtime = TheOnePSRuntime(
+            role=role, index=idx, num_servers=n_srv, num_workers=n_wrk,
+            master_endpoint=os.environ.get("PADDLE_MASTER_ENDPOINT"))
+        self._is_initialized = True
+        return self
+
+    def is_server(self):
+        return self._env_is_ps()
+
+    def is_worker(self):
+        return not self._env_is_ps()
+
+    def init_server(self, *args, **kwargs):
+        if self._ps_runtime is not None:
+            self._ps_runtime.init()
+
+    def run_server(self):
+        if self._ps_runtime is not None:
+            self._ps_runtime.run_server()
+
+    def init_worker(self, scopes=None):
+        if self._ps_runtime is not None:
+            self._ps_runtime.init()
+
+    def stop_worker(self):
+        # no-op in collective mode (reference parity: scripts call this
+        # unconditionally at teardown)
+        if self._ps_runtime is not None:
+            self._ps_runtime.stop()
 
     def get_hybrid_communicate_group(self):
         return self._hcg
@@ -146,9 +197,6 @@ class _Fleet:
         return {}
 
     def shrink(self, threshold=None):
-        pass
-
-    def stop_worker(self):
         pass
 
 

@@ -1,0 +1,249 @@
+"""Parameter-server runtime (fleet PS mode).
+
+Reference: python/paddle/distributed/ps/the_one_ps.py:1031 (TheOnePSRuntime),
+C++ tables paddle/fluid/distributed/ps/table/ (dense/sparse memory tables),
+brpc service paddle/fluid/distributed/ps/service/.
+
+TPU-native design: servers are plain CPU processes holding sharded tables
+(the giant embedding never touches the TPU); workers pull the rows a batch
+actually needs, run the dense math on-device via the normal jitted path, and
+push sparse gradients back. Transport is the in-repo RPC layer (rpc.py) —
+brpc's role. Sharding is id % num_servers, like the reference's hash shard
+(paddle/fluid/distributed/ps/table/common_sparse_table.cc semantics).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "TheOnePSRuntime"]
+
+
+class SparseTable:
+    """id -> row vector table with lazy init + SGD apply (reference
+    common_sparse_table / MemorySparseTable)."""
+
+    def __init__(self, name, dim, initializer="zeros", seed=0, lr=0.1):
+        self.name = name
+        self.dim = dim
+        self.rows = {}
+        self.lr = lr
+        self._rng = np.random.RandomState(seed)
+        self._init = initializer
+
+    def _new_row(self):
+        if self._init == "zeros":
+            return np.zeros(self.dim, np.float32)
+        scale = 1.0 / np.sqrt(self.dim)
+        return self._rng.uniform(-scale, scale, self.dim).astype(np.float32)
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.dim), np.float32)
+        for i, _id in enumerate(ids):
+            _id = int(_id)
+            if _id not in self.rows:
+                self.rows[_id] = self._new_row()
+            out[i] = self.rows[_id]
+        return out
+
+    def push_grad(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        for _id, g in zip(ids, grads):
+            _id = int(_id)
+            if _id not in self.rows:
+                self.rows[_id] = self._new_row()
+            self.rows[_id] -= self.lr * g
+
+    def state(self):
+        return {"ids": np.asarray(sorted(self.rows), np.int64),
+                "values": np.stack([self.rows[i] for i in sorted(self.rows)])
+                if self.rows else np.zeros((0, self.dim), np.float32)}
+
+    def load_state(self, st):
+        self.rows = {int(i): np.asarray(v, np.float32)
+                     for i, v in zip(st["ids"], st["values"])}
+
+
+class DenseTable:
+    def __init__(self, name, shape, lr=0.1):
+        self.name = name
+        self.value = np.zeros(shape, np.float32)
+        self.lr = lr
+
+    def pull(self):
+        return self.value
+
+    def push_grad(self, grad):
+        self.value -= self.lr * np.asarray(grad, np.float32)
+
+
+class PSServer:
+    """Table host. Its public methods are invoked via rpc from workers
+    (the brpc PsService analog)."""
+
+    _current = None
+
+    def __init__(self, server_index, num_servers):
+        self.server_index = server_index
+        self.num_servers = num_servers
+        self.tables = {}
+        PSServer._current = self
+
+    def create_table(self, name, dim, initializer="uniform", lr=0.1):
+        if name not in self.tables:
+            self.tables[name] = SparseTable(
+                name, dim, initializer, seed=self.server_index, lr=lr)
+        return True
+
+    def pull_sparse(self, name, ids):
+        return self.tables[name].pull(ids)
+
+    def push_sparse(self, name, ids, grads):
+        self.tables[name].push_grad(ids, grads)
+        return True
+
+    def save_table(self, name):
+        return self.tables[name].state()
+
+    def load_table(self, name, st):
+        self.tables[name].load_state(st)
+        return True
+
+
+# module-level trampolines: rpc pickles these by reference, executing
+# against the server process's PSServer._current
+def _srv_create_table(name, dim, initializer, lr):
+    return PSServer._current.create_table(name, dim, initializer, lr)
+
+
+def _srv_pull_sparse(name, ids):
+    return PSServer._current.pull_sparse(name, ids)
+
+
+def _srv_push_sparse(name, ids, grads):
+    return PSServer._current.push_sparse(name, ids, grads)
+
+
+def _srv_save(name):
+    return PSServer._current.save_table(name)
+
+
+class PSClient:
+    """Worker-side handle: shards requests by id % num_servers and fans
+    them out over rpc (reference ps client in the_one_ps)."""
+
+    def __init__(self, server_names):
+        self.server_names = list(server_names)
+
+    def create_table(self, name, dim, initializer="uniform", lr=0.1):
+        for s in self.server_names:
+            rpc.rpc_sync(s, _srv_create_table, (name, dim, initializer, lr))
+
+    def _shard(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        n = len(self.server_names)
+        owner = ids % n
+        return ids, owner
+
+    def pull_sparse(self, name, ids):
+        ids, owner = self._shard(ids)
+        futs, slots = [], []
+        for s_idx, s_name in enumerate(self.server_names):
+            mask = owner == s_idx
+            if not mask.any():
+                continue
+            futs.append(rpc.rpc_async(s_name, _srv_pull_sparse,
+                                      (name, ids[mask].tolist())))
+            slots.append(mask)
+        dim = None
+        out = None
+        for fut, mask in zip(futs, slots):
+            rows = fut.result()
+            if out is None:
+                dim = rows.shape[1] if rows.size else 0
+                out = np.zeros((len(ids), dim), np.float32)
+            out[mask] = rows
+        return out if out is not None else np.zeros((0, 0), np.float32)
+
+    def push_sparse(self, name, ids, grads):
+        ids, owner = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        futs = []
+        for s_idx, s_name in enumerate(self.server_names):
+            mask = owner == s_idx
+            if not mask.any():
+                continue
+            futs.append(rpc.rpc_async(
+                s_name, _srv_push_sparse,
+                (name, ids[mask].tolist(), grads[mask])))
+        for f in futs:
+            f.result()
+
+    def save_table(self, name):
+        parts = [rpc.rpc_sync(s, _srv_save, (name,))
+                 for s in self.server_names]
+        ids = np.concatenate([p["ids"] for p in parts])
+        vals = np.concatenate([p["values"] for p in parts])
+        order = np.argsort(ids)
+        return {"ids": ids[order], "values": vals[order]}
+
+
+class TheOnePSRuntime:
+    """Role-aware bootstrap (reference the_one_ps.py:1031): servers host
+    tables and block; workers get a PSClient."""
+
+    def __init__(self, role=None, index=None, num_servers=1, num_workers=1,
+                 master_endpoint=None):
+        import os
+        self.role = role or os.environ.get("TRAINING_ROLE",
+                                           "TRAINER").upper()
+        self.num_servers = num_servers
+        self.num_workers = num_workers
+        self.index = index if index is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.master_endpoint = master_endpoint
+        self.client = None
+        self.server = None
+
+    def _rank(self):
+        # global rpc rank: servers first, then workers
+        if self.role in ("PSERVER", "SERVER"):
+            return self.index
+        return self.num_servers + self.index
+
+    def _name(self):
+        if self.role in ("PSERVER", "SERVER"):
+            return f"server:{self.index}"
+        return f"worker:{self.index}"
+
+    def init(self):
+        world = self.num_servers + self.num_workers
+        # the table host must exist BEFORE this process becomes reachable:
+        # a worker may rpc create_table the instant its init barrier lifts
+        if self.role in ("PSERVER", "SERVER"):
+            self.server = PSServer(self.index, self.num_servers)
+        rpc.init_rpc(self._name(), rank=self._rank(), world_size=world,
+                     master_endpoint=self.master_endpoint)
+        if self.role not in ("PSERVER", "SERVER"):
+            self.client = PSClient(
+                [f"server:{i}" for i in range(self.num_servers)])
+        return self
+
+    def run_server(self):
+        """Block until every worker signalled exit (workers drive the
+        tables via rpc in the meantime)."""
+        st = rpc._require_state()
+        import time
+        while st.store.add("ps/exit", 0) < self.num_workers:
+            time.sleep(0.05)
+
+    def stop(self):
+        st = rpc._state
+        if st is not None and self.role not in ("PSERVER", "SERVER"):
+            try:
+                st.store.add("ps/exit", 1)  # release run_server loops
+            except Exception:
+                pass
+        rpc.shutdown()

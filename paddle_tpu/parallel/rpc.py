@@ -1,0 +1,212 @@
+"""paddle.distributed.rpc parity: init_rpc / rpc_sync / rpc_async /
+get_worker_info / shutdown.
+
+Reference: python/paddle/distributed/rpc/rpc.py:73-260 (over a C++ brpc
+agent, paddle/fluid/distributed/rpc/). TPU-native runtime: the agent is a
+Python thread serving pickled (fn, args, kwargs) calls over raw TCP
+sockets; rendezvous + barrier ride the native TCPStore
+(paddle_tpu/runtime/csrc/tcp_store.cc), which replaces the reference's
+MasterDaemon. Heavy tensors should flow through the collective layer, not
+RPC — same guidance as the reference.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 120.0
+
+_state = None
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store, server, infos):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.server = server
+        self.infos = infos            # name -> WorkerInfo
+        self.by_rank = {i.rank: i for i in infos.values()}
+        self.pool = ThreadPoolExecutor(max_workers=8)
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(conn, payload: bytes):
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(conn):
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    return _recv_exact(conn, n)
+
+
+class _Server:
+    """Per-worker daemon accepting pickled calls (the brpc agent analog)."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn):
+        try:
+            with conn:
+                fn, args, kwargs = pickle.loads(_recv_msg(conn))
+                try:
+                    result = (True, fn(*args, **kwargs))
+                except Exception as e:  # ship the exception back
+                    result = (False, e)
+                _send_msg(conn, pickle.dumps(result))
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's agent + exchange worker infos through TCPStore
+    (reference rpc.py:73)."""
+    global _state
+    import os
+    from ..runtime import TCPStore
+
+    if _state is not None:
+        raise RuntimeError("rpc is already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER_ENDPOINT", "127.0.0.1:8090")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = _Server()
+    store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+    info = WorkerInfo(name, rank, my_ip, server.port)
+    store.set(f"rpc/worker/{rank}", pickle.dumps(info))
+    infos = {}
+    for r in range(world_size):
+        wi = pickle.loads(store.get(f"rpc/worker/{r}"))  # blocking get
+        infos[wi.name] = wi
+    _state = _RpcState(name, rank, world_size, store, server, infos)
+    _barrier()
+    return _state
+
+
+def _require_state():
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state
+
+
+def _barrier(tolerant=False):
+    st = _require_state()
+    key = "rpc/barrier/seq"
+    import time
+    try:
+        n = st.store.add(key, 1)
+        target = ((n - 1) // st.world_size + 1) * st.world_size
+        while st.store.add(key, 0) < target:
+            time.sleep(0.01)
+    except Exception:
+        # tolerant mode (shutdown): the master store may already be gone
+        # because every peer reached shutdown — that IS the barrier
+        if not tolerant:
+            raise
+
+
+def _call(info: WorkerInfo, payload, timeout):
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout) as conn:
+        _send_msg(conn, payload)
+        ok, value = pickle.loads(_recv_msg(conn))
+    if not ok:
+        raise value
+    return value
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call (reference rpc.py:141)."""
+    return rpc_async(to, fn, args, kwargs, timeout).result()
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking remote call returning a Future with .wait()
+    (reference rpc.py:179 returns a FutureWrapper)."""
+    st = _require_state()
+    if to not in st.infos:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(st.infos)}")
+    payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})))
+    fut = st.pool.submit(_call, st.infos[to], payload,
+                         None if timeout <= 0 else timeout)
+    fut.wait = fut.result  # paddle Future API parity
+    return fut
+
+
+def get_worker_info(name):
+    return _require_state().infos[name]
+
+
+def get_all_worker_infos():
+    st = _require_state()
+    return [st.by_rank[r] for r in sorted(st.by_rank)]
+
+
+def get_current_worker_info():
+    st = _require_state()
+    return st.infos[st.name]
+
+
+def shutdown():
+    """Graceful: barrier so no worker exits while peers still call it
+    (reference rpc.py:239 _barrier_never_timeout + stop agent)."""
+    global _state
+    if _state is None:
+        return
+    _barrier(tolerant=True)
+    _state.server.close()
+    _state.pool.shutdown(wait=False)
+    try:
+        _state.store.close()
+    except Exception:
+        pass
+    _state = None

@@ -149,7 +149,11 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
             cur = jnp.max(jnp.abs(_v(x))).astype(jnp.float32)
             r = self._moving_rate
             new_scale = r * unwrap(self._scale) + (1 - r) * cur
-            self._scale.set_value(new_scale)
+            # under jit tracing the buffer update is a Python side effect on
+            # a tracer; skip it there (the traced graph still uses the
+            # updated scale) — eager QAT steps persist it
+            if not isinstance(new_scale, jax.core.Tracer):
+                self._scale.set_value(new_scale)
             scale = new_scale
         else:
             scale = unwrap(self._scale)
@@ -174,8 +178,13 @@ class FakeQuanterChannelWiseAbsMaxLayer(BaseQuanter):
     """Per-output-channel abs-max weight quanter (reference
     FakeQuantChannelWiseAbsMax)."""
 
-    def __init__(self, layer=None, quant_axis=1, bit_length=8):
+    def __init__(self, layer=None, quant_axis=None, bit_length=8):
         super().__init__()
+        if quant_axis is None:
+            # per-output-channel: axis 0 for conv OIHW weights (reference
+            # default), axis 1 for Linear's [in, out] layout
+            from ..nn.layers_basic import _ConvND
+            quant_axis = 0 if isinstance(layer, _ConvND) else 1
         self._quant_axis = quant_axis
         self._bit_length = bit_length
         self._scale_val = None
@@ -332,7 +341,10 @@ class Quantization:
     def __init__(self, config: QuantConfig):
         self._config = config
 
-    def _transform(self, model, wrap_fn):
+    def _transform(self, model, wrap_fn, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)  # keep the fp original intact
         for name, sub in list(model.named_sublayers()):
             cfg = self._config._config_for(sub, name)
             target = self._config._qat_mapping.get(type(sub))
@@ -340,6 +352,11 @@ class Quantization:
                 replacement = wrap_fn(sub, cfg, target)
                 _set_sublayer(model, name, replacement)
         return model
+
+    def quantize(self, model, inplace=False):
+        return self._transform(model,
+                               lambda sub, cfg, tgt: tgt(sub, cfg),
+                               inplace=inplace)
 
     def convert(self, model, inplace=False):
         """Freeze: eval-mode scales baked; observers stop updating."""
@@ -354,16 +371,10 @@ class QAT(Quantization):
     """Quantization-aware training (reference qat.py). quantize() swaps
     matched layers for Quanted* wrappers with trainable-through STE."""
 
-    def quantize(self, model, inplace=False):
-        return self._transform(model, lambda sub, cfg, tgt: tgt(sub, cfg))
-
 
 class PTQ(Quantization):
     """Post-training quantization (reference ptq.py): wrap with observers,
     run calibration batches, then convert()."""
-
-    def quantize(self, model, inplace=False):
-        return self._transform(model, lambda sub, cfg, tgt: tgt(sub, cfg))
 
 
 def _set_sublayer(root, dotted, new):

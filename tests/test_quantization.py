@@ -131,3 +131,33 @@ def test_quanted_conv2d_matches_unquantized_closely():
     cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax(quant_axis=0))
     out = Q.QuantedConv2D(conv, cfg._default)(x).numpy()
     assert np.abs(out - ref).max() < 0.15
+
+
+def test_quantize_not_inplace_preserves_original():
+    model = nn.Sequential(nn.Linear(4, 4))
+    cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax())
+    qmodel = Q.QAT(cfg).quantize(model, inplace=False)
+    kinds = [type(m).__name__ for _, m in model.named_sublayers()]
+    qkinds = [type(m).__name__ for _, m in qmodel.named_sublayers()]
+    assert "QuantedLinear" not in kinds  # fp original untouched
+    assert "QuantedLinear" in qkinds
+
+
+def test_channelwise_axis_inferred_per_layer_kind():
+    conv, lin = nn.Conv2D(2, 3, 3), nn.Linear(5, 7)
+    cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax())
+    qc = Q.QuantedConv2D(conv, cfg._default)
+    ql = Q.QuantedLinear(lin, cfg._default)
+    assert qc.weight_quanter.quant_axis() == 0  # conv OIHW out-channel
+    assert ql.weight_quanter.quant_axis() == 1  # linear [in, out] out-col
+    x = pt.to_tensor(np.ones((1, 2, 5, 5), np.float32))
+    qc(x)
+    assert qc.weight_quanter.scales().shape == [3, 1, 1, 1]
+
+
+def test_fleet_stop_worker_safe_without_ps():
+    from paddle_tpu.parallel import fleet as fleet_mod
+    f = fleet_mod._Fleet()
+    f.stop_worker()  # must be a no-op, not AttributeError
+    f.run_server()
+    f.init_worker()

@@ -1,0 +1,191 @@
+"""RPC + parameter-server tests — real multi-process, mirroring the
+reference's single-host multi-process pattern (test_rpc_*.py,
+test_dist_fleet_ps*.py)."""
+import multiprocessing as mp
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _env():
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+# ------------------------------------------------------------------- rpc
+def _sq(x):
+    return x * x
+
+
+def _boom():
+    raise ValueError("remote boom")
+
+
+def _rpc_worker(rank, world, port, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from paddle_tpu.parallel import rpc
+    rpc.init_rpc(f"w{rank}", rank=rank, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        if rank == 0:
+            assert rpc.rpc_sync("w1", _sq, args=(7,)) == 49
+            fut = rpc.rpc_async("w1", _sq, args=(np.arange(3),))
+            np.testing.assert_array_equal(fut.wait(), [0, 1, 4])
+            try:
+                rpc.rpc_sync("w1", _boom)
+                q.put(("fail", "no exception"))
+                return
+            except ValueError as e:
+                assert "remote boom" in str(e)
+            infos = rpc.get_all_worker_infos()
+            assert [i.name for i in infos] == ["w0", "w1"]
+            assert rpc.get_worker_info("w1").rank == 1
+            q.put(("ok", rank))
+        else:
+            # server side just stays alive until shutdown barrier
+            q.put(("ok", rank))
+    finally:
+        rpc.shutdown()
+
+
+def test_rpc_two_processes():
+    ctx = mp.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rpc_worker, args=(r, 2, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=90) for _ in procs]
+    for p in procs:
+        p.join(timeout=90)
+    assert all(s == "ok" for s, _ in results), results
+
+
+# -------------------------------------------------------------------- ps
+def _ps_proc(role, index, n_srv, n_wrk, port, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from paddle_tpu.parallel import rpc
+    from paddle_tpu.parallel.ps import TheOnePSRuntime
+    rt = TheOnePSRuntime(role=role, index=index, num_servers=n_srv,
+                         num_workers=n_wrk,
+                         master_endpoint=f"127.0.0.1:{port}").init()
+    try:
+        if role == "PSERVER":
+            q.put(("ok", f"s{index}"))
+            rt.run_server()
+        else:
+            c = rt.client
+            c.create_table("emb", dim=4, initializer="zeros", lr=0.5)
+            ids = np.array([1, 2, 5, 2])
+            rows = c.pull_sparse("emb", ids)
+            assert rows.shape == (4, 4)
+            np.testing.assert_allclose(rows, 0)  # zero init
+            # push grad of ones for ids [1,2]; server applies -lr*g
+            c.push_sparse("emb", np.array([1, 2]), np.ones((2, 4)))
+            after = c.pull_sparse("emb", np.array([1, 2, 5]))
+            np.testing.assert_allclose(after[0], -0.5)
+            np.testing.assert_allclose(after[1], -0.5)
+            np.testing.assert_allclose(after[2], 0.0)
+            st = c.save_table("emb")
+            assert set(st["ids"].tolist()) == {1, 2, 5}
+            q.put(("ok", f"w{index}"))
+    except Exception as e:  # pragma: no cover
+        q.put(("fail", f"{role}{index}: {e!r}"))
+    finally:
+        rt.stop()
+
+
+def test_parameter_server_end_to_end():
+    ctx = mp.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_ps_proc, args=("PSERVER", 0, 2, 1, port, q)),
+        ctx.Process(target=_ps_proc, args=("PSERVER", 1, 2, 1, port, q)),
+        ctx.Process(target=_ps_proc, args=("TRAINER", 0, 2, 1, port, q)),
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=120) for _ in procs]
+    for p in procs:
+        p.join(timeout=120)
+        if p.is_alive():
+            p.terminate()
+    assert all(s == "ok" for s, _ in results), results
+
+
+def test_sparse_table_local():
+    from paddle_tpu.parallel.ps import SparseTable
+    t = SparseTable("t", dim=3, initializer="uniform", lr=1.0)
+    r = t.pull([4, 9])
+    assert r.shape == (2, 3)
+    before = r.copy()
+    t.push_grad([4], np.ones((1, 3)))
+    after = t.pull([4])
+    np.testing.assert_allclose(after[0], before[0] - 1.0, rtol=1e-6)
+
+
+def _fleet_ps_proc(role, index, n_srv, n_wrk, port, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TRAINING_ROLE"] = role
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(
+        f"127.0.0.1:{7000+i}" for i in range(n_srv))
+    os.environ["PADDLE_TRAINERS_NUM"] = str(n_wrk)
+    os.environ["PADDLE_MASTER_ENDPOINT"] = f"127.0.0.1:{port}"
+    if role == "PSERVER":
+        os.environ["PADDLE_PSERVER_ID"] = str(index)
+    else:
+        os.environ["PADDLE_TRAINER_ID"] = str(index)
+    from paddle_tpu.parallel import fleet as fleet_mod
+    fleet = fleet_mod.fleet
+    fleet.init(is_collective=False)
+    try:
+        if fleet.is_server():
+            fleet.init_server()
+            q.put(("ok", f"s{index}"))
+            fleet.run_server()
+        else:
+            fleet.init_worker()
+            c = fleet._ps_runtime.client
+            c.create_table("emb", dim=2, initializer="zeros", lr=1.0)
+            c.push_sparse("emb", np.array([3]), np.ones((1, 2)))
+            row = c.pull_sparse("emb", np.array([3]))
+            np.testing.assert_allclose(row[0], -1.0)
+            q.put(("ok", f"w{index}"))
+    except Exception as e:  # pragma: no cover
+        q.put(("fail", f"{role}{index}: {e!r}"))
+    finally:
+        fleet.stop_worker()
+
+
+def test_fleet_ps_mode():
+    ctx = mp.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_fleet_ps_proc,
+                    args=("PSERVER", 0, 1, 1, port, q)),
+        ctx.Process(target=_fleet_ps_proc,
+                    args=("TRAINER", 0, 1, 1, port, q)),
+    ]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=120) for _ in procs]
+    for p in procs:
+        p.join(timeout=120)
+        if p.is_alive():
+            p.terminate()
+    assert all(s == "ok" for s, _ in results), results
