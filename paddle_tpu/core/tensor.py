@@ -93,6 +93,51 @@ def set_static_recorder(recorder):
     _static_recorder = recorder
 
 
+_check_nan_inf = False      # FLAGS_check_nan_inf (phi/core/flags.cc:62)
+_check_nan_inf_level = 0    # 0 = raise, >=1 = warn
+
+
+def _flag_truthy(v):
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    return s not in ("", "0", "false", "no", "off", "none")
+
+
+def set_nan_inf_check(enabled, level=0):
+    """Numerical sanitizer toggle (reference FLAGS_check_nan_inf: per-op
+    device-side scans, framework/details/nan_inf_utils_detail.cu; eager
+    hook eager/nan_inf_utils.cc). Wired from runtime.set_flags; accepts the
+    env-protocol strings ('1'/'true'/'false'/...) and bools."""
+    global _check_nan_inf, _check_nan_inf_level
+    _check_nan_inf = _flag_truthy(enabled)
+    try:
+        _check_nan_inf_level = int(str(level))
+    except (TypeError, ValueError):
+        _check_nan_inf_level = 1 if _flag_truthy(level) else 0
+
+
+def _nan_inf_scan(name, out):
+    import jax
+    import numpy as np
+    flat, _ = jax.tree_util.tree_flatten(out)
+    for v in flat:
+        if isinstance(v, jax.core.Tracer):
+            continue  # traced graphs: use jax_debug_nans instead
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(v))):
+                arr = np.asarray(v)
+                msg = (f"Operator {name or '<anonymous>'} output contains "
+                       f"Inf/Nan: {int(np.isnan(arr).sum())} nan, "
+                       f"{int(np.isinf(arr).sum())} inf "
+                       f"(shape {arr.shape})")
+                if _check_nan_inf_level >= 1:
+                    import warnings
+                    warnings.warn(msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
 def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
     """Execute ``fn(*values, **kwargs)``; record a vjp node if needed.
 
@@ -122,6 +167,8 @@ def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
     )
     if not record:
         out = fn(*vals, **kwargs)
+        if _check_nan_inf:
+            _nan_inf_scan(name or getattr(fn, "__name__", None), out)
         if not any_tensor:
             return out
         return jax.tree_util.tree_map(lambda v: wrap(v), out)
@@ -137,6 +184,8 @@ def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
         return fn(*vv, **kwargs)
 
     out_vals, vjp = jax.vjp(f, *[vals[p] for p in diff_pos])
+    if _check_nan_inf:
+        _nan_inf_scan(name or getattr(fn, "__name__", None), out_vals)
     flat, treedef = jax.tree_util.tree_flatten(out_vals)
     node = Node(
         parents=[args[p] for p in diff_pos],

@@ -1,1 +1,7 @@
 from . import autograd, nn  # noqa: F401
+
+from . import checkpoint  # noqa: E402,F401
+from .nn.functional import (  # noqa: E402,F401
+    fused_softmax_mask as softmax_mask_fuse,
+    fused_softmax_mask_upper_triangle as softmax_mask_fuse_upper_triangle,
+)

@@ -1,0 +1,75 @@
+"""Auto-checkpoint for preemptible jobs (reference:
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py — decorated
+train loops snapshot program+epoch state keyed by a run hash).
+
+TPU-native: epoch-granular snapshots through io.checkpoint (orbax-style
+sharded save) into $PADDLE_CHECKPOINT_DIR; `train_epoch_range` resumes from
+the newest complete snapshot after preemption."""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+
+def _ckpt_root():
+    return os.environ.get("PADDLE_CHECKPOINT_DIR", "./auto_checkpoint")
+
+
+class TrainEpochRange:
+    """Iterate epochs with save/restore (reference TrainEpochRange)."""
+
+    def __init__(self, max_epoch_num, name, save_checkpoint_inter=1,
+                 checkpoint_dir=None):
+        self.name = name
+        self.max_epoch_num = max_epoch_num
+        self.save_inter = save_checkpoint_inter
+        self.dir = os.path.join(checkpoint_dir or _ckpt_root(), name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._state = {"epoch": -1}
+        self._objs = {}
+        meta = os.path.join(self.dir, "meta.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self._state = json.load(f)
+
+    def restored_from(self):
+        return self._state["epoch"]
+
+    def add(self, name, obj):
+        """Register a state_dict-capable object (model/optimizer)."""
+        self._objs[name] = obj
+        epoch = self._state["epoch"]
+        if epoch >= 0:
+            path = os.path.join(self.dir, f"e{epoch}", f"{name}.pdparams")
+            if os.path.exists(path):
+                from ..io.save_load import load
+                obj.set_state_dict(load(path))
+        return self
+
+    def save(self, epoch):
+        from ..io.save_load import save
+        edir = os.path.join(self.dir, f"e{epoch}")
+        os.makedirs(edir, exist_ok=True)
+        for name, obj in self._objs.items():
+            save(obj.state_dict(), os.path.join(edir, f"{name}.pdparams"))
+        self._state["epoch"] = epoch
+        with open(os.path.join(self.dir, "meta.json"), "w") as f:
+            json.dump(self._state, f)
+        # keep only the newest complete snapshot (reference keeps max_num)
+        for d in os.listdir(self.dir):
+            if d.startswith("e") and d != f"e{epoch}":
+                shutil.rmtree(os.path.join(self.dir, d),
+                              ignore_errors=True)
+
+    def __iter__(self):
+        start = self._state["epoch"] + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            if (epoch + 1) % self.save_inter == 0:
+                self.save(epoch)
+
+
+def train_epoch_range(max_epoch_num, name="auto_ckpt",
+                      save_checkpoint_inter=1):
+    return TrainEpochRange(max_epoch_num, name, save_checkpoint_inter)

@@ -152,3 +152,39 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     if not pre_layer_norm:
         out = F.layer_norm(out, d, ln2_scale, ln2_bias, ln2_epsilon)
     return out
+
+
+def fused_softmax_mask(x, mask, name=None):
+    """softmax(x + mask) fused (reference
+    paddle/phi/kernels/fusion/fused_softmax_mask_kernel.h; Python
+    paddle.incubate.softmax_mask_fuse). x [B,H,S,S], mask [B,1,S,S]; XLA
+    fuses the add into the softmax on TPU."""
+    import jax
+    from ...core.tensor import dispatch
+
+    def fn(xv, mv):
+        return jax.nn.softmax(xv + mv, axis=-1)
+
+    return dispatch(fn, x, mask, name="fused_softmax_mask")
+
+
+def fused_softmax_mask_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference
+    fused_softmax_mask_upper_triangle GPU kernel;
+    paddle.incubate.softmax_mask_fuse_upper_triangle). Keeps the lower
+    triangle (incl. diagonal) of the trailing [S,S] scores."""
+    import jax
+    import jax.numpy as jnp
+    from ...core.tensor import dispatch
+
+    def fn(xv):
+        s = xv.shape[-1]
+        keep = jnp.tril(jnp.ones((s, s), bool))
+        neg = jnp.asarray(jnp.finfo(
+            xv.dtype if jnp.issubdtype(xv.dtype, jnp.floating)
+            else jnp.float32).min, xv.dtype)
+        masked = jnp.where(keep, xv, neg)
+        out = jax.nn.softmax(masked, axis=-1)
+        return jnp.where(keep, out, 0)
+
+    return dispatch(fn, x, name="fused_softmax_mask_upper_triangle")

@@ -1,8 +1,9 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
-    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, Optimizer,
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, LarsMomentum,
+    Momentum, Optimizer,
     RMSProp,
 )
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb", "lr"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "lr"]

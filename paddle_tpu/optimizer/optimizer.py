@@ -18,7 +18,7 @@ from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LarsMomentum"]
 
 
 class Optimizer:
@@ -387,3 +387,35 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
         return (p32 - lr * trust * r).astype(p.dtype), {"m": m, "v": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (reference: paddle.incubate.optimizer.LarsMomentumOptimizer;
+    phi lars_momentum kernel): layer-wise adaptive rate scaling on top of
+    momentum SGD — local_lr = lr * coeff * ||p|| / (||g|| + lambda*||p||)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def init_state(self, params):
+        return {"velocity": _zeros_tree(params)}
+
+    def _update_leaf(self, g, p, state, lr, step, wd):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm
+            / (g_norm + self._lars_wd * p_norm + self._eps),
+            lr)
+        v = self._momentum * state["velocity"] + local_lr * (
+            g32 + self._lars_wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}

@@ -19,13 +19,15 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
+from .recompute_util import recompute, recompute_sequential  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 
 
 def __getattr__(name):
     import importlib
     if name in ("fleet", "pipeline", "sharding", "moe", "auto_parallel",
-                "launch", "checkpoint", "rpc"):
+                "launch", "checkpoint", "rpc", "ps",
+                "meta_optimizers"):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod

@@ -174,9 +174,11 @@ class _Fleet:
 
     def distributed_optimizer(self, optimizer, strategy=None):
         self._user_defined_optimizer = optimizer
+        st = strategy or self._strategy
+        from .meta_optimizers import apply_strategy_meta_optimizers
+        optimizer = apply_strategy_meta_optimizers(optimizer, st)
         from .hybrid_optimizer import HybridParallelOptimizer
-        return HybridParallelOptimizer(optimizer, self._hcg,
-                                       self._strategy)
+        return HybridParallelOptimizer(optimizer, self._hcg, st)
 
     # ------------------------------------------------------------ save/load
     def save(self, state, path, **kw):

@@ -1,0 +1,173 @@
+"""Meta-optimizer zoo (fleet static meta-optimizers, eager-style).
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/ —
+GradientMergeOptimizer, LocalSGDOptimizer, DGCOptimizer,
+RecomputeOptimizer, LarsOptimizer, LambOptimizer (factory
+base/meta_optimizer_factory.py, composition base/strategy_compiler.py).
+
+TPU-native: each is a thin wrapper over the inner Optimizer's step()/
+clear_grad(); the math (accumulate / sparsify / average) is jnp on the
+gradient pytree, so a jitted train step fuses it. Composition happens in
+fleet.distributed_optimizer based on DistributedStrategy flags, mirroring
+strategy_compiler's ordering."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import unwrap, wrap
+
+__all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer", "DGCOptimizer",
+           "RecomputeOptimizer", "apply_strategy_meta_optimizers"]
+
+
+class _MetaOptimizer:
+    """Delegates everything to the inner optimizer unless overridden."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+
+class GradientMergeOptimizer(_MetaOptimizer):
+    """Accumulate grads for k_steps micro-steps, apply once
+    (reference meta_optimizers/gradient_merge_optimizer.py; the pass
+    version passes/auto_parallel_gradient_merge.py)."""
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        super().__init__(inner)
+        self.k_steps = k_steps
+        self.avg = avg
+        self._acc = {}
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        for p in self._inner._parameters:
+            if p.grad is None:
+                continue
+            g = unwrap(p.grad)
+            key = id(p)
+            self._acc[key] = g if key not in self._acc else \
+                self._acc[key] + g
+        if self._count % self.k_steps != 0:
+            for p in self._inner._parameters:
+                p.grad = None       # consumed into the accumulator
+            return
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p in self._inner._parameters:
+            key = id(p)
+            if key in self._acc:
+                p.grad = wrap(self._acc[key] * scale)
+        self._acc.clear()
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner.clear_grad(set_to_zero)
+
+
+class LocalSGDOptimizer(_MetaOptimizer):
+    """Step locally; every k_steps average params across the data-parallel
+    group (reference meta_optimizers/localsgd_optimizer.py). Under pjit
+    the replicas are consistent by construction, so the averaging uses the
+    collective API only when an explicit multi-process group exists."""
+
+    def __init__(self, inner, k_steps=4):
+        super().__init__(inner)
+        self.k_steps = k_steps
+        self._count = 0
+
+    def _average_params(self):
+        from . import collective
+        for p in self._inner._parameters:
+            t = wrap(unwrap(p))
+            # pmean inside shard_map/pjit; no-op outside an axis context
+            # (pjit replicas are consistent by construction there)
+            out = collective.all_reduce(t, op=collective.ReduceOp.AVG)
+            p._replace_value(unwrap(out))
+
+    def step(self):
+        self._inner.step()
+        self._count += 1
+        if self._count % self.k_steps == 0:
+            self._average_params()
+
+
+class DGCOptimizer(_MetaOptimizer):
+    """Deep gradient compression: top-k sparsification with error feedback
+    (reference meta_optimizers/dgc_optimizer.py, CUDA dgc op
+    paddle/fluid/operators/dgc_op.h)."""
+
+    def __init__(self, inner, rampup_begin_step=0, sparsity=0.999):
+        super().__init__(inner)
+        self.rampup_begin_step = rampup_begin_step
+        self.sparsity = sparsity
+        self._residual = {}
+        self._step_i = 0
+
+    def _compress(self, g, key):
+        r = self._residual.get(key)
+        full = g + r if r is not None else g
+        flat = full.reshape(-1)
+        k = max(1, int(flat.size * (1.0 - self.sparsity)))
+        thresh = jnp.sort(jnp.abs(flat))[-k]
+        mask = jnp.abs(full) >= thresh
+        sparse = jnp.where(mask, full, 0)
+        self._residual[key] = full - sparse
+        return sparse
+
+    def step(self):
+        self._step_i += 1
+        if self._step_i > self.rampup_begin_step:
+            for p in self._inner._parameters:
+                if p.grad is None:
+                    continue
+                p.grad = wrap(self._compress(unwrap(p.grad), id(p)))
+        self._inner.step()
+
+
+class RecomputeOptimizer(_MetaOptimizer):
+    """API-parity shell (reference meta_optimizers/recompute_optimizer.py):
+    recompute itself is jax.checkpoint on the model's forward — see
+    parallel.recompute(); the optimizer needs no gradient changes."""
+
+    def __init__(self, inner, checkpoints=None):
+        super().__init__(inner)
+        self.checkpoints = checkpoints or []
+
+    def step(self):
+        self._inner.step()
+
+
+def apply_strategy_meta_optimizers(optimizer, strategy):
+    """strategy_compiler.py analog: stack wrappers by strategy flags in the
+    reference's valid composition order (dgc → gradient_merge → localsgd)."""
+    if strategy is None:
+        return optimizer
+    if getattr(strategy, "dgc", False):
+        cfg = getattr(strategy, "dgc_configs",
+                      {"rampup_begin_step": 0, "sparsity": [0.999]})
+        sp = cfg.get("sparsity", [0.999])
+        sp = sp[0] if isinstance(sp, (list, tuple)) else sp
+        optimizer = DGCOptimizer(
+            optimizer, rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            sparsity=sp)
+    if getattr(strategy, "gradient_merge", False):
+        cfg = strategy.gradient_merge_configs
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            avg=cfg.get("avg", True))
+    if getattr(strategy, "localsgd", False):
+        cfg = getattr(strategy, "localsgd_configs", {"k_steps": 4})
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      k_steps=cfg.get("k_steps", 4))
+    if getattr(strategy, "recompute", False):
+        optimizer = RecomputeOptimizer(
+            optimizer,
+            checkpoints=strategy.recompute_configs.get("checkpoints"))
+    return optimizer

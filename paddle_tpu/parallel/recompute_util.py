@@ -1,0 +1,54 @@
+"""Activation recompute (reference:
+python/paddle/distributed/fleet/utils/recompute.py — RecomputeFunction
+PyLayer re-running forward in backward; fleet meta-optimizer
+recompute_optimizer.py).
+
+TPU-native: `jax.checkpoint` (rematerialization) IS recompute; under jit
+XLA drops the activations and replays the forward in the backward pass.
+In eager Tensor mode the wrapper simply calls the function (the eager tape
+holds vjp closures; memory semantics only change under jit)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              **kwargs):
+    """fleet.utils.recompute parity. function: a Layer or callable."""
+    fn = function.forward if hasattr(function, "forward") else function
+    if any(isinstance(a, Tensor) for a in args):
+        # eager path: tape-recorded as usual
+        return fn(*args, **kwargs)
+    ck = jax.checkpoint(functools.partial(fn, **kwargs)) if kwargs else \
+        jax.checkpoint(fn)
+    return ck(*args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle.incubate.distributed.fleet.recompute_sequential parity:
+    recompute over segments of a Sequential container."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_size = max(1, len(funcs) // max(1, segments))
+    out = args
+    for i in range(0, len(funcs), seg_size):
+        seg = funcs[i:i + seg_size]
+
+        def run_seg(*inner, _seg=seg):
+            cur = inner
+            for f in _seg:
+                cur = f(*cur) if isinstance(cur, tuple) else f(cur)
+                if not isinstance(cur, tuple):
+                    cur = (cur,)
+            return cur if len(cur) > 1 else cur[0]
+
+        out = recompute(run_seg, *out, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]

@@ -11,6 +11,8 @@ RPC — same guidance as the reference.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
 import pickle
 import socket
 import struct
@@ -21,12 +23,14 @@ from concurrent.futures import Future, ThreadPoolExecutor
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
 _DEFAULT_RPC_TIMEOUT = 120.0
+_DIGEST_LEN = 32  # sha256
 
 _state = None
 
 
 class _RpcState:
-    def __init__(self, name, rank, world_size, store, server, infos):
+    def __init__(self, name, rank, world_size, store, server, infos,
+                 cookie):
         self.name = name
         self.rank = rank
         self.world_size = world_size
@@ -35,6 +39,29 @@ class _RpcState:
         self.infos = infos            # name -> WorkerInfo
         self.by_rank = {i.rank: i for i in infos.values()}
         self.pool = ThreadPoolExecutor(max_workers=8)
+        self.cookie = cookie
+        self._conns = threading.local()  # per-thread connection cache
+
+    def connection(self, info: WorkerInfo, timeout):
+        cache = getattr(self._conns, "map", None)
+        if cache is None:
+            cache = self._conns.map = {}
+        key = (info.ip, info.port)
+        conn = cache.get(key)
+        if conn is None:
+            conn = socket.create_connection(key, timeout=timeout)
+            cache[key] = conn
+        return conn
+
+    def drop_connection(self, info: WorkerInfo):
+        cache = getattr(self._conns, "map", None)
+        if cache:
+            conn = cache.pop((info.ip, info.port), None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
 
 def _recv_exact(conn, n):
@@ -56,13 +83,31 @@ def _recv_msg(conn):
     return _recv_exact(conn, n)
 
 
-class _Server:
-    """Per-worker daemon accepting pickled calls (the brpc agent analog)."""
+def _sign(cookie: bytes, payload: bytes) -> bytes:
+    return hmac_mod.new(cookie, payload, hashlib.sha256).digest()
 
-    def __init__(self):
+
+def _safe_dumps(result_tuple):
+    try:
+        return pickle.dumps(result_tuple)
+    except Exception as e:  # unpicklable result/exception: ship a summary
+        ok, value = result_tuple
+        kind = "result" if ok else "exception"
+        return pickle.dumps((False, RuntimeError(
+            f"rpc {kind} not picklable ({e!r}): {value!r}")))
+
+
+class _Server:
+    """Per-worker daemon serving pickled calls over persistent connections
+    (the brpc agent analog). Every request frame is HMAC-authenticated
+    with the job cookie exchanged through the TCPStore — pickled payloads
+    from anything without the cookie are never unpickled."""
+
+    def __init__(self, bind_ip="0.0.0.0"):
+        self.cookie = None  # set by init_rpc before the port is published
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("0.0.0.0", 0))
+        self.sock.bind((bind_ip, 0))
         self.sock.listen(64)
         self.port = self.sock.getsockname()[1]
         self._stop = False
@@ -75,18 +120,25 @@ class _Server:
                 conn, _ = self.sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve_one, args=(conn,),
+            threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
-    def _serve_one(self, conn):
+    def _serve_conn(self, conn):
+        """Handle a request stream until the peer disconnects."""
         try:
             with conn:
-                fn, args, kwargs = pickle.loads(_recv_msg(conn))
-                try:
-                    result = (True, fn(*args, **kwargs))
-                except Exception as e:  # ship the exception back
-                    result = (False, e)
-                _send_msg(conn, pickle.dumps(result))
+                while not self._stop:
+                    frame = _recv_msg(conn)
+                    digest, payload = frame[:_DIGEST_LEN], frame[_DIGEST_LEN:]
+                    if self.cookie is None or not hmac_mod.compare_digest(
+                            digest, _sign(self.cookie, payload)):
+                        return  # unauthenticated: drop without unpickling
+                    fn, args, kwargs = pickle.loads(payload)
+                    try:
+                        result = (True, fn(*args, **kwargs))
+                    except Exception as e:  # ship the exception back
+                        result = (False, e)
+                    _send_msg(conn, _safe_dumps(result))
         except (ConnectionError, OSError):
             pass
 
@@ -118,15 +170,31 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     server = _Server()
     store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
                      world_size=world_size)
-    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
-        socket.gethostbyname(socket.gethostname())
+    # job cookie: rank 0 mints it, everyone reads it via the rendezvous
+    # (the store is the trust root, like the reference's master daemon)
+    if rank == 0:
+        import secrets
+        cookie = secrets.token_bytes(32)
+        store.set("rpc/cookie", cookie)
+    else:
+        cookie = store.get("rpc/cookie")
+    server.cookie = cookie
+    # advertise the address routable from the master's network, not the
+    # hostname alias (often 127.0.1.1 on Debian-style /etc/hosts)
+    if host in ("127.0.0.1", "localhost"):
+        my_ip = "127.0.0.1"
+    else:
+        probe = socket.create_connection((host, int(port)), timeout=30)
+        my_ip = probe.getsockname()[0]
+        probe.close()
     info = WorkerInfo(name, rank, my_ip, server.port)
     store.set(f"rpc/worker/{rank}", pickle.dumps(info))
     infos = {}
     for r in range(world_size):
         wi = pickle.loads(store.get(f"rpc/worker/{r}"))  # blocking get
         infos[wi.name] = wi
-    _state = _RpcState(name, rank, world_size, store, server, infos)
+    _state = _RpcState(name, rank, world_size, store, server, infos,
+                       cookie)
     _barrier()
     return _state
 
@@ -154,9 +222,19 @@ def _barrier(tolerant=False):
 
 
 def _call(info: WorkerInfo, payload, timeout):
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout) as conn:
-        _send_msg(conn, payload)
+    st = _require_state()
+    frame = _sign(st.cookie, payload) + payload
+    try:
+        conn = st.connection(info, timeout)
+        conn.settimeout(timeout)
+        _send_msg(conn, frame)
+        ok, value = pickle.loads(_recv_msg(conn))
+    except (ConnectionError, OSError):
+        # stale cached connection (peer restarted): retry once fresh
+        st.drop_connection(info)
+        conn = st.connection(info, timeout)
+        conn.settimeout(timeout)
+        _send_msg(conn, frame)
         ok, value = pickle.loads(_recv_msg(conn))
     if not ok:
         raise value

@@ -181,10 +181,13 @@ class FakeQuanterChannelWiseAbsMaxLayer(BaseQuanter):
     def __init__(self, layer=None, quant_axis=None, bit_length=8):
         super().__init__()
         if quant_axis is None:
-            # per-output-channel: axis 0 for conv OIHW weights (reference
-            # default), axis 1 for Linear's [in, out] layout
+            # per-output-channel: conv OIHW → axis 0, transpose conv
+            # [in, out//g, kh, kw] → axis 1, Linear [in, out] → axis 1
             from ..nn.layers_basic import _ConvND
-            quant_axis = 0 if isinstance(layer, _ConvND) else 1
+            if isinstance(layer, _ConvND):
+                quant_axis = 1 if getattr(layer, "_transpose", False) else 0
+            else:
+                quant_axis = 1
         self._quant_axis = quant_axis
         self._bit_length = bit_length
         self._scale_val = None
@@ -359,7 +362,12 @@ class Quantization:
                                inplace=inplace)
 
     def convert(self, model, inplace=False):
-        """Freeze: eval-mode scales baked; observers stop updating."""
+        """Freeze: eval-mode scales baked; observers stop updating. With
+        inplace=False (default) the QAT/calibration model stays live and a
+        frozen copy is returned."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         model.eval()
         for _, sub in model.named_sublayers(include_self=True):
             if isinstance(sub, BaseObserver):

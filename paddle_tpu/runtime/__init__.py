@@ -171,6 +171,13 @@ def set_flags(flags: dict):
     for k, v in flags.items():
         name = k[6:] if k.startswith("FLAGS_") else k
         lib().pt_flags_set(name.encode(), str(v).encode())
+    # live hooks: flags that change framework behavior immediately
+    if any(k.endswith("check_nan_inf") or k.endswith("check_nan_inf_level")
+           for k in flags):
+        from ..core.tensor import set_nan_inf_check
+        cur = get_flags(["FLAGS_check_nan_inf", "FLAGS_check_nan_inf_level"])
+        set_nan_inf_check(cur["FLAGS_check_nan_inf"] or 0,
+                          cur["FLAGS_check_nan_inf_level"] or 0)
 
 
 def get_flags(names):

@@ -1,0 +1,103 @@
+"""cpp_extension toolchain test: JIT-build a C++ op, run it eagerly, under
+jit, and through autograd (reference: test_custom_relu_op_jit.py pattern)."""
+import os
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+SRC = textwrap.dedent("""
+    #include <cstdint>
+    extern "C" void square_fwd(const float* x, long long n, float* y) {
+        for (long long i = 0; i < n; ++i) y[i] = x[i] * x[i];
+    }
+    extern "C" void square_bwd(const float* x, const float* gy,
+                               long long n, float* gx) {
+        for (long long i = 0; i < n; ++i) gx[i] = 2.0f * x[i] * gy[i];
+    }
+    extern "C" void weighted_sum(const float** ins, const long long* sizes,
+                                 int n_inputs, float* out) {
+        for (long long i = 0; i < sizes[0]; ++i) {
+            float acc = 0.0f;
+            for (int k = 0; k < n_inputs; ++k) acc += ins[k][i];
+            out[i] = acc;
+        }
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext():
+    from paddle_tpu.utils import cpp_extension as cpp
+    with tempfile.NamedTemporaryFile("w", suffix=".cc",
+                                     delete=False) as f:
+        f.write(SRC)
+        path = f.name
+    mod = cpp.load("test_sq_ext", [path], verbose=True)
+    yield mod
+    os.unlink(path)
+
+
+def test_elementwise_op_forward(ext):
+    import jax.numpy as jnp
+    op = ext.elementwise_op("square_fwd")
+    x = np.array([1.0, -2.0, 3.0], np.float32)
+    np.testing.assert_allclose(np.asarray(op(jnp.asarray(x))), x * x)
+
+
+def test_elementwise_op_under_jit(ext):
+    import jax
+    import jax.numpy as jnp
+    op = ext.elementwise_op("square_fwd")
+    jop = jax.jit(lambda v: op(v) + 1.0)
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    np.testing.assert_allclose(np.asarray(jop(jnp.asarray(x))),
+                               x * x + 1.0)
+
+
+def test_elementwise_op_grad(ext):
+    import jax
+    import jax.numpy as jnp
+    op = ext.elementwise_op("square_fwd", grad_symbol="square_bwd")
+    x = jnp.asarray([1.0, -2.0, 3.0])
+    g = jax.grad(lambda v: op(v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), [2.0, -4.0, 6.0])
+
+
+def test_custom_multi_input_op(ext):
+    import jax.numpy as jnp
+    op = ext.custom_op("weighted_sum", n_inputs=3)
+    a = np.ones((4,), np.float32)
+    b = np.full((4,), 2.0, np.float32)
+    c = np.full((4,), 3.0, np.float32)
+    out = op(jnp.asarray(a), jnp.asarray(b), jnp.asarray(c))
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+
+
+def test_missing_symbol_raises(ext):
+    with pytest.raises(AttributeError, match="no symbol"):
+        ext.elementwise_op("nope_fn")
+
+
+def test_compile_error_raises():
+    from paddle_tpu.utils import cpp_extension as cpp
+    with tempfile.NamedTemporaryFile("w", suffix=".cc",
+                                     delete=False) as f:
+        f.write("this is not C++")
+        path = f.name
+    with pytest.raises(RuntimeError, match="compilation"):
+        cpp.load("broken_ext", [path])
+    os.unlink(path)
+
+
+def test_integration_with_framework_autograd(ext):
+    """Custom op inside a paddle_tpu train step."""
+    import paddle_tpu as pt
+    from paddle_tpu.core.tensor import dispatch
+    op = ext.elementwise_op("square_fwd", grad_symbol="square_bwd")
+    x = pt.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    y = dispatch(op, x, name="custom_square")
+    s = dispatch(lambda v: v.sum(), y, name="sum")
+    s.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])

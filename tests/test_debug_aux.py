@@ -1,0 +1,117 @@
+"""Debug/aux subsystem tests: nan/inf sanitizer, fused softmax mask ops,
+auto-checkpoint, run_check, memory stats."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+class TestNanInfCheck:
+    def teardown_method(self):
+        from paddle_tpu.core.tensor import set_nan_inf_check
+        set_nan_inf_check(False)
+
+    def test_raises_on_nan(self):
+        from paddle_tpu import runtime
+        runtime.set_flags({"FLAGS_check_nan_inf": 1})
+        x = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="Inf/Nan"):
+            pt.log(x - 1.0)  # log(-1) and log(0)
+
+    def test_warn_level(self):
+        from paddle_tpu import runtime
+        runtime.set_flags({"FLAGS_check_nan_inf": 1,
+                           "FLAGS_check_nan_inf_level": 1})
+        x = pt.to_tensor(np.array([-1.0], np.float32))
+        with pytest.warns(UserWarning, match="Inf/Nan"):
+            pt.sqrt(x)
+
+    def test_off_by_default(self):
+        from paddle_tpu import runtime
+        runtime.set_flags({"FLAGS_check_nan_inf": 0})
+        x = pt.to_tensor(np.array([-1.0], np.float32))
+        out = pt.sqrt(x)  # silently nan, like the reference default
+        assert np.isnan(out.numpy()).all()
+
+    def test_checks_grad_path_outputs(self):
+        from paddle_tpu import runtime
+        runtime.set_flags({"FLAGS_check_nan_inf": 1,
+                           "FLAGS_check_nan_inf_level": 0})
+        x = pt.to_tensor(np.array([0.0], np.float32),
+                         stop_gradient=False)
+        with pytest.raises(FloatingPointError):
+            pt.ops.OPS["divide"](pt.to_tensor(np.float32(1.0)), x)
+
+
+class TestFusedSoftmaxMask:
+    def test_softmax_mask_fuse(self):
+        from paddle_tpu import incubate
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2, 4, 4).astype(np.float32)
+        mask = np.where(rng.rand(2, 1, 4, 4) < 0.3, -10000.0,
+                        0.0).astype(np.float32)
+        out = incubate.softmax_mask_fuse(pt.to_tensor(x),
+                                         pt.to_tensor(mask)).numpy()
+        e = np.exp((x + mask) - (x + mask).max(-1, keepdims=True))
+        ref = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        rng = np.random.RandomState(0)
+        from paddle_tpu import incubate
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        out = incubate.softmax_mask_fuse_upper_triangle(
+            pt.to_tensor(x)).numpy()
+        # rows softmax over the causal prefix; strictly-upper entries 0
+        for i in range(5):
+            row = out[0, 0, i]
+            assert np.allclose(row[i + 1:], 0)
+            np.testing.assert_allclose(row[:i + 1].sum(), 1.0, rtol=1e-5)
+
+    def test_upper_triangle_grad_flows(self):
+        x = pt.to_tensor(np.random.randn(1, 1, 3, 3).astype(np.float32),
+                         stop_gradient=False)
+        from paddle_tpu import incubate
+        out = incubate.softmax_mask_fuse_upper_triangle(x)
+        pt.ops.OPS["sum"](out).backward()
+        assert x.grad is not None
+
+
+class TestAutoCheckpoint:
+    def test_resume_after_interrupt(self):
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+        d = tempfile.mkdtemp()
+        model = nn.Linear(4, 4)
+        opt = pt.optimizer.AdamW(parameters=model.parameters())
+
+        r1 = TrainEpochRange(5, "job", checkpoint_dir=d)
+        r1.add("model", model).add("opt", opt)
+        seen = []
+        for epoch in r1:
+            seen.append(epoch)
+            if epoch == 2:
+                break  # simulated preemption AFTER e2 save? break skips save
+        # epochs 0,1 were saved (save happens after yield); e2 not saved
+        assert seen == [0, 1, 2]
+
+        model2 = nn.Linear(4, 4)
+        opt2 = pt.optimizer.AdamW(parameters=model2.parameters())
+        r2 = TrainEpochRange(5, "job", checkpoint_dir=d)
+        r2.add("model", model2).add("opt", opt2)
+        assert r2.restored_from() == 1
+        rest = list(r2)
+        assert rest == [2, 3, 4]
+        # restored weights equal the e1 snapshot of the original model
+        np.testing.assert_allclose(model2.weight.numpy(),
+                                   model.weight.numpy())
+
+
+def test_run_check_and_memory_stats():
+    pt.utils.run_check()
+    from paddle_tpu import device
+    stats = device.memory_stats()
+    assert isinstance(stats, dict)

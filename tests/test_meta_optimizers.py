@@ -1,0 +1,190 @@
+"""Meta-optimizer zoo + recompute + LARS tests (reference
+test_fleet_gradient_merge_meta_optimizer.py / localsgd / dgc /
+test_fleet_lars_meta_optimizer.py patterns, eager-style)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.parallel.meta_optimizers import (
+    DGCOptimizer, GradientMergeOptimizer, LocalSGDOptimizer,
+    RecomputeOptimizer, apply_strategy_meta_optimizers)
+
+
+def _model_and_data(seed=0):
+    pt.seed(seed)
+    rng = np.random.RandomState(seed)
+    model = nn.Linear(4, 3)
+    x = pt.to_tensor(rng.randn(8, 4).astype(np.float32))
+    y = pt.to_tensor(rng.randint(0, 3, size=(8,)))
+    return model, x, y
+
+
+def _loss_step(model, x, y):
+    loss = nn.functional.cross_entropy(model(x), y)
+    loss.backward()
+    return float(loss.numpy())
+
+
+class TestGradientMerge:
+    def test_applies_every_k_steps(self):
+        model, x, y = _model_and_data()
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters())
+        opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+        w0 = model.weight.numpy().copy()
+        _loss_step(model, x, y)
+        opt.step()
+        opt.clear_grad()
+        np.testing.assert_allclose(model.weight.numpy(), w0)  # no update yet
+        _loss_step(model, x, y)
+        opt.step()
+        opt.clear_grad()
+        assert not np.allclose(model.weight.numpy(), w0)      # applied
+
+    def test_merged_equals_full_batch(self):
+        """Two half-batch merged steps == one full-batch step (the defining
+        property of gradient merge, reference TestDistBase tolerance)."""
+        modelA, x, y = _model_and_data()
+        modelB = nn.Linear(4, 3)
+        modelB.set_state_dict(modelA.state_dict())
+        # A: one step on the full batch
+        optA = pt.optimizer.SGD(learning_rate=0.1,
+                                parameters=modelA.parameters())
+        loss = nn.functional.cross_entropy(modelA(x), y)
+        loss.backward()
+        optA.step()
+        # B: two merged micro-steps on the halves
+        optB = GradientMergeOptimizer(
+            pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=modelB.parameters()), k_steps=2)
+        import jax.numpy as jnp
+        for sl in (slice(0, 4), slice(4, 8)):
+            xs = pt.to_tensor(x.numpy()[sl])
+            ys = pt.to_tensor(y.numpy()[sl])
+            li = nn.functional.cross_entropy(modelB(xs), ys)
+            li.backward()
+            optB.step()
+            optB.clear_grad()
+        np.testing.assert_allclose(modelA.weight.numpy(),
+                                   modelB.weight.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestDGC:
+    def test_sparsifies_with_error_feedback(self):
+        model, x, y = _model_and_data()
+        inner = pt.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters())
+        opt = DGCOptimizer(inner, rampup_begin_step=0, sparsity=0.75)
+        _loss_step(model, x, y)
+        g_before = model.weight.grad.numpy().copy()
+        opt.step()
+        # residual buffer holds the unsent mass
+        res = opt._residual[id(model.weight)]
+        nz = int((np.asarray(res) != 0).sum())
+        assert nz > 0  # something was withheld
+        # and training still converges
+        losses = []
+        for _ in range(20):
+            losses.append(_loss_step(model, x, y))
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0]
+
+    def test_rampup_defers_compression(self):
+        model, x, y = _model_and_data()
+        opt = DGCOptimizer(
+            pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=model.parameters()),
+            rampup_begin_step=100, sparsity=0.99)
+        _loss_step(model, x, y)
+        opt.step()
+        assert not opt._residual  # dense until rampup
+
+
+class TestLocalSGDAndRecompute:
+    def test_localsgd_steps(self):
+        model, x, y = _model_and_data()
+        opt = LocalSGDOptimizer(
+            pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=model.parameters()), k_steps=2)
+        w0 = model.weight.numpy().copy()
+        for _ in range(4):
+            _loss_step(model, x, y)
+            opt.step()
+            opt.clear_grad()
+        assert not np.allclose(model.weight.numpy(), w0)
+
+    def test_recompute_eager_matches_plain(self):
+        from paddle_tpu.parallel import recompute
+        model, x, y = _model_and_data()
+        ref = model(x).numpy()
+        out = recompute(model, x)
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_recompute_traced_uses_checkpoint(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.parallel import recompute
+
+        def f(v):
+            return recompute(lambda u: jnp.sin(u) * 2.0, v).sum()
+
+        g = jax.grad(f)(jnp.ones((4,)))
+        np.testing.assert_allclose(np.asarray(g), 2.0 * np.cos(1.0),
+                                   rtol=1e-6)
+
+    def test_recompute_sequential(self):
+        import jax.numpy as jnp
+        from paddle_tpu.parallel import recompute_sequential
+        fns = [lambda v: v + 1.0, lambda v: v * 2.0, lambda v: v - 3.0]
+        out = recompute_sequential({"segments": 2}, fns, jnp.ones((2,)))
+        np.testing.assert_allclose(np.asarray(out), 1.0)  # ((1+1)*2)-3
+
+
+class TestStrategyComposition:
+    def test_apply_strategy_stacks_wrappers(self):
+        from paddle_tpu.parallel.fleet import DistributedStrategy
+        model, _, _ = _model_and_data()
+        st = DistributedStrategy()
+        st.dgc = True
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 4}
+        st.localsgd = True
+        opt = apply_strategy_meta_optimizers(
+            pt.optimizer.SGD(parameters=model.parameters()), st)
+        # localsgd(gm(dgc(sgd)))
+        assert isinstance(opt, LocalSGDOptimizer)
+        assert isinstance(opt.inner_opt, GradientMergeOptimizer)
+        assert opt.inner_opt.k_steps == 4
+        assert isinstance(opt.inner_opt.inner_opt, DGCOptimizer)
+
+
+class TestLars:
+    def test_lars_trains(self):
+        model, x, y = _model_and_data()
+        opt = pt.optimizer.LarsMomentum(learning_rate=0.1,
+                                        parameters=model.parameters())
+        losses = []
+        for _ in range(15):
+            losses.append(_loss_step(model, x, y))
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0]
+
+    def test_lars_trust_ratio_scales_update(self):
+        """Update magnitude tracks ||p||/||g|| (definition of LARS)."""
+        pt.seed(0)
+        p_big = pt.Parameter(np.full((4, 4), 10.0, np.float32))
+        p_small = pt.Parameter(np.full((4, 4), 0.1, np.float32))
+        g = np.ones((4, 4), np.float32)
+        for p in (p_big, p_small):
+            p.grad = pt.to_tensor(g)
+        opt = pt.optimizer.LarsMomentum(
+            learning_rate=1.0, momentum=0.0, lars_weight_decay=0.0,
+            parameters=[p_big, p_small])
+        before_b, before_s = p_big.numpy().copy(), p_small.numpy().copy()
+        opt.step()
+        db = np.abs(p_big.numpy() - before_b).mean()
+        ds = np.abs(p_small.numpy() - before_s).mean()
+        assert db / ds > 50  # big params get proportionally bigger steps

@@ -101,13 +101,17 @@ def test_convert_freezes_observer():
     model = Q.PTQ(cfg).quantize(nn.Sequential(nn.Linear(4, 4)))
     model(pt.to_tensor(rng.randn(8, 4).astype(np.float32)))
     ptq = Q.PTQ(cfg)
-    ptq.convert(model)
-    (_, quanted), = [kv for kv in model.named_sublayers()
+    frozen = ptq.convert(model)
+    (_, quanted), = [kv for kv in frozen.named_sublayers()
                      if type(kv[1]).__name__ == "QuantedLinear"]
     before = float(quanted.activation_quanter.scales().numpy())
-    model(pt.to_tensor(rng.randn(8, 4).astype(np.float32) * 100))
+    frozen(pt.to_tensor(rng.randn(8, 4).astype(np.float32) * 100))
     after = float(quanted.activation_quanter.scales().numpy())
     assert before == after  # outlier serving batch must not move scales
+    # the live calibration model still observes (inplace=False semantics)
+    (_, live_q), = [kv for kv in model.named_sublayers()
+                    if type(kv[1]).__name__ == "QuantedLinear"]
+    assert live_q.activation_quanter._frozen is False
 
 
 def test_double_quantize_does_not_double_wrap():
@@ -155,9 +159,22 @@ def test_channelwise_axis_inferred_per_layer_kind():
     assert qc.weight_quanter.scales().shape == [3, 1, 1, 1]
 
 
-def test_fleet_stop_worker_safe_without_ps():
-    from paddle_tpu.parallel import fleet as fleet_mod
-    f = fleet_mod._Fleet()
-    f.stop_worker()  # must be a no-op, not AttributeError
-    f.run_server()
-    f.init_worker()
+
+def test_transpose_conv_quant_axis():
+    convT = nn.Conv2DTranspose(4, 6, 3)
+    cfg = Q.QuantConfig(weight=Q.FakeQuanterChannelWiseAbsMax())
+    q = cfg._default.weight._instance(convT)
+    assert q.quant_axis() == 1  # [in, out//g, kh, kw] out-channel axis
+
+
+def test_nan_inf_flag_accepts_bool_and_strings():
+    from paddle_tpu import runtime
+    from paddle_tpu.core import tensor as ct
+    runtime.set_flags({"FLAGS_check_nan_inf": True})
+    assert ct._check_nan_inf is True
+    runtime.set_flags({"FLAGS_check_nan_inf": "false"})
+    assert ct._check_nan_inf is False
+    runtime.set_flags({"FLAGS_check_nan_inf": "1"})
+    assert ct._check_nan_inf is True
+    runtime.set_flags({"FLAGS_check_nan_inf": 0})
+    assert ct._check_nan_inf is False

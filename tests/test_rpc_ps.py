@@ -189,3 +189,56 @@ def test_fleet_ps_mode():
         if p.is_alive():
             p.terminate()
     assert all(s == "ok" for s, _ in results), results
+
+
+def test_fleet_stop_worker_safe_without_ps():
+    from paddle_tpu.parallel import fleet as fleet_mod
+    f = fleet_mod._Fleet()
+    f.stop_worker()  # must be a no-op, not AttributeError
+    f.run_server()
+    f.init_worker()
+
+
+def _unpicklable():
+    return lambda: None  # locals in a lambda aren't picklable by name
+
+
+def _rpc_worker2(rank, world, port, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from paddle_tpu.parallel import rpc
+    rpc.init_rpc(f"w{rank}", rank=rank, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        if rank == 0:
+            # unpicklable result must come back as a prompt RuntimeError,
+            # not a hung socket timeout
+            t0 = time.time()
+            try:
+                rpc.rpc_sync("w1", _unpicklable, timeout=60)
+                q.put(("fail", "no error for unpicklable result"))
+                return
+            except RuntimeError as e:
+                assert "not picklable" in str(e), str(e)
+            assert time.time() - t0 < 30, "should fail fast, not time out"
+            # persistent connection: many calls reuse one socket happily
+            for i in range(20):
+                assert rpc.rpc_sync("w1", _sq, args=(i,)) == i * i
+            q.put(("ok", rank))
+        else:
+            q.put(("ok", rank))
+    finally:
+        rpc.shutdown()
+
+
+def test_rpc_unpicklable_and_persistent_conns():
+    ctx = mp.get_context("spawn")
+    port = _free_port()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rpc_worker2, args=(r, 2, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=90) for _ in procs]
+    for p in procs:
+        p.join(timeout=90)
+    assert all(s == "ok" for s, _ in results), results
