@@ -44,7 +44,8 @@ def __getattr__(name):
     if name in ("parallel", "io", "hapi", "metric", "profiler", "vision",
                 "models", "utils", "incubate", "static", "device", "runtime",
                 "inference", "sparse", "text", "audio", "geometric",
-                "quantization", "distribution"):
+                "quantization", "distribution", "fft", "signal",
+                "regularizer"):
         import importlib
         try:
             mod = importlib.import_module(f".{name}", __name__)

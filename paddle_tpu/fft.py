@@ -1,0 +1,146 @@
+"""paddle.fft parity over jnp.fft (XLA's native FFT lowering on TPU).
+
+Reference: python/paddle/fft.py (fft/ifft/rfft/... + freq/shift helpers;
+phi kernels paddle/phi/kernels/funcs/fft.h). Norm conventions follow the
+reference: "backward" (default), "forward", "ortho". Every transform goes
+through dispatch() so eager autograd records it on the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import dispatch, wrap
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fftn",
+           "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn", "fft2", "ifft2",
+           "rfft2", "irfft2", "hfft2", "ihfft2", "fftfreq", "rfftfreq",
+           "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    if norm not in (None, "backward", "forward", "ortho"):
+        raise ValueError(f"invalid norm {norm!r}; expected backward/"
+                         f"forward/ortho")
+    return None if norm == "backward" else norm
+
+
+def _wrap1(np_fn, opname):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        nm = _norm(norm)
+        return dispatch(lambda v: np_fn(v, n=n, axis=axis, norm=nm),
+                        x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+def _wrapn(np_fn, opname):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        nm = _norm(norm)
+        return dispatch(lambda v: np_fn(v, s=s, axes=axes, norm=nm),
+                        x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+def _wrap2(np_fn, opname):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        nm = _norm(norm)
+        return dispatch(lambda v: np_fn(v, s=s, axes=axes, norm=nm),
+                        x, name=opname)
+    op.__name__ = opname
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+
+
+def _out_sizes(shape, s, axes):
+    sizes = {ax: shape[ax] for ax in axes}
+    if s is not None:
+        for ax, n in zip(axes, s):
+            sizes[ax] = n
+    return sizes
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Hermitian-input N-D FFT → real output. Identity used:
+    hfftn(x) = N_total * irfftn(conj(x)) with global normalization over
+    the full transform size, matching the reference's c2r kernel."""
+    _norm(norm)
+
+    def fn(xv):
+        ax = tuple(range(xv.ndim)) if axes is None else tuple(axes)
+        out = jnp.fft.irfftn(jnp.conj(xv), s=s, axes=ax, norm=None)
+        n_total = 1
+        for a in ax:
+            n_total *= out.shape[a]
+        if norm in (None, "backward"):
+            scale = n_total
+        elif norm == "forward":
+            scale = 1.0
+        else:  # ortho
+            scale = jnp.sqrt(jnp.asarray(float(n_total)))
+        return out * scale
+
+    return dispatch(fn, x, name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: ihfftn(x) = conj(rfftn(x)) / N_total (backward)."""
+    _norm(norm)
+
+    def fn(xv):
+        ax = tuple(range(xv.ndim)) if axes is None else tuple(axes)
+        out = jnp.conj(jnp.fft.rfftn(xv, s=s, axes=ax, norm=None))
+        n_total = 1
+        for a in ax:
+            n_total *= xv.shape[a] if s is None else \
+                dict(zip(ax, s)).get(a, xv.shape[a])
+        if norm in (None, "backward"):
+            scale = 1.0 / n_total
+        elif norm == "forward":
+            scale = 1.0
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.asarray(float(n_total)))
+        return out * scale
+
+    return dispatch(fn, x, name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return wrap(jnp.fft.fftfreq(n, d).astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return wrap(jnp.fft.rfftfreq(n, d).astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return dispatch(lambda v: jnp.fft.fftshift(v, axes=axes), x,
+                    name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return dispatch(lambda v: jnp.fft.ifftshift(v, axes=axes), x,
+                    name="ifftshift")

@@ -33,6 +33,17 @@ class _MetaOptimizer:
     def inner_opt(self):
         return self._inner
 
+    # HybridParallelOptimizer installs its distributed grad clip via
+    # `opt._grad_clip = ...`; proxy the write down to the real optimizer
+    # (plain __getattr__ only delegates reads)
+    @property
+    def _grad_clip(self):
+        return self._inner._grad_clip
+
+    @_grad_clip.setter
+    def _grad_clip(self, value):
+        self._inner._grad_clip = value
+
 
 class GradientMergeOptimizer(_MetaOptimizer):
     """Accumulate grads for k_steps micro-steps, apply once

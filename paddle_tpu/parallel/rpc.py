@@ -43,6 +43,7 @@ class _RpcState:
         self._conns = threading.local()  # per-thread connection cache
 
     def connection(self, info: WorkerInfo, timeout):
+        """Returns (conn, was_cached)."""
         cache = getattr(self._conns, "map", None)
         if cache is None:
             cache = self._conns.map = {}
@@ -51,7 +52,8 @@ class _RpcState:
         if conn is None:
             conn = socket.create_connection(key, timeout=timeout)
             cache[key] = conn
-        return conn
+            return conn, False
+        return conn, True
 
     def drop_connection(self, info: WorkerInfo):
         cache = getattr(self._conns, "map", None)
@@ -138,7 +140,8 @@ class _Server:
                         result = (True, fn(*args, **kwargs))
                     except Exception as e:  # ship the exception back
                         result = (False, e)
-                    _send_msg(conn, _safe_dumps(result))
+                    body = _safe_dumps(result)
+                    _send_msg(conn, _sign(self.cookie, body) + body)
         except (ConnectionError, OSError):
             pass
 
@@ -224,18 +227,30 @@ def _barrier(tolerant=False):
 def _call(info: WorkerInfo, payload, timeout):
     st = _require_state()
     frame = _sign(st.cookie, payload) + payload
+    conn, cached = st.connection(info, timeout)
+    conn.settimeout(timeout)
     try:
-        conn = st.connection(info, timeout)
-        conn.settimeout(timeout)
         _send_msg(conn, frame)
-        ok, value = pickle.loads(_recv_msg(conn))
     except (ConnectionError, OSError):
-        # stale cached connection (peer restarted): retry once fresh
+        # at-most-once: retry ONLY send-phase failures on a cached (likely
+        # stale) connection — the request never reached the peer
         st.drop_connection(info)
-        conn = st.connection(info, timeout)
+        if not cached:
+            raise
+        conn, _ = st.connection(info, timeout)
         conn.settimeout(timeout)
         _send_msg(conn, frame)
-        ok, value = pickle.loads(_recv_msg(conn))
+    try:
+        reply = _recv_msg(conn)
+    except Exception:
+        # request may have executed; never re-send (non-idempotent calls)
+        st.drop_connection(info)
+        raise
+    digest, body = reply[:_DIGEST_LEN], reply[_DIGEST_LEN:]
+    if not hmac_mod.compare_digest(digest, _sign(st.cookie, body)):
+        st.drop_connection(info)
+        raise ConnectionError("rpc response failed authentication")
+    ok, value = pickle.loads(body)
     if not ok:
         raise value
     return value

@@ -1,0 +1,31 @@
+"""paddle.regularizer parity (L1Decay/L2Decay).
+
+Reference: python/paddle/regularizer.py → fluid/regularizer.py. Applied by
+optimizers at step time (L2 folds into weight_decay; L1 adds sign(p))."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

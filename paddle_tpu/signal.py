@@ -1,0 +1,151 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py:31-236 (frame/overlap_add ops backed by
+phi frame_kernel/overlap_add_kernel; stft composed from frame+matmul).
+TPU-native: framing is a strided gather and overlap_add a segment-sum —
+both single XLA ops that fuse with the surrounding FFT pipeline. Public
+ops go through dispatch() so the eager tape records them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, dispatch, unwrap
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_v(xv, frame_length, hop_length, axis):
+    seq = xv.shape[axis]
+    n_frames = 1 + (seq - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    if axis in (-1, xv.ndim - 1):
+        out = xv[..., idx]                       # [..., n_frames, frame_len]
+        return jnp.swapaxes(out, -1, -2)         # [..., frame_len, n_frames]
+    if axis == 0:
+        return xv[idx]                           # [n_frames, frame_len, ...]
+    raise ValueError("axis must be 0 or -1")
+
+
+def _overlap_add_v(xv, hop_length, axis):
+    if axis in (-1, xv.ndim - 1):
+        frame_length, n_frames = xv.shape[-2], xv.shape[-1]
+        frames = jnp.swapaxes(xv, -1, -2)        # [..., n_frames, frame_len]
+    elif axis == 0:
+        n_frames, frame_length = xv.shape[0], xv.shape[1]
+        frames = jnp.moveaxis(xv, (0, 1), (-2, -1))
+    else:
+        raise ValueError("axis must be 0 or -1")
+    out_len = (n_frames - 1) * hop_length + frame_length
+    pos = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :]).reshape(-1)
+    flat = frames.reshape(frames.shape[:-2] + (-1,))
+    if flat.ndim == 1:
+        out = jax.ops.segment_sum(flat, pos, num_segments=out_len)
+    else:
+        lead = flat.shape[:-1]
+        out = jax.vmap(lambda f: jax.ops.segment_sum(
+            f, pos, num_segments=out_len))(flat.reshape(-1, flat.shape[-1]))
+        out = out.reshape(lead + (out_len,))
+    if axis == 0 and xv.ndim > 2:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames; frame axis is added next to `axis`
+    ([..., seq] -> [..., frame_length, num_frames] for axis=-1,
+    [seq, ...] -> [num_frames, frame_length, ...] for axis=0)."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    xv = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if frame_length > xv.shape[axis]:
+        raise ValueError(f"frame_length ({frame_length}) > sequence length "
+                         f"({xv.shape[axis]})")
+    return dispatch(lambda v: _frame_v(v, frame_length, hop_length, axis),
+                    x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames back into a signal."""
+    return dispatch(lambda v: _overlap_add_v(v, hop_length, axis), x,
+                    name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """[B, T] (or [T]) -> complex [B, n_fft//2+1, n_frames] like the
+    reference (signal.py:236)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_in = None if window is None else (
+        unwrap(window) if isinstance(window, Tensor)
+        else jnp.asarray(window))
+
+    def fn(xv):
+        squeeze = xv.ndim == 1
+        if squeeze:
+            xv = xv[None]
+        win = jnp.ones(win_length, xv.dtype) if win_in is None \
+            else win_in.astype(xv.dtype)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        if center:
+            xv = jnp.pad(xv, [(0, 0), (n_fft // 2, n_fft // 2)],
+                         mode=pad_mode)
+        frames = _frame_v(xv, n_fft, hop_length, -1)     # [B, n_fft, F]
+        frames = jnp.swapaxes(frames, -1, -2) * win      # [B, F, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)                 # [B, bins, F]
+        return out[0] if squeeze else out
+
+    return dispatch(fn, x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.py istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win_in = None if window is None else (
+        unwrap(window) if isinstance(window, Tensor)
+        else jnp.asarray(window))
+
+    def fn(xv):
+        squeeze = xv.ndim == 2
+        if squeeze:
+            xv = xv[None]
+        win = jnp.ones(win_length, jnp.float32) if win_in is None \
+            else win_in.astype(jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(xv, -1, -2)                  # [B, F, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(spec, axis=-1).real             # [B, F, n_fft]
+        frames = frames * win
+        sig = _overlap_add_v(jnp.swapaxes(frames, -1, -2), hop_length, -1)
+        env = _overlap_add_v(
+            jnp.broadcast_to((win * win)[:, None],
+                             (n_fft, frames.shape[1])), hop_length, -1)
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2:]
+            if length is None:
+                sig = sig[..., :sig.shape[-1] - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig[0] if squeeze else sig
+
+    return dispatch(fn, x, name="istft")

@@ -47,7 +47,11 @@ def _compile(name, sources, extra_cxx_cflags, extra_ldflags,
     for s in srcs:
         with open(s, "rb") as f:
             tag.update(f.read())
+    # everything that changes the build output must key the cache
+    # (headers reached via -I are not tracked; bump a flag to force)
     tag.update(" ".join(extra_cxx_cflags or []).encode())
+    tag.update(b"|" + " ".join(extra_ldflags or []).encode())
+    tag.update(b"|" + " ".join(extra_include_paths or []).encode())
     so_path = os.path.join(build_dir, f"{name}_{tag.hexdigest()[:12]}.so")
     if not os.path.exists(so_path):
         cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]

@@ -1,0 +1,168 @@
+"""paddle.fft / paddle.signal / paddle.regularizer tests (numpy oracle)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(8).astype(np.float32)
+        X = pt.fft.fft(pt.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(X._value),
+                                   np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = pt.fft.ifft(X)
+        np.testing.assert_allclose(np.asarray(back._value).real, x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rfft_irfft(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16).astype(np.float32)
+        X = pt.fft.rfft(pt.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(X._value), np.fft.rfft(x),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            pt.fft.irfft(X).numpy(), x, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("norm", ["backward", "forward", "ortho"])
+    def test_norms(self, norm):
+        x = np.arange(8, dtype=np.float32)
+        got = np.asarray(pt.fft.fft(pt.to_tensor(x), norm=norm)._value)
+        ref = np.fft.fft(x, norm=None if norm == "backward" else norm)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_fftn(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(pt.fft.fft2(pt.to_tensor(x))._value),
+            np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(pt.fft.rfftn(pt.to_tensor(x))._value),
+            np.fft.rfftn(x), rtol=1e-4, atol=1e-4)
+
+    def test_hfft_ihfft(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(9).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(pt.fft.hfft(pt.to_tensor(x))._value),
+            np.fft.hfft(x), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(
+            np.asarray(pt.fft.ihfft(pt.to_tensor(x))._value),
+            np.fft.ihfft(x), rtol=1e-4, atol=1e-4)
+
+    def test_freq_shift(self):
+        np.testing.assert_allclose(pt.fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5), rtol=1e-6)
+        np.testing.assert_allclose(pt.fft.rfftfreq(8).numpy(),
+                                   np.fft.rfftfreq(8), rtol=1e-6)
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_allclose(
+            pt.fft.fftshift(pt.to_tensor(x)).numpy(), np.fft.fftshift(x))
+        np.testing.assert_allclose(
+            pt.fft.ifftshift(pt.to_tensor(x)).numpy(),
+            np.fft.ifftshift(x))
+
+    def test_invalid_norm_raises(self):
+        with pytest.raises(ValueError, match="invalid norm"):
+            pt.fft.fft(pt.to_tensor(np.ones(4, np.float32)), norm="bad")
+
+
+class TestSignal:
+    def test_frame(self):
+        x = np.arange(10, dtype=np.float32)
+        f = pt.signal.frame(pt.to_tensor(x), 4, 2).numpy()
+        assert f.shape == (4, 4)  # [frame_len, n_frames]
+        np.testing.assert_allclose(f[:, 0], [0, 1, 2, 3])
+        np.testing.assert_allclose(f[:, 1], [2, 3, 4, 5])
+
+    def test_overlap_add_inverts_frame_nonoverlap(self):
+        x = np.arange(12, dtype=np.float32)
+        f = pt.signal.frame(pt.to_tensor(x), 4, 4)
+        back = pt.signal.overlap_add(f, 4).numpy()
+        np.testing.assert_allclose(back, x)
+
+    def test_overlap_add_sums_overlaps(self):
+        frames = np.ones((3, 2), np.float32)  # [frame_len, n_frames]
+        out = pt.signal.overlap_add(pt.to_tensor(frames), 1).numpy()
+        np.testing.assert_allclose(out, [1, 2, 2, 1])
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 512).astype(np.float32)
+        from paddle_tpu.audio.functional import get_window
+        win = get_window("hann", 128)
+        spec = pt.signal.stft(pt.to_tensor(x), n_fft=128, hop_length=32,
+                              window=win)
+        assert spec.shape == [2, 65, 1 + 512 // 32]
+        back = pt.signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                               length=512)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_matches_numpy(self):
+        x = np.sin(np.arange(256, dtype=np.float32))
+        spec = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=64,
+                              center=False).numpy()
+        ref0 = np.fft.rfft(x[:64])
+        np.testing.assert_allclose(spec[:, 0], ref0, rtol=1e-3, atol=1e-3)
+
+
+def test_regularizer():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    import jax.numpy as jnp
+    p = jnp.asarray([1.0, -2.0])
+    g = jnp.zeros(2)
+    np.testing.assert_allclose(np.asarray(L2Decay(0.1)(p, g)),
+                               [0.1, -0.2], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(L1Decay(0.5)(p, g)),
+                               [0.5, -0.5], rtol=1e-6)
+
+
+class TestGradFlow:
+    def test_fft_grad_flows_through_tape(self):
+        rng = np.random.RandomState(0)
+        x = pt.to_tensor(rng.randn(8).astype(np.float32),
+                         stop_gradient=False)
+        y = pt.fft.rfft(x)
+        import jax.numpy as jnp
+        mag = pt.ops.OPS["sum"](
+            pt.to_tensor(0.0) + y.abs() if hasattr(y, "abs") else y)
+        # simpler: real-valued reduction via dispatch
+        from paddle_tpu.core.tensor import dispatch
+        loss = dispatch(lambda v: jnp.sum(jnp.abs(v) ** 2), y,
+                        name="energy")
+        loss.backward()
+        assert x.grad is not None
+        # Parseval: d/dx sum|rfft(x)|^2 = 2*n*x for real input (approx;
+        # one-sided spectrum halves interior bins -> just check nonzero)
+        assert np.abs(x.grad.numpy()).sum() > 0
+
+    def test_frame_grad_flows(self):
+        x = pt.to_tensor(np.arange(10, dtype=np.float32),
+                         stop_gradient=False)
+        f = pt.signal.frame(x, 4, 2)
+        pt.ops.OPS["sum"](f).backward()
+        assert x.grad is not None
+        # each sample participates in the #frames covering it
+        assert x.grad.numpy().max() == 2.0  # hop 2, len 4 -> overlap 2
+
+    def test_hfftn_matches_1d_hfft(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(9).astype(np.float32) + 1j * rng.randn(9).astype(
+            np.float32)
+        import jax.numpy as jnp
+        for norm in ("backward", "forward", "ortho"):
+            got = np.asarray(pt.fft.hfftn(
+                pt.to_tensor(np.asarray(x)), axes=(0,), norm=norm)._value)
+            ref = np.fft.hfft(x, norm=None if norm == "backward" else norm)
+            np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+    def test_ihfftn_matches_1d_ihfft(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(10).astype(np.float32)
+        for norm in ("backward", "forward", "ortho"):
+            got = np.asarray(pt.fft.ihfftn(
+                pt.to_tensor(x), axes=(0,), norm=norm)._value)
+            ref = np.fft.ihfft(x, norm=None if norm == "backward" else norm)
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
