@@ -1,0 +1,55 @@
+import time, numpy as np, jax, jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu.jit import functional_call
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_345m
+
+cpu = jax.local_devices(backend="cpu")[0]
+with jax.default_device(cpu):
+    cfg = gpt2_345m(dropout=0.0)
+    model = GPTForCausalLM(cfg); model.astype("bfloat16"); model.eval()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    init_fn, update_fn = opt.functional()
+    params0 = model.raw_params()
+    state0 = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), init_fn(params0))
+dev = jax.devices()[0]
+n_params = sum(int(np.prod(v.shape)) for v in params0.values())
+print("init done", flush=True)
+
+def loss_softmax(logits, labels):
+    lg = logits[:, :-1]; lb = labels[:, 1:]
+    logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+    return -jnp.take_along_axis(logp, lb[..., None], -1).mean()
+
+def loss_lse(logits, labels):
+    lg = logits[:, :-1]; lb = labels[:, 1:]
+    tgt = jnp.take_along_axis(lg, lb[..., None], -1)[..., 0].astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg.astype(jnp.float32), axis=-1)
+    return (lse - tgt).mean()
+
+def bench(loss_fn, batch, tag, iters=6):
+    params = jax.device_put(params0, dev)
+    state = jax.device_put(state0, dev)
+    def step(params, state, ids, i):
+        def compute(ps):
+            return loss_fn(functional_call(model, ps, ids), ids)
+        loss, grads = jax.value_and_grad(compute)(params)
+        new_p, new_s = update_fn(grads, params, state, step=i)
+        return loss, new_p, new_s
+    step = jax.jit(step, donate_argnums=(0, 1))
+    ids = jax.device_put(np.random.randint(0, cfg.vocab_size, size=(batch, 1024)).astype(np.int32), dev)
+    loss, params, state = step(params, state, ids, 1); float(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        loss, params, state = step(params, state, ids, i+2)
+    fl = float(loss); dt = (time.perf_counter()-t0)/iters
+    tok = batch*1024/dt
+    print(f"{tag}: {dt*1000:.1f} ms/step, {tok:,.0f} tok/s, mfu={tok*6*n_params/197e12:.3f}", flush=True)
+
+import sys
+which = sys.argv[1]
+if which == "a":
+    bench(loss_softmax, 8, "b8-softmax")
+    bench(loss_lse, 8, "b8-lse")
+else:
+    bench(loss_lse, 16, "b16-lse")
+    bench(loss_lse, 32, "b32-lse")

@@ -1,0 +1,50 @@
+import sys, time, numpy as np, jax, jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu.jit import functional_call
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_345m
+
+kind, batch = sys.argv[1], int(sys.argv[2])
+cpu = jax.local_devices(backend="cpu")[0]
+t0 = time.time()
+with jax.default_device(cpu):
+    cfg = gpt2_345m(dropout=0.0)
+    model = GPTForCausalLM(cfg); model.astype("bfloat16"); model.eval()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    init_fn, update_fn = opt.functional()
+    params = model.raw_params()
+    state = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), init_fn(params))
+print("init", round(time.time()-t0, 1), flush=True)
+dev = jax.devices()[0]
+params = jax.device_put(params, dev); state = jax.device_put(state, dev)
+n_params = sum(int(np.prod(v.shape)) for v in params.values())
+
+def loss_softmax(logits, labels):
+    lg = logits[:, :-1]; lb = labels[:, 1:]
+    logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+    return -jnp.take_along_axis(logp, lb[..., None], -1).mean()
+
+def loss_lse(logits, labels):
+    lg = logits[:, :-1]; lb = labels[:, 1:]
+    tgt = jnp.take_along_axis(lg, lb[..., None], -1)[..., 0].astype(jnp.float32)
+    lse = jax.nn.logsumexp(lg.astype(jnp.float32), axis=-1)
+    return (lse - tgt).mean()
+
+loss_fn = {"softmax": loss_softmax, "lse": loss_lse}[kind]
+
+def step(params, state, ids, i):
+    def compute(ps):
+        return loss_fn(functional_call(model, ps, ids), ids)
+    loss, grads = jax.value_and_grad(compute)(params)
+    new_p, new_s = update_fn(grads, params, state, step=i)
+    return loss, new_p, new_s
+step = jax.jit(step, donate_argnums=(0, 1))
+ids = jax.device_put(np.random.randint(0, cfg.vocab_size, size=(batch, 1024)).astype(np.int32), dev)
+t0 = time.time()
+loss, params, state = step(params, state, ids, 1); float(loss)
+print("compile+first", round(time.time()-t0, 1), flush=True)
+t0 = time.perf_counter(); iters = 6
+for i in range(iters):
+    loss, params, state = step(params, state, ids, i+2)
+fl = float(loss); dt = (time.perf_counter()-t0)/iters
+tok = batch*1024/dt
+print(f"RESULT {kind} b{batch}: {dt*1000:.1f} ms/step {tok:,.0f} tok/s mfu={tok*6*n_params/197e12:.3f}", flush=True)

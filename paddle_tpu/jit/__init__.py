@@ -71,11 +71,15 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         from ..nn.layer import Layer
         if isinstance(fn, Layer):
             return TranslatedLayer(fn)
+        # AST pass first: tensor-dependent if/while/for become lax control
+        # flow (reference ast_transformer pipeline), then jit
+        from .dy2static import convert_to_static
+        static_fn = convert_to_static(fn)
 
         @functools.wraps(fn)
         def wrapper(*args, **kw):
             vals = _unwrap_tree(args)
-            out = _jitted(fn)(*vals, **kw)
+            out = _jitted(static_fn)(*vals, **kw)
             return _wrap_tree(out)
 
         return wrapper

@@ -1,0 +1,427 @@
+"""dy2static: AST transforms turning tensor-dependent Python control flow
+into XLA-traceable lax primitives.
+
+Reference: python/paddle/jit/dy2static/ — ast_transformer.py (15
+transformers), convert_operators.py (convert_ifelse/convert_while_loop/
+convert_logical_and...), program_translator.py StaticFunction cache.
+
+TPU-native: instead of rewriting to a ProgramDesc, the rewritten function
+stays a JAX-traceable Python function — `if` on a traced scalar becomes
+`lax.cond`, `while` becomes `lax.while_loop`, `for i in range(traced_n)`
+becomes `lax.fori_loop`, and `and/or/not` on tensors become logical ops.
+When the predicate is a concrete Python value the original Python control
+flow runs unchanged, so one transformed function serves both eager and
+traced execution (the reference's dual-mode contract).
+
+Supported rewrite subset (same shape as the reference's core transformers):
+variables mutated in a branch/loop must already be bound before it, and
+branches must produce matching pytree structures — both are the standard
+lax.cond/while_loop contracts; violations raise with a clear message.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["convert_to_static", "Dy2StaticError", "convert_ifelse",
+           "convert_while_loop", "convert_for_range", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "convert_bool"]
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------- runtime
+
+def _raw(x):
+    from ..core.tensor import Tensor, unwrap
+    return unwrap(x) if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    x = _raw(x)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _pred(x):
+    """Predicate -> traced bool scalar or Python bool."""
+    r = _raw(x)
+    if isinstance(r, (jax.Array, jax.core.Tracer)):
+        if getattr(r, "ndim", 0) != 0 and getattr(r, "size", 1) != 1:
+            raise Dy2StaticError(
+                "control-flow predicate must be a scalar (got shape "
+                f"{getattr(r, 'shape', None)})")
+        return r.reshape(()).astype(bool) if _is_traced(r) else \
+            bool(jnp.reshape(r, ()))
+    return r
+
+
+def convert_ifelse(pred, true_fn, false_fn, args):
+    """reference convert_operators.py convert_ifelse."""
+    p = _pred(pred)
+    if isinstance(p, bool):
+        return true_fn(*args) if p else false_fn(*args)
+    from ..core.tensor import Tensor, unwrap
+
+    def strip(vals):
+        return jax.tree_util.tree_map(
+            lambda v: unwrap(v) if isinstance(v, Tensor) else v, vals,
+            is_leaf=lambda v: isinstance(v, Tensor))
+
+    args = strip(tuple(args))  # lax.cond operands must be raw arrays
+    try:
+        return lax.cond(p, lambda a: strip(true_fn(*a)),
+                        lambda a: strip(false_fn(*a)), args)
+    except TypeError as e:
+        raise Dy2StaticError(
+            f"if/else branches returned mismatched structures under "
+            f"tracing: {e}") from None
+
+
+def convert_while_loop(cond_fn, body_fn, carry):
+    p = _pred(cond_fn(*carry))
+    if isinstance(p, bool):  # concrete: plain Python loop
+        while _pred(cond_fn(*carry)):
+            carry = body_fn(*carry)
+        return carry
+
+    def c(state):
+        return _pred(cond_fn(*state))
+
+    def b(state):
+        return tuple(body_fn(*state))
+
+    return tuple(lax.while_loop(c, b, tuple(carry)))
+
+
+def convert_for_range(n, body_fn, carry):
+    """for i in range(n) with possibly-traced n -> fori_loop."""
+    if not _is_traced(n):
+        for i in range(int(_raw(n))):
+            carry = body_fn(i, *carry)
+        return carry
+
+    def b(i, state):
+        return tuple(body_fn(i, *state))
+
+    return tuple(lax.fori_loop(0, _raw(n), b, tuple(carry)))
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    if not _is_traced(l):
+        return rhs_fn() if l else l
+    return jnp.logical_and(_raw(l), _raw(rhs_fn()))
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    if not _is_traced(l):
+        return l if l else rhs_fn()
+    return jnp.logical_or(_raw(l), _raw(rhs_fn()))
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not x
+    return jnp.logical_not(_raw(x))
+
+
+def convert_bool(x):
+    """`if x:` predicate evaluation hook."""
+    return _pred(x)
+
+
+# --------------------------------------------------------------- analysis
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by assignment/augassign/for-target inside a block."""
+
+    def __init__(self):
+        self.names = []
+
+    def _add(self, t):
+        if isinstance(t, ast.Name):
+            if t.id not in self.names:
+                self.names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._add(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs bind their own scope
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _load_names(node):
+    return sorted({n.id for n in ast.walk(node)
+                   if isinstance(n, ast.Name)
+                   and isinstance(n.ctx, ast.Load)})
+
+
+def _has_disallowed(stmts):
+    """Return/break/continue/yield in THIS block's scope (nested function
+    defs — including our own generated branch functions — have their own
+    scope and must not count)."""
+    def scan(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return None
+        if isinstance(node, (ast.Return, ast.Break, ast.Continue,
+                             ast.Yield, ast.YieldFrom)):
+            return type(node).__name__
+        for child in ast.iter_child_nodes(node):
+            r = scan(child)
+            if r:
+                return r
+        return None
+
+    for s in stmts:
+        r = scan(s)
+        if r:
+            return r
+    return None
+
+
+_JST = "_paddle_tpu_jst"
+
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+def _jst_attr(fn):
+    return ast.Attribute(value=_name(_JST), attr=fn, ctx=ast.Load())
+
+
+# ------------------------------------------------------------ transformer
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """The reference's IfElse/Loop/Logical transformers in one pass."""
+
+    def __init__(self):
+        self._counter = 0
+
+    def _fresh(self, kind):
+        self._counter += 1
+        return f"__dy2st_{kind}_{self._counter}"
+
+    # -- logical ops -----------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = ast.Call(
+                func=_jst_attr(fn),
+                args=[ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], kwonlyargs=[],
+                          kw_defaults=[], defaults=[]), body=v),
+                      ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], kwonlyargs=[],
+                          kw_defaults=[], defaults=[]), body=out)],
+                keywords=[])
+        return ast.copy_location(out, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(ast.Call(
+                func=_jst_attr("convert_logical_not"),
+                args=[node.operand], keywords=[]), node)
+        return node
+
+    # -- if/else ---------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        bad = _has_disallowed(node.body) or _has_disallowed(node.orelse)
+        if bad:
+            return node  # leave untransformed: works eagerly, and under
+            # trace the predicate bool() raises a clear jax error
+        assigned = sorted(set(_assigned(node.body))
+                          | set(_assigned(node.orelse)))
+        if not assigned:
+            return node
+        tname, fname = self._fresh("true"), self._fresh("false")
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(a) for a in assigned], ctx=ast.Load()))
+
+        def mk(fn_name, body):
+            return ast.FunctionDef(
+                name=fn_name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=a) for a in assigned],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=(body or [ast.Pass()]) + [ret],
+                decorator_list=[], type_params=[])
+
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(a, ast.Store)
+                                     for a in assigned], ctx=ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("convert_ifelse"),
+                args=[node.test, _name(tname), _name(fname),
+                      ast.Tuple(elts=[_name(a) for a in assigned],
+                                ctx=ast.Load())],
+                keywords=[]))
+        out = [mk(tname, node.body), mk(fname, node.orelse), call]
+        for stmt in out:
+            ast.copy_location(stmt, node)
+            ast.fix_missing_locations(stmt)
+        return out
+
+    # -- while -----------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_disallowed(node.body):
+            return node
+        # only names REBOUND in the body become loop carries; names that
+        # are merely read resolve lexically from the enclosing scope
+        carry = [c for c in _assigned(node.body)
+                 if not c.startswith("__dy2st")]
+        if not carry:
+            return node
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        args = ast.arguments(posonlyargs=[],
+                             args=[ast.arg(arg=a) for a in carry],
+                             kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[], type_params=[])
+        body_fn = ast.FunctionDef(
+            name=bname, args=args,
+            body=node.body + [ast.Return(value=ast.Tuple(
+                elts=[_name(a) for a in carry], ctx=ast.Load()))],
+            decorator_list=[], type_params=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(a, ast.Store) for a in carry],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("convert_while_loop"),
+                args=[_name(cname), _name(bname),
+                      ast.Tuple(elts=[_name(a) for a in carry],
+                                ctx=ast.Load())],
+                keywords=[]))
+        out = [cond_fn, body_fn, call]
+        for stmt in out:
+            ast.copy_location(stmt, node)
+            ast.fix_missing_locations(stmt)
+        return out
+
+    # -- for i in range(...) ---------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and len(node.iter.args) == 1
+                    and isinstance(node.target, ast.Name))
+        if not is_range or node.orelse or _has_disallowed(node.body):
+            return node
+        assigned = [a for a in _assigned(node.body)
+                    if a != node.target.id and not a.startswith("__dy2st")]
+        if not assigned:
+            return node
+        bname = self._fresh("forbody")
+        body_fn = ast.FunctionDef(
+            name=bname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=node.target.id)]
+                + [ast.arg(arg=a) for a in assigned],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=node.body + [ast.Return(value=ast.Tuple(
+                elts=[_name(a) for a in assigned], ctx=ast.Load()))],
+            decorator_list=[], type_params=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(a, ast.Store)
+                                     for a in assigned], ctx=ast.Store())],
+            value=ast.Call(
+                func=_jst_attr("convert_for_range"),
+                args=[node.iter.args[0], _name(bname),
+                      ast.Tuple(elts=[_name(a) for a in assigned],
+                                ctx=ast.Load())],
+                keywords=[]))
+        out = [body_fn, call]
+        for stmt in out:
+            ast.copy_location(stmt, node)
+            ast.fix_missing_locations(stmt)
+        return out
+
+
+# --------------------------------------------------------------- frontend
+
+_cache = {}
+
+
+def convert_to_static(func):
+    """Rewrite `func`'s control flow for tracing; returns the transformed
+    function (reference: program_translator.py StaticFunction +
+    ast_transformer pipeline). Falls back to the original on any source/
+    parse failure (builtins, lambdas, REPL)."""
+    key = getattr(func, "__code__", None)
+    if key in _cache:
+        return _cache[key]
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        # drop decorators: the transformed fn is called by the wrapper
+        if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fdef.decorator_list = []
+        tree = _ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<dy2static {func.__name__}>",
+                       mode="exec")
+        import sys
+        glb = dict(func.__globals__)
+        glb[_JST] = sys.modules[__name__]
+        # rebind the closure by executing the def in an env seeded with
+        # the free variables' current values
+        if func.__closure__:
+            for nm, cell in zip(func.__code__.co_freevars,
+                                func.__closure__):
+                try:
+                    glb[nm] = cell.cell_contents
+                except ValueError:
+                    pass
+        loc = {}
+        exec(code, glb, loc)
+        new_fn = loc[func.__name__]
+        new_fn = functools.wraps(func)(new_fn)
+        _cache[key] = new_fn
+        return new_fn
+    except (OSError, TypeError, SyntaxError, IndexError, KeyError):
+        _cache[key] = func
+        return func

@@ -595,6 +595,55 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
                     data_format, avg=True, count_include_pad=not exclusive)
 
 
+def _adaptive_bins(in_size, o):
+    """paddle/torch adaptive bin edges: [floor(i*in/o), ceil((i+1)*in/o))."""
+    import numpy as _np
+    i = _np.arange(o)
+    start = (i * in_size) // o
+    end = -((-(i + 1) * in_size) // o)  # ceil division
+    return start, end
+
+
+def _adaptive_pool_general(out, d_axis, o, avg):
+    """One spatial dim, arbitrary output size. avg: exact bin means via
+    cumsum (integral image per dim — bin sizes vary by at most 1, and mean
+    of per-dim means with correct per-bin counts equals the ND bin mean
+    because the counts factorize across dims). max: fixed-width gather
+    with -inf masking (max is associative, so separable is exact)."""
+    in_size = out.shape[d_axis]
+    start, end = _adaptive_bins(in_size, o)
+    if avg:
+        csum = jnp.cumsum(out, axis=d_axis)
+        zero_shape = list(out.shape)
+        zero_shape[d_axis] = 1
+        csum = jnp.concatenate(
+            [jnp.zeros(zero_shape, out.dtype), csum], axis=d_axis)
+        hi = jnp.take(csum, jnp.asarray(end), axis=d_axis)
+        lo = jnp.take(csum, jnp.asarray(start), axis=d_axis)
+        cnt = jnp.asarray((end - start).astype("float32"))
+        shape = [1] * out.ndim
+        shape[d_axis] = o
+        return (hi - lo) / cnt.reshape(shape).astype(out.dtype)
+    # max path
+    import numpy as _np
+    w = int((end - start).max())
+    idx = start[:, None] + _np.arange(w)[None, :]          # [o, w]
+    valid = idx < end[:, None]
+    idx = _np.minimum(idx, in_size - 1)
+    g = jnp.take(out, jnp.asarray(idx.reshape(-1)), axis=d_axis)
+    new_shape = list(out.shape)
+    new_shape[d_axis:d_axis + 1] = [o, w]
+    g = g.reshape(new_shape)
+    mask_shape = [1] * len(new_shape)
+    mask_shape[d_axis] = o
+    mask_shape[d_axis + 1] = w
+    neg = jnp.asarray(-jnp.inf, out.dtype) if \
+        jnp.issubdtype(out.dtype, jnp.floating) else \
+        jnp.iinfo(out.dtype).min
+    g = jnp.where(jnp.asarray(valid).reshape(mask_shape), g, neg)
+    return jnp.max(g, axis=d_axis + 1)
+
+
 def _adaptive_pool(x, output_size, dims, data_format, avg):
     channels_last = not data_format.startswith("NC")
     if isinstance(output_size, int):
@@ -606,13 +655,15 @@ def _adaptive_pool(x, output_size, dims, data_format, avg):
         o = output_size[d]
         if o is None or o == in_size:
             continue
-        assert in_size % o == 0, "adaptive pool needs divisible sizes on TPU"
-        k = in_size // o
-        shape = list(out.shape)
-        shape[spatial_start + d:spatial_start + d + 1] = [o, k]
-        r = out.reshape(shape)
-        out = jnp.mean(r, axis=spatial_start + d + 1) if avg else \
-            jnp.max(r, axis=spatial_start + d + 1)
+        if in_size % o == 0:  # fast reshape path
+            k = in_size // o
+            shape = list(out.shape)
+            shape[spatial_start + d:spatial_start + d + 1] = [o, k]
+            r = out.reshape(shape)
+            out = jnp.mean(r, axis=spatial_start + d + 1) if avg else \
+                jnp.max(r, axis=spatial_start + d + 1)
+        else:
+            out = _adaptive_pool_general(out, spatial_start + d, o, avg)
     return out
 
 

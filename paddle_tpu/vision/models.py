@@ -95,14 +95,16 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
-        self.conv3 = nn.Conv2D(planes, planes * 4, 1, bias_attr=False)
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(width)
+        self.conv3 = nn.Conv2D(width, planes * 4, 1, bias_attr=False)
         self.bn3 = nn.BatchNorm2D(planes * 4)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -118,9 +120,12 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True):
+    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
+                 groups=1, width_per_group=64):
         super().__init__()
         self.inplanes = 64
+        self.groups = groups
+        self.base_width = width_per_group
         self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
                                bias_attr=False)
         self.bn1 = nn.BatchNorm2D(64)
@@ -142,10 +147,13 @@ class ResNet(nn.Layer):
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        extra = {}
+        if block is BottleneckBlock:
+            extra = dict(groups=self.groups, base_width=self.base_width)
+        layers = [block(self.inplanes, planes, stride, downsample, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **extra))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -171,3 +179,51 @@ def resnet50(pretrained=False, **kw):
 
 def resnet101(pretrained=False, **kw):
     return ResNet(BottleneckBlock, [3, 4, 23, 3], **kw)
+
+
+from .models_ext import *  # noqa: E402,F401,F403
+from .models_ext import __all__ as _ext_all
+__all__ = list(__all__) + list(_ext_all)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_vgg_layers([64, "M", 128, "M", 256, 256, "M", 512, 512,
+                            "M", 512, 512, "M"], batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_vgg_layers([64, 64, "M", 128, 128, "M", 256, 256, "M",
+                            512, 512, "M", 512, 512, "M"], batch_norm),
+               **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_vgg_layers([64, 64, "M", 128, 128, "M", 256, 256, 256, 256,
+                            "M", 512, 512, 512, 512, "M", 512, 512, 512,
+                            512, "M"], batch_norm), **kwargs)
+
+
+def resnet152(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], **kw)
+
+
+def resnext50_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=32,
+                  width_per_group=4, **kw)
+
+
+def resnext101_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=32,
+                  width_per_group=4, **kw)
+
+
+def wide_resnet50_2(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], width_per_group=128, **kw)
+
+
+def wide_resnet101_2(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], width_per_group=128, **kw)
+
+
+__all__ += ["vgg11", "vgg13", "vgg19", "resnet152", "resnext50_32x4d",
+            "resnext101_32x4d", "wide_resnet50_2", "wide_resnet101_2"]

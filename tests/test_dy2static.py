@@ -1,0 +1,193 @@
+"""dy2static AST transform tests (reference: dygraph_to_static test suite
+pattern — same function must agree eagerly and traced)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.jit.dy2static import (Dy2StaticError, convert_to_static)
+
+
+def _agree(fn, *np_args, jit_also=True):
+    """Transformed fn must match the original eagerly AND under jax.jit."""
+    static = convert_to_static(fn)
+    ref = fn(*[np.asarray(a) for a in np_args])
+    got_eager = static(*[np.asarray(a) for a in np_args])
+    np.testing.assert_allclose(np.asarray(got_eager), np.asarray(ref),
+                               rtol=1e-6)
+    if jit_also:
+        got_jit = jax.jit(static)(*[jnp.asarray(a) for a in np_args])
+        np.testing.assert_allclose(np.asarray(got_jit), np.asarray(ref),
+                                   rtol=1e-6)
+
+
+class TestIfElse:
+    def test_simple_if(self):
+        def f(x):
+            y = x * 2
+            if x.sum() > 0:
+                y = y + 1
+            else:
+                y = y - 1
+            return y
+
+        _agree(f, np.array([1.0, 2.0], np.float32))
+        _agree(f, np.array([-1.0, -2.0], np.float32))
+
+    def test_if_without_else(self):
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                y = y * 10
+            return y
+
+        _agree(f, np.array([3.0], np.float32))
+        _agree(f, np.array([-3.0], np.float32))
+
+    def test_nested_if(self):
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                if x.sum() > 10:
+                    y = y * 100
+                else:
+                    y = y * 10
+            else:
+                y = -y
+            return y
+
+        for v in ([20.0], [5.0], [-5.0]):
+            _agree(f, np.array(v, np.float32))
+
+    def test_python_bool_stays_python(self):
+        def f(x, flag):
+            y = x
+            if flag:
+                y = y + 1
+            return y
+
+        static = convert_to_static(f)
+        out = static(np.array([1.0], np.float32), True)
+        np.testing.assert_allclose(np.asarray(out), [2.0])
+        out = static(np.array([1.0], np.float32), False)
+        np.testing.assert_allclose(np.asarray(out), [1.0])
+
+
+class TestLoops:
+    def test_while_loop(self):
+        def f(x):
+            i = jnp.asarray(0)
+            s = x * 0
+            while i < 5:
+                s = s + x
+                i = i + 1
+            return s
+
+        _agree(f, np.array([2.0], np.float32))
+
+    def test_while_data_dependent_bound(self):
+        def f(x, n):
+            s = x * 0
+            i = n * 0
+            while i < n:
+                s = s + x
+                i = i + 1
+            return s
+
+        static = convert_to_static(f)
+        got = jax.jit(static)(jnp.asarray([3.0]), jnp.asarray(4))
+        np.testing.assert_allclose(np.asarray(got), [12.0])
+
+    def test_for_range(self):
+        def f(x):
+            acc = x * 0
+            for i in range(4):
+                acc = acc + x * i
+            return acc
+
+        _agree(f, np.array([1.0, 2.0], np.float32))
+
+    def test_for_range_traced_bound(self):
+        def f(x, n):
+            acc = x * 0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        static = convert_to_static(f)
+        got = jax.jit(static)(jnp.asarray([5.0]), jnp.asarray(3))
+        np.testing.assert_allclose(np.asarray(got), [15.0])
+
+
+class TestLogical:
+    def test_and_or_not(self):
+        def f(x):
+            a = x.sum() > 0
+            b = x.sum() < 10
+            y = x
+            if a and b:
+                y = y + 100
+            if a or b:
+                y = y + 1
+            if not a:
+                y = y - 1000
+            return y
+
+        for v in ([5.0], [20.0], [-5.0]):
+            _agree(f, np.array(v, np.float32))
+
+
+class TestToStaticIntegration:
+    def test_to_static_with_control_flow(self):
+        @pt.jit.to_static
+        def relu_like(x):
+            y = x
+            if x.sum() > 0:
+                y = y * 2
+            else:
+                y = y * 0
+            return y
+
+        out = relu_like(pt.to_tensor(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        out = relu_like(pt.to_tensor(np.array([-1.0, -2.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [0.0, 0.0])
+
+    def test_layer_with_loop_under_jit(self):
+        def body(x, steps):
+            acc = x * 0
+            for i in range(steps):
+                acc = acc + jnp.sin(x + i)
+            return acc
+
+        static = convert_to_static(body)
+        ref = body(np.asarray([0.5], np.float32), 3)
+        got = jax.jit(static, static_argnums=())(
+            jnp.asarray([0.5]), jnp.asarray(3))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5)
+
+    def test_mismatched_branches_raise_clearly(self):
+        def f(x):
+            if x.sum() > 0:
+                y = jnp.ones((2,))
+            else:
+                y = jnp.ones((3,))
+            return y
+
+        static = convert_to_static(f)
+        with pytest.raises(Exception):
+            jax.jit(static)(jnp.asarray([1.0]))
+
+    def test_scalar_pred_requirement(self):
+        def f(x):
+            y = x
+            if x > 0:  # vector predicate
+                y = y + 1
+            return y
+
+        static = convert_to_static(f)
+        with pytest.raises(Dy2StaticError, match="scalar"):
+            jax.jit(static)(jnp.asarray([1.0, -1.0]))

@@ -1,0 +1,91 @@
+"""Vision model zoo forward-shape tests (reference test_vision_models.py
+pattern: construct + forward on a small input)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+
+
+def _run(model, size=64, channels=3, classes=10):
+    x = pt.to_tensor(np.random.RandomState(0).randn(
+        1, channels, size, size).astype(np.float32))
+    model.eval()
+    out = model(x)
+    assert out.shape == [1, classes]
+
+
+@pytest.mark.parametrize("fn", [
+    lambda: M.alexnet(num_classes=10),
+    lambda: M.mobilenet_v1(num_classes=10),
+    lambda: M.mobilenet_v2(num_classes=10),
+    lambda: M.mobilenet_v3_small(num_classes=10),
+    lambda: M.mobilenet_v3_large(num_classes=10),
+    lambda: M.squeezenet1_0(num_classes=10),
+    lambda: M.squeezenet1_1(num_classes=10),
+    lambda: M.shufflenet_v2_x1_0(num_classes=10),
+])
+def test_small_nets_forward(fn):
+    _run(fn(), size=64)
+
+
+@pytest.mark.parametrize("fn", [
+    lambda: M.densenet121(num_classes=10),
+    lambda: M.googlenet(num_classes=10),
+    lambda: M.inception_v3(num_classes=10),
+])
+def test_big_nets_forward(fn):
+    _run(fn(), size=96)
+
+
+def test_resnext_and_wide():
+    _run(M.resnext50_32x4d(num_classes=10), size=64)
+    _run(M.wide_resnet50_2(num_classes=10), size=64)
+
+
+def test_vgg_variants_construct():
+    for f in (M.vgg11, M.vgg13, M.vgg19):
+        m = f(num_classes=10)
+        assert isinstance(m, M.VGG)
+
+
+def test_mobilenet_v2_trains():
+    pt.seed(0)
+    import paddle_tpu.nn as nn
+    m = M.mobilenet_v2(num_classes=4, scale=0.25)
+    m.train()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(4, 3, 32, 32).astype(np.float32))
+    y = pt.to_tensor(rng.randint(0, 4, size=(4,)))
+    l0 = None
+    for i in range(6):
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if l0 is None:
+            l0 = float(loss.numpy())
+    assert float(loss.numpy()) < l0
+
+
+def test_adaptive_pool_non_divisible_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 7, 5).astype(np.float32)
+    got = pt.nn.functional.adaptive_avg_pool2d(
+        pt.to_tensor(x), (3, 2)).numpy()
+    ref = TF.adaptive_avg_pool2d(torch.from_numpy(x), (3, 2)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    got = pt.nn.functional.adaptive_max_pool2d(
+        pt.to_tensor(x), (3, 2)).numpy()
+    ref = TF.adaptive_max_pool2d(torch.from_numpy(x), (3, 2)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_adaptive_pool_upsample_case():
+    # in_size < out_size (AlexNet on small inputs)
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = pt.nn.functional.adaptive_avg_pool2d(pt.to_tensor(x), (4, 4))
+    assert out.shape == [1, 1, 4, 4]
