@@ -13,6 +13,36 @@ import time
 import numpy as np
 
 
+def _devices_with_retry(attempts=6):
+    """Bring up the accelerator backend with retries.
+
+    Round-1 failure mode: the first backend touch raised
+    `Unable to initialize backend 'axon': UNAVAILABLE` (remote TPU relay
+    still warming up) and the script died with no JSON line. Retry with
+    backoff; raise only after all attempts.
+    """
+    import jax
+    last = None
+    for i in range(attempts):
+        try:
+            devs = jax.devices()
+            if devs:
+                return devs
+        except Exception as e:  # backend init faults are RuntimeError-ish
+            last = e
+            time.sleep(min(2 ** i, 30))
+    raise last if last else RuntimeError("no jax devices")
+
+
+def _cpu_device_or_none():
+    """CPU staging device for cheap param init; never fault the run."""
+    import jax
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:
+        return None
+
+
 def peak_flops_bf16():
     import jax
     kind = jax.devices()[0].device_kind.lower()
