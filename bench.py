@@ -67,10 +67,14 @@ def main():
     seq = 1024
     batch = 8
 
+    devices = _devices_with_retry()
+
     # Build params on the CPU backend: on remote-execution TPU setups each
     # device-side init op would pay a separate remote compile.
-    cpu = jax.local_devices(backend="cpu")[0]
-    with jax.default_device(cpu):
+    cpu = _cpu_device_or_none()
+    import contextlib
+    with (jax.default_device(cpu) if cpu is not None
+          else contextlib.nullcontext()):
         cfg = gpt2_345m(dropout=0.0)
         model = GPTForCausalLM(cfg)
         model.astype("bfloat16")
@@ -83,7 +87,7 @@ def main():
         state = init_fn(params)
         # master fp32 moments for stability (cheap on HBM at 345M)
         state = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), state)
-    dev = jax.devices()[0]
+    dev = devices[0]
     params = jax.device_put(params, dev)
     state = jax.device_put(state, dev)
 
