@@ -24,6 +24,7 @@ import ast
 import functools
 import inspect
 import textwrap
+import types
 
 import jax
 import jax.numpy as jnp
@@ -31,11 +32,69 @@ from jax import lax
 
 __all__ = ["convert_to_static", "Dy2StaticError", "convert_ifelse",
            "convert_while_loop", "convert_for_range", "convert_logical_and",
-           "convert_logical_or", "convert_logical_not", "convert_bool"]
+           "convert_logical_or", "convert_logical_not", "convert_bool",
+           "UNDEFINED"]
 
 
 class Dy2StaticError(RuntimeError):
     pass
+
+
+class _Undefined:
+    """Placeholder for a name first bound inside a control-flow branch
+    (reference mechanism: dy2static UndefinedVar,
+    python/paddle/jit/dy2static/utils.py). Seeded before the rewritten
+    `if` so referencing the name as a lax.cond operand is legal; using the
+    value itself raises a clear error instead of UnboundLocalError."""
+
+    def _err(self, *a, **k):
+        raise Dy2StaticError(
+            "variable was only assigned along one control-flow branch and "
+            "is used before being defined on the taken path")
+
+    __bool__ = __add__ = __radd__ = __sub__ = __rsub__ = _err
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _err
+    __floordiv__ = __rfloordiv__ = __mod__ = __rmod__ = _err
+    __pow__ = __rpow__ = __matmul__ = __rmatmul__ = _err
+    __neg__ = __pos__ = __abs__ = __invert__ = _err
+    __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _err
+    __call__ = __getitem__ = __setitem__ = __iter__ = __len__ = _err
+    __int__ = __float__ = __index__ = __complex__ = _err
+    __array__ = __contains__ = _err
+    __hash__ = object.__hash__  # defining __eq__ would otherwise unset it
+
+    def __getattr__(self, name):
+        # dunder probes (copy/pickle/inspect protocols) must fall through
+        # as plain AttributeError; any real attribute use is an error
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        self._err()
+
+    def __repr__(self):
+        return "<dy2static UNDEFINED>"
+
+
+UNDEFINED = _Undefined()
+
+# zero-leaf pytree: UNDEFINED may ride through lax.cond operands/results
+# without being treated as an array
+try:
+    jax.tree_util.register_pytree_node(
+        _Undefined, lambda u: ((), None), lambda aux, ch: UNDEFINED)
+except ValueError:
+    pass  # module re-import: already registered
+
+
+def _seed_stmts(names):
+    """`try: n\nexcept NameError: n = UNDEFINED` for each name, so names
+    first bound inside the rewritten block exist before the runtime call."""
+    return [ast.Try(
+        body=[ast.Expr(value=_name(n))],
+        handlers=[ast.ExceptHandler(
+            type=_name("NameError"), name=None,
+            body=[ast.Assign(targets=[_name(n, ast.Store)],
+                             value=_jst_attr("UNDEFINED"))])],
+        orelse=[], finalbody=[]) for n in names]
 
 
 # ---------------------------------------------------------------- runtime
@@ -51,16 +110,17 @@ def _is_traced(x):
 
 
 def _pred(x):
-    """Predicate -> traced bool scalar or Python bool."""
+    """Predicate -> traced bool scalar or Python bool. Concrete values
+    (incl. np.bool_/0-d arrays, which are NOT Python bool) always become
+    a real bool so the eager fast path is taken."""
     r = _raw(x)
-    if isinstance(r, (jax.Array, jax.core.Tracer)):
-        if getattr(r, "ndim", 0) != 0 and getattr(r, "size", 1) != 1:
-            raise Dy2StaticError(
-                "control-flow predicate must be a scalar (got shape "
-                f"{getattr(r, 'shape', None)})")
-        return r.reshape(()).astype(bool) if _is_traced(r) else \
-            bool(jnp.reshape(r, ()))
-    return r
+    if getattr(r, "ndim", 0) != 0 and getattr(r, "size", 1) != 1:
+        raise Dy2StaticError(
+            "control-flow predicate must be a scalar (got shape "
+            f"{getattr(r, 'shape', None)})")
+    if _is_traced(r):
+        return r.reshape(()).astype(bool)
+    return bool(r)
 
 
 def convert_ifelse(pred, true_fn, false_fn, args):
@@ -295,7 +355,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Tuple(elts=[_name(a) for a in assigned],
                                 ctx=ast.Load())],
                 keywords=[]))
-        out = [mk(tname, node.body), mk(fname, node.orelse), call]
+        out = _seed_stmts(assigned) + [mk(tname, node.body),
+                                       mk(fname, node.orelse), call]
         for stmt in out:
             ast.copy_location(stmt, node)
             ast.fix_missing_locations(stmt)
@@ -333,7 +394,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Tuple(elts=[_name(a) for a in carry],
                                 ctx=ast.Load())],
                 keywords=[]))
-        out = [cond_fn, body_fn, call]
+        out = _seed_stmts(carry) + [cond_fn, body_fn, call]
         for stmt in out:
             ast.copy_location(stmt, node)
             ast.fix_missing_locations(stmt)
@@ -373,7 +434,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                       ast.Tuple(elts=[_name(a) for a in assigned],
                                 ctx=ast.Load())],
                 keywords=[]))
-        out = [body_fn, call]
+        out = _seed_stmts(assigned) + [body_fn, call]
         for stmt in out:
             ast.copy_location(stmt, node)
             ast.fix_missing_locations(stmt)
@@ -381,8 +442,62 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
 
 # --------------------------------------------------------------- frontend
+#
+# Two-level cache design:
+#  - `_code_cache` memoizes the EXPENSIVE part (source → AST transform →
+#    compiled code object) per func.__code__; None marks untransformable.
+#  - The returned function is built per closure by binding the transformed
+#    code to the ORIGINAL cell objects via types.FunctionType, so free
+#    variables stay live (a later `nonlocal` rebind is seen, unlike a
+#    bake-values-into-globals scheme) and factory closures never share
+#    state. `_fn_memo` is a small bounded LRU keyed by (code, cell ids)
+#    purely to keep jax.jit caches stable across repeated to_static calls
+#    on the same closure; eviction only costs a re-bind, never correctness.
 
-_cache = {}
+_code_cache = {}   # func.__code__ -> transformed inner code object | None
+_fn_memo = {}      # (code, cell-id-tuple) -> (fn, cells)  [bounded]
+_FN_MEMO_MAX = 512
+_MISSING = object()
+
+
+def _transform_to_code(func):
+    """Parse+transform func's source; return a code object whose free
+    variables match the original's (so original cells can be bound)."""
+    src = textwrap.dedent(inspect.getsource(func))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # drop decorators: the transformed fn is called by the wrapper
+    if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        fdef.decorator_list = []
+    tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+    freevars = func.__code__.co_freevars
+    if freevars:
+        # wrap in an outer def whose params are the free names: compiling
+        # it makes those names free in the inner code object, which we
+        # then extract and later bind to the ORIGINAL cells
+        outer = ast.FunctionDef(
+            name="__dy2st_outer__",
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[], type_params=[])
+        tree = ast.Module(body=[outer], type_ignores=[])
+        ast.fix_missing_locations(tree)
+    mod_code = compile(tree, filename=f"<dy2static {func.__name__}>",
+                       mode="exec")
+    # dig out the function's code object (possibly nested in the outer)
+    holder = mod_code
+    if freevars:
+        holder = next(c for c in mod_code.co_consts
+                      if isinstance(c, types.CodeType)
+                      and c.co_name == "__dy2st_outer__")
+    inner = next(c for c in holder.co_consts
+                 if isinstance(c, types.CodeType)
+                 and c.co_name == func.__name__)
+    return inner
 
 
 def convert_to_static(func):
@@ -390,38 +505,44 @@ def convert_to_static(func):
     function (reference: program_translator.py StaticFunction +
     ast_transformer pipeline). Falls back to the original on any source/
     parse failure (builtins, lambdas, REPL)."""
-    key = getattr(func, "__code__", None)
-    if key in _cache:
-        return _cache[key]
-    try:
-        src = textwrap.dedent(inspect.getsource(func))
-        tree = ast.parse(src)
-        fdef = tree.body[0]
-        # drop decorators: the transformed fn is called by the wrapper
-        if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            fdef.decorator_list = []
-        tree = _ControlFlowTransformer().visit(tree)
-        ast.fix_missing_locations(tree)
-        code = compile(tree, filename=f"<dy2static {func.__name__}>",
-                       mode="exec")
-        import sys
-        glb = dict(func.__globals__)
-        glb[_JST] = sys.modules[__name__]
-        # rebind the closure by executing the def in an env seeded with
-        # the free variables' current values
-        if func.__closure__:
-            for nm, cell in zip(func.__code__.co_freevars,
-                                func.__closure__):
-                try:
-                    glb[nm] = cell.cell_contents
-                except ValueError:
-                    pass
-        loc = {}
-        exec(code, glb, loc)
-        new_fn = loc[func.__name__]
-        new_fn = functools.wraps(func)(new_fn)
-        _cache[key] = new_fn
-        return new_fn
-    except (OSError, TypeError, SyntaxError, IndexError, KeyError):
-        _cache[key] = func
+    code = getattr(func, "__code__", None)
+    if code is None:
         return func
+    cells = getattr(func, "__closure__", None)
+    memo_key = (code, tuple(id(c) for c in cells) if cells else None)
+    hit = _fn_memo.get(memo_key)
+    if hit is not None:
+        return hit[0]
+
+    entry = _code_cache.get(code, _MISSING)
+    if entry is _MISSING:
+        try:
+            entry = _transform_to_code(func)
+        except (OSError, TypeError, SyntaxError, IndexError, KeyError,
+                ValueError, StopIteration):
+            entry = None
+        _code_cache[code] = entry
+    if entry is None:
+        return func
+
+    import sys
+    glb = dict(func.__globals__)
+    glb[_JST] = sys.modules[__name__]
+    try:
+        if cells:
+            cellmap = dict(zip(code.co_freevars, cells))
+            closure = tuple(cellmap[n] for n in entry.co_freevars)
+        else:
+            closure = None
+        new_fn = types.FunctionType(entry, glb, func.__name__,
+                                    func.__defaults__, closure)
+        new_fn.__kwdefaults__ = func.__kwdefaults__
+        new_fn = functools.wraps(func)(new_fn)
+    except (KeyError, TypeError):
+        _code_cache[code] = None
+        return func
+    if len(_fn_memo) >= _FN_MEMO_MAX:  # bounded: drop ~oldest half
+        for k in list(_fn_memo)[:_FN_MEMO_MAX // 2]:
+            del _fn_memo[k]
+    _fn_memo[memo_key] = (new_fn, cells)
+    return new_fn

@@ -18,6 +18,7 @@ import numpy as np
 
 from ..core.dtype import convert_dtype
 from ..core.tensor import Parameter, Tensor
+from ..utils.unique_name import generate as unique_name
 from . import initializer as I
 
 __all__ = ["Layer", "LayerList", "Sequential", "ParameterList", "LayerDict"]
@@ -46,6 +47,15 @@ class Layer:
         self._forward_pre_hooks = collections.OrderedDict()
         self._forward_post_hooks = collections.OrderedDict()
         self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._full_name = unique_name(self._name_scope)
+
+    def full_name(self):
+        """Unique per-instance name, e.g. ``linear_0`` (reference
+        Layer.full_name, python/paddle/fluid/dygraph/layers.py). Stable
+        across deepcopy — the copy keeps the original's name — which is
+        what lets by-layer configs (e.g. quantization) survive the
+        copy-then-transform flow."""
+        return self._full_name
 
     # ------------------------------------------------------------ attributes
     def __setattr__(self, name, value):

@@ -173,14 +173,50 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     server = _Server()
     store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
                      world_size=world_size)
-    # job cookie: rank 0 mints it, everyone reads it via the rendezvous
-    # (the store is the trust root, like the reference's master daemon)
-    if rank == 0:
+    # Job cookie for request HMAC. Two modes:
+    #  - PADDLE_RPC_SECRET set (recommended): every worker derives the
+    #    cookie locally from the pre-shared secret; it never transits the
+    #    store, so a network peer who can reach the store cannot learn it.
+    #  - unset: rank 0 mints a random cookie and publishes it through the
+    #    rendezvous store. The store has no auth, so this only protects
+    #    against accidental connections, NOT against an attacker who can
+    #    reach the store port — same trust model as the reference's
+    #    master daemon. Deployments on untrusted networks must set
+    #    PADDLE_RPC_SECRET (the launcher forwards it to every rank).
+    secret = os.environ.get("PADDLE_RPC_SECRET")
+    if secret:
+        cookie = hmac_mod.new(secret.encode(), b"paddle_tpu/rpc/cookie/v1",
+                              hashlib.sha256).digest()
+    elif rank == 0:
         import secrets
         cookie = secrets.token_bytes(32)
         store.set("rpc/cookie", cookie)
     else:
-        cookie = store.get("rpc/cookie")
+        cookie = None  # resolved below after the mode check
+    # Fail fast on asymmetric configuration instead of hanging in a store
+    # get (rank N waiting for a cookie rank 0 never publishes) or failing
+    # every later call with blanket HMAC errors: rank 0 publishes its
+    # auth mode + a one-way cookie fingerprint for everyone to verify.
+    if rank == 0:
+        store.set("rpc/auth_mode", b"secret" if secret else b"store")
+        store.set("rpc/cookie_fp",
+                  hashlib.sha256(b"fp/" + cookie).digest())
+    else:
+        mode = store.get("rpc/auth_mode").decode()
+        if mode == "secret" and not secret:
+            raise RuntimeError(
+                "rank 0 has PADDLE_RPC_SECRET set but this rank does not; "
+                "export the same PADDLE_RPC_SECRET on every rank")
+        if mode == "store" and secret:
+            raise RuntimeError(
+                "this rank has PADDLE_RPC_SECRET set but rank 0 does not; "
+                "export the same PADDLE_RPC_SECRET on every rank")
+        if cookie is None:
+            cookie = store.get("rpc/cookie")
+        fp = hashlib.sha256(b"fp/" + cookie).digest()
+        if fp != store.get("rpc/cookie_fp"):
+            raise RuntimeError(
+                "PADDLE_RPC_SECRET differs between this rank and rank 0")
     server.cookie = cookie
     # advertise the address routable from the master's network, not the
     # hostname alias (often 127.0.1.1 on Debian-style /etc/hosts)

@@ -232,15 +232,20 @@ class QuantConfig:
 
     def __init__(self, activation=None, weight=None):
         self._default = SingleLayerConfig(activation, weight)
-        self._by_layer = {}     # id(layer) -> cfg
-        self._by_name = {}      # layer full name -> cfg
+        self._by_layer = {}     # layer.full_name() -> cfg
+        self._by_name = {}      # dotted attribute path -> cfg
         self._by_type = {}      # type -> cfg
         self._qat_mapping = dict(_DEFAULT_QAT_MAPPING)
 
     def add_layer_config(self, layer, activation=None, weight=None):
+        # keyed by full_name(), not id(): quantize() deepcopies the model
+        # before transforming, and the copy keeps full_name while id
+        # changes (reference python/paddle/quantization/config.py keys
+        # by layer.full_name() for the same reason)
         layers = layer if isinstance(layer, (list, tuple)) else [layer]
         for l in layers:
-            self._by_layer[id(l)] = SingleLayerConfig(activation, weight)
+            self._by_layer[l.full_name()] = SingleLayerConfig(
+                activation, weight)
 
     def add_name_config(self, name, activation=None, weight=None):
         names = name if isinstance(name, (list, tuple)) else [name]
@@ -257,8 +262,9 @@ class QuantConfig:
         self._qat_mapping[source] = target
 
     def _config_for(self, layer, name):
-        if id(layer) in self._by_layer:
-            return self._by_layer[id(layer)]
+        key = layer.full_name() if hasattr(layer, "full_name") else None
+        if key in self._by_layer:
+            return self._by_layer[key]
         if name in self._by_name:
             return self._by_name[name]
         for t, cfg in self._by_type.items():

@@ -69,14 +69,23 @@ def scope_guard(scope):
         _scope_stack.pop()
 
 
-def _replay(ops, env):
+def _replay(ops, env, protect=frozenset()):
+    """Replay recorded ops into env. Names in ``protect`` are grad leaves:
+    their injected values are never overwritten, and an op is skipped
+    entirely only when ALL of its outputs are protected (an op with a
+    protected and an unprotected output must still run to produce the
+    sibling — skipping it on a partial match dropped sibling outputs)."""
     for op in ops:
+        outs = set(op.outputs)
+        if outs and outs <= protect:
+            continue
         vals = [env[i.name] if isinstance(i, VarRef) else i
                 for i in op.inputs]
         out = op.fn(*vals, **op.attrs)
         flat, _ = jax.tree_util.tree_flatten(out)
         for n, v in zip(op.outputs, flat):
-            env[n] = v
+            if n not in protect:
+                env[n] = v
     return env
 
 
@@ -188,13 +197,10 @@ class Executor:
                 def target_of(wrt_vals, _tgt=tgt, _wrt=wrt, _base=base):
                     e = dict(_base)
                     e.update(zip(_wrt, wrt_vals))
-                    # treat wrt vars as leaves: skip their producing ops so
-                    # the injected values aren't overwritten by the replay
-                    # (grad w.r.t. an intermediate would otherwise be 0)
-                    wset = set(_wrt)
-                    live = [op for op in ops
-                            if not (set(op.outputs) & wset)]
-                    _replay(live, e)
+                    # wrt vars are grad leaves: protect the injected
+                    # values (else grad w.r.t. an intermediate is 0),
+                    # while ops that also produce non-wrt siblings run
+                    _replay(ops, e, protect=frozenset(_wrt))
                     return e[_tgt].sum()
 
                 gs = jax.grad(target_of)([env[n] for n in wrt])

@@ -1,0 +1,235 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+(a) dy2static visit_If UnboundLocalError for names first bound in a branch,
+(b) dy2static closure cache keyed only by __code__,
+(c) quantization configs keyed by id(layer) lost across deepcopy,
+(d) RPC cookie derivable from a pre-shared secret (never transits store),
+(e) static gradients() dropping ops when any output is a wrt var.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.jit.dy2static import convert_to_static, UNDEFINED
+
+
+# ---------------------------------------------------------------- (a)
+
+class TestBranchFirstBinding:
+    def test_var_first_bound_in_branch_eager(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x * 3
+            return y
+
+        static = convert_to_static(f)
+        out = static(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 4.0])
+        out = static(np.array([-1.0, -2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [-3.0, -6.0])
+
+    def test_var_first_bound_in_branch_traced(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x * 3
+            return y
+
+        static = convert_to_static(f)
+        out = jax.jit(static)(jnp.array([1.0, 2.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 4.0])
+
+    def test_one_sided_binding_unused_is_ok_eager(self):
+        # `tmp` only exists on the positive path and is only used there;
+        # eager execution of the negative path must not crash
+        def f(x):
+            if x.sum() > 0:
+                tmp = x * 10
+                out = tmp + 1
+            else:
+                out = x - 1
+            return out
+
+        static = convert_to_static(f)
+        out = static(np.array([-1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [-2.0])
+        out = static(np.array([1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [11.0])
+
+    def test_undefined_use_raises_clearly(self):
+        with pytest.raises(Exception):
+            UNDEFINED + 1
+
+    def test_loop_first_binding(self):
+        def f(x):
+            for i in range(3):
+                acc = x * i if i == 0 else acc + x * i
+            return acc
+
+        # acc first bound inside the loop; eager path must work
+        static = convert_to_static(f)
+        out = static(np.array([1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [3.0])
+
+
+# ---------------------------------------------------------------- (b)
+
+class TestClosureCache:
+    def test_factory_closures_not_conflated(self):
+        def make(scale):
+            def f(x):
+                if x.sum() > 0:
+                    y = x * scale
+                else:
+                    y = x
+                return y
+            return f
+
+        f2 = convert_to_static(make(2.0))
+        f5 = convert_to_static(make(5.0))
+        x = np.array([1.0], np.float32)
+        np.testing.assert_allclose(np.asarray(f2(x)), [2.0])
+        np.testing.assert_allclose(np.asarray(f5(x)), [5.0])
+
+    def test_nonlocal_rebind_stays_live(self):
+        # cells are bound, not baked: a rebind after conversion must be
+        # seen by the converted function, like the original would
+        def make():
+            s = 2.0
+
+            def f(x):
+                if x.sum() > 0:
+                    y = x * s
+                else:
+                    y = x
+                return y
+
+            def set_s(v):
+                nonlocal s
+                s = v
+            return f, set_s
+
+        f, set_s = make()
+        static = convert_to_static(f)
+        x = np.array([1.0], np.float32)
+        np.testing.assert_allclose(np.asarray(static(x)), [2.0])
+        set_s(7.0)
+        np.testing.assert_allclose(np.asarray(static(x)), [7.0])
+
+    def test_fn_memo_bounded(self):
+        from paddle_tpu.jit import dy2static as d
+
+        def make(k):
+            def f(x):
+                if x.sum() > 0:
+                    y = x + k
+                else:
+                    y = x
+                return y
+            return f
+
+        for i in range(int(d._FN_MEMO_MAX * 1.5)):
+            convert_to_static(make(float(i)))
+        assert len(d._fn_memo) <= d._FN_MEMO_MAX
+
+
+class TestUndefinedGuards:
+    def test_comparison_raises(self):
+        with pytest.raises(Exception):
+            UNDEFINED == 0
+
+    def test_float_raises(self):
+        with pytest.raises(Exception):
+            float(UNDEFINED)
+
+    def test_returning_one_sided_var_fails_on_use(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            return y
+
+        static = convert_to_static(f)
+        out = static(np.array([-1.0], np.float32))
+        # using the escaped placeholder must raise, not silently compare
+        with pytest.raises(Exception):
+            out + 1
+
+
+# ---------------------------------------------------------------- (c)
+
+class TestQuantConfigKeying:
+    def test_layer_config_survives_deepcopy(self):
+        from paddle_tpu.quantization import (QAT, QuantConfig,
+                                             FakeQuanterWithAbsMaxObserver)
+        net = pt.nn.Sequential(pt.nn.Linear(4, 4), pt.nn.Linear(4, 2))
+        target = net[0]
+        q = FakeQuanterWithAbsMaxObserver()
+        cfg = QuantConfig()
+        cfg.add_layer_config(target, activation=q, weight=q)
+        qat = QAT(cfg)
+        qmodel = qat.quantize(net)  # deepcopies internally
+        from paddle_tpu.quantization import QuantedLinear
+        subs = dict(qmodel.named_sublayers())
+        assert any(isinstance(s, QuantedLinear) for s in subs.values()), \
+            "per-layer config was lost across deepcopy"
+        # the second Linear had no config and must remain unquantized
+        n_quanted = sum(isinstance(s, QuantedLinear) for s in subs.values())
+        assert n_quanted == 1
+
+    def test_full_name_stable_across_deepcopy(self):
+        import copy
+        l = pt.nn.Linear(3, 3)
+        assert copy.deepcopy(l).full_name() == l.full_name()
+
+
+# ---------------------------------------------------------------- (d)
+
+class TestRpcCookie:
+    def test_secret_derivation_deterministic_and_store_free(self):
+        import hashlib
+        import hmac as hmac_mod
+        # the derivation used by init_rpc when PADDLE_RPC_SECRET is set:
+        # purely local, so equal secrets -> equal cookies on every rank
+        d1 = hmac_mod.new(b"s3cret", b"paddle_tpu/rpc/cookie/v1",
+                          hashlib.sha256).digest()
+        d2 = hmac_mod.new(b"s3cret", b"paddle_tpu/rpc/cookie/v1",
+                          hashlib.sha256).digest()
+        d3 = hmac_mod.new(b"other", b"paddle_tpu/rpc/cookie/v1",
+                          hashlib.sha256).digest()
+        assert d1 == d2 != d3
+
+    def test_init_rpc_honors_secret_env(self, monkeypatch):
+        import inspect
+        from paddle_tpu.parallel import rpc as rpc_mod
+        src = inspect.getsource(rpc_mod.init_rpc)
+        assert "PADDLE_RPC_SECRET" in src
+
+
+# ---------------------------------------------------------------- (e)
+
+class TestGradientsSiblingOutputs:
+    def test_grad_wrt_one_output_of_multi_output_op(self):
+        """An op producing (a, b) where only `a` is a wrt var: grads of a
+        target that consumes BOTH must not lose `b`'s op."""
+        import paddle_tpu.static as static
+        from paddle_tpu.core.tensor import dispatch
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", shape=[3], dtype="float32")
+            a, b = dispatch(lambda v: (v * 2.0, v * 3.0), x, name="twin")
+            loss = (a + b).sum()
+            gvars = static.gradients([loss], [a])
+
+        exe = static.Executor()
+        xv = np.array([1.0, 2.0, 3.0], np.float32)
+        out = exe.run(prog, feed={"x": xv}, fetch_list=[gvars[0]])
+        # d loss / d a = 1 everywhere; before the fix the op producing
+        # (a, b) was dropped entirely, so sibling b was missing and the
+        # replay crashed (or produced wrong grads)
+        np.testing.assert_allclose(out[0], np.ones(3, np.float32))
