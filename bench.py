@@ -19,8 +19,11 @@ def _devices_with_retry(attempts=6):
     Round-1 failure mode: the first backend touch raised
     `Unable to initialize backend 'axon': UNAVAILABLE` (remote TPU relay
     still warming up) and the script died with no JSON line. Retry with
-    backoff; raise only after all attempts.
+    backoff; raise only after all attempts. A "not in the list of known
+    backends" failure means plugin *discovery* failed at import — that is
+    permanent for the process, so re-exec to retry registration.
     """
+    import os
     import jax
     last = None
     for i in range(attempts):
@@ -30,6 +33,13 @@ def _devices_with_retry(attempts=6):
                 return devs
         except Exception as e:  # backend init faults are RuntimeError-ish
             last = e
+            if "not in the list of known backends" in str(e):
+                n = int(os.environ.get("PT_BENCH_REEXEC", "0"))
+                if n < 5:
+                    os.environ["PT_BENCH_REEXEC"] = str(n + 1)
+                    time.sleep(min(2 ** n * 5, 60))
+                    os.execv(sys.executable, [sys.executable] + sys.argv)
+                raise
             time.sleep(min(2 ** i, 30))
     raise last if last else RuntimeError("no jax devices")
 
@@ -57,15 +67,29 @@ def peak_flops_bf16():
     return 197e12  # conservative default
 
 
-def main():
+def _build_model(config_name):
+    """Returns (model, cfg, metric_name, batch, seq)."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_345m
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_350m
+
+    if config_name == "llama350m":
+        # BASELINE.md's llama family on the single bench chip: the 7B
+        # TP(+sharding) configs need a multi-chip slice; this runs the
+        # same architecture (RMSNorm/rope/SwiGLU/flash-attn path) sized
+        # for one chip and reports the same tokens/s/chip metric.
+        cfg = llama_350m()
+        return (LlamaForCausalLM(cfg), cfg,
+                "llama_350m_train_tokens_per_sec_per_chip", 8, 1024)
+    cfg = gpt2_345m(dropout=0.0)
+    return (GPTForCausalLM(cfg), cfg,
+            "gpt2_345m_train_tokens_per_sec_per_chip", 8, 1024)
+
+
+def main(config_name="gpt2"):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.jit import functional_call
-    from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_345m
-
-    seq = 1024
-    batch = 8
 
     devices = _devices_with_retry()
 
@@ -75,8 +99,7 @@ def main():
     import contextlib
     with (jax.default_device(cpu) if cpu is not None
           else contextlib.nullcontext()):
-        cfg = gpt2_345m(dropout=0.0)
-        model = GPTForCausalLM(cfg)
+        model, cfg, metric, batch, seq = _build_model(config_name)
         model.astype("bfloat16")
         model.eval()  # dropout off; still training math
         opt = pt.optimizer.AdamW(learning_rate=1e-4,
@@ -133,7 +156,7 @@ def main():
     mfu = tokens_per_sec * (flops_per_token + attn_flops) / peak_flops_bf16()
 
     print(json.dumps({
-        "metric": "gpt2_345m_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -144,4 +167,5 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    main("llama350m" if "--config=llama350m" in sys.argv[1:] or
+         "llama350m" in sys.argv[1:] else "gpt2")

@@ -120,6 +120,14 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     return models, optimizers
 
 
+@jax.jit
+def _fused_unscale(grads, inv):
+    """(g * inv for all grads, single all-finite flag) in one XLA program."""
+    unscaled = tuple(g * inv.astype(g.dtype) for g in grads)
+    flags = [jnp.all(jnp.isfinite(g)) for g in unscaled]
+    return unscaled, jnp.stack(flags).all()
+
+
 class GradScaler:
     """Dynamic loss scaling (reference grad_scaler.py:38).
 
@@ -140,6 +148,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = set()  # id(optimizer) already unscaled this step
 
     def scale(self, var):
         if not self._enable:
@@ -149,14 +158,26 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameters:
-            if p.grad is not None:
-                g = unwrap(p.grad) * inv
-                found = found | bool(jnp.any(~jnp.isfinite(g)))
-                p.grad = wrap(g)
-        self._found_inf = found
+        # guard against double-unscaling (reference grad_scaler.py keys
+        # OptimizerState.UNSCALED per optimizer): the documented
+        # unscale_-then-clip-then-step pattern must not divide twice
+        if id(optimizer) in self._unscaled:
+            return
+        self._unscaled.add(id(optimizer))
+        params = [p for p in optimizer._parameters if p.grad is not None]
+        if not params:
+            self._found_inf = False
+            return
+        grads = tuple(unwrap(p.grad) for p in params)
+        # ONE jitted program: unscale every grad and reduce finiteness to a
+        # single flag — a single device->host sync per step, not one per
+        # parameter (reference: check_finite_and_unscale fused kernel,
+        # paddle/fluid/operators/amp/check_finite_and_unscale_op.cu)
+        unscaled, finite = _fused_unscale(
+            grads, jnp.asarray(1.0 / self._scale, jnp.float32))
+        for p, g in zip(params, unscaled):
+            p.grad = wrap(g)
+        self._found_inf = not bool(finite)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -172,6 +193,7 @@ class GradScaler:
             optimizer.step()
 
     def update(self):
+        self._unscaled.clear()
         if not self._enable or not self._dynamic:
             return
         if self._found_inf:

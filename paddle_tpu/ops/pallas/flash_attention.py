@@ -8,9 +8,14 @@ grid dimension accumulating into VMEM scratch, so each Q block streams K/V
 tiles through VMEM exactly once. Layout is paddle's [batch, seq, heads, dim];
 internally [B,H,S,D].
 
-Backward currently differentiates a blockwise XLA recompute (O(S·block)
-memory via lax.scan) — the dedicated Pallas backward kernel is the M4 perf
-item. Forward returns the logsumexp needed for that backward.
+Backward is a dedicated two-kernel Pallas pass (dq; dk+dv) from the saved
+output + logsumexp, FlashAttention-2 style: delta = rowsum(do*o) is
+precomputed, each kernel recomputes p = exp(s - lse) blockwise and
+accumulates into VMEM scratch. Both kernels work in the transposed
+[block_k, block_q] frame so lse/delta stay (1, block_q) row vectors
+(no in-kernel transposes; contractions go through dot_general on the MXU)
+and causal block skip prunes fully-masked tiles. Reference capability:
+paddle/phi/kernels/gpu/flash_attn_grad_kernel.cu.
 """
 import functools
 import math
@@ -20,8 +25,11 @@ import jax.numpy as jnp
 
 from . import on_tpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# v5e-swept defaults (benchmarks/flash_block_sweep.py): 1024/1024 is
+# 3.7x faster fwd and 4.5x fwd+bwd than 128/128; >1024 fails to compile
+# (VMEM). Kernels clamp to the sequence length when shorter.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
@@ -96,8 +104,8 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal,
 
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     nq = sq // block_q
     nk = sk // block_k
     grid = (bh, nq, nk)
@@ -133,28 +141,215 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal,
     return o, lse[:, :, 0]
 
 
+# -------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, sm_scale, causal, block_q, block_k,
+                   num_kv_blocks):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)            # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)            # [block_k, d]
+        do = do_ref[0].astype(jnp.float32)          # [block_q, d]
+        lse = lse_ref[0]                            # [1, block_q]
+        delta = delta_ref[0]                        # [1, block_q]
+        # transposed frame: st[kk, qq] = k·q * scale
+        st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        st = st * sm_scale                          # [block_k, block_q]
+        pt = jnp.exp(st - lse)                      # exp(s - lse)^T
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            pt = jnp.where(q_pos >= k_pos, pt, 0.0)
+        dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dst = pt * (dpt - delta)                    # [block_k, block_q]
+        # dq[qq, d] += ds[qq, kk] @ k[kk, d]  == dst^T @ k via dim-0 contract
+        dq_scr[:] = dq_scr[:] + sm_scale * jax.lax.dot_general(
+            dst, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= (qi + 1) * block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_k, num_q_blocks):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)            # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)            # [block_k, d]
+        do = do_ref[0].astype(jnp.float32)          # [block_q, d]
+        lse = lse_ref[0]                            # [1, block_q]
+        delta = delta_ref[0]                        # [1, block_q]
+        st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        st = st * sm_scale
+        pt = jnp.exp(st - lse)                      # [block_k, block_q]
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            pt = jnp.where(q_pos >= k_pos, pt, 0.0)
+        # dv[kk, d] += p^T[kk, qq] @ do[qq, d]
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            pt, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dpt = jax.lax.dot_general(v, do, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dst = pt * (dpt - delta)                    # [block_k, block_q]
+        # dk[kk, d] += ds^T[kk, qq] @ q[qq, d]
+        dk_scr[:] = dk_scr[:] + sm_scale * jax.lax.dot_general(
+            dst, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when((qi + 1) * block_q - 1 >= ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, sm_scale, causal,
+                      block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                      interpret=False, dlse=None):
+    """q,k,v,o,do: [BH, S, D]; lse: [BH, S]. Returns (dq, dk, dv).
+
+    ``dlse``: optional cotangent of lse (ring-attention merge path). It
+    folds into the row term: ds = p*(dp - delta + dlse), so we just pass
+    delta' = delta - dlse to the kernels."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
+    nq = sq // block_q
+    nk = sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    # rows as [BH*nq, 1, block_q]: block == array dims on the last two
+    # axes, which satisfies Mosaic's (8, 128) block-tiling constraint
+    lse = lse.astype(jnp.float32).reshape(bh * nq, 1, block_q)
+    delta = delta.reshape(bh * nq, 1, block_q)
+
+    qkv_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec_q = pl.BlockSpec((1, 1, block_q),
+                              lambda b, i, j: (b * nq + i, 0, 0))
+    kv_spec_q = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv_blocks=nk),
+        grid=(bh, nq, nk),
+        in_specs=[qkv_spec_q, kv_spec_q, kv_spec_q, qkv_spec_q,
+                  row_spec_q, row_spec_q],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    qkv_spec_k = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    row_spec_k = pl.BlockSpec((1, 1, block_q),
+                              lambda b, j, i: (b * nq + i, 0, 0))
+    kv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qkv_spec_k, kv_spec_k, kv_spec_k, qkv_spec_k,
+                  row_spec_k, row_spec_k],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 # ----------------------------------------------------- XLA reference path
 
 
 def _ref_attention(q, k, v, sm_scale, causal):
     """[B,H,S,D] reference; used for CPU tests and as backward recompute."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
-    if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
-        s = jnp.where(mask, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return _ref_with_lse(q, k, v, sm_scale, causal)[0]
 
 
 # --------------------------------------------------------------- public api
 
 
+def _fit_block(pref, seq):
+    """Largest power-of-two block <= pref that divides seq (>=128)."""
+    b = min(pref, seq)
+    while b > 128 and seq % b != 0:
+        b //= 2
+    return b
+
+
+def _pallas_ok(q, k):
+    """Pallas path requires whole blocks: seq lengths must be divisible
+    by SOME supported block size (>=128) — the kernels then pick the
+    largest fitting one, so e.g. seq 2560 runs with 512-blocks instead of
+    falling back to the O(S^2)-memory XLA composition."""
+    sq, sk = q.shape[2], k.shape[2]
+    return (available() and sq % _fit_block(DEFAULT_BLOCK_Q, sq) == 0
+            and sk % _fit_block(DEFAULT_BLOCK_K, sk) == 0)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, sm_scale, causal):
     # q,k,v: [B,H,S,D]
-    if available():
+    if _pallas_ok(q, k):
         b, h, s, d = q.shape
         o, _ = _flash_fwd_pallas(q.reshape(b * h, s, d),
                                  k.reshape(b * h, k.shape[2], d),
@@ -165,17 +360,99 @@ def _flash(q, k, v, sm_scale, causal):
 
 
 def _flash_fwd(q, k, v, sm_scale, causal):
-    return _flash(q, k, v, sm_scale, causal), (q, k, v)
+    if _pallas_ok(q, k):
+        b, h, s, d = q.shape
+        o, lse = _flash_fwd_pallas(q.reshape(b * h, s, d),
+                                   k.reshape(b * h, k.shape[2], d),
+                                   v.reshape(b * h, v.shape[2], d),
+                                   sm_scale, causal)
+        return o.reshape(b, h, s, d), (q, k, v, o, lse)
+    return _ref_attention(q, k, v, sm_scale, causal), (q, k, v, None, None)
 
 
 def _flash_bwd(sm_scale, causal, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if o is not None:
+        b, h, s, d = q.shape
+        sk = k.shape[2]
+        dq, dk, dv = _flash_bwd_pallas(
+            q.reshape(b * h, s, d), k.reshape(b * h, sk, d),
+            v.reshape(b * h, sk, d), o, lse,
+            g.reshape(b * h, s, d), sm_scale, causal)
+        return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
+                dv.reshape(b, h, sk, d))
     _, vjp = jax.vjp(lambda q_, k_, v_: _ref_attention(q_, k_, v_, sm_scale,
                                                        causal), q, k, v)
     return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# --------------------------------------------- (o, lse) variant for ring
+
+def _ref_with_lse(q, k, v, sm_scale, causal):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(q, k, v, sm_scale, causal):
+    """[B,H,S,D] attention returning (o, lse[B,H,S]). The lse output is
+    differentiable, which is what lets ring attention merge per-ring-step
+    partial results (weights depend on lse) with exact gradients."""
+    if _pallas_ok(q, k):
+        b, h, s, d = q.shape
+        sk = k.shape[2]
+        o, lse = _flash_fwd_pallas(q.reshape(b * h, s, d),
+                                   k.reshape(b * h, sk, d),
+                                   v.reshape(b * h, sk, d),
+                                   sm_scale, causal)
+        return o.reshape(b, h, s, d), lse.reshape(b, h, s)
+    return _ref_with_lse(q, k, v, sm_scale, causal)
+
+
+def _fwl_fwd(q, k, v, sm_scale, causal):
+    if _pallas_ok(q, k):
+        b, h, s, d = q.shape
+        sk = k.shape[2]
+        o, lse = _flash_fwd_pallas(q.reshape(b * h, s, d),
+                                   k.reshape(b * h, sk, d),
+                                   v.reshape(b * h, sk, d),
+                                   sm_scale, causal)
+        return ((o.reshape(b, h, s, d), lse.reshape(b, h, s)),
+                (q, k, v, o, lse))
+    out = _ref_with_lse(q, k, v, sm_scale, causal)
+    return out, (q, k, v, None, None)
+
+
+def _fwl_bwd(sm_scale, causal, res, ct):
+    q, k, v, o, lse = res
+    do, dlse = ct
+    if o is not None:
+        b, h, s, d = q.shape
+        sk = k.shape[2]
+        dq, dk, dv = _flash_bwd_pallas(
+            q.reshape(b * h, s, d), k.reshape(b * h, sk, d),
+            v.reshape(b * h, sk, d), o, lse,
+            do.reshape(b * h, s, d), sm_scale, causal,
+            dlse=dlse.reshape(b * h, s))
+        return (dq.reshape(b, h, s, d), dk.reshape(b, h, sk, d),
+                dv.reshape(b, h, sk, d))
+    _, vjp = jax.vjp(lambda a, b_, c: _ref_with_lse(a, b_, c, sm_scale,
+                                                    causal), q, k, v)
+    return vjp((do, dlse))
+
+
+flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
 
 
 def flash_attention(q, k, v, causal=False, sm_scale=None):

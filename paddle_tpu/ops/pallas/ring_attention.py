@@ -43,47 +43,59 @@ def _block_attn(q, k, v, sm_scale, mask=None):
 
 def ring_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None):
     """q,k,v: LOCAL shards [B, H, S_local, D] inside shard_map over
-    `axis_name`. Returns local attention output [B, H, S_local, D]."""
+    `axis_name`. Returns local attention output [B, H, S_local, D].
+
+    Each ring step runs the Pallas flash kernel (XLA reference off-TPU)
+    on the KV block currently held and merges (o, lse) pairs with
+    logaddexp weights — the flash backward consumes the lse cotangent
+    exactly (flash_attention.py _fwl_bwd), so the whole ring
+    differentiates through the fused kernel. Causal steps dispatch per
+    block origin: diagonal → causal kernel, below → full kernel, above →
+    skipped entirely (no FLOPs for fully-masked tiles)."""
+    from .flash_attention import flash_attention_with_lse
+
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
-    sq = q.shape[2]
+    b, h, sq, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]  # kv travels to next rank
 
-    def seq_mask(src_rank):
-        """Causal mask for local q rows vs kv from src_rank."""
-        if not causal:
-            return None
-        q_pos = my * sq + jax.lax.broadcasted_iota(jnp.int32, (sq, sq), 0)
-        k_pos = src_rank * sq + jax.lax.broadcasted_iota(jnp.int32,
-                                                         (sq, sq), 1)
-        return (q_pos >= k_pos)[None, None]
-
     def step(carry, i):
-        kv, acc, m_run, l_run = carry
-        k_i, v_i = kv
-        # kv currently held originated at rank (my - i) mod n
-        src = (my - i) % n
-        numer, m_blk, l_blk = _block_attn(q, k_i, v_i, sm_scale,
-                                          seq_mask(src))
-        m_new = jnp.maximum(m_run, m_blk)
-        c_run = jnp.exp(m_run - m_new)
-        c_blk = jnp.exp(m_blk - m_new)
-        acc = acc * c_run[..., None] + numer * c_blk[..., None]
-        l_new = l_run * c_run + l_blk * c_blk
+        (k_i, v_i), o_run, lse_run = carry
+        src = (my - i) % n  # rank where the held kv block originated
+
+        def full(_):
+            return flash_attention_with_lse(q, k_i, v_i, sm_scale, False)
+
+        def diag(_):
+            return flash_attention_with_lse(q, k_i, v_i, sm_scale, True)
+
+        def masked(_):
+            return (jnp.zeros((b, h, sq, d), q.dtype),
+                    jnp.full((b, h, sq), NEG_INF, jnp.float32))
+
+        if causal:
+            # 0: src < my (full), 1: src == my (diagonal), 2: src > my
+            case = jnp.where(src == my, 1, jnp.where(src > my, 2, 0))
+            o_blk, lse_blk = jax.lax.switch(case, [full, diag, masked],
+                                            None)
+        else:
+            o_blk, lse_blk = full(None)
+
+        lse_new = jnp.logaddexp(lse_run, lse_blk)
+        w_run = jnp.exp(lse_run - lse_new)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+        o_new = o_run * w_run + o_blk.astype(jnp.float32) * w_blk
         k_n = jax.lax.ppermute(k_i, axis_name, perm)
         v_n = jax.lax.ppermute(v_i, axis_name, perm)
-        return ((k_n, v_n), acc, m_new, l_new), None
+        return ((k_n, v_n), o_new, lse_new), None
 
-    b, h, _, d = q.shape
-    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
-    (kv_f, acc, m_f, l_f), _ = jax.lax.scan(
-        step, ((k, v), acc0, m0, l0), jnp.arange(n))
-    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
-    return (acc / l_safe[..., None]).astype(q.dtype)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    (_, o_f, lse_f), _ = jax.lax.scan(step, ((k, v), o0, lse0),
+                                      jnp.arange(n))
+    return o_f.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None,

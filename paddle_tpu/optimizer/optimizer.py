@@ -30,6 +30,7 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._opt_state = None
         self._step_count = 0
+        self._fused_cache = {}  # (keys, wds) -> jitted multi-tensor update
 
     # ---------------------------------------------------------- functional
     def init_state(self, params):
@@ -58,37 +59,68 @@ class Optimizer:
     def _learning_rate(self):
         return self._lr
 
+    def _get_fused_step(self, keys, wds):
+        """One jitted XLA program updating EVERY live parameter (clip +
+        moment updates + apply) — the reference's multi-tensor
+        fused_adam_kernel.cu capability. Keyed by the live-param set and
+        their static weight-decay values; lr and step enter as traced
+        scalars so routine steps never recompile."""
+        cache_key = (keys, wds)
+        fn = self._fused_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        wd_of = dict(zip(keys, wds))
+
+        def fused(tree_g, tree_p, sub_state, lr, step):
+            if self._grad_clip is not None:
+                gs = self._grad_clip.clip_values(
+                    [tree_g[k] for k in keys])
+                tree_g = dict(zip(keys, gs))
+            new_p = {}
+            new_state = {name: {} for name in sub_state}
+            for k in keys:
+                leaf_state = {name: st[k]
+                              for name, st in sub_state.items()}
+                np_, ns = self._update_leaf(
+                    tree_g[k], tree_p[k], leaf_state, lr, step, wd_of[k])
+                new_p[k] = np_
+                for name, v in ns.items():
+                    new_state[name][k] = v
+            return new_p, new_state
+
+        # NO buffer donation here: eager params/opt-state may be aliased
+        # outside (p.detach() wraps the same jax.Array; tape residuals of
+        # retain_graph backward; user-held state_dict views) — donating
+        # would invalidate those aliases on TPU. The functional() path
+        # used inside fully-jitted train steps is where donation belongs.
+        fn = jax.jit(fused)
+        self._fused_cache[cache_key] = fn
+        return fn
+
     def step(self):
-        params = [p for p in self._parameters if p.trainable]
-        grads = [p.grad._value if p.grad is not None else None for p in params]
-        live = [(p, g) for p, g in zip(params, grads) if g is not None]
+        all_params = [p for p in self._parameters if p.trainable]
+        live = [(i, p) for i, p in enumerate(all_params)
+                if p.grad is not None]
         if not live:
             return
-        if self._grad_clip is not None:
-            gs = self._grad_clip.clip_values([g for _, g in live])
-            live = [(p, g) for (p, _), g in zip(live, gs)]
-        tree_p = {str(i): p._value for i, (p, _) in enumerate(live)}
-        tree_g = {str(i): g for i, (_, g) in enumerate(live)}
         if self._opt_state is None:
             self._opt_state = self.init_state(
-                {str(i): p._value for i, p in enumerate(
-                    [p for p in self._parameters if p.trainable])})
-        # state keyed by global trainable-param index; map the live subset
-        all_params = [p for p in self._parameters if p.trainable]
-        index_of = {id(p): str(i) for i, p in enumerate(all_params)}
-        sub_state = jax.tree_util.tree_map(
-            lambda x: x, self._opt_state)  # shallow copy container
+                {str(i): p._value for i, p in enumerate(all_params)})
         self._step_count += 1
-        lr = self.get_lr()
-        for key_live, (p, g) in zip(tree_p, live):
-            k = index_of[id(p)]
-            leaf_state = {name: st[k] for name, st in self._opt_state.items()}
-            new_p, new_leaf = self._update_leaf(
-                g, p._value, leaf_state, lr, self._step_count,
-                self._wd_for(p))
-            p._replace_value(new_p)
-            for name, v in new_leaf.items():
-                self._opt_state[name][k] = v
+        keys = tuple(str(i) for i, _ in live)
+        tree_g = {str(i): p.grad._value for i, p in live}
+        tree_p = {str(i): p._value for i, p in live}
+        sub_state = {name: {k: st[k] for k in keys}
+                     for name, st in self._opt_state.items()}
+        wds = tuple(float(self._wd_for(p) or 0.0) for _, p in live)
+        fn = self._get_fused_step(keys, wds)
+        new_p, new_state = fn(tree_g, tree_p, sub_state,
+                              jnp.asarray(self.get_lr(), jnp.float32),
+                              jnp.asarray(self._step_count, jnp.int32))
+        for (i, p) in live:
+            p._replace_value(new_p[str(i)])
+        for name, st in self._opt_state.items():
+            st.update(new_state[name])
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

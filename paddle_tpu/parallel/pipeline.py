@@ -73,6 +73,11 @@ class SegmentLayers:
         n = len(self.layers)
         if self.method == "uniform":
             return self.uniform(n, self.num_parts)
+        if self.method == "param":
+            # balance stages by parameter count (reference pp_layers.py
+            # segmentation-by-weight used for embedding/head-heavy models)
+            return self._by_weight([self._param_weight(l)
+                                    for l in self.layers])
         if self.method.startswith("layer:"):
             # segment by occurrences of a named layer class
             cls_name = self.method.split(":", 1)[1]
@@ -80,6 +85,21 @@ class SegmentLayers:
                        for l in self.layers]
             return self._by_weight(weights)
         raise ValueError(self.method)
+
+    @staticmethod
+    def _param_weight(desc):
+        if isinstance(desc, LayerDesc):
+            # probe-build to count params; run under a scratch unique_name
+            # generator so the throwaway layers don't advance the global
+            # counters (full_name()s of later real layers must not depend
+            # on whether segmentation probed)
+            from ..utils import unique_name as _un
+            with _un.guard(_un.UniqueNameGenerator()):
+                built = desc.build_layer()
+        else:
+            built = desc
+        return max(1, sum(int(np.prod(p.shape))
+                          for p in built.parameters()))
 
     def _name_of(self, desc):
         if isinstance(desc, LayerDesc):

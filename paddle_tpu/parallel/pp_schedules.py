@@ -1,0 +1,238 @@
+"""Static pipeline schedule tables: 1F1B and interleaved-1F1B.
+
+Reference semantics: PipelineParallel.forward_backward_pipeline
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:117 —
+warmup forwards, steady 1F1B, cooldown backwards) and
+PipelineParallelWithInterleave (:461 — v virtual stage chunks per device).
+
+TPU-native design: the reference runs these as per-rank Python loops with
+NCCL p2p; here the WHOLE schedule is computed ahead of time (plain Python,
+trace-time) into dense [T, S] tick tables, and a single SPMD
+shard_map+scan executes them in lockstep with two ppermute channels
+(activations up, gradients down) — see pp_1f1b.py. Because every
+microbatch/slot index is static, there is no shape handshake
+(SendRecvMeta deleted) and XLA sees one fully-static program.
+
+The scheduler is an event simulator with the 1F1B policy: a device always
+prefers a ready backward; forwards are admitted while the per-device
+in-flight count stays under the 1F1B bound. Slots for the three ring
+buffers (activation inbox, gradient inbox, saved forward inputs) are
+allocated by a free-list during simulation, so buffer sizes are exactly
+the schedule's true high-water marks.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["Schedule", "build_schedule", "bubble_fraction",
+           "gpipe_bubble_fraction"]
+
+
+@dataclasses.dataclass
+class Schedule:
+    """Dense tick tables, all int32 [T, S] (S = devices), -1 = inactive.
+
+    Virtual stage j (0..v*S-1) lives on device j % S, chunk j // S.
+    """
+    S: int
+    M: int
+    v: int
+    T: int
+    f_vs: np.ndarray        # fwd virtual stage
+    f_mb: np.ndarray        # fwd microbatch
+    f_read: np.ndarray      # act-inbox slot to read (-1: vs==0, from input)
+    f_save: np.ndarray      # x-saved slot to write (-1: vs==0, not saved)
+    b_vs: np.ndarray        # bwd virtual stage
+    b_mb: np.ndarray        # bwd microbatch
+    b_gread: np.ndarray     # grad-inbox slot to read (-1: vs==VS-1)
+    b_xread: np.ndarray     # x-saved slot to read (-1: vs==0, from input)
+    recv_a: np.ndarray      # act-inbox slot to store this tick's arrival
+    recv_g: np.ndarray      # grad-inbox slot to store this tick's arrival
+    n_aslots: int
+    n_gslots: int
+    n_xslots: int
+
+    @property
+    def VS(self):
+        return self.S * self.v
+
+
+class _SlotPool:
+    def __init__(self):
+        self.free = []
+        self.next = 0
+        self.live = {}
+
+    def alloc(self, key):
+        slot = self.free.pop() if self.free else self.next
+        if slot == self.next:
+            self.next += 1
+        self.live[key] = slot
+        return slot
+
+    def release(self, key):
+        self.free.append(self.live.pop(key))
+
+
+def build_schedule(S, M, v=1):
+    """Simulate 1F1B (interleaved when v>1) and emit dense tables."""
+    VS = S * v
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+
+    # completion tick of each op (None = not yet scheduled)
+    f_done = {}                     # (vs, m) -> tick
+    b_done = {}
+    inflight = [0] * S              # fwds not yet backed, per device
+    # in-flight cap per device = 1F1B warmup depth + 1 steady slot.
+    # Megatron interleave warmup count is (S - i - 1)*? + (v-1)*S; for
+    # v=1 this reduces to the classic S - i bound.
+    cap = [max(1, (S - i - 1) + (v - 1) * S + 1) for i in range(S)]
+
+    apool, gpool, xpool = _SlotPool(), _SlotPool(), _SlotPool()
+
+    rows = []
+    t = 0
+    total_ops = 2 * VS * M
+    done_ops = 0
+    # arrival bookkeeping: (vs, m) act available on consumer at tick
+    act_avail = {}                  # (vs, m) -> (tick, slot)  vs >= 1
+    grad_avail = {}                 # (vs, m) -> (tick, slot)  vs <= VS-2
+    x_saved = {}                    # (vs, m) -> slot
+
+    while done_ops < total_ops:
+        if t > 10 * (total_ops + VS):
+            raise RuntimeError("schedule simulation did not converge")
+        row = {k: [-1] * S for k in
+               ("f_vs", "f_mb", "f_read", "f_save", "b_vs", "b_mb",
+                "b_gread", "b_xread", "recv_a", "recv_g")}
+        sends_a, sends_g = [], []   # (from_dev, vs, m) completed this tick
+
+        for i in range(S):
+            # ---- choose op for device i at tick t: prefer ready bwd.
+            # Candidates are ordered Megatron-style by microbatch GROUP of
+            # size S, cycling chunks within a group (fwd: low chunk first,
+            # bwd: high chunk first) — this is the interleaved-1F1B order
+            # and reduces to plain microbatch order for v=1.
+            chosen = None
+            bwd_cands = []
+            for c in range(v):
+                vs = c * S + i
+                for m in range(M):
+                    if (vs, m) in b_done or (vs, m) not in f_done \
+                            or f_done[(vs, m)] > t - 1:
+                        continue
+                    if vs == VS - 1:
+                        ready = True        # loss grad is local
+                        g = None
+                    else:
+                        ga = grad_avail.get((vs, m))
+                        ready = ga is not None and ga[0] <= t
+                        g = ga[1] if ready else None
+                    if ready:
+                        bwd_cands.append(((m // S, v - 1 - c, m % S),
+                                          vs, m, g))
+            if bwd_cands:
+                _, vs, m, g = min(bwd_cands)
+                chosen = ("b", vs, m, g)
+            if chosen is None and inflight[i] < cap[i]:
+                fwd_cands = []
+                for c in range(v):
+                    vs = c * S + i
+                    for m in range(M):
+                        if (vs, m) in f_done:
+                            continue
+                        if vs == 0:
+                            ready = True
+                            a = None
+                        else:
+                            aa = act_avail.get((vs, m))
+                            ready = aa is not None and aa[0] <= t
+                            a = aa[1] if ready else None
+                        # chunks process microbatches in order: don't run
+                        # (vs, m) before (vs, m-1)
+                        if m > 0 and (vs, m - 1) not in f_done:
+                            ready = False
+                        if ready:
+                            fwd_cands.append(((m // S, c, m % S), vs, m, a))
+                            break  # only the first unfinished m per chunk
+                if fwd_cands:
+                    _, vs, m, a = min(fwd_cands)
+                    chosen = ("f", vs, m, a)
+
+            if chosen is None:
+                continue
+            kind, vs, m, slot = chosen
+            if kind == "f":
+                row["f_vs"][i] = vs
+                row["f_mb"][i] = m
+                if vs > 0:
+                    row["f_read"][i] = slot
+                    apool.release((vs, m))
+                    del act_avail[(vs, m)]
+                    xs = xpool.alloc((vs, m))
+                    x_saved[(vs, m)] = xs
+                    row["f_save"][i] = xs
+                f_done[(vs, m)] = t
+                inflight[i] += 1
+                done_ops += 1
+                if vs < VS - 1:
+                    sends_a.append((i, vs, m))
+            else:
+                row["b_vs"][i] = vs
+                row["b_mb"][i] = m
+                if vs < VS - 1:
+                    row["b_gread"][i] = slot
+                    gpool.release((vs, m))
+                    del grad_avail[(vs, m)]
+                if vs > 0:
+                    xs = x_saved.pop((vs, m))
+                    row["b_xread"][i] = xs
+                    xpool.release((vs, m))
+                b_done[(vs, m)] = t
+                inflight[i] -= 1
+                done_ops += 1
+                if vs > 0:
+                    sends_g.append((i, vs, m))
+
+        # ---- deliver sends (usable from tick t+1)
+        for (i, vs, m) in sends_a:
+            dst = (vs + 1) % S
+            slot = apool.alloc((vs + 1, m))
+            act_avail[(vs + 1, m)] = (t + 1, slot)
+            row["recv_a"][dst] = slot
+        for (i, vs, m) in sends_g:
+            dst = (vs - 1) % S
+            slot = gpool.alloc((vs - 1, m))
+            grad_avail[(vs - 1, m)] = (t + 1, slot)
+            row["recv_g"][dst] = slot
+
+        rows.append(row)
+        t += 1
+
+    T = len(rows)
+
+    def tbl(key):
+        return np.array([r[key] for r in rows], np.int32)
+
+    return Schedule(
+        S=S, M=M, v=v, T=T,
+        f_vs=tbl("f_vs"), f_mb=tbl("f_mb"), f_read=tbl("f_read"),
+        f_save=tbl("f_save"), b_vs=tbl("b_vs"), b_mb=tbl("b_mb"),
+        b_gread=tbl("b_gread"), b_xread=tbl("b_xread"),
+        recv_a=tbl("recv_a"), recv_g=tbl("recv_g"),
+        n_aslots=max(apool.next, 1), n_gslots=max(gpool.next, 1),
+        n_xslots=max(xpool.next, 1))
+
+
+def bubble_fraction(sched: Schedule):
+    """Idle fraction of device-ticks (fwd and bwd slots count equally)."""
+    busy = int((sched.f_vs >= 0).sum() + (sched.b_vs >= 0).sum())
+    return 1.0 - busy / float(sched.T * sched.S)
+
+
+def gpipe_bubble_fraction(S, M):
+    """Fill-drain wave: T = 2*(M + S - 1), busy = 2*M per device."""
+    return 1.0 - (2.0 * M) / (2.0 * (M + S - 1))
