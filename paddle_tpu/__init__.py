@@ -24,6 +24,12 @@ from .core.dtype import (  # noqa: F401
     int16, int32, int64, uint8,
 )
 from .ops.registry import OPS as _OPS
+from .ops.registry import install_tensor_methods as _install_tm
+
+# second pass: nn.functional etc. registered more ops (relu, softmax, …)
+# after paddle_tpu.ops ran its install — pick up their method/inplace
+# variants too (idempotent)
+_install_tm()
 
 # re-export every registered op at top level (paddle.* flat namespace parity)
 _g = globals()

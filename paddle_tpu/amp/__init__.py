@@ -191,6 +191,10 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        # the unscale is consumed by this step: clear per-step (reference
+        # clears OptimizerState.UNSCALED on step, not only on update()),
+        # so loops that skip update() don't skip unscaling forever
+        self._unscaled.discard(id(optimizer))
 
     def update(self):
         self._unscaled.clear()

@@ -100,6 +100,11 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
 
 @register("vector_norm")
 def vector_norm(x, p=2, axis=None, keepdim=False):
+    # axis=None means the VECTOR norm of the flattened input (paddle
+    # semantics); jnp.linalg.norm would compute the matrix 2-norm for 2-D
+    if axis is None:
+        out = jnp.linalg.norm(x.reshape(-1), ord=p)
+        return out.reshape((1,) * x.ndim) if keepdim else out
     return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
 
 
@@ -204,9 +209,15 @@ def lu(x, pivot=True):
     return lu_, piv
 
 
-@register("multi_dot")
 def multi_dot(xs):
-    return jnp.linalg.multi_dot(xs)
+    """paddle.linalg.multi_dot(list_of_tensors)."""
+    from ..core.tensor import dispatch as _dispatch
+    return _dispatch(lambda *vs: jnp.linalg.multi_dot(vs), *xs,
+                     name="multi_dot")
+
+
+from .registry import register_direct as _register_direct  # noqa: E402
+_register_direct("multi_dot", multi_dot)
 
 
 @register("householder_product")

@@ -450,7 +450,17 @@ def mode(x, axis=-1, keepdim=False):
 @register("searchsorted")
 def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     side = "right" if right else "left"
-    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    if sorted_sequence.ndim > 1:
+        # paddle supports batched innermost-dim search; jnp.searchsorted
+        # is 1-D only, so vmap over the leading dims
+        fn = lambda s, v: jnp.searchsorted(s, v, side=side)
+        for _ in range(sorted_sequence.ndim - 1):
+            fn = jax.vmap(fn)
+        out = fn(sorted_sequence,
+                 values.reshape(sorted_sequence.shape[:-1] + (-1,)))
+        out = out.reshape(values.shape)
+    else:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
     return out.astype(jnp.int32) if out_int32 else out.astype(jnp.int64)
 
 

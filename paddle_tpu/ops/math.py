@@ -446,18 +446,38 @@ def cumprod(x, dim=None):
     return jnp.cumprod(x, axis=dim)
 
 
+def _cum_extreme(x, axis, is_max):
+    """(running extreme values, index of first attaining element) —
+    paddle.cummax/cummin return both (python/paddle/tensor/math.py).
+    Associative scan over (value, index) pairs; strict comparison keeps
+    the EARLIEST index on ties, and the pairwise combine is associative
+    so the scan is correct for any tree order."""
+    ax = axis % x.ndim
+    n = x.shape[ax]
+    pos = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32).reshape(
+            [-1 if i == ax else 1 for i in range(x.ndim)]), x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        better = (bv > av) if is_max else (bv < av)
+        return jnp.where(better, bv, av), jnp.where(better, bi, ai)
+
+    vals, idx = jax.lax.associative_scan(combine, (x, pos), axis=ax)
+    return vals, idx.astype(jnp.int64)
+
+
 @register("cummax")
 def cummax(x, axis=None):
     xs = x.reshape(-1) if axis is None else x
-    ax = 0 if axis is None else axis
-    return jax.lax.cummax(xs, axis=ax)
+    return _cum_extreme(xs, 0 if axis is None else axis, True)
 
 
 @register("cummin")
 def cummin(x, axis=None):
     xs = x.reshape(-1) if axis is None else x
-    ax = 0 if axis is None else axis
-    return jax.lax.cummin(xs, axis=ax)
+    return _cum_extreme(xs, 0 if axis is None else axis, False)
 
 
 @register("logcumsumexp")

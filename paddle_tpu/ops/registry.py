@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import functools
 
+import jax.numpy as jnp
+
 from ..core.tensor import Tensor, dispatch
 
 OPS = {}            # name -> callable (public op)
@@ -50,7 +52,59 @@ def register_direct(name, fn, *, method=None):
     return fn
 
 
+# ops that also get an `x.<name>_()` in-place variant (reference: paddle's
+# generated *_ inplace APIs, paddle/phi/api/yaml/ops.yaml inplace entries).
+# JAX arrays are immutable, so "in-place" = compute + rebind the wrapper's
+# value (Tensor.set_value); the recorded tape keeps functional semantics.
+INPLACE_OPS = ("add", "subtract", "multiply", "divide", "scale", "clip",
+               "exp", "sqrt", "rsqrt", "reciprocal", "floor", "ceil",
+               "round", "trunc", "remainder", "lerp", "pow", "tanh",
+               "sigmoid", "relu", "squeeze", "unsqueeze", "flatten",
+               "flip", "cast")
+
+
 def install_tensor_methods():
     for mname, op in TENSOR_METHODS.items():
         if not hasattr(Tensor, mname):
             setattr(Tensor, mname, op)
+
+    from ..core.tensor import unwrap, wrap
+
+    def mk_inplace(op):
+        def method(self, *args, **kwargs):
+            # run the op on a SNAPSHOT carrying the pre-mutation tape
+            # identity: the new node's parent must be the old value, not
+            # the rebound self (self-referential parent would cut the
+            # upstream graph out of backward)
+            snapshot = wrap(unwrap(self),
+                            stop_gradient=self.stop_gradient)
+            snapshot._node = self._node
+            snapshot._out_index = self._out_index
+            out = op(snapshot, *args, **kwargs)
+            # adopt the output tensor wholesale: raw value (cast_/
+            # squeeze_ legally change dtype/shape) AND the tape node
+            self._value = unwrap(out)
+            if isinstance(out, Tensor):
+                self._node = out._node
+                self._out_index = out._out_index
+                self.stop_gradient = out.stop_gradient
+            return self
+        return method
+
+    for name in INPLACE_OPS:
+        op = OPS.get(name)
+        if op is not None and not hasattr(Tensor, name + "_"):
+            setattr(Tensor, name + "_", mk_inplace(op))
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    if not hasattr(Tensor, "zero_"):
+        Tensor.zero_ = zero_
+    if not hasattr(Tensor, "fill_"):
+        Tensor.fill_ = fill_

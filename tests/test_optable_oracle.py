@@ -1,0 +1,143 @@
+"""Oracle tests driven by the declarative op table (the ops.yaml-analogue
+single source of truth — paddle_tpu/ops/optable.py). One parameterized
+test per table row; plus API-surface and inplace-variant checks.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops.optable import TABLE, coverage_names
+
+
+def _run_case(case):
+    op = getattr(pt, case.name, None)
+    assert op is not None, f"op {case.name} missing from namespace"
+    np_inputs = [gen() for gen in case.inputs.values()]
+    tensors = [pt.to_tensor(v) for v in np_inputs]
+    if case.call is not None:
+        out = case.call(op, tensors, case.attrs)
+    else:
+        out = op(*tensors, **case.attrs)
+    expected = case.ref(*np_inputs) if case.inputs else case.ref()
+
+    def leaves(x):
+        if isinstance(x, (tuple, list)):
+            return [l for e in x for l in leaves(e)]
+        return [x]
+
+    got, want = leaves(out), leaves(expected)
+    assert len(got) == len(want), (len(got), len(want))
+    for g, w in zip(got, want):
+        g = np.asarray(g.numpy() if hasattr(g, "numpy") else g)
+        w = np.asarray(w)
+        if w.dtype == bool or np.issubdtype(w.dtype, np.integer):
+            np.testing.assert_array_equal(g.astype(w.dtype), w)
+        elif np.issubdtype(w.dtype, np.complexfloating):
+            np.testing.assert_allclose(g.astype(np.complex128),
+                                       w.astype(np.complex128),
+                                       atol=case.atol, rtol=case.rtol)
+        else:
+            np.testing.assert_allclose(g.astype(np.float64),
+                                       w.astype(np.float64),
+                                       atol=case.atol, rtol=case.rtol)
+
+
+@pytest.mark.parametrize("case", TABLE, ids=[c.case_id for c in TABLE])
+def test_optable_oracle(case):
+    _run_case(case)
+
+
+def test_case_count_meets_floor():
+    # VERDICT round-3 target: >=300 oracle cases driven by the table
+    # (plus the legacy suite in test_ops_oracle.py)
+    assert len(TABLE) >= 300, len(TABLE)
+
+
+def test_every_table_op_in_namespace():
+    missing = [n for n in coverage_names() if not hasattr(pt, n)]
+    assert not missing, missing
+
+
+class TestInplaceVariants:
+    def test_add_(self):
+        x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = x.add_(pt.to_tensor(np.array([10.0, 20.0], np.float32)))
+        assert y is x
+        np.testing.assert_allclose(x.numpy(), [11.0, 22.0])
+
+    def test_clip_scale_chain(self):
+        x = pt.to_tensor(np.array([-5.0, 0.5, 5.0], np.float32))
+        x.clip_(min=-1.0, max=1.0).scale_(scale=2.0)
+        np.testing.assert_allclose(x.numpy(), [-2.0, 1.0, 2.0])
+
+    def test_cast_changes_dtype(self):
+        x = pt.to_tensor(np.array([1.7], np.float32))
+        x.cast_("int32")
+        assert "int32" in str(x.dtype)
+
+    def test_zero_fill(self):
+        x = pt.to_tensor(np.ones((2, 2), np.float32))
+        x.zero_()
+        np.testing.assert_allclose(x.numpy(), np.zeros((2, 2)))
+        x.fill_(3.5)
+        np.testing.assert_allclose(x.numpy(), np.full((2, 2), 3.5))
+
+    def test_exp_sqrt_(self):
+        x = pt.to_tensor(np.array([4.0], np.float32))
+        x.sqrt_()
+        np.testing.assert_allclose(x.numpy(), [2.0])
+        x.exp_()
+        np.testing.assert_allclose(x.numpy(), [np.exp(2.0)], rtol=1e-6)
+
+    def test_inplace_participates_in_autograd(self):
+        # review regression: relu_ after multiply must keep the relu
+        # derivative on the tape (not backprop through a*b alone)
+        a = pt.to_tensor(np.array([-2.0, 3.0], np.float32),
+                         stop_gradient=False)
+        b = pt.to_tensor(np.array([5.0, 7.0], np.float32),
+                         stop_gradient=False)
+        y = a * b
+        y.relu_()
+        y.sum().backward()
+        # d/da relu(a*b) = b * (a*b > 0)
+        np.testing.assert_allclose(a.grad.numpy(), [0.0, 7.0])
+
+
+class TestReviewRegressions:
+    def test_cummax_indices(self):
+        x = pt.to_tensor(np.array([3.0, 1.0, 5.0, 5.0], np.float32))
+        vals, idx = pt.cummax(x, axis=0)
+        np.testing.assert_allclose(vals.numpy(), [3.0, 3.0, 5.0, 5.0])
+        np.testing.assert_array_equal(idx.numpy(), [0, 0, 2, 2])
+
+    def test_cummin_indices_2d(self):
+        x = np.array([[2.0, 1.0], [0.5, 3.0], [0.5, 0.0]], np.float32)
+        vals, idx = pt.cummin(pt.to_tensor(x), axis=0)
+        np.testing.assert_allclose(vals.numpy(),
+                                   [[2.0, 1.0], [0.5, 1.0], [0.5, 0.0]])
+        np.testing.assert_array_equal(idx.numpy(),
+                                      [[0, 0], [1, 0], [1, 2]])
+
+    def test_vector_norm_keepdim_all_axes(self):
+        x = pt.to_tensor(np.ones((2, 3), np.float32))
+        out = pt.vector_norm(x, keepdim=True)
+        assert tuple(out.numpy().shape) == (1, 1)
+
+    def test_scaler_step_without_update_keeps_unscaling(self):
+        net = pt.nn.Linear(2, 1)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        scaler = pt.amp.GradScaler(init_loss_scaling=8.0,
+                                   use_dynamic_loss_scaling=False)
+        x = pt.to_tensor(np.ones((4, 2), np.float32))
+        for _ in range(3):
+            loss = net(x).mean()
+            scaler.scale(loss).backward()
+            scaler.unscale_(opt)
+            g = net.weight.grad.numpy().copy()
+            scaler.step(opt)   # must not re-unscale, must not skip next
+            opt.clear_grad()
+        # third-iteration grad must be exactly unscaled (1.0): the
+        # skip-unscale bug would leave 8.0, double-unscale would give
+        # 0.125
+        np.testing.assert_allclose(np.abs(g), 1.0, rtol=1e-5)
