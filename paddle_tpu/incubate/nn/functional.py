@@ -31,17 +31,17 @@ def fused_linear(x, weight, bias=None, transpose_weight=False):
 
 def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
                             activation="gelu"):
-    """cublasLt epilogue parity (fused_gemm_epilogue_op.cu): matmul+bias+act
-    in one subgraph — XLA fuses the epilogue into the MXU matmul."""
+    """cublasLt epilogue parity (fused_gemm_epilogue_op.cu): matmul+bias+
+    act in one pass. On TPU this routes to the Pallas fused kernel
+    (ops/pallas/gemm_epilogue.py — bias+activation applied in VMEM after
+    the K-loop, never round-tripping HBM); elsewhere the jnp composition,
+    which XLA fuses."""
+    from ...ops.pallas.gemm_epilogue import fused_gemm_epilogue
+
     def fn(xv, yv, bv):
         a = xv.T if trans_x else xv
         b = yv.T if trans_y else yv
-        out = a @ b + bv
-        if activation == "gelu":
-            return jax.nn.gelu(out)
-        if activation == "relu":
-            return jax.nn.relu(out)
-        return out
+        return fused_gemm_epilogue(a, b, bv, activation)
     return dispatch(fn, x, y, bias, name="fused_gemm_epilogue")
 
 

@@ -13,7 +13,8 @@ import os
 
 import jax
 
-__all__ = ["CheckpointManager", "save_sharded", "load_sharded"]
+__all__ = ["CheckpointManager", "save_sharded", "load_sharded",
+           "checkpoint_meta_tree"]
 
 
 def _ocp():
@@ -30,6 +31,20 @@ def save_sharded(state, path, overwrite=True):
     ckptr.wait_until_finished()
 
 
+def checkpoint_meta_tree(path):
+    """Saved pytree of per-array metadata (shape/dtype), across orbax API
+    generations (new StandardCheckpointer.metadata returns StepMetadata
+    wrapping item_metadata.tree; older ones return the tree directly)."""
+    ocp = _ocp()
+    meta = ocp.StandardCheckpointer().metadata(os.path.abspath(path))
+    item = getattr(meta, "item_metadata", None)
+    if item is not None:
+        meta = getattr(item, "tree", item)
+    if isinstance(meta, dict):
+        return dict(meta)
+    return meta
+
+
 def load_sharded(path, target=None, shardings=None):
     """Restore; when `shardings` (pytree of NamedSharding) is given the
     arrays land re-sliced for the new mesh — the reference converter.py
@@ -41,7 +56,7 @@ def load_sharded(path, target=None, shardings=None):
         return ckptr.restore(path)
     if shardings is not None:
         # build abstract arrays with desired shardings from saved metadata
-        meta = ckptr.metadata(path)
+        meta = checkpoint_meta_tree(path)
         abstract = jax.tree_util.tree_map(
             lambda m, sh: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sh),
             meta, shardings)

@@ -239,23 +239,109 @@ def default_collate_fn(batch):
     return batch
 
 
+# --------------------------------------------- multiprocess worker plumbing
+
+class _ShmRef:
+    """Pickle-light reference to a numpy array parked in POSIX shared
+    memory (reference: dataloader_iter.py:162 shared-mem worker queue —
+    large batches cross the process boundary as a name + memcpy, never
+    through pickle serialization)."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _tree_to_shm(obj):
+    from multiprocessing import shared_memory
+    if isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.frombuffer(shm.buf, obj.dtype)[:obj.size] = obj.reshape(-1)
+        ref = _ShmRef(shm.name, obj.shape, obj.dtype)
+        shm.close()  # worker-side handle; parent unlinks after reading
+        return ref
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_shm(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_shm(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_from_shm(obj):
+    from multiprocessing import shared_memory
+    if isinstance(obj, _ShmRef):
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.frombuffer(shm.buf, obj.dtype)[
+                :int(np.prod(obj.shape))].reshape(obj.shape).copy()
+        finally:
+            shm.close()
+            shm.unlink()
+        return arr
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_from_shm(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_from_shm(v) for k, v in obj.items()}
+    return obj
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
+                 num_workers, worker_init_fn, use_shared_memory, seed):
+    """Worker process body (reference _worker_loop, dataloader/worker.py)."""
+    global _worker_info
+    _worker_info = _WorkerInfo(wid, num_workers, dataset)
+    np.random.seed((seed + wid) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        epoch, bidx, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            if use_shared_memory:
+                batch = _tree_to_shm(batch)
+            result_queue.put((epoch, bidx, True, batch))
+        except Exception:
+            import traceback
+            result_queue.put((epoch, bidx, False, traceback.format_exc()))
+
+
 class DataLoader:
+    """paddle.io.DataLoader parity. num_workers>0 spawns REAL worker
+    processes (fork) with per-worker index queues and a shared result
+    queue; use_shared_memory routes numpy payloads through POSIX shared
+    memory instead of pickle (reference
+    python/paddle/fluid/dataloader/dataloader_iter.py:162,370)."""
+
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
-        self._pool = None
+        self.prefetch_factor = prefetch_factor
+        self._workers = []
+        self._index_queues = []
+        self._result_queue = None
+        self._epoch = 0
 
     def __len__(self):
         return len(self.batch_sampler)
@@ -263,6 +349,63 @@ class DataLoader:
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 
+    # ---------------------------------------------------- worker control
+    def _start_workers(self):
+        ctx = multiprocessing.get_context("fork")
+        self._result_queue = ctx.Queue()
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, self._result_queue,
+                      self.collate_fn, wid, self.num_workers,
+                      self.worker_init_fn, self.use_shared_memory,
+                      np.random.randint(0, 2 ** 31)),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+            self._index_queues.append(iq)
+
+    def _drain_result_queue(self):
+        """Unlink any parked shared-memory payloads so abandoned epochs
+        and error paths don't leak /dev/shm segments."""
+        import queue as queue_mod
+        if self._result_queue is None:
+            return
+        while True:
+            try:
+                item = self._result_queue.get_nowait()
+            except (queue_mod.Empty, OSError, ValueError):
+                return
+            payload = item[-1]
+            if item[-2]:  # ok flag: payload may hold shm refs
+                try:
+                    _tree_from_shm(payload)
+                except Exception:
+                    pass
+
+    def _shutdown_workers(self):
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except (OSError, ValueError):
+                pass
+        self._drain_result_queue()
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._drain_result_queue()
+        self._workers, self._index_queues = [], []
+        self._result_queue = None
+
+    def __del__(self):
+        try:
+            self._shutdown_workers()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- iter
     def __iter__(self):
         if isinstance(self.dataset, IterableDataset):
             yield from self._iter_iterable()
@@ -271,13 +414,69 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
-        # thread pool prefetch (workers feed the accelerator ahead of step)
-        if self._pool is None:
-            self._pool = multiprocessing.pool.ThreadPool(self.num_workers)
+        yield from self._iter_multiprocess()
+
+    def _iter_multiprocess(self):
+        import time as time_mod
+        import queue as queue_mod
+        if not self._workers:
+            self._start_workers()
+        self._epoch += 1
+        epoch = self._epoch
         batches = list(self.batch_sampler)
-        for out in self._pool.imap(self._fetch, batches,
-                                   chunksize=1):
-            yield out
+        # bounded dispatch (reference: prefetch_factor * num_workers
+        # outstanding batches) — no unbounded /dev/shm buildup when the
+        # consumer is slower than the workers
+        window = max(2, self.prefetch_factor) * self.num_workers
+        next_submit = 0
+
+        def submit_upto(n):
+            nonlocal next_submit
+            while next_submit < min(n, len(batches)):
+                self._index_queues[next_submit % self.num_workers].put(
+                    (epoch, next_submit, batches[next_submit]))
+                next_submit += 1
+
+        submit_upto(window)
+        pending = {}
+        try:
+            for want in range(len(batches)):
+                deadline = (time_mod.monotonic() + self.timeout
+                            if self.timeout else None)
+                while want not in pending:
+                    try:
+                        # poll so dead workers / user timeout are noticed
+                        # even though timeout=0 means wait-forever
+                        ep, bidx, ok, payload = self._result_queue.get(
+                            timeout=5.0)
+                    except queue_mod.Empty:
+                        dead = [i for i, p in enumerate(self._workers)
+                                if not p.is_alive()]
+                        if dead:
+                            self._shutdown_workers()
+                            raise RuntimeError(
+                                f"DataLoader workers died: {dead}")
+                        if deadline and time_mod.monotonic() > deadline:
+                            self._shutdown_workers()
+                            raise RuntimeError(
+                                f"DataLoader timed out after "
+                                f"{self.timeout}s waiting for batch "
+                                f"{want}")
+                        continue
+                    if not ok:
+                        self._shutdown_workers()
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{payload}")
+                    if self.use_shared_memory:
+                        payload = _tree_from_shm(payload)
+                    if ep != epoch:
+                        continue  # stale result from an abandoned epoch
+                    pending[bidx] = payload
+                submit_upto(want + 1 + window)
+                yield pending.pop(want)
+        finally:
+            if not self.persistent_workers:
+                self._shutdown_workers()
 
     def _iter_iterable(self):
         batch = []

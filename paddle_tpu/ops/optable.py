@@ -108,7 +108,7 @@ _UNARY = [
         v > 0, v, 1.6732632423543772 * np.expm1(v)), F),
     ("mish", lambda v: v * np.tanh(np.log1p(np.exp(-np.abs(v)))
                                    + np.maximum(v, 0)), F),
-    ("gelu", lambda v: 0.5 * v * (1 + _sps.erf(v / np.sqrt(2.0)))
+    ("gelu", (lambda v: 0.5 * v * (1 + _sps.erf(v / np.sqrt(2.0))))
      if _sps else None, F),
     ("logsigmoid", lambda v: -(np.log1p(np.exp(-np.abs(v)))
                                + np.maximum(-v, 0)), F),

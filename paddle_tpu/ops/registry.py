@@ -97,11 +97,17 @@ def install_tensor_methods():
             setattr(Tensor, name + "_", mk_inplace(op))
 
     def zero_(self):
+        # constant rebind: detach from the tape (backprop through the old
+        # producer would be wrong — the value no longer depends on it)
         self._value = jnp.zeros_like(self._value)
+        self._node = None
+        self._out_index = 0
         return self
 
     def fill_(self, value):
         self._value = jnp.full_like(self._value, value)
+        self._node = None
+        self._out_index = 0
         return self
 
     if not hasattr(Tensor, "zero_"):

@@ -32,3 +32,6 @@ def __getattr__(name):
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu.parallel' has no attribute {name!r}")
+
+from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401,E402
+                         UserDefinedRoleMaker, Role)

@@ -1,0 +1,84 @@
+"""Mesh-change checkpoint conversion.
+
+Reference: python/paddle/distributed/auto_parallel/converter.py +
+dist_saver.py — restore a checkpoint saved under one parallel layout
+(e.g. dp=8) onto a different one (e.g. dp=2 x mp=4), re-slicing every
+tensor. TPU-native: orbax stores the GLOBAL array; restore takes target
+NamedShardings, so conversion = building the target sharding tree and
+letting orbax/XLA lay the shards out. This module adds the converter's
+user-facing pieces on top of io/checkpoint.py:
+
+- spec-tree helpers: build target shardings from (mesh, PartitionSpec)
+  per-tensor maps with a default;
+- in-memory conversion for live states (device_put re-slice);
+- name remapping for structural renames between save and load
+  (converter.py's slot-name matching).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding
+
+from .mesh import HybridMesh, P
+
+__all__ = ["build_shardings", "convert_state", "load_on_mesh",
+           "save_for_mesh_change"]
+
+
+def build_shardings(mesh, state_or_meta, spec_map=None, default=P()):
+    """Target sharding tree for `state_or_meta` (pytree of arrays or
+    ShapeDtypeStructs). spec_map: {tree-path-string: PartitionSpec};
+    unlisted leaves get `default`."""
+    m = mesh.mesh if isinstance(mesh, HybridMesh) else mesh
+    spec_map = spec_map or {}
+    flat = jax.tree_util.tree_flatten_with_path(state_or_meta)[0]
+
+    def path_str(path):
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+
+    out = {}
+    for path, leaf in flat:
+        spec = spec_map.get(path_str(path), default)
+        out[path_str(path)] = NamedSharding(m, spec)
+    treedef = jax.tree_util.tree_structure(state_or_meta)
+    leaves = [out[path_str(p)] for p, _ in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def convert_state(state, shardings):
+    """In-memory mesh change: re-slice a live pytree onto new shardings
+    (reference Converter.convert for in-memory tensors)."""
+    return jax.tree_util.tree_map(
+        lambda a, sh: jax.device_put(a, sh), state, shardings)
+
+
+def save_for_mesh_change(state, path):
+    """Save with global-array layout so any future mesh can restore it.
+    (orbax already stores globals; alias kept for converter API parity)."""
+    from ..io.checkpoint import save_sharded
+    save_sharded(state, path)
+
+
+def load_on_mesh(path, mesh, spec_map=None, default=P(),
+                 name_map=None):
+    """Restore `path` onto `mesh` with per-leaf PartitionSpecs.
+
+    name_map: {saved_name: new_name} applied to the top-level dict keys
+    before sharding resolution (converter.py's renamed-parameter
+    matching). Returns the restored pytree.
+    """
+    from ..io.checkpoint import checkpoint_meta_tree, load_sharded
+    meta = checkpoint_meta_tree(path)
+    if name_map:
+        if not isinstance(meta, dict):
+            raise ValueError("name_map needs a dict-structured checkpoint")
+        meta = {name_map.get(k, k): v for k, v in meta.items()}
+    shardings = build_shardings(mesh, meta, spec_map, default)
+    if name_map:
+        inv = {v: k for k, v in name_map.items()}
+        # restore under SAVED names, then rename
+        saved_shard = {inv.get(k, k): v for k, v in shardings.items()}
+        restored = load_sharded(path, shardings=saved_shard)
+        return {name_map.get(k, k): v for k, v in restored.items()}
+    return load_sharded(path, shardings=shardings)

@@ -71,7 +71,17 @@ class _Fleet:
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
         self._strategy = strategy or DistributedStrategy()
-        if not is_collective or self._env_is_ps():
+        # Decide PS-ness FIRST (TRAINING_ROLE=PSERVER in env forces it
+        # even under the default is_collective=True), then build the
+        # matching role maker — a collective-parsed role maker would turn
+        # a PSERVER process into a serverless TRAINER.
+        ps_mode = (not is_collective) or self._env_is_ps() or (
+            role_maker is not None and role_maker.is_server())
+        if role_maker is None:
+            from .role_maker import PaddleCloudRoleMaker
+            role_maker = PaddleCloudRoleMaker(is_collective=not ps_mode)
+        self._role_maker = role_maker
+        if ps_mode:
             return self._init_ps(role_maker)
         hc = self._strategy.hybrid_configs
         dp = hc.get("dp_degree", 1)
@@ -102,20 +112,16 @@ class _Fleet:
         return os.environ.get("TRAINING_ROLE", "").upper() in (
             "PSERVER", "SERVER")
 
-    def _init_ps(self, role_maker=None):
+    def _init_ps(self, role_maker):
         """Parameter-server mode bring-up (reference fleet.init with a
         non-collective role maker → TheOnePSRuntime)."""
         import os
         from .ps import TheOnePSRuntime
-        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
-        srv_list = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
-        n_srv = len(srv_list.split(",")) if srv_list else 0
-        n_wrk = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-        idx = int(os.environ.get(
-            "PADDLE_PSERVER_ID" if role in ("PSERVER", "SERVER")
-            else "PADDLE_TRAINER_ID", 0))
+        role = "PSERVER" if role_maker.is_server() else "TRAINER"
         self._ps_runtime = TheOnePSRuntime(
-            role=role, index=idx, num_servers=n_srv, num_workers=n_wrk,
+            role=role, index=role_maker.role_id(),
+            num_servers=role_maker.server_num(),
+            num_workers=role_maker.worker_num(),
             master_endpoint=os.environ.get("PADDLE_MASTER_ENDPOINT"))
         self._is_initialized = True
         return self

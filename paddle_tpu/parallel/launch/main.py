@@ -34,6 +34,11 @@ def _parse_args(argv=None):
     p.add_argument("--max_restart", type=int, default=0)
     p.add_argument("--run_mode", default="collective",
                    choices=["collective", "ps", "rpc"])
+    p.add_argument("--server_num", type=int, default=0,
+                   help="ps mode: pserver processes on this node")
+    p.add_argument("--trainer_num", type=int, default=None,
+                   help="ps mode: trainer processes on this node "
+                        "(default nproc_per_node)")
     p.add_argument("--devices", default=None)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -97,6 +102,14 @@ class Controller:
                 else "8476"
         return env
 
+    def _spawn(self, env, log_name):
+        a = self.args
+        cmd = [sys.executable, a.training_script,
+               *[x for x in a.training_script_args if x != "--"]]
+        c = Container(cmd, env, os.path.join(a.log_dir, log_name))
+        self.containers.append(c)
+        c.start()
+
     def run(self):
         a = self.args
         store_server = None
@@ -107,18 +120,53 @@ class Controller:
                 store_server = TCPStoreServer(port)
             except RuntimeError:
                 store_server = None  # already bound by another component
-        for i in range(a.nproc_per_node):
-            env = self.build_env(i)
-            cmd = [sys.executable, a.training_script,
-                   *[x for x in a.training_script_args if x != "--"]]
-            log = os.path.join(a.log_dir, f"workerlog.{i}")
-            c = Container(cmd, env, log)
-            self.containers.append(c)
-            c.start()
+        if a.run_mode == "ps":
+            self._run_ps()
+        else:
+            for i in range(a.nproc_per_node):
+                env = self.build_env(i)
+                if a.run_mode == "rpc":
+                    # rpc controller: expose the rendezvous endpoint the
+                    # rpc agent expects (reference controllers/rpc.py)
+                    env["PADDLE_MASTER_ENDPOINT"] = a.master or \
+                        "127.0.0.1:8090"
+                self._spawn(env, f"workerlog.{i}")
         code = self.watch()
         if store_server:
             store_server.stop()
         return code
+
+    def _run_ps(self):
+        """PS controller (reference launch/controllers/ps.py): spawn
+        pserver containers then trainer containers, writing the PS env
+        protocol (TRAINING_ROLE, PADDLE_PSERVERS_IP_PORT_LIST, ...)."""
+        a = self.args
+        n_srv = a.server_num
+        n_trn = a.trainer_num if a.trainer_num is not None \
+            else a.nproc_per_node
+        base_port = 7164
+        servers = [f"127.0.0.1:{base_port + i}" for i in range(n_srv)]
+        common = {
+            "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(servers),
+            "PADDLE_TRAINERS_NUM": str(n_trn),
+            "PADDLE_JOB_ID": a.job_id,
+        }
+        if a.master:
+            common["PADDLE_MASTER_ENDPOINT"] = a.master
+        for i in range(n_srv):
+            env = dict(os.environ)
+            env.update(common)
+            env.update({"TRAINING_ROLE": "PSERVER",
+                        "PADDLE_PSERVER_ID": str(i),
+                        "POD_IP": "127.0.0.1",
+                        "PADDLE_PORT": servers[i].split(":")[1]})
+            self._spawn(env, f"serverlog.{i}")
+        for i in range(n_trn):
+            env = dict(os.environ)
+            env.update(common)
+            env.update({"TRAINING_ROLE": "TRAINER",
+                        "PADDLE_TRAINER_ID": str(i)})
+            self._spawn(env, f"workerlog.{i}")
 
     def watch(self):
         a = self.args

@@ -1,0 +1,171 @@
+"""Cluster role makers: parse scheduler-injected environment into roles.
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py —
+PaddleCloudRoleMaker (PaddleCloud/K8s env protocol: TRAINING_ROLE,
+PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+PADDLE_PSERVERS_IP_PORT_LIST, PADDLE_PORT/POD_IP) and
+UserDefinedRoleMaker (explicit lists). TPU-native note: the collective
+path only needs (rank, world, endpoints) to seed jax.distributed /
+TCPStore rendezvous; the PS path additionally splits server vs worker
+roles. The barrier rides the native TCPStore instead of gloo.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    # -- queries (reference role_maker.py public surface) ---------------
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id if self.is_worker() else -1
+
+    def server_index(self):
+        return self._current_id if self.is_server() else -1
+
+    def role_id(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+    def get_local_endpoint(self):
+        eps = (self._worker_endpoints if self.is_worker()
+               else self._server_endpoints)
+        if 0 <= self._current_id < len(eps):
+            return eps[self._current_id]
+        return None
+
+    def barrier(self, comm_world="worker"):
+        """Cross-process barrier via the rendezvous TCPStore when the env
+        provides a master; no-op in single-process runs."""
+        master = os.environ.get("PADDLE_MASTER") or \
+            os.environ.get("MASTER_ADDR")
+        world = self.worker_num() if comm_world == "worker" \
+            else self.server_num()
+        if not master or world <= 1:
+            return
+        import time
+        from ..runtime import TCPStore
+        host = master.split(":")[0]
+        port = int(master.split(":")[1]) if ":" in master \
+            else int(os.environ.get("MASTER_PORT", "8476"))
+        store = TCPStore(host=host, port=port,
+                         is_master=(self._current_id == 0
+                                    and comm_world == "worker"),
+                         world_size=world)
+        key = f"rm/barrier/{comm_world}"
+        n = store.add(key, 1)
+        target = ((n - 1) // world + 1) * world
+        while store.add(key, 0) < target:
+            time.sleep(0.01)
+
+    def to_string(self):
+        return (f"role={self._role} id={self._current_id} "
+                f"workers={self._worker_endpoints} "
+                f"servers={self._server_endpoints}")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Parse the PaddleCloud/K8s env protocol (reference
+    role_maker.py:PaddleCloudRoleMaker._ps_env/_collective_env)."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        if is_collective:
+            self._collective_env()
+        else:
+            self._ps_env()
+
+    def _collective_env(self):
+        self._role = Role.WORKER
+        self._current_id = int(os.environ.get(
+            "PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        if not self._worker_endpoints:
+            n = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                   os.environ.get("WORLD_SIZE", "1")))
+            self._worker_endpoints = [f"127.0.0.1:{6170 + i}"
+                                      for i in range(n)]
+
+    def _ps_env(self):
+        role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        servers = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in servers.split(",") if e]
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        if role in ("PSERVER", "SERVER"):
+            self._role = Role.SERVER
+            port = os.environ.get("PADDLE_PORT")
+            ip = os.environ.get("POD_IP", "127.0.0.1")
+            me = f"{ip}:{port}" if port else None
+            if me and me in self._server_endpoints:
+                self._current_id = self._server_endpoints.index(me)
+            else:
+                self._current_id = int(os.environ.get(
+                    "PADDLE_PSERVER_ID", "0"))
+        elif role == "HETER_TRAINER":
+            self._role = Role.HETER_WORKER
+            self._current_id = int(os.environ.get(
+                "PADDLE_TRAINER_ID", "0"))
+        else:
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get(
+                "PADDLE_TRAINER_ID", "0"))
+        if not self._worker_endpoints:
+            n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+            self._worker_endpoints = [f"127.0.0.1:{6170 + i}"
+                                      for i in range(n)]
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role lists (reference role_maker.py:UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=None, worker_endpoints=None,
+                 server_endpoints=None, **kwargs):
+        RoleMakerBase.__init__(self)
+        self._is_collective = is_collective
+        self._role = role
+        self._current_id = current_id
+        self._worker_endpoints = list(worker_endpoints or [])
+        if not self._worker_endpoints and worker_num:
+            self._worker_endpoints = [f"127.0.0.1:{6170 + i}"
+                                      for i in range(worker_num)]
+        self._server_endpoints = list(server_endpoints or [])

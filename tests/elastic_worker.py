@@ -1,0 +1,110 @@
+"""Worker for the elastic kill-and-relaunch e2e test (launched as a real
+process by paddle_tpu.parallel.launch.Controller).
+
+Phase "train": world_size ranks in lockstep (native TCPStore barrier),
+rank 0 checkpoints every step, CRASH_RANK exits non-zero at CRASH_STEP.
+Phase "resume": a single worker (the smaller cluster) restores the last
+checkpoint ONTO A DIFFERENT MESH LAYOUT via the converter and finishes
+training, writing result.json.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu.parallel as dist
+from paddle_tpu.parallel.mesh import P
+from paddle_tpu.parallel.checkpoint_converter import (build_shardings,
+                                                      load_on_mesh)
+from paddle_tpu.io.checkpoint import save_sharded
+
+RANK = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+CKDIR = os.environ["CKPT_DIR"]
+PHASE = os.environ.get("PHASE", "train")
+CRASH_RANK = int(os.environ.get("CRASH_RANK", "-1"))
+CRASH_STEP = int(os.environ.get("CRASH_STEP", "3"))
+TOTAL = int(os.environ.get("TOTAL_STEPS", "6"))
+MASTER = os.environ.get("PADDLE_MASTER", "127.0.0.1:29712")
+
+TARGET = np.linspace(-1.0, 1.0, 32).reshape(8, 4).astype(np.float32)
+
+
+def loss_and_grad(w):
+    diff = w - jnp.asarray(TARGET)
+    return jnp.sum(diff * diff), 2.0 * diff
+
+
+def train_steps(w, start, end, losses):
+    for step in range(start, end):
+        loss, g = loss_and_grad(w)
+        w = w - 0.1 * g
+        losses.append(float(loss))
+    return w
+
+
+def main():
+    if PHASE == "train":
+        from paddle_tpu.runtime import TCPStore
+        host, port = MASTER.rsplit(":", 1)
+        store = TCPStore(host=host, port=int(port),
+                         is_master=(RANK == 0), world_size=WORLD)
+
+        mesh = dist.init_mesh(dp=4)                # save-time layout
+        sh = build_shardings(mesh, {"w": np.zeros((8, 4), np.float32)},
+                             spec_map={"w": P("dp")})
+        w = jax.device_put(jnp.zeros((8, 4), jnp.float32), sh["w"])
+        losses = []
+        for step in range(TOTAL):
+            # lockstep barrier through the store (real cross-process sync)
+            store.add(f"bar/{step}", 1)
+            deadline = time.time() + 60
+            while store.add(f"bar/{step}", 0) < WORLD:
+                if time.time() > deadline:
+                    raise RuntimeError(f"barrier timeout at step {step}")
+                time.sleep(0.02)
+            if RANK == CRASH_RANK and step == CRASH_STEP:
+                os._exit(17)                        # simulated crash
+            loss, g = loss_and_grad(w)
+            w = w - 0.1 * g
+            losses.append(float(loss))
+            if RANK == 0:
+                save_sharded({"w": w,
+                              "step": jnp.asarray(step + 1, jnp.int32)},
+                             os.path.join(CKDIR, f"step_{step + 1}"))
+                with open(os.path.join(CKDIR, "LATEST"), "w") as f:
+                    f.write(str(step + 1))
+        return 0
+
+    # ---- resume on the smaller cluster with a DIFFERENT mesh layout
+    with open(os.path.join(CKDIR, "LATEST")) as f:
+        last = int(f.read().strip())
+    mesh_b = dist.init_mesh(dp=2, mp=2)
+    state = load_on_mesh(os.path.join(CKDIR, f"step_{last}"), mesh_b,
+                         spec_map={"w": P("dp", "mp")})
+    w = state["w"]
+    assert w.sharding.spec == P("dp", "mp"), w.sharding
+    start = int(state["step"])
+    assert start == last, (start, last)
+    losses = []
+    w = train_steps(w, start, TOTAL, losses)
+    with open(os.path.join(CKDIR, "result.json"), "w") as f:
+        json.dump({"resumed_from": start, "final_w": np.asarray(w).tolist(),
+                   "losses": losses}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

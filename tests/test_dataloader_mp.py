@@ -1,0 +1,155 @@
+"""Multiprocess DataLoader: correctness, shared memory, worker scaling
+(reference pattern: dataloader_iter.py multiprocess tests +
+test_dataloader_* throughput behavior)."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io.dataloader import (DataLoader, Dataset, get_worker_info)
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=32, dim=8):
+        self.x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i]
+
+
+class SlowDataset(ArrayDataset):
+    """CPU-burning transform: multiprocess workers must parallelize it
+    (a GIL-bound thread pool cannot)."""
+
+    def __getitem__(self, i):
+        deadline = time.perf_counter() + 0.02
+        acc = 0.0
+        while time.perf_counter() < deadline:
+            acc += float(np.sum(self.x[i] * self.x[i]))
+        return self.x[i] + (acc * 0.0)
+
+
+class DictDataset(ArrayDataset):
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": np.int64(i)}
+
+
+class WorkerProbeDataset(ArrayDataset):
+    def __getitem__(self, i):
+        info = get_worker_info()
+        wid = -1 if info is None else info.id
+        return np.array([i, wid], np.int64)
+
+
+def _epoch(loader):
+    return [np.asarray(b) for b in loader]
+
+
+@pytest.mark.parametrize("shm", [False, True])
+def test_mp_matches_single_process(shm):
+    ds = ArrayDataset(32, 8)
+    ref = _epoch(DataLoader(ds, batch_size=4, num_workers=0))
+    got = _epoch(DataLoader(ds, batch_size=4, num_workers=3,
+                            use_shared_memory=shm))
+    assert len(ref) == len(got) == 8
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(r, g)
+
+
+def test_mp_dict_batches():
+    ds = DictDataset(16, 4)
+    out = list(DataLoader(ds, batch_size=4, num_workers=2))
+    assert len(out) == 4
+    for bi, b in enumerate(out):
+        np.testing.assert_array_equal(
+            np.asarray(b["y"]), np.arange(bi * 4, bi * 4 + 4))
+
+
+def test_workers_really_run_in_subprocesses():
+    ds = WorkerProbeDataset(12, 2)
+    out = list(DataLoader(ds, batch_size=3, num_workers=2))
+    wids = {int(row[1]) for b in out for row in np.asarray(b)}
+    assert wids <= {0, 1} and len(wids) >= 1
+    assert -1 not in wids, "samples were loaded in the parent process"
+
+
+def test_persistent_workers_two_epochs():
+    ds = ArrayDataset(16, 4)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    e1 = _epoch(dl)
+    workers_after_1 = list(dl._workers)
+    e2 = _epoch(dl)
+    assert all(p.is_alive() for p in workers_after_1)
+    for a, b in zip(e1, e2):
+        np.testing.assert_allclose(a, b)
+    dl._shutdown_workers()
+
+
+def test_persistent_early_break_no_stale_batches():
+    # review regression: break mid-epoch, then a full epoch — the second
+    # epoch must not be satisfied by the abandoned epoch's results
+    class Tagged(ArrayDataset):
+        pass
+
+    ds = ArrayDataset(16, 4)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True, shuffle=False)
+    it = iter(dl)
+    next(it)     # abandon after one batch
+    del it
+    got = _epoch(dl)
+    ref = _epoch(DataLoader(ds, batch_size=4, num_workers=0))
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        np.testing.assert_allclose(g, r)
+    dl._shutdown_workers()
+
+
+def test_bounded_prefetch_window():
+    ds = ArrayDataset(64, 2)
+    dl = DataLoader(ds, batch_size=2, num_workers=2, prefetch_factor=2)
+    it = iter(dl)
+    next(it)
+    # after one consumed batch only ~window batches may be dispatched
+    submitted = sum(q.qsize() for q in dl._index_queues)
+    assert submitted <= 2 * max(2, dl.prefetch_factor) * dl.num_workers
+    list(it)  # finish cleanly
+
+
+def test_worker_exception_propagates():
+    class Boom(ArrayDataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return self.x[i]
+
+    dl = DataLoader(Boom(8, 2), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(dl)
+
+
+@pytest.mark.slow
+def test_workers_scale_slow_transform():
+    """VERDICT done-criterion: multiprocess workers must speed up a
+    CPU-bound per-sample transform (threads cannot, GIL)."""
+    ds = SlowDataset(24, 8)
+
+    t0 = time.perf_counter()
+    _epoch(DataLoader(ds, batch_size=4, num_workers=0))
+    t_serial = time.perf_counter() - t0
+
+    dl = DataLoader(ds, batch_size=4, num_workers=4,
+                    persistent_workers=True)
+    _epoch(dl)                       # warm epoch pays worker startup
+    t0 = time.perf_counter()
+    _epoch(dl)                       # steady state
+    t_mp = time.perf_counter() - t0
+    dl._shutdown_workers()
+
+    # 24 samples x 20ms = 480ms serial; 4 procs in steady state should
+    # cut it well below half
+    assert t_mp < t_serial * 0.6, (t_serial, t_mp)

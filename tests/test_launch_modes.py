@@ -1,0 +1,78 @@
+"""Launcher controller modes: ps env protocol, rpc endpoint, restart
+(reference launch/controllers/{collective,ps,rpc}.py + controller.py:72)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PS_PROBE = """
+import json, os, sys
+role = os.environ.get("TRAINING_ROLE")
+out = {
+    "role": role,
+    "id": os.environ.get("PADDLE_PSERVER_ID" if role == "PSERVER"
+                         else "PADDLE_TRAINER_ID"),
+    "servers": os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST"),
+    "trainers_num": os.environ.get("PADDLE_TRAINERS_NUM"),
+}
+path = os.environ["PROBE_DIR"] + f"/{role}_{out['id']}.json"
+json.dump(out, open(path, "w"))
+"""
+
+RESTART_PROBE = """
+import os, sys
+marker = os.environ["PROBE_DIR"] + "/attempt"
+n = 0
+if os.path.exists(marker):
+    n = int(open(marker).read())
+open(marker, "w").write(str(n + 1))
+sys.exit(1 if n == 0 else 0)   # fail once, succeed on restart
+"""
+
+
+def _launch(tmp_path, script_body, extra_args, extra_env=None):
+    script = tmp_path / "probe.py"
+    script.write_text(script_body)
+    env = dict(os.environ)
+    env["PROBE_DIR"] = str(tmp_path)
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "paddle_tpu.parallel.launch.main",
+           "--log_dir", str(tmp_path / "log"), *extra_args, str(script)]
+    return subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=240)
+
+
+def test_ps_mode_env_protocol(tmp_path):
+    r = _launch(tmp_path, PS_PROBE,
+                ["--run_mode", "ps", "--server_num", "1",
+                 "--trainer_num", "2"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    srv = json.load(open(tmp_path / "PSERVER_0.json"))
+    assert srv["servers"].startswith("127.0.0.1:")
+    t0 = json.load(open(tmp_path / "TRAINER_0.json"))
+    t1 = json.load(open(tmp_path / "TRAINER_1.json"))
+    assert t0["trainers_num"] == "2" and t1["id"] == "1"
+    assert t0["servers"] == srv["servers"]
+
+
+def test_rpc_mode_sets_master_endpoint(tmp_path):
+    body = """
+import json, os
+json.dump({"ep": os.environ.get("PADDLE_MASTER_ENDPOINT")},
+          open(os.environ["PROBE_DIR"] + "/rpc.json", "w"))
+"""
+    r = _launch(tmp_path, body,
+                ["--run_mode", "rpc", "--master", "127.0.0.1:29901"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert json.load(open(tmp_path / "rpc.json"))["ep"] == \
+        "127.0.0.1:29901"
+
+
+def test_watch_restarts_failed_worker(tmp_path):
+    r = _launch(tmp_path, RESTART_PROBE, ["--max_restart", "1"])
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert open(tmp_path / "attempt").read() == "2"

@@ -82,6 +82,20 @@ class TestInplaceVariants:
         x.fill_(3.5)
         np.testing.assert_allclose(x.numpy(), np.full((2, 2), 3.5))
 
+    def test_zero_detaches_tape(self):
+        # review regression: zeroing a computed tensor must NOT backprop
+        # through the stale producer
+        a = pt.to_tensor(np.array([2.0], np.float32),
+                         stop_gradient=False)
+        b = pt.to_tensor(np.array([3.0], np.float32),
+                         stop_gradient=False)
+        y = a * b
+        y.zero_()
+        out = y + a  # keep a path to `a` so backward() has a graph
+        out.sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [1.0])
+        assert b.grad is None
+
     def test_exp_sqrt_(self):
         x = pt.to_tensor(np.array([4.0], np.float32))
         x.sqrt_()

@@ -1,0 +1,62 @@
+"""Role maker env parsing (reference fleet/base/role_maker.py tests)."""
+import numpy as np
+
+from paddle_tpu.parallel.role_maker import (PaddleCloudRoleMaker, Role,
+                                            UserDefinedRoleMaker)
+
+
+def test_collective_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "10.0.0.1:6170,10.0.0.2:6170,10.0.0.3:6170")
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    assert rm.is_worker() and not rm.is_server()
+    assert rm.worker_index() == 2
+    assert rm.worker_num() == 3
+    assert not rm.is_first_worker()
+    assert rm.get_local_endpoint() == "10.0.0.3:6170"
+
+
+def test_ps_server_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                       "10.0.0.9:7164,10.0.0.10:7164")
+    monkeypatch.setenv("POD_IP", "10.0.0.10")
+    monkeypatch.setenv("PADDLE_PORT", "7164")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    rm = PaddleCloudRoleMaker(is_collective=False)
+    assert rm.is_server()
+    assert rm.server_index() == 1
+    assert rm.server_num() == 2
+    assert rm.worker_num() == 4
+    assert rm.worker_index() == -1
+
+
+def test_ps_trainer_env(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "10.0.0.9:7164")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    rm = PaddleCloudRoleMaker(is_collective=False)
+    assert rm.is_worker()
+    assert rm.worker_index() == 1
+    assert rm.get_pserver_endpoints() == ["10.0.0.9:7164"]
+
+
+def test_user_defined():
+    rm = UserDefinedRoleMaker(
+        is_collective=True, current_id=0, role=Role.WORKER,
+        worker_endpoints=["127.0.0.1:1", "127.0.0.1:2"])
+    assert rm.is_first_worker()
+    assert rm.worker_num() == 2
+
+
+def test_fleet_init_uses_role_maker(monkeypatch):
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:7164")
+    monkeypatch.setenv("PADDLE_PSERVER_ID", "0")
+    from paddle_tpu.parallel.fleet import _Fleet
+    f = _Fleet()
+    f.init(is_collective=False)
+    assert f._role_maker.is_server()
+    assert f._ps_runtime is not None
