@@ -138,7 +138,8 @@ def _nan_inf_scan(name, out):
                     raise FloatingPointError(msg)
 
 
-def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
+def dispatch(fn, *args, name=None, nondiff_args=(), static_out_aval=None,
+             **kwargs):
     """Execute ``fn(*values, **kwargs)``; record a vjp node if needed.
 
     ``fn`` must be a JAX-traceable function of positional array args.
@@ -148,7 +149,8 @@ def dispatch(fn, *args, name=None, nondiff_args=(), **kwargs):
     """
     global _amp
     if _static_recorder is not None and _static_recorder.active(args):
-        return _static_recorder.record(fn, args, kwargs, name=name)
+        return _static_recorder.record(fn, args, kwargs, name=name,
+                                       static_out_aval=static_out_aval)
     any_tensor = any(isinstance(a, Tensor) for a in args)
     vals = [unwrap(a) for a in args]
     # AMP O1: cast inputs by white/black list membership (amp/__init__.py)

@@ -13,9 +13,83 @@ Hessian = hessian
 
 
 def forward_grad(outputs, inputs, grad_inputs=None):
-    """Forward-mode grad (reference primapi.forward_grad)."""
-    raise NotImplementedError(
-        "use paddle_tpu.autograd.jvp (jax.jvp) for forward-mode AD")
+    """Forward-mode grad on static-program Variables (reference
+    primapi.forward_grad, python/paddle/incubate/autograd/primapi.py).
+
+    Appends a forward-JVP op to the owning Program — the recorded
+    subgraph from `inputs` to `outputs` is replayed under jax.jvp at
+    execution time — and returns new Variables holding the tangents.
+    For eager tensors use paddle_tpu.autograd.jvp directly.
+    """
+    import jax
+    import numpy as np
+
+    from ...static.executor import _replay
+    from ...static.graph import OpDesc, VarRef, Variable
+
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if not all(isinstance(v, Variable) for v in list(outs) + list(ins)):
+        raise TypeError(
+            "forward_grad expects static Variables; for eager tensors "
+            "use paddle_tpu.autograd.jvp")
+    prog = outs[0].block.program
+    block = prog.global_block
+    ops = list(block.ops)
+    wrt = [v.name for v in ins]
+    out_names = [v.name for v in outs]
+    produced = {n for op in ops for n in op.outputs}
+    ext = []
+    for op in ops:
+        for i in op.inputs:
+            if isinstance(i, VarRef) and i.name not in produced \
+                    and i.name not in ext and i.name not in wrt:
+                ext.append(i.name)
+    if grad_inputs is None:
+        tangents = []        # materialized as ones_like at RUN time, so
+        # dynamic (-1) feed dims work — a baked array would carry the
+        # placeholder build-time shape
+    else:
+        gi = grad_inputs if isinstance(grad_inputs, (list, tuple)) \
+            else [grad_inputs]
+        # Variables become graph inputs; concrete values become literals
+        tangents = [VarRef(t.name) if isinstance(t, Variable)
+                    else np.asarray(getattr(t, "_value", t)) for t in gi]
+    n_tg = len(tangents)
+
+    def fn(*vals):
+        import jax.numpy as jnp
+
+        n_ext = len(ext)
+        ext_vals = vals[:n_ext]
+        wrt_vals = vals[n_ext:n_ext + len(wrt)]
+        tg = vals[n_ext + len(wrt):]
+        if not n_tg:
+            tg = tuple(jnp.ones_like(v) for v in wrt_vals)
+
+        def f(wv):
+            e = dict(zip(ext, ext_vals))
+            e.update(zip(wrt, wv))
+            _replay(ops, e, protect=frozenset(wrt))
+            return tuple(e[n] for n in out_names)
+
+        _, jvp_out = jax.jvp(f, (tuple(wrt_vals),), (tuple(tg),))
+        return jvp_out
+
+    from ...utils import unique_name
+    new_vars = []
+    for v in outs:
+        nv = Variable(v._value, name=unique_name.generate(
+            f"{v.name}@FJVP"), block=block)
+        block.vars[nv.name] = nv
+        new_vars.append(nv)
+    block.append_op(OpDesc(
+        "forward_grad", fn,
+        [VarRef(n) for n in ext] + [VarRef(n) for n in wrt]
+        + list(tangents),
+        {}, [nv.name for nv in new_vars], None))
+    prog._version += 1
+    return new_vars if isinstance(outputs, (list, tuple)) else new_vars[0]
 
 
 def grad(outputs, inputs, grad_outputs=None):

@@ -139,9 +139,65 @@ def create_predictor(config):
 
 
 def convert_to_mixed_precision(src_prefix, dst_prefix, mixed_precision="bf16",
-                               backend=None, **kwargs):
-    """Re-export an inference archive with inputs/constants cast to bf16/fp16
-    (reference: paddle.inference.convert_to_mixed_precision)."""
-    raise NotImplementedError(
-        "re-export the source program under paddle_tpu.amp.auto_cast "
-        "instead; StableHLO archives are precision-final")
+                               backend=None, model=None, input_spec=None,
+                               **kwargs):
+    """Re-export an inference archive in mixed precision (reference:
+    paddle.inference.convert_to_mixed_precision, which rewrites the saved
+    __model__ program's var dtypes).
+
+    Two paths:
+    - ``model`` given (the Layer the archive was exported from, or any
+      equivalent): full conversion — parameters are cast to the target
+      dtype and a fresh archive is exported to ``dst_prefix``.
+    - archive-only: the serialized StableHLO constants are precision-
+      final, so the converted archive wraps the original computation with
+      inputs/outputs cast to the target dtype (activation-boundary mixed
+      precision); weights keep their stored dtype.
+    """
+    import json
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dtype import convert_dtype
+    from .export import _export_fn, _write, export_layer, load_exported
+
+    dt = convert_dtype({"bf16": "bfloat16", "fp16": "float16",
+                        "float16": "float16",
+                        "bfloat16": "bfloat16"}.get(mixed_precision,
+                                                    mixed_precision))
+    dt = jnp.dtype(dt)
+    if model is not None:
+        import copy
+        m = copy.deepcopy(model)
+        m.astype(dt.name)
+        if input_spec is None:
+            # reuse the source archive's feed specs
+            with open(src_prefix + ".pdmeta") as f:
+                meta = json.load(f)
+            from ..static import InputSpec
+            input_spec = [InputSpec(shape=s["shape"], dtype=s["dtype"],
+                                    name=s["name"])
+                          for s in meta["feed_specs"]]
+        export_layer(dst_prefix, m, input_spec)
+        return dst_prefix
+
+    prog, feed_names, fetch_names = load_exported(src_prefix)
+    in_avals = prog._exported.in_avals
+
+    def mixed(*xs):
+        out = prog._exported.call(*xs)
+        cast = lambda t: (t.astype(dt)
+                          if jnp.issubdtype(t.dtype, jnp.floating) else t)
+        return jax.tree_util.tree_map(cast, out)
+
+    # feeds keep the original dtypes (reference semantics: fp32 feeds,
+    # reduced-precision compute/outputs); the serialized constants are
+    # precision-final, so this path converts the activation boundary only
+    exported = _export_fn(mixed, list(in_avals))
+    specs = [{"name": n, "shape": [int(d) if isinstance(d, int) else -1
+                                   for d in a.shape],
+              "dtype": str(a.dtype)}
+             for n, a in zip(feed_names, in_avals)]
+    _write(dst_prefix, exported, feed_names, fetch_names, specs)
+    return dst_prefix

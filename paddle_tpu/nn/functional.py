@@ -479,11 +479,22 @@ def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
             lo = eff - padding[i][0]
             hi = eff - padding[i][1] + output_padding[i]
             pad.append((lo, hi))
-    out = jax.lax.conv_transpose(
-        x, weight, strides=stride, padding=pad, rhs_dilation=dilation,
-        dimension_numbers=dn, transpose_kernel=False)
     if groups != 1:
-        raise NotImplementedError("grouped conv_transpose lands later")
+        # grouped transpose conv: split input channels and the kernel's
+        # group blocks, run per-group transposes, concat outputs
+        # (paddle semantics: weight [in_c, out_c/groups, *k])
+        cin_axis = x.ndim - 1 if channels_last else 1
+        xs = jnp.split(x, groups, axis=cin_axis)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [jax.lax.conv_transpose(
+            xg, wg, strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, transpose_kernel=False)
+            for xg, wg in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=cin_axis)
+    else:
+        out = jax.lax.conv_transpose(
+            x, weight, strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, transpose_kernel=False)
     if bias is not None:
         bshape = [1] * out.ndim
         bshape[out.ndim - 1 if channels_last else 1] = bias.shape[0]

@@ -2,9 +2,11 @@
 
 Reference: python/paddle/nn/layer/{common,conv,norm,activation,loss}.py.
 """
+import jax
 import jax.numpy as jnp
+import numpy as np
 
-from ..core.tensor import Parameter
+from ..core.tensor import Parameter, Tensor
 from . import functional as F
 from . import initializer as I
 from .layer import Layer
@@ -500,10 +502,56 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, layer, name="weight", n_power_iterations=1, eps=1e-12,
-                 dim=0):
-        super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the vision phase")
+    """paddle.nn.SpectralNorm parity: ``forward(weight)`` returns the
+    spectrally-normalized weight, estimating the top singular value by
+    power iteration on persistent u/v buffers (reference
+    python/paddle/nn/layer/norm.py SpectralNorm / spectral_norm op).
+    Stop-gradient through u/v like the reference kernel."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        self._shape = list(weight_shape)
+        h = self._shape[dim]
+        w = int(np.prod(self._shape)) // h
+        rng = np.random.RandomState(0)
+        self.register_buffer("weight_u", Tensor(
+            rng.randn(h).astype(dtype)), persistable=True)
+        self.register_buffer("weight_v", Tensor(
+            rng.randn(w).astype(dtype)), persistable=True)
+
+    def forward(self, weight):
+        from ..core.tensor import dispatch, unwrap
+
+        dim, eps, iters = self._dim, self._eps, self._power_iters
+        h = self._shape[dim]
+        perm = [dim] + [i for i in range(len(self._shape)) if i != dim]
+
+        # one eager power iteration updates the persistent u/v buffers
+        # (stop-gradient side channel, like the reference's in-place u/v);
+        # the dispatched op then only computes sigma and the division
+        w_raw = jax.lax.stop_gradient(unwrap(weight))
+        mat = jnp.transpose(w_raw, perm).reshape(h, -1)
+        u, v = unwrap(self.weight_u), unwrap(self.weight_v)
+        for _ in range(iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        if not isinstance(u, jax.core.Tracer):
+            self.weight_u.set_value(u)
+            self.weight_v.set_value(v)
+
+        def fn(wv, uv, vv):
+            m = jnp.transpose(wv, perm).reshape(h, -1)
+            sigma = uv @ (m @ vv)
+            return wv / sigma
+
+        return dispatch(fn, weight, u, v, name="spectral_norm",
+                        nondiff_args=(1, 2))
 
 
 # ------------------------------------------------------------- activations

@@ -13,12 +13,14 @@ brpc's role. Sharding is id % num_servers, like the reference's hash shard
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import rpc
 
-__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
-           "TheOnePSRuntime"]
+__all__ = ["SparseTable", "SSDSparseTable", "DenseTable", "PSServer",
+           "PSClient", "TheOnePSRuntime"]
 
 
 class SparseTable:
@@ -66,6 +68,149 @@ class SparseTable:
                      for i, v in zip(st["ids"], st["values"])}
 
 
+class SSDSparseTable(SparseTable):
+    """Disk-backed sparse table: bounded in-memory hot cache over an
+    embedded on-disk store, for embedding tables larger than RAM.
+
+    Reference capability: paddle/fluid/distributed/ps/table/
+    ssd_sparse_table.h (RocksDB-backed rows behind MemorySparseTable).
+    TPU-native runtime note: RocksDB isn't in this image; sqlite3
+    (stdlib, C-backed B-tree) plays the persistent KV role. Eviction is
+    LRU; dirty rows flush on eviction and on save()/flush().
+    """
+
+    def __init__(self, name, dim, path=None, cache_rows=100_000,
+                 initializer="zeros", seed=0, lr=0.1):
+        import sqlite3
+        import tempfile
+        import threading
+        from collections import OrderedDict
+
+        super().__init__(name, dim, initializer, seed, lr)
+        self.rows = OrderedDict()          # hot cache, LRU order
+        self._dirty = set()
+        self.cache_rows = cache_rows
+        self.path = path or os.path.join(
+            tempfile.gettempdir(), f"pt_ssd_table_{name}_{os.getpid()}.db")
+        # PSServer methods run on per-connection RPC handler threads:
+        # allow cross-thread use and serialize every table op with a lock
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        self._lock = threading.RLock()
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows (id INTEGER PRIMARY KEY, "
+            "val BLOB)")
+        self._db.commit()
+
+    # ------------------------------------------------------ cache mgmt
+    def _load_from_disk(self, _id):
+        cur = self._db.execute("SELECT val FROM rows WHERE id=?", (_id,))
+        hit = cur.fetchone()
+        if hit is None:
+            return None
+        return np.frombuffer(hit[0], np.float32).copy()
+
+    def _evict_if_needed(self):
+        while len(self.rows) > self.cache_rows:
+            old_id, val = self.rows.popitem(last=False)
+            if old_id in self._dirty:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO rows (id, val) VALUES (?, ?)",
+                    (old_id, val.astype(np.float32).tobytes()))
+                self._dirty.discard(old_id)
+
+    def _get_row(self, _id, create=True):
+        row = self.rows.get(_id)
+        if row is not None:
+            self.rows.move_to_end(_id)
+            return row
+        row = self._load_from_disk(_id)
+        if row is None:
+            if not create:
+                return None
+            row = self._new_row()
+            self._dirty.add(_id)
+        self.rows[_id] = row
+        self._evict_if_needed()
+        return self.rows.get(_id, row)
+
+    # --------------------------------------------------------- public
+    def pull(self, ids):
+        with self._lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, _id in enumerate(ids):
+                out[i] = self._get_row(int(_id))
+            return out
+
+    def push_grad(self, ids, grads):
+        with self._lock:
+            grads = np.asarray(grads, np.float32)
+            for _id, g in zip(ids, grads):
+                _id = int(_id)
+                row = self._get_row(_id)
+                row -= self.lr * g
+                self.rows[_id] = row
+                self._dirty.add(_id)
+            self._evict_if_needed()
+
+    def flush(self):
+        with self._lock:
+            for _id in list(self._dirty):
+                if _id in self.rows:
+                    self._db.execute(
+                        "INSERT OR REPLACE INTO rows (id, val) "
+                        "VALUES (?, ?)",
+                        (_id, self.rows[_id].astype(np.float32).tobytes()))
+            self._dirty.clear()
+            self._db.commit()
+
+    def num_rows(self):
+        self.flush()
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+
+    def shrink(self, keep_ids):
+        """Drop rows not in keep_ids (reference table shrink for stale
+        features)."""
+        keep = {int(i) for i in keep_ids}
+        self.flush()
+        with self._lock:
+            cur = self._db.execute("SELECT id FROM rows")
+            drop = [r[0] for r in cur.fetchall() if r[0] not in keep]
+            self._db.executemany("DELETE FROM rows WHERE id=?",
+                                 [(d,) for d in drop])
+            self._db.commit()
+            for d in drop:
+                self.rows.pop(d, None)
+                self._dirty.discard(d)
+
+    def state(self):
+        self.flush()
+        with self._lock:
+            pairs = self._db.execute(
+                "SELECT id, val FROM rows ORDER BY id").fetchall()
+        ids = np.asarray([p[0] for p in pairs], np.int64)
+        vals = (np.stack([np.frombuffer(p[1], np.float32) for p in pairs])
+                if pairs else np.zeros((0, self.dim), np.float32))
+        return {"ids": ids, "values": vals}
+
+    def load_state(self, st):
+        with self._lock:
+            self._db.execute("DELETE FROM rows")
+            self._db.executemany(
+                "INSERT INTO rows (id, val) VALUES (?, ?)",
+                [(int(i), np.asarray(v, np.float32).tobytes())
+                 for i, v in zip(st["ids"], st["values"])])
+            self._db.commit()
+            self.rows.clear()
+            self._dirty.clear()
+
+    def close(self):
+        self.flush()
+        with self._lock:
+            self._db.close()
+
+
 class DenseTable:
     def __init__(self, name, shape, lr=0.1):
         self.name = name
@@ -91,10 +236,13 @@ class PSServer:
         self.tables = {}
         PSServer._current = self
 
-    def create_table(self, name, dim, initializer="uniform", lr=0.1):
+    def create_table(self, name, dim, initializer="uniform", lr=0.1,
+                     table_type="memory", **kw):
         if name not in self.tables:
-            self.tables[name] = SparseTable(
-                name, dim, initializer, seed=self.server_index, lr=lr)
+            cls = SSDSparseTable if table_type == "ssd" else SparseTable
+            self.tables[name] = cls(
+                name, dim, initializer=initializer,
+                seed=self.server_index, lr=lr, **kw)
         return True
 
     def pull_sparse(self, name, ids):
@@ -114,8 +262,11 @@ class PSServer:
 
 # module-level trampolines: rpc pickles these by reference, executing
 # against the server process's PSServer._current
-def _srv_create_table(name, dim, initializer, lr):
-    return PSServer._current.create_table(name, dim, initializer, lr)
+def _srv_create_table(name, dim, initializer, lr, table_type="memory",
+                      kw=None):
+    return PSServer._current.create_table(
+        name, dim, initializer=initializer, lr=lr, table_type=table_type,
+        **(kw or {}))
 
 
 def _srv_pull_sparse(name, ids):
@@ -137,9 +288,11 @@ class PSClient:
     def __init__(self, server_names):
         self.server_names = list(server_names)
 
-    def create_table(self, name, dim, initializer="uniform", lr=0.1):
+    def create_table(self, name, dim, initializer="uniform", lr=0.1,
+                     table_type="memory", **kw):
         for s in self.server_names:
-            rpc.rpc_sync(s, _srv_create_table, (name, dim, initializer, lr))
+            rpc.rpc_sync(s, _srv_create_table,
+                         (name, dim, initializer, lr, table_type, kw))
 
     def _shard(self, ids):
         ids = np.asarray(ids).reshape(-1)

@@ -19,6 +19,7 @@ from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
                     default_startup_program, in_static_build, program_guard)
 from . import nn  # noqa: F401
+from . import collective  # noqa: F401  # noqa: F401
 
 __all__ = [
     "Program", "Variable", "Executor", "Scope", "global_scope",

@@ -209,7 +209,7 @@ class _Recorder:
         return _guard_depth > 0 and any(
             isinstance(a, Variable) for a in args)
 
-    def record(self, fn, args, kwargs, name=None):
+    def record(self, fn, args, kwargs, name=None, static_out_aval=None):
         block = _default_main.global_block
         inputs, avals = [], []
         for a in args:
@@ -228,7 +228,14 @@ class _Recorder:
             else:
                 inputs.append(a)
                 avals.append(a)
-        out_avals = jax.eval_shape(functools.partial(fn, **kwargs), *avals)
+        if static_out_aval is not None:
+            # ops that cannot be shape-traced outside their execution
+            # context (e.g. c_* collectives need a bound mesh axis)
+            # declare their output avals explicitly
+            out_avals = static_out_aval
+        else:
+            out_avals = jax.eval_shape(functools.partial(fn, **kwargs),
+                                       *avals)
         flat, treedef = jax.tree_util.tree_flatten(out_avals)
         op_type = name or getattr(fn, "__name__", "op")
         out_vars = [block.create_var(av, name=unique_name.generate(op_type))

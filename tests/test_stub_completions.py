@@ -1,0 +1,134 @@
+"""Tests for previously-stubbed capabilities: forward_grad (static
+forward-mode AD), SpectralNorm, grouped conv_transpose, and
+convert_to_mixed_precision."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+
+
+def test_forward_grad_static():
+    import paddle_tpu.static as static
+    from paddle_tpu.incubate.autograd import forward_grad
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", shape=[3], dtype="float32")
+        y = x * x + x
+        (jv,) = forward_grad([y], [x])
+
+    exe = static.Executor()
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[jv])
+    # d(x^2+x)/dx with tangent 1 = 2x+1
+    np.testing.assert_allclose(out[0], 2 * xv + 1, rtol=1e-6)
+
+
+def test_forward_grad_custom_tangent():
+    import paddle_tpu.static as static
+    from paddle_tpu.incubate.autograd import forward_grad
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", shape=[2], dtype="float32")
+        y = pt.sin(x)
+        jv = forward_grad(y, x, grad_inputs=np.array([2.0, 0.5],
+                                                     np.float32))
+
+    exe = static.Executor()
+    xv = np.array([0.3, 1.1], np.float32)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[jv])
+    np.testing.assert_allclose(out[0], np.cos(xv) * [2.0, 0.5],
+                               rtol=1e-6)
+
+
+def test_forward_grad_dynamic_batch():
+    # review regression: tangents must materialize at RUN time so a
+    # dynamic (-1) feed dim works
+    import paddle_tpu.static as static
+    from paddle_tpu.incubate.autograd import forward_grad
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", shape=[-1, 3], dtype="float32")
+        y = x * x
+        (jv,) = forward_grad([y], [x])
+
+    exe = static.Executor()
+    xv = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[jv])
+    np.testing.assert_allclose(out[0], 2 * xv, rtol=1e-6)
+
+
+def test_spectral_norm_unit_sigma():
+    sn = pt.nn.SpectralNorm([4, 6], dim=0, power_iters=20)
+    rng = np.random.RandomState(0)
+    w = pt.to_tensor(rng.randn(4, 6).astype(np.float32))
+    out = sn(w)
+    # normalized weight must have top singular value ~1
+    sig = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(sig, 1.0, rtol=1e-3)
+
+
+def test_spectral_norm_grad_flows():
+    sn = pt.nn.SpectralNorm([3, 3], power_iters=5)
+    w = pt.to_tensor(np.eye(3, dtype=np.float32) * 2, stop_gradient=False)
+    out = sn(w)
+    out.sum().backward()
+    assert w.grad is not None
+    assert np.isfinite(w.grad.numpy()).all()
+
+
+def test_grouped_conv2d_transpose():
+    rng = np.random.RandomState(0)
+    g, cin, cout_pg = 2, 4, 3
+    x = rng.randn(1, cin, 5, 5).astype(np.float32)
+    w = rng.randn(cin, cout_pg, 3, 3).astype(np.float32)
+    out = pt.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w),
+                              stride=1, padding=0, groups=g)
+    assert list(out.shape) == [1, g * cout_pg, 7, 7]
+    # group 0 must equal the ungrouped transpose on its channel slice
+    ref0 = pt.conv2d_transpose(
+        pt.to_tensor(x[:, :cin // g]), pt.to_tensor(w[:cin // g]),
+        stride=1, padding=0, groups=1)
+    np.testing.assert_allclose(out.numpy()[:, :cout_pg],
+                               ref0.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_convert_to_mixed_precision(tmp_path):
+    import paddle_tpu.inference as infer
+    from paddle_tpu.static import InputSpec
+
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 2))
+    src = str(tmp_path / "m_fp32")
+    pt.jit.save(net, src, input_spec=[InputSpec([2, 4], "float32", "x")])
+
+    # full conversion (model available): params cast to bf16
+    dst = str(tmp_path / "m_bf16")
+    infer.convert_to_mixed_precision(src, dst, "bf16", model=net)
+    cfg = infer.Config(dst)
+    pred = infer.create_predictor(cfg)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    ref = net(pt.to_tensor(x)).numpy()
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(np.asarray(got, np.float32), ref,
+                               rtol=5e-2, atol=5e-2)
+
+    # archive-only conversion: boundary-cast wrapper, still runs
+    dst2 = str(tmp_path / "m_wrap")
+    infer.convert_to_mixed_precision(src, dst2, "bf16")
+    pred2 = infer.create_predictor(infer.Config(dst2))
+    h2 = pred2.get_input_handle(pred2.get_input_names()[0])
+    h2.copy_from_cpu(x.astype(np.float32))
+    pred2.run()
+    out2 = pred2.get_output_handle(
+        pred2.get_output_names()[0]).copy_to_cpu()
+    assert "bfloat16" in str(np.asarray(out2).dtype) or np.allclose(
+        np.asarray(out2, np.float32), ref, rtol=5e-2, atol=5e-2)

@@ -15,20 +15,30 @@ def _run(model, size=64, channels=3, classes=10):
     assert out.shape == [1, classes]
 
 
+# two representatives run by default; the rest are `slow` (eager CNN
+# forwards on CPU are compile-bound — the full zoo adds ~5 min)
 @pytest.mark.parametrize("fn", [
     lambda: M.alexnet(num_classes=10),
-    lambda: M.mobilenet_v1(num_classes=10),
     lambda: M.mobilenet_v2(num_classes=10),
+])
+def test_small_nets_forward(fn):
+    _run(fn(), size=64)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fn", [
+    lambda: M.mobilenet_v1(num_classes=10),
     lambda: M.mobilenet_v3_small(num_classes=10),
     lambda: M.mobilenet_v3_large(num_classes=10),
     lambda: M.squeezenet1_0(num_classes=10),
     lambda: M.squeezenet1_1(num_classes=10),
     lambda: M.shufflenet_v2_x1_0(num_classes=10),
 ])
-def test_small_nets_forward(fn):
+def test_small_nets_forward_full_zoo(fn):
     _run(fn(), size=64)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("fn", [
     lambda: M.densenet121(num_classes=10),
     lambda: M.googlenet(num_classes=10),
@@ -38,6 +48,7 @@ def test_big_nets_forward(fn):
     _run(fn(), size=96)
 
 
+@pytest.mark.slow
 def test_resnext_and_wide():
     _run(M.resnext50_32x4d(num_classes=10), size=64)
     _run(M.wide_resnet50_2(num_classes=10), size=64)
@@ -49,6 +60,7 @@ def test_vgg_variants_construct():
         assert isinstance(m, M.VGG)
 
 
+@pytest.mark.slow
 def test_mobilenet_v2_trains():
     pt.seed(0)
     import paddle_tpu.nn as nn

@@ -1,0 +1,49 @@
+"""ZeRO-vs-DP loss parity across stages (VERDICT distributed-test-depth
+item; reference pattern: dygraph_group_sharded_stage3.py ZeRO-vs-DP
+parity asserted over training steps)."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as pt
+import paddle_tpu.parallel as dist
+
+
+def _make(seed=0):
+    pt.seed(seed)
+    net = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.GELU(),
+                           pt.nn.Linear(32, 8))
+    opt = pt.optimizer.AdamW(learning_rate=0.01,
+                             parameters=net.parameters())
+    return net, opt
+
+
+def _loss_fn(out, labels):
+    return ((out - labels) ** 2).mean()
+
+
+def _train(zero_stage, steps=5):
+    mesh = dist.init_mesh(dp=2, sharding=2 if zero_stage else 1)
+    net, opt = _make(0)
+    from paddle_tpu.parallel.api import parallel_train_step
+    step_fn, params, opt_state, _ = parallel_train_step(
+        net, _loss_fn, opt, mesh, zero_stage=zero_stage)
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(steps):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 8).astype(np.float32)
+        batch = {"inputs": (x,), "labels": (y,)}
+        loss, params, opt_state = step_fn(params, opt_state, batch,
+                                          i + 1, None)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_dp(stage):
+    base = _train(0)
+    zs = _train(stage)
+    np.testing.assert_allclose(zs, base, rtol=2e-4, atol=1e-5)
+    assert base[-1] < base[0]
