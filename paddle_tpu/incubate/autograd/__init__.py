@@ -35,9 +35,19 @@ def forward_grad(outputs, inputs, grad_inputs=None):
             "use paddle_tpu.autograd.jvp")
     prog = outs[0].block.program
     block = prog.global_block
-    ops = list(block.ops)
     wrt = [v.name for v in ins]
     out_names = [v.name for v in outs]
+    # backward-slice to the ancestors of the outputs: replaying the whole
+    # block would re-execute unrelated towers inside the jvp
+    needed = set(out_names)
+    ops = []
+    for op in reversed(list(block.ops)):
+        if any(o in needed for o in op.outputs):
+            ops.append(op)
+            from ...static.graph import VarRef as _VR
+            needed.update(i.name for i in op.inputs
+                          if isinstance(i, _VR))
+    ops = list(reversed(ops))
     produced = {n for op in ops for n in op.outputs}
     ext = []
     for op in ops:

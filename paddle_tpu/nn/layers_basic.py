@@ -530,13 +530,19 @@ class SpectralNorm(Layer):
         h = self._shape[dim]
         perm = [dim] + [i for i in range(len(self._shape)) if i != dim]
 
-        # one eager power iteration updates the persistent u/v buffers
-        # (stop-gradient side channel, like the reference's in-place u/v);
-        # the dispatched op then only computes sigma and the division
+        # power iteration updates the persistent u/v buffers eagerly
+        # (stop-gradient side channel, like the reference's in-place
+        # u/v); the dispatched op then only computes sigma + division.
+        # Under a jit trace the buffers can't be written back (they bake
+        # in as constants), so cross-step accumulation is unavailable —
+        # compensate with enough iterations for a converged per-step
+        # estimate instead of silently keeping a one-step-from-random u.
         w_raw = jax.lax.stop_gradient(unwrap(weight))
+        traced = isinstance(w_raw, jax.core.Tracer)
+        n_iter = max(iters, 8) if traced else iters
         mat = jnp.transpose(w_raw, perm).reshape(h, -1)
         u, v = unwrap(self.weight_u), unwrap(self.weight_v)
-        for _ in range(iters):
+        for _ in range(n_iter):
             v = mat.T @ u
             v = v / (jnp.linalg.norm(v) + eps)
             u = mat @ v

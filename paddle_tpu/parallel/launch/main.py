@@ -73,6 +73,50 @@ class Container:
                 self.proc.kill()
 
 
+class Watcher:
+    """Background resource monitor (reference launch/job/watcher.py:42 —
+    tails per-pod cpu/mem usage). Samples /proc into
+    <log_dir>/metrics.jsonl once per interval."""
+
+    def __init__(self, log_dir, interval=5.0):
+        import threading
+        self.path = os.path.join(log_dir, "metrics.jsonl")
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._thread.start()
+
+    def _sample(self):
+        import json
+        try:
+            with open("/proc/meminfo") as f:
+                mem = {k.strip(): v.strip() for k, v in
+                       (line.split(":", 1) for line in f if ":" in line)}
+            with open("/proc/loadavg") as f:
+                load = f.read().split()[:3]
+            return json.dumps({
+                "ts": time.time(),
+                "loadavg": [float(x) for x in load],
+                "mem_available_kb": int(
+                    mem.get("MemAvailable", "0 kB").split()[0]),
+            })
+        except OSError:
+            return None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            line = self._sample()
+            if line:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+
+    def stop(self):
+        self._stop.set()
+
+
 class Controller:
     """Spawn containers, write the env protocol, watch & restart
     (reference launch/controllers/controller.py:72 watch)."""
@@ -80,6 +124,7 @@ class Controller:
     def __init__(self, args):
         self.args = args
         self.containers = []
+        self.watcher = Watcher(args.log_dir)
 
     def build_env(self, local_rank):
         a = self.args
@@ -120,6 +165,7 @@ class Controller:
                 store_server = TCPStoreServer(port)
             except RuntimeError:
                 store_server = None  # already bound by another component
+        self.watcher.start()
         if a.run_mode == "ps":
             self._run_ps()
         else:
@@ -132,6 +178,7 @@ class Controller:
                         "127.0.0.1:8090"
                 self._spawn(env, f"workerlog.{i}")
         code = self.watch()
+        self.watcher.stop()
         if store_server:
             store_server.stop()
         return code
@@ -192,6 +239,7 @@ class Controller:
             time.sleep(1)
 
     def stop(self):
+        self.watcher.stop()
         for c in self.containers:
             c.terminate()
 

@@ -29,8 +29,15 @@ def _aval_of(x):
 
 def _nranks(ax):
     from ..parallel.mesh import get_mesh
+    from ..utils.enforce import InvalidArgumentError
     m = get_mesh()
-    return m.degree(ax) if m else 1
+    if m is None or m.degree(ax) < 1:
+        raise InvalidArgumentError(
+            f"c_* op needs the gather width for axis {ax!r} at build "
+            "time: initialize a mesh (paddle_tpu.parallel.init_mesh) "
+            "before recording, or pass nranks explicitly",
+            hint="a silent nranks=1 would record the un-gathered shape")
+    return m.degree(ax)
 
 __all__ = ["c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
            "c_allgather", "c_broadcast", "c_concat", "c_identity",
@@ -148,26 +155,29 @@ def c_softmax_with_cross_entropy(logits, label, ring_id=0, axis_name=None,
 
 
 def run_program_sharded(program, mesh, feed, fetch_list, in_specs,
-                        scope=None):
+                        out_specs=None, scope=None, check_vma=False):
     """Execute a Program containing c_* ops under shard_map over `mesh`.
 
     feed: {name: GLOBAL array}; in_specs: {name: PartitionSpec for its
-    shard_map split}. Returns fetched GLOBAL arrays (out specs inferred
-    as replicated — collectives produce replicated/global results).
+    shard_map split}; out_specs: {name: PartitionSpec} for each fetch
+    (default replicated — correct for post-collective results; fetching
+    a still-sharded intermediate needs its real spec or shard_map
+    assembles one shard's local value as the global answer; pass
+    check_vma=True to have jax verify replication instead of trusting
+    the default).
     """
     from jax.sharding import PartitionSpec as P
 
-    from .executor import _replay, global_scope
-    from .graph import VarRef
+    from .executor import _referenced_scope_names, _replay, global_scope
 
     scope = scope or global_scope()
     ops = list(program.global_block.ops)
     fetch_names = [f.name if hasattr(f, "name") else str(f)
                    for f in fetch_list]
     feed_names = list(feed)
-    scope_names = [i.name for op in ops for i in op.inputs
-                   if isinstance(i, VarRef) and i.name in scope._vars
-                   and i.name not in feed_names]
+    out_specs = out_specs or {}
+    scope_names = [n for n in _referenced_scope_names(program, scope)
+                   if n not in feed_names]
     scope_vals = [scope._vars[n] for n in scope_names]
 
     def body(*vals):
@@ -179,7 +189,8 @@ def run_program_sharded(program, mesh, feed, fetch_list, in_specs,
     specs = tuple(in_specs.get(n, P()) for n in feed_names) + \
         tuple(P() for _ in scope_names)
     out = jax.shard_map(body, mesh=m, in_specs=specs,
-                        out_specs=tuple(P() for _ in fetch_names),
-                        check_vma=False)(
+                        out_specs=tuple(out_specs.get(n, P())
+                                        for n in fetch_names),
+                        check_vma=check_vma)(
         *[feed[n] for n in feed_names], *scope_vals)
     return list(out)

@@ -62,3 +62,5 @@ def run_check():
         assert bool(jnp.isfinite(out))
     print(f"PaddleTPU is installed successfully! "
           f"({ndev} device(s) available)")
+
+from . import enforce  # noqa: F401,E402

@@ -1,0 +1,43 @@
+"""Structured enforce/error system (reference enforce.h taxonomy)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.enforce import (
+    EnforceNotMet, InvalidArgumentError, NotFoundError, OutOfRangeError,
+    UnimplementedError, enforce, enforce_eq, enforce_ge, enforce_gt,
+    enforce_not_none, enforce_shape)
+
+
+def test_typed_errors_taxonomy():
+    for cls in (InvalidArgumentError, NotFoundError, OutOfRangeError,
+                UnimplementedError):
+        with pytest.raises(EnforceNotMet) as e:
+            raise cls("boom", hint="check your inputs")
+        assert cls.error_type in str(e.value)
+        assert "Hint" in str(e.value)
+        assert "operator stack" in str(e.value)
+
+
+def test_enforce_helpers():
+    assert enforce(True)
+    with pytest.raises(InvalidArgumentError):
+        enforce(False, "must hold")
+    assert enforce_eq(3, 3)
+    with pytest.raises(InvalidArgumentError, match="expected 3"):
+        enforce_eq(3, 4)
+    assert enforce_gt(2, 1) and enforce_ge(2, 2)
+    with pytest.raises(InvalidArgumentError):
+        enforce_gt(1, 2)
+
+
+def test_enforce_shape_wildcards():
+    x = np.zeros((5, 3, 7))
+    assert enforce_shape(x, [-1, 3, 7])
+    with pytest.raises(InvalidArgumentError, match="shape mismatch"):
+        enforce_shape(x, [5, 4, 7], name="weight")
+
+
+def test_enforce_not_none():
+    assert enforce_not_none(0) == 0  # falsy but not None is fine
+    with pytest.raises(NotFoundError):
+        enforce_not_none(None, "scope var")

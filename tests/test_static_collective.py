@@ -2,6 +2,7 @@
 (reference: paddle/fluid/operators/collective/ op suite +
 collective/collective_allreduce_api.py test pattern)."""
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +29,19 @@ def test_c_allreduce_and_concat():
     ref_sum = xg.reshape(2, 4, 4).sum(1)
     np.testing.assert_allclose(np.asarray(out[0]), ref_sum)
     np.testing.assert_allclose(np.asarray(out[1]), xg)
+
+
+def test_c_allgather_without_mesh_fails_loud(monkeypatch):
+    # review regression: a missing mesh must not record an un-gathered
+    # output shape (silent nranks=1)
+    from paddle_tpu.parallel import mesh as mesh_mod
+    from paddle_tpu.utils.enforce import InvalidArgumentError
+    monkeypatch.setattr(mesh_mod, "_GLOBAL_MESH", None)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", shape=[2, 4], dtype="float32")
+        with pytest.raises(InvalidArgumentError, match="nranks"):
+            C.c_allgather(x, axis_name="mp")
 
 
 def test_c_broadcast():
