@@ -44,9 +44,8 @@ def forward_grad(outputs, inputs, grad_inputs=None):
     for op in reversed(list(block.ops)):
         if any(o in needed for o in op.outputs):
             ops.append(op)
-            from ...static.graph import VarRef as _VR
             needed.update(i.name for i in op.inputs
-                          if isinstance(i, _VR))
+                          if isinstance(i, VarRef))
     ops = list(reversed(ops))
     produced = {n for op in ops for n in op.outputs}
     ext = []

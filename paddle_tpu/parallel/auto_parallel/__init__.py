@@ -3,3 +3,6 @@ from .interface import (  # noqa: F401
     dtensor_from_fn, reshard, shard_layer, shard_op, shard_tensor,
 )
 from .process_mesh import ProcessMesh  # noqa: F401
+
+from .tuner import (ClusterSpec, ModelSpec,  # noqa: F401,E402
+                    ParallelTuner, RuleBasedTuner, tune)

@@ -1,0 +1,185 @@
+"""Parallel-layout tuner: cost-model search over hybrid degrees.
+
+Reference: python/paddle/distributed/auto_parallel/tuner/
+(ParallelTuner, RuleBasedTuner, OptimizationTuner) + cost/ (comp/comm
+cost models, cluster topology). TPU-native redesign: instead of
+profiling candidate static programs, a closed-form analytical model over
+the (dp, mp, pp, sharding) factorizations of the chip count — the
+per-config step-time estimate combines
+
+- compute: model FLOPs / (chips * peak), perfectly parallel across dp
+  and pp, with the pipeline bubble factor (S-1)/(M+S-1) for GPipe or
+  the interleaved fraction;
+- TP communication: per-layer activation allreduces over the mp axis at
+  ICI bandwidth (2 allreduces per transformer layer, 2*(mp-1)/mp ring
+  cost);
+- DP/sharding communication: gradient reduce-scatter+all-gather of the
+  param bytes per step;
+- memory feasibility: params + grads + optimizer states + activation
+  estimate per chip must fit HBM (configs that don't are discarded).
+
+`tune()` returns ranked candidates; `RuleBasedTuner` applies the
+reference's heuristics (prefer mp within a host, pp across, dp outermost)
+as a tie-break.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+__all__ = ["ClusterSpec", "ModelSpec", "Candidate", "ParallelTuner",
+           "RuleBasedTuner", "tune"]
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """Cluster description (reference cluster.py fake-topology JSONs)."""
+    n_chips: int = 8
+    peak_flops: float = 459e12          # bf16 / chip (v5p default)
+    hbm_bytes: float = 95e9             # per chip
+    ici_bandwidth: float = 90e9         # bytes/s per link direction
+    dcn_bandwidth: float = 6.25e9       # bytes/s (crossing slices)
+    chips_per_host: int = 4
+    chips_per_slice: int = 0            # 0 = single slice (all ICI)
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Transformer shape (enough for the closed-form cost model)."""
+    n_params: float = 7e9
+    n_layers: int = 32
+    hidden: int = 4096
+    seq_len: int = 4096
+    batch_tokens: int = 4 * 1024 * 1024   # global tokens per step
+    bytes_per_param: float = 2.0          # bf16 weights
+    optimizer_bytes_per_param: float = 12.0  # fp32 master + m + v
+
+
+@dataclasses.dataclass
+class Candidate:
+    dp: int
+    mp: int
+    pp: int
+    sharding: int
+    step_time: float
+    compute_time: float
+    comm_time: float
+    bubble_fraction: float
+    mem_per_chip: float
+    feasible: bool
+
+    @property
+    def degrees(self):
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_degree": self.sharding}
+
+
+def _factorizations(n):
+    out = []
+    for dp in _divisors(n):
+        for mp in _divisors(n // dp):
+            rem = n // (dp * mp)
+            for pp in _divisors(rem):
+                sharding = rem // pp
+                out.append((dp, mp, pp, sharding))
+    return out
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class ParallelTuner:
+    """Search all hybrid factorizations, score by the analytical model
+    (reference ParallelTuner searches dist-attr spaces; here the space is
+    the mesh-degree assignment — GSPMD handles the per-op attrs)."""
+
+    def __init__(self, cluster: ClusterSpec = None,
+                 model: ModelSpec = None, micro_batches=8,
+                 interleave=1):
+        self.cluster = cluster or ClusterSpec()
+        self.model = model or ModelSpec()
+        self.micro_batches = micro_batches
+        self.interleave = interleave
+
+    # ---------------------------------------------------------- model
+    def _score(self, dp, mp, pp, sharding):
+        c, m = self.cluster, self.model
+        chips = dp * mp * pp * sharding
+        flops = 6.0 * m.n_params * m.batch_tokens
+        eff_chips = chips
+        compute = flops / (eff_chips * c.peak_flops)
+
+        # pipeline bubble (GPipe / interleaved-1F1B)
+        if pp > 1:
+            M = self.micro_batches * self.interleave
+            bubble = (pp - 1) / (M + pp - 1)
+        else:
+            bubble = 0.0
+        compute = compute / max(1e-9, (1.0 - bubble))
+
+        # TP: 2 activation allreduces per layer over mp, ring cost
+        comm = 0.0
+        if mp > 1:
+            act_bytes = (m.batch_tokens / max(dp * pp * sharding, 1)) \
+                * m.hidden * m.bytes_per_param
+            per_ar = 2.0 * (mp - 1) / mp * act_bytes / c.ici_bandwidth
+            comm += 2.0 * m.n_layers * per_ar
+        # DP/sharding gradient reduction of the param bytes. dp is the
+        # outermost mesh axis: on a multi-slice cluster it is the axis
+        # that crosses DCN, so its reduction is costed at DCN bandwidth
+        # when the job spans slices.
+        red = dp * sharding
+        if red > 1:
+            slice_chips = c.chips_per_slice or c.n_chips
+            bw = c.dcn_bandwidth if chips > slice_chips \
+                else c.ici_bandwidth
+            grad_bytes = m.n_params * m.bytes_per_param / (mp * pp)
+            comm += 2.0 * (red - 1) / red * grad_bytes / bw
+
+        # memory per chip
+        shard_denom = mp * pp * max(sharding, 1)
+        params_b = m.n_params * m.bytes_per_param / (mp * pp)
+        grads_b = params_b
+        opt_b = m.n_params * m.optimizer_bytes_per_param / shard_denom
+        act_b = (m.batch_tokens / max(dp * pp * sharding, 1)) * m.hidden \
+            * m.bytes_per_param * 2  # rematerialized transformer rough cut
+        mem = params_b + grads_b + opt_b + act_b
+        feasible = mem <= c.hbm_bytes
+
+        return Candidate(dp, mp, pp, sharding,
+                         step_time=compute + comm,
+                         compute_time=compute, comm_time=comm,
+                         bubble_fraction=bubble, mem_per_chip=mem,
+                         feasible=feasible)
+
+    def tune(self, top_k=5):
+        cands = [self._score(*f)
+                 for f in _factorizations(self.cluster.n_chips)]
+        ranked = sorted([x for x in cands if x.feasible],
+                        key=lambda x: x.step_time)
+        if not ranked:   # nothing fits: report least-infeasible anyway
+            ranked = sorted(cands, key=lambda x: x.mem_per_chip)
+        return ranked[:top_k]
+
+
+class RuleBasedTuner(ParallelTuner):
+    """Reference RuleBasedTuner heuristics as tie-breaks: mp must fit in
+    one host (ICI-rich), pp spans hosts, dp outermost."""
+
+    def tune(self, top_k=5):
+        ranked = super().tune(top_k=len(
+            _factorizations(self.cluster.n_chips)))
+        host = self.cluster.chips_per_host
+
+        def key(cand):
+            return (round(cand.step_time, 6),
+                    0 if cand.mp <= host else 1,    # mp inside a host
+                    -cand.dp)                        # dp outermost
+        ranked = sorted(ranked, key=key)
+        return ranked[:top_k]
+
+
+def tune(cluster=None, model=None, top_k=5, rule_based=True, **kw):
+    cls = RuleBasedTuner if rule_based else ParallelTuner
+    return cls(cluster, model, **kw).tune(top_k=top_k)

@@ -31,7 +31,9 @@ def _nranks(ax):
     from ..parallel.mesh import get_mesh
     from ..utils.enforce import InvalidArgumentError
     m = get_mesh()
-    if m is None or m.degree(ax) < 1:
+    # degree() defaults unknown axes to 1 — require the axis to actually
+    # exist in the mesh, else the un-gathered shape would be recorded
+    if m is None or ax not in m.degrees:
         raise InvalidArgumentError(
             f"c_* op needs the gather width for axis {ax!r} at build "
             "time: initialize a mesh (paddle_tpu.parallel.init_mesh) "

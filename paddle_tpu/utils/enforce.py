@@ -26,7 +26,10 @@ class EnforceNotMet(RuntimeError):
 
     def __init__(self, message, hint=None):
         self.hint = hint
-        frames = traceback.extract_stack()[:-2]
+        # trim only this __init__'s frame so a direct `raise TypedError`
+        # keeps its raise site in the summary (enforce() callers show the
+        # enforce frame too, which is accurate)
+        frames = traceback.extract_stack()[:-1]
         tail = "".join(traceback.format_list(frames[-3:]))
         full = f"{self.error_type}: {message}"
         if hint:
