@@ -32,6 +32,13 @@ def _kernel(x_ref, w_ref, o_ref, *, eps):
     o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def _pick_block_rows(rows, block_rows):
+    br = min(block_rows, rows)
+    while rows % br:
+        br //= 2
+    return max(br, 1)
+
+
 def _pallas_fwd(x, w, eps, block_rows=256):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -40,10 +47,7 @@ def _pallas_fwd(x, w, eps, block_rows=256):
     d = x.shape[-1]
     rows = x.size // d
     x2 = x.reshape(rows, d)
-    br = min(block_rows, rows)
-    while rows % br:
-        br //= 2
-    br = max(br, 1)
+    br = _pick_block_rows(rows, block_rows)
     out = pl.pallas_call(
         functools.partial(_kernel, eps=eps),
         out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
@@ -97,10 +101,7 @@ def _pallas_bwd(x, w, g, eps, block_rows=256, interpret=False):
     rows = x.size // d
     x2 = x.reshape(rows, d)
     g2 = g.reshape(rows, d)
-    br = min(block_rows, rows)
-    while rows % br:
-        br //= 2
-    br = max(br, 1)
+    br = _pick_block_rows(rows, block_rows)
     nblocks = rows // br
     dx, dw = pl.pallas_call(
         functools.partial(_bwd_kernel, eps=eps, nblocks=nblocks),

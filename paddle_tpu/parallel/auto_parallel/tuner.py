@@ -25,7 +25,6 @@ as a tie-break.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 
 __all__ = ["ClusterSpec", "ModelSpec", "Candidate", "ParallelTuner",
            "RuleBasedTuner", "tune"]
@@ -107,8 +106,7 @@ class ParallelTuner:
         c, m = self.cluster, self.model
         chips = dp * mp * pp * sharding
         flops = 6.0 * m.n_params * m.batch_tokens
-        eff_chips = chips
-        compute = flops / (eff_chips * c.peak_flops)
+        compute = flops / (chips * c.peak_flops)
 
         # pipeline bubble (GPipe / interleaved-1F1B)
         if pp > 1:
@@ -126,16 +124,17 @@ class ParallelTuner:
             per_ar = 2.0 * (mp - 1) / mp * act_bytes / c.ici_bandwidth
             comm += 2.0 * m.n_layers * per_ar
         # DP/sharding gradient reduction of the param bytes. dp is the
-        # outermost mesh axis: on a multi-slice cluster it is the axis
-        # that crosses DCN, so its reduction is costed at DCN bandwidth
-        # when the job spans slices.
-        red = dp * sharding
-        if red > 1:
-            slice_chips = c.chips_per_slice or c.n_chips
-            bw = c.dcn_bandwidth if chips > slice_chips \
-                else c.ici_bandwidth
-            grad_bytes = m.n_params * m.bytes_per_param / (mp * pp)
-            comm += 2.0 * (red - 1) / red * grad_bytes / bw
+        # outermost mesh axis: on a multi-slice cluster it is the one
+        # crossing DCN; the sharding axis sits inside a slice (ICI).
+        grad_bytes = m.n_params * m.bytes_per_param / (mp * pp)
+        slice_chips = c.chips_per_slice or c.n_chips
+        if dp > 1:
+            dp_crosses_dcn = chips > slice_chips
+            bw = c.dcn_bandwidth if dp_crosses_dcn else c.ici_bandwidth
+            comm += 2.0 * (dp - 1) / dp * grad_bytes / bw
+        if sharding > 1:
+            comm += 2.0 * (sharding - 1) / sharding * grad_bytes \
+                / c.ici_bandwidth
 
         # memory per chip
         shard_denom = mp * pp * max(sharding, 1)
@@ -160,7 +159,7 @@ class ParallelTuner:
                         key=lambda x: x.step_time)
         if not ranked:   # nothing fits: report least-infeasible anyway
             ranked = sorted(cands, key=lambda x: x.mem_per_chip)
-        return ranked[:top_k]
+        return ranked if top_k is None else ranked[:top_k]
 
 
 class RuleBasedTuner(ParallelTuner):
@@ -168,8 +167,7 @@ class RuleBasedTuner(ParallelTuner):
     one host (ICI-rich), pp spans hosts, dp outermost."""
 
     def tune(self, top_k=5):
-        ranked = super().tune(top_k=len(
-            _factorizations(self.cluster.n_chips)))
+        ranked = super().tune(top_k=None)
         host = self.cluster.chips_per_host
 
         def key(cand):

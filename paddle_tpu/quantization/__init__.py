@@ -25,7 +25,8 @@ from ..nn import functional as F
 
 __all__ = [
     "fake_quant", "quant_dequant", "BaseQuanter", "BaseObserver",
-    "QuanterFactory", "quanter", "AbsmaxObserver",
+    "QuanterFactory", "quanter", "AbsmaxObserver", "EMAObserver",
+    "AVGObserver", "HistObserver", "KLObserver", "MSEObserver",
     "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
     "QuantConfig", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
 ]
@@ -132,6 +133,194 @@ class AbsmaxObserverLayer(BaseObserver):
 
 class AbsmaxObserver(QuanterFactory):
     _layer_cls = AbsmaxObserverLayer
+
+
+class EMAObserverLayer(BaseObserver):
+    """Exponential-moving-average absmax (reference observers/ema.py)."""
+
+    def __init__(self, layer=None, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._rate = moving_rate
+        self._ema = None
+        del layer
+
+    def observe(self, x):
+        v = float(jnp.max(jnp.abs(_v(x))))
+        self._ema = v if self._ema is None else \
+            self._rate * self._ema + (1.0 - self._rate) * v
+
+    def scales(self):
+        return wrap(jnp.asarray(self._ema or 0.0, jnp.float32))
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def cal_thresholds(self):
+        pass
+
+
+class EMAObserver(QuanterFactory):
+    _layer_cls = EMAObserverLayer
+
+
+class AVGObserverLayer(BaseObserver):
+    """Mean of per-batch absmax (reference observers/avg.py)."""
+
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._sum = 0.0
+        self._n = 0
+        del layer
+
+    def observe(self, x):
+        self._sum += float(jnp.max(jnp.abs(_v(x))))
+        self._n += 1
+
+    def scales(self):
+        return wrap(jnp.asarray(self._sum / max(self._n, 1), jnp.float32))
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def cal_thresholds(self):
+        pass
+
+
+class AVGObserver(QuanterFactory):
+    _layer_cls = AVGObserverLayer
+
+
+class _HistogramObserverBase(BaseObserver):
+    """Shared |x| histogram accumulation (reference observers/
+    base_hist.py): a fixed-bin histogram over [0, running_max], rescaled
+    when the range grows."""
+
+    def __init__(self, layer=None, quant_bits=8, bins_count=2048):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._bins = bins_count
+        self._hist = np.zeros(bins_count, np.float64)
+        self._max = 0.0
+        self._scale = None
+        del layer
+
+    def observe(self, x):
+        self._scale = None   # new data invalidates the cached threshold
+        v = np.abs(np.asarray(_v(x), np.float64)).reshape(-1)
+        vmax = float(v.max()) if v.size else 0.0
+        if vmax > self._max:
+            if self._max > 0.0:
+                # re-bin the old histogram onto the wider range
+                old_edges = np.linspace(0, self._max, self._bins + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                self._hist = np.histogram(
+                    centers, bins=self._bins, range=(0, vmax),
+                    weights=self._hist)[0]
+            self._max = vmax
+        if self._max > 0.0:
+            self._hist += np.histogram(v, bins=self._bins,
+                                       range=(0, self._max))[0]
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def scales(self):
+        if self._scale is None:
+            self.cal_thresholds()
+        return wrap(jnp.asarray(self._scale or self._max, jnp.float32))
+
+
+class HistObserverLayer(_HistogramObserverBase):
+    """Percentile threshold (reference observers/hist.py)."""
+
+    def __init__(self, layer=None, quant_bits=8, bins_count=2048,
+                 percent=0.999):
+        super().__init__(layer, quant_bits, bins_count)
+        self._percent = percent
+
+    def cal_thresholds(self):
+        total = self._hist.sum()
+        if total <= 0:
+            self._scale = self._max
+            return
+        cum = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cum, self._percent))
+        edges = np.linspace(0, self._max, self._bins + 1)
+        self._scale = float(edges[min(idx + 1, self._bins)])
+
+
+class HistObserver(QuanterFactory):
+    _layer_cls = HistObserverLayer
+
+
+class KLObserverLayer(_HistogramObserverBase):
+    """KL-divergence threshold search (reference observers/kl.py — the
+    TensorRT-style calibration: pick the clip threshold whose quantized
+    distribution has minimal KL divergence from the observed one)."""
+
+    def cal_thresholds(self):
+        total = self._hist.sum()
+        if total <= 0:
+            self._scale = self._max
+            return
+        levels = 2 ** (self._quant_bits - 1)
+        eps = 1e-10
+        p_full = self._hist / total + eps
+        p_full /= p_full.sum()
+        best_kl, best_i = np.inf, self._bins
+        start = max(levels, self._bins // 16)
+        for i in range(start, self._bins + 1, max(1, self._bins // 128)):
+            # quantize the kept range into `levels` buckets; bins past the
+            # clip threshold get (near-)zero mass, so clipping away real
+            # probability carries an explicit KL cost — without the
+            # full-support comparison, i == levels represents p exactly
+            # and the search degenerates to the smallest threshold
+            chunks = np.array_split(self._hist[:i], levels)
+            q = np.concatenate([
+                np.full(len(c), c.sum() / max((c > 0).sum(), 1))
+                * (c > 0) for c in chunks])
+            q_full = np.concatenate(
+                [q, np.zeros(self._bins - i)]) + eps
+            q_full /= q_full.sum()
+            kl = float(np.sum(p_full * np.log(p_full / q_full)))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        edges = np.linspace(0, self._max, self._bins + 1)
+        self._scale = float(edges[best_i])
+
+
+class KLObserver(QuanterFactory):
+    _layer_cls = KLObserverLayer
+
+
+class MSEObserverLayer(_HistogramObserverBase):
+    """Scale minimizing quantization MSE over the observed histogram
+    (reference observers/mse.py)."""
+
+    def cal_thresholds(self):
+        total = self._hist.sum()
+        if total <= 0:
+            self._scale = self._max
+            return
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        edges = np.linspace(0, self._max, self._bins + 1)
+        centers = (edges[:-1] + edges[1:]) / 2
+        w = self._hist / total
+        best_mse, best_s = np.inf, self._max
+        for frac in np.linspace(0.3, 1.0, 36):
+            s = self._max * frac
+            q = np.clip(np.round(centers / s * qmax), -qmax, qmax) \
+                * s / qmax
+            mse = float(np.sum(w * (centers - q) ** 2))
+            if mse < best_mse:
+                best_mse, best_s = mse, s
+        self._scale = float(best_s)
+
+
+class MSEObserver(QuanterFactory):
+    _layer_cls = MSEObserverLayer
 
 
 class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):

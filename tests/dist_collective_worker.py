@@ -1,0 +1,42 @@
+"""Worker for the multi-process collective e2e: launcher env ->
+init_parallel_env -> jax.distributed -> cross-process CPU collective."""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu.parallel as dist
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def main():
+    env = dist.init_parallel_env()   # consumes the launcher env protocol
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == int(os.environ["PADDLE_TRAINERS_NUM"]), world
+
+    mesh = Mesh(jax.devices(), ("dp",))
+    x = jax.make_array_from_callback(
+        (world * 4,), NamedSharding(mesh, P("dp")),
+        lambda idx: jnp.full((4,), rank + 1.0, jnp.float32))
+    total = jax.jit(lambda a: a.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(x)
+    got = float(total)
+    expected = sum(4.0 * (r + 1) for r in range(world))
+    assert got == expected, (got, expected)
+
+    out = os.path.join(os.environ["PROBE_DIR"], f"rank{rank}.json")
+    json.dump({"rank": rank, "world": world, "sum": got}, open(out, "w"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

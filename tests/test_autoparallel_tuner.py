@@ -43,9 +43,19 @@ def test_bubble_fraction_decreases_with_microbatches():
 def test_rule_based_keeps_mp_in_host():
     cl = ClusterSpec(n_chips=16, chips_per_host=4, hbm_bytes=30e9)
     md = ModelSpec(n_params=20e9)
-    best = RuleBasedTuner(cl, md).tune(top_k=3)
-    # among near-equal configs the tuner prefers mp <= chips_per_host
-    assert any(c.mp <= 4 for c in best)
+    best = RuleBasedTuner(cl, md).tune(top_k=1)[0]
+    # the winning config must keep tensor parallelism inside one host
+    assert best.mp <= 4
+
+    # and the tie-break is live: among configs with (near-)equal step
+    # time, a same-cost mp>host config must not outrank an mp<=host one
+    all_ranked = RuleBasedTuner(cl, md).tune(top_k=None)
+    times = [round(c.step_time, 6) for c in all_ranked]
+    first_time = times[0]
+    same_cost = [c for c in all_ranked
+                 if round(c.step_time, 6) == first_time]
+    if any(c.mp > 4 for c in same_cost):
+        assert same_cost[0].mp <= 4
 
 
 def test_strategy_degrees_consumable():

@@ -1,0 +1,32 @@
+"""Multi-process collective e2e: 2 REAL processes through the launcher's
+env protocol, jax.distributed bring-up, and a cross-process collective
+(reference pattern: test_parallel_dygraph_dataparallel.py
+start_local_trainers + collective_allreduce_api over 2 trainers)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_collective(tmp_path):
+    worker = os.path.join(REPO, "tests", "dist_collective_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+    env.update({"PROBE_DIR": str(tmp_path), "PYTHONUNBUFFERED": "1"})
+    cmd = [sys.executable, "-m", "paddle_tpu.parallel.launch.main",
+           "--nproc_per_node", "2", "--master", "127.0.0.1:29883",
+           "--log_dir", str(tmp_path / "log"), worker]
+    r = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                       text=True, timeout=300)
+    logs = ""
+    for i in range(2):
+        p = tmp_path / "log" / f"workerlog.{i}"
+        if p.exists():
+            logs += f"--- worker {i} ---\n" + p.read_text()[-1500:]
+    assert r.returncode == 0, (r.stdout, r.stderr, logs)
+    res = [json.load(open(tmp_path / f"rank{i}.json")) for i in range(2)]
+    assert all(x["world"] == 2 for x in res)
+    # sum over both processes' shards: 4*1 + 4*2
+    assert all(x["sum"] == 12.0 for x in res)

@@ -1,0 +1,87 @@
+"""PTQ observer zoo (reference observers/{abs_max,ema,avg,hist,kl,mse})."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.quantization import (AbsmaxObserver, AVGObserver,
+                                     EMAObserver, HistObserver, KLObserver,
+                                     MSEObserver)
+
+
+def _feed(layer_cls_factory, batches):
+    obs = layer_cls_factory._layer_cls(None)
+    for b in batches:
+        obs(pt.to_tensor(b))
+    return obs
+
+
+RNG = np.random.RandomState(0)
+GAUSS = [RNG.randn(512).astype(np.float32) for _ in range(8)]
+
+
+def test_absmax_tracks_running_max():
+    obs = _feed(AbsmaxObserver, [np.array([1.0, -3.0], np.float32),
+                                 np.array([2.0], np.float32)])
+    assert float(obs.scales().numpy()) == 3.0
+
+
+def test_ema_smooths():
+    obs = EMAObserver._layer_cls(None, moving_rate=0.5)
+    obs(pt.to_tensor(np.array([4.0], np.float32)))
+    obs(pt.to_tensor(np.array([2.0], np.float32)))
+    assert abs(float(obs.scales().numpy()) - 3.0) < 1e-6
+
+
+def test_avg_means_batch_maxima():
+    obs = _feed(AVGObserver, [np.array([4.0], np.float32),
+                              np.array([2.0], np.float32)])
+    assert abs(float(obs.scales().numpy()) - 3.0) < 1e-6
+
+
+def test_hist_percentile_clips_outlier():
+    data = list(GAUSS) + [np.array([100.0], np.float32)]  # one outlier
+    obs = _feed(HistObserver, data)
+    obs.cal_thresholds()
+    s = float(obs.scales().numpy())
+    # the 99.9th percentile threshold must clip far below the outlier
+    assert s < 50.0
+    assert s > 1.0
+
+
+def test_kl_threshold_reasonable():
+    obs = _feed(KLObserver, GAUSS)
+    obs.cal_thresholds()
+    s = float(obs.scales().numpy())
+    mx = max(float(np.abs(g).max()) for g in GAUSS)
+    assert 0.5 < s <= mx + 1e-6
+
+
+def test_mse_threshold_below_max_for_heavy_tail():
+    data = list(GAUSS) + [np.array([30.0], np.float32)]
+    obs = _feed(MSEObserver, data)
+    obs.cal_thresholds()
+    s = float(obs.scales().numpy())
+    assert s < 30.0  # clipping the single outlier wins on MSE
+
+
+def test_hist_scale_invalidated_by_new_data():
+    # review regression: observing after a scales() read must recompute
+    obs = HistObserver._layer_cls(None)
+    obs(pt.to_tensor(np.ones(64, np.float32)))
+    s1 = float(obs.scales().numpy())
+    obs(pt.to_tensor(np.full(512, 50.0, np.float32)))
+    s2 = float(obs.scales().numpy())
+    assert s2 > s1 * 5
+
+
+def test_observer_in_ptq_flow():
+    from paddle_tpu.quantization import PTQ, QuantConfig
+    net = pt.nn.Sequential(pt.nn.Linear(8, 8))
+    cfg = QuantConfig(activation=HistObserver(), weight=AbsmaxObserver())
+    ptq = PTQ(cfg)
+    qmodel = ptq.quantize(net)
+    for _ in range(4):   # calibration batches
+        qmodel(pt.to_tensor(RNG.randn(4, 8).astype(np.float32)))
+    frozen = ptq.convert(qmodel)
+    out = frozen(pt.to_tensor(RNG.randn(4, 8).astype(np.float32)))
+    assert np.isfinite(out.numpy()).all()
