@@ -192,9 +192,13 @@ class DataParallel:
         return self._layers.set_state_dict(*a, **k)
 
 
-def fused_allreduce_gradients(parameter_list, hcg=None):
+def fused_allreduce_gradients(parameter_list, hcg=None, fp16_wire=False):
     """Reference: fleet/utils/hybrid_parallel_util.py:206. Inside shard_map
-    psums grads over dp; eager single-process: no-op."""
+    psums grads over dp; eager single-process: no-op. fp16_wire casts the
+    grad to fp16 for the psum and restores fp32 after (the
+    fp16_allreduce meta-optimizer's halved wire bytes)."""
+    import jax.numpy as jnp
+
     from .collective import axis_or_none
     axis = axis_or_none("dp")
     if axis is None:
@@ -202,4 +206,9 @@ def fused_allreduce_gradients(parameter_list, hcg=None):
     for p in parameter_list:
         if p.grad is not None:
             g = unwrap(p.grad)
-            p.grad._replace_value(jax.lax.psum(g, axis))
+            if fp16_wire and g.dtype == jnp.float32:
+                g = jax.lax.psum(g.astype(jnp.float16), axis).astype(
+                    jnp.float32)
+            else:
+                g = jax.lax.psum(g, axis)
+            p.grad._replace_value(g)

@@ -69,7 +69,10 @@ class HybridParallelOptimizer:
         from .api import fused_allreduce_gradients
         if self._hcg is not None and \
                 self._hcg.get_data_parallel_world_size() > 1:
-            fused_allreduce_gradients(self._inner._parameters, self._hcg)
+            fused_allreduce_gradients(
+                self._inner._parameters, self._hcg,
+                fp16_wire=bool(getattr(self._inner, "_fp16_allreduce",
+                                       False)))
         self._inner.step()
 
     def clear_grad(self, *a, **k):

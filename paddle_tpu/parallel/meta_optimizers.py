@@ -17,6 +17,8 @@ import jax.numpy as jnp
 from ..core.tensor import unwrap, wrap
 
 __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer", "DGCOptimizer",
+           "AMPOptimizer", "FP16AllReduceOptimizer", "PipelineOptimizer",
+           "RawProgramOptimizer", "ASPOptimizer",
            "RecomputeOptimizer", "apply_strategy_meta_optimizers"]
 
 
@@ -155,6 +157,118 @@ class RecomputeOptimizer(_MetaOptimizer):
         self._inner.step()
 
 
+class AMPOptimizer(_MetaOptimizer):
+    """Mixed-precision meta optimizer (reference meta_optimizers/
+    amp_optimizer.py): owns a GradScaler; scale the loss via
+    ``opt.scale(loss)`` before backward, then ``opt.step()`` unscales,
+    checks finiteness and applies — the program-rewrite of the reference
+    collapses into the scaler since compute dtype is bf16/fp16 already."""
+
+    def __init__(self, inner, init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        super().__init__(inner)
+        from ..amp import GradScaler
+        self._scaler = GradScaler(
+            init_loss_scaling=init_loss_scaling,
+            use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+        self._pending_scaled = False
+
+    def scale(self, loss):
+        self._pending_scaled = True
+        return self._scaler.scale(loss)
+
+    def step(self):
+        # transparent when the caller never scaled the loss (the fleet
+        # minimize() path) — unscaling unscaled grads would silently
+        # divide every update by init_loss_scaling
+        if self._pending_scaled:
+            self._scaler.step(self._inner)
+            self._scaler.update()
+            self._pending_scaled = False
+        else:
+            self._inner.step()
+
+    def minimize(self, loss, *args, **kwargs):
+        scaled = self.scale(loss)
+        scaled.backward()
+        self.step()
+        return [], []
+
+
+class FP16AllReduceOptimizer(_MetaOptimizer):
+    """Reference meta_optimizers/fp16_allreduce_optimizer.py: gradients
+    cross the wire in fp16. The cast must happen AT the allreduce, so
+    this wrapper sets the flag fused_allreduce_gradients(...,
+    fp16_wire=True) consumes (parallel/api.py) — the psum then moves
+    half the bytes and the update still runs in fp32. step() itself is
+    pass-through."""
+
+    _fp16_allreduce = True
+
+    def step(self):
+        self._inner.step()
+
+
+class PipelineOptimizer(_MetaOptimizer):
+    """API-parity shell (reference meta_optimizers/pipeline_optimizer.py):
+    the schedule itself lives in parallel.pp_1f1b / pp_schedule — the
+    optimizer needs no gradient changes in the SPMD design."""
+
+    def __init__(self, inner, num_microbatches=1, **kw):
+        super().__init__(inner)
+        self.num_microbatches = num_microbatches
+
+
+class RawProgramOptimizer(_MetaOptimizer):
+    """API-parity shell (reference raw_program_optimizer.py inserts DP
+    allreduce into the raw program; GSPMD's dp axis sharding makes that
+    insertion the compiler's job)."""
+
+
+class ASPOptimizer(_MetaOptimizer):
+    """2:4 structured sparsity (reference paddle.incubate.asp +
+    asp_optimizer.py): after every step, re-apply per-row 2-of-4
+    magnitude masks to 2-D weights so the MXU-friendly N:M pattern is
+    preserved through training."""
+
+    def __init__(self, inner, n=2, m=4, excluded_layers=None):
+        super().__init__(inner)
+        self.n, self.m = n, m
+        self.excluded_layers = set(excluded_layers or [])
+
+    def _prunable(self, p):
+        w = unwrap(p)
+        if w.ndim != 2 or w.shape[1] < self.m:
+            return False
+        name = getattr(p, "name", "") or ""
+        if name in self.excluded_layers:
+            return False
+        # reference ASP restricts pruning to fc/conv weights; embedding
+        # tables must never be N:M-masked
+        return "embed" not in name.lower()
+
+    @staticmethod
+    def _mask_2d(w, n, m):
+        d0, d1 = w.shape
+        pad = (-d1) % m
+        wp = jnp.pad(w, ((0, 0), (0, pad)))
+        groups = wp.reshape(d0, -1, m)
+        thresh = -jnp.sort(-jnp.abs(groups), axis=-1)[..., n - 1:n]
+        mask = (jnp.abs(groups) >= thresh).astype(w.dtype)
+        # ties can keep >n entries; that's allowed (superset mask)
+        return mask.reshape(d0, -1)[:, :d1]
+
+    def prune(self):
+        for p in self._inner._parameters:
+            if self._prunable(p):
+                w = unwrap(p)
+                p._replace_value(w * self._mask_2d(w, self.n, self.m))
+
+    def step(self):
+        self._inner.step()
+        self.prune()
+
+
 def apply_strategy_meta_optimizers(optimizer, strategy):
     """strategy_compiler.py analog: stack wrappers by strategy flags in the
     reference's valid composition order (dgc → gradient_merge → localsgd)."""
@@ -181,4 +295,20 @@ def apply_strategy_meta_optimizers(optimizer, strategy):
         optimizer = RecomputeOptimizer(
             optimizer,
             checkpoints=strategy.recompute_configs.get("checkpoints"))
+    if getattr(strategy, "fp16_allreduce", False):
+        optimizer = FP16AllReduceOptimizer(optimizer)
+    if getattr(strategy, "amp", False):
+        cfg = getattr(strategy, "amp_configs", {}) or {}
+        optimizer = AMPOptimizer(
+            optimizer,
+            init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15),
+            use_dynamic_loss_scaling=cfg.get(
+                "use_dynamic_loss_scaling", True))
+    if getattr(strategy, "asp", False):
+        optimizer = ASPOptimizer(optimizer)
+    if getattr(strategy, "pipeline", False):
+        cfg = getattr(strategy, "pipeline_configs", {}) or {}
+        optimizer = PipelineOptimizer(
+            optimizer,
+            num_microbatches=cfg.get("accumulate_steps", 1))
     return optimizer

@@ -188,3 +188,88 @@ class TestLars:
         db = np.abs(p_big.numpy() - before_b).mean()
         ds = np.abs(p_small.numpy() - before_s).mean()
         assert db / ds > 50  # big params get proportionally bigger steps
+
+
+class TestNewMetaOptimizers:
+    def _net(self):
+        import paddle_tpu as pt
+        net = pt.nn.Linear(8, 8)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        return net, opt
+
+    def test_amp_optimizer_scales_and_steps(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.meta_optimizers import AMPOptimizer
+        net, opt = self._net()
+        amp_opt = AMPOptimizer(opt, init_loss_scaling=256.0)
+        x = pt.to_tensor(np.ones((4, 8), np.float32))
+        w0 = net.weight.numpy().copy()
+        loss = net(x).mean()
+        amp_opt.scale(loss).backward()
+        amp_opt.step()
+        opt.clear_grad()
+        # params moved by the UNSCALED gradient magnitude
+        delta = np.abs(net.weight.numpy() - w0).max()
+        assert 0 < delta < 1.0, delta
+
+    def test_fp16_allreduce_keeps_training(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.meta_optimizers import (
+            FP16AllReduceOptimizer)
+        net, opt = self._net()
+        m = FP16AllReduceOptimizer(opt)
+        x = pt.to_tensor(np.ones((4, 8), np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        m.step()
+        assert np.isfinite(net.weight.numpy()).all()
+
+    def test_asp_enforces_2_of_4(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.meta_optimizers import ASPOptimizer
+        net, opt = self._net()
+        asp = ASPOptimizer(opt)
+        x = pt.to_tensor(np.random.RandomState(0).randn(
+            4, 8).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        asp.step()
+        w = net.weight.numpy()
+        groups = w.reshape(w.shape[0], -1, 4)
+        nz = (np.abs(groups) > 0).sum(-1)
+        assert (nz <= 3).all()          # ties may keep an extra entry
+        assert (nz >= 1).all()
+
+    def test_strategy_flags_stack_new_wrappers(self):
+        from paddle_tpu.parallel.meta_optimizers import (
+            AMPOptimizer, ASPOptimizer, apply_strategy_meta_optimizers)
+
+        class S:
+            amp = True
+            asp = True
+        _, opt = self._net()
+        wrapped = apply_strategy_meta_optimizers(opt, S())
+        # both wrappers must be applied, pipeline outermost order:
+        # amp first, then asp wraps it
+        assert isinstance(wrapped, ASPOptimizer)
+        assert isinstance(wrapped.inner_opt, AMPOptimizer)
+
+    def test_amp_transparent_without_scale(self):
+        # review regression: the fleet minimize() path never calls
+        # scale(); step() must NOT unscale unscaled grads
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.meta_optimizers import AMPOptimizer
+        net, opt = self._net()
+        amp_opt = AMPOptimizer(opt, init_loss_scaling=32768.0)
+        x = pt.to_tensor(np.ones((4, 8), np.float32))
+        w0 = net.weight.numpy().copy()
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        amp_opt.step()     # no scale() happened
+        delta = np.abs(net.weight.numpy() - w0).max()
+        assert delta > 1e-4, "update was shrunk by the loss scale"
