@@ -1,0 +1,133 @@
+"""BERT family (encoder-only, learned positions + token types, GELU).
+
+Reference parity target: the dy2static/hapi BERT suites
+(python/paddle/fluid/tests/unittests/dygraph_to_static/test_bert.py,
+PaddleNLP-style BertModel surface: sequence output + pooled output,
+MLM + NSP pretraining heads). Built from paddle_tpu.nn so one definition
+serves eager, jit, GSPMD TP (via dp/mp sharding of the dense layers),
+and PipelineLayer segmentation.
+"""
+from dataclasses import dataclass
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "bert_base", "bert_tiny"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as pt
+        seq = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = pt.arange(0, seq, 1).astype("int64")
+        if token_type_ids is None:
+            token_type_ids = pt.zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertModel(nn.Layer):
+    """Returns (sequence_output [B,S,H], pooled_output [B,H])."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        layer = nn.TransformerEncoderLayer(
+            d_model=cfg.hidden_size, nhead=cfg.num_heads,
+            dim_feedforward=cfg.intermediate_size, dropout=cfg.dropout,
+            activation="gelu", normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, position_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(h, src_mask=attention_mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference BertPretrainingHeads surface)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.mlm_decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        return self.mlm_decoder(h), self.nsp(pooled)
+
+    def loss(self, mlm_logits, nsp_logits, masked_labels, nsp_labels,
+             ignore_index=-100):
+        mlm = F.cross_entropy(
+            mlm_logits.reshape([-1, mlm_logits.shape[-1]]),
+            masked_labels.reshape([-1]), ignore_index=ignore_index)
+        nsp = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm + nsp
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    kw.setdefault("dropout", 0.0)
+    return BertConfig(**kw)

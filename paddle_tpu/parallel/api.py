@@ -197,8 +197,6 @@ def fused_allreduce_gradients(parameter_list, hcg=None, fp16_wire=False):
     psums grads over dp; eager single-process: no-op. fp16_wire casts the
     grad to fp16 for the psum and restores fp32 after (the
     fp16_allreduce meta-optimizer's halved wire bytes)."""
-    import jax.numpy as jnp
-
     from .collective import axis_or_none
     axis = axis_or_none("dp")
     if axis is None:

@@ -231,21 +231,33 @@ class ASPOptimizer(_MetaOptimizer):
     magnitude masks to 2-D weights so the MXU-friendly N:M pattern is
     preserved through training."""
 
-    def __init__(self, inner, n=2, m=4, excluded_layers=None):
+    def __init__(self, inner, n=2, m=4, model=None, excluded_layers=None):
         super().__init__(inner)
         self.n, self.m = n, m
         self.excluded_layers = set(excluded_layers or [])
+        # structural restriction (reference ASP supports fc/conv weights
+        # only): when the model is available, prune exactly the weights
+        # of Linear layers — names are unreliable (Parameter.name is
+        # often None), so identity against the module tree is the check
+        self._prunable_ids = None
+        if model is not None:
+            from ..nn.layers_basic import Linear
+            self._prunable_ids = {
+                id(l.weight) for l in model.sublayers(include_self=True)
+                if isinstance(l, Linear) and l.weight is not None}
 
     def _prunable(self, p):
         w = unwrap(p)
         if w.ndim != 2 or w.shape[1] < self.m:
             return False
+        if self._prunable_ids is not None:
+            return id(p) in self._prunable_ids
         name = getattr(p, "name", "") or ""
         if name in self.excluded_layers:
             return False
-        # reference ASP restricts pruning to fc/conv weights; embedding
-        # tables must never be N:M-masked
-        return "embed" not in name.lower()
+        # no model given: fall back to the name heuristic; unnamed params
+        # are skipped so embedding tables can't be masked by accident
+        return bool(name) and "embed" not in name.lower()
 
     @staticmethod
     def _mask_2d(w, n, m):
@@ -305,7 +317,10 @@ def apply_strategy_meta_optimizers(optimizer, strategy):
             use_dynamic_loss_scaling=cfg.get(
                 "use_dynamic_loss_scaling", True))
     if getattr(strategy, "asp", False):
-        optimizer = ASPOptimizer(optimizer)
+        optimizer = ASPOptimizer(
+            optimizer, model=getattr(strategy, "_asp_model", None))
+    if getattr(strategy, "without_graph_optimization", False):
+        optimizer = RawProgramOptimizer(optimizer)
     if getattr(strategy, "pipeline", False):
         cfg = getattr(strategy, "pipeline_configs", {}) or {}
         optimizer = PipelineOptimizer(

@@ -1,0 +1,81 @@
+"""BERT family (reference dygraph_to_static/test_bert.py pattern:
+construct, forward shapes, pretraining loss decreases, jit parity)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models.bert import (BertForPretraining,
+                                    BertForSequenceClassification,
+                                    BertModel, bert_tiny)
+
+RNG = np.random.RandomState(0)
+
+
+def _ids(b=2, s=16, vocab=128):
+    return pt.to_tensor(RNG.randint(0, vocab, size=(b, s)).astype(
+        np.int64))
+
+
+def test_bert_model_shapes():
+    cfg = bert_tiny()
+    m = BertModel(cfg)
+    m.eval()
+    seq, pooled = m(_ids())
+    assert list(seq.shape) == [2, 16, 64]
+    assert list(pooled.shape) == [2, 64]
+
+
+def test_pretraining_loss_decreases():
+    pt.seed(0)
+    cfg = bert_tiny()
+    model = BertForPretraining(cfg)
+    model.eval()  # dropout 0 anyway; deterministic
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    ids = _ids(4, 16)
+    labels = _ids(4, 16)
+    nsp_labels = pt.to_tensor(RNG.randint(0, 2, size=(4,)).astype(
+        np.int64))
+    first = None
+    for _ in range(8):
+        mlm, nsp = model(ids)
+        loss = model.loss(mlm, nsp, labels, nsp_labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    assert float(loss.numpy()) < first
+
+
+def test_sequence_classification_and_jit():
+    import jax
+    cfg = bert_tiny()
+    m = BertForSequenceClassification(cfg, num_classes=3)
+    m.eval()
+    ids = _ids(2, 16)
+    eager = m(ids).numpy()
+    assert eager.shape == (2, 3)
+
+    from paddle_tpu.jit import functional_call
+    params = m.raw_params()
+    buffers = {n: b._value for n, b in m.named_buffers()}
+
+    def fwd(p, i):
+        return functional_call(m, p, i, buffers=buffers or None)
+
+    jitted = jax.jit(fwd)(params, ids._value)
+    np.testing.assert_allclose(np.asarray(jitted), eager, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_masked_labels_ignore_index():
+    cfg = bert_tiny()
+    model = BertForPretraining(cfg)
+    model.eval()
+    ids = _ids(2, 8)
+    mlm, nsp = model(ids)
+    labels = np.full((2, 8), -100, np.int64)
+    labels[0, 3] = 7  # single supervised position
+    loss = model.loss(mlm, nsp, pt.to_tensor(labels),
+                      pt.to_tensor(np.array([0, 1], np.int64)))
+    assert np.isfinite(float(loss.numpy()))

@@ -232,7 +232,7 @@ class TestNewMetaOptimizers:
         import paddle_tpu as pt
         from paddle_tpu.parallel.meta_optimizers import ASPOptimizer
         net, opt = self._net()
-        asp = ASPOptimizer(opt)
+        asp = ASPOptimizer(opt, model=net)
         x = pt.to_tensor(np.random.RandomState(0).randn(
             4, 8).astype(np.float32))
         loss = (net(x) ** 2).mean()
@@ -273,3 +273,37 @@ class TestNewMetaOptimizers:
         amp_opt.step()     # no scale() happened
         delta = np.abs(net.weight.numpy() - w0).max()
         assert delta > 1e-4, "update was shrunk by the loss scale"
+
+
+    def test_asp_never_prunes_embeddings(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.meta_optimizers import ASPOptimizer
+
+        class Net(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = pt.nn.Embedding(16, 8)
+                self.fc = pt.nn.Linear(8, 8)
+
+            def forward(self, ids):
+                return self.fc(self.emb(ids))
+
+        net = Net()
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+        asp = ASPOptimizer(opt, model=net)
+        emb0 = net.emb.weight.numpy().copy()
+        ids = pt.to_tensor(np.arange(4).astype(np.int64))
+        loss = (net(ids) ** 2).mean()
+        loss.backward()
+        asp.step()
+        emb1 = net.emb.weight.numpy()
+        # embedding updated by SGD but NOT 2:4-masked: no row may have
+        # half its entries exactly zeroed
+        groups = emb1.reshape(16, -1, 4)
+        assert not ((np.abs(groups) > 0).sum(-1) <= 2).all()
+        # while the Linear weight IS masked
+        w = net.fc.weight.numpy()
+        assert ((np.abs(w.reshape(8, -1, 4)) > 0).sum(-1) <= 3).all()
+        del emb0
