@@ -33,7 +33,7 @@ from jax import lax
 __all__ = ["convert_to_static", "Dy2StaticError", "convert_ifelse",
            "convert_while_loop", "convert_for_range", "convert_logical_and",
            "convert_logical_or", "convert_logical_not", "convert_bool",
-           "UNDEFINED"]
+           "convert_ifexp", "convert_assert", "convert_print", "UNDEFINED"]
 
 
 class Dy2StaticError(RuntimeError):
@@ -199,6 +199,44 @@ def convert_bool(x):
     return _pred(x)
 
 
+def convert_ifexp(pred, true_fn, false_fn):
+    """Ternary `a if cond else b` (reference convert_operators.py
+    convert_ifelse expression form). Routed through convert_ifelse so
+    traced predicates get lax.cond with full pytree outputs (tuples etc.)
+    instead of a structure-mangling jnp.where."""
+    return convert_ifelse(pred, lambda: true_fn(), lambda: false_fn(), ())
+
+
+def convert_assert(pred, message=None):
+    """`assert` statement (reference convert_operators.py convert_assert
+    -> Assert op). Eager: real assert. Traced: cannot branch on data —
+    matches the reference's behavior of deferring to runtime checks; use
+    paddle_tpu.debugging.enable_check_nan_inf for traced validation."""
+    p = _pred(pred)
+    if isinstance(p, bool):
+        if not p:
+            raise AssertionError(message if message is not None else "")
+    return None
+
+
+def convert_print(*args, **kwargs):
+    """`print` (reference PrintTransformer -> Print op). Traced tensors
+    print at RUN time via jax.debug.print; non-array args (labels etc.)
+    fold into the format string since they aren't valid JAX types."""
+    if any(_is_traced(a) for a in args):
+        parts, arrays = [], []
+        for a in args:
+            r = _raw(a)
+            if isinstance(r, (jax.Array, jax.core.Tracer)):
+                parts.append("{}")
+                arrays.append(r)
+            else:
+                parts.append(str(a).replace("{", "{{").replace("}", "}}"))
+        jax.debug.print(" ".join(parts), *arrays)
+        return None
+    return print(*args, **kwargs)
+
+
 # --------------------------------------------------------------- analysis
 
 class _AssignedNames(ast.NodeVisitor):
@@ -319,6 +357,34 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             return ast.copy_location(ast.Call(
                 func=_jst_attr("convert_logical_not"),
                 args=[node.operand], keywords=[]), node)
+        return node
+
+    def visit_IfExp(self, node):
+        self.generic_visit(node)
+        mk = lambda b: ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]), body=b)
+        return ast.copy_location(ast.Call(
+            func=_jst_attr("convert_ifexp"),
+            args=[node.test, mk(node.body), mk(node.orelse)],
+            keywords=[]), node)
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test]
+        if node.msg is not None:
+            args.append(node.msg)
+        return ast.copy_location(ast.Expr(value=ast.Call(
+            func=_jst_attr("convert_assert"), args=args, keywords=[])),
+            node)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id == "print" \
+                and not node.keywords:
+            return ast.copy_location(ast.Call(
+                func=_jst_attr("convert_print"), args=node.args,
+                keywords=[]), node)
         return node
 
     # -- if/else ---------------------------------------------------------

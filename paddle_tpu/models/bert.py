@@ -69,10 +69,23 @@ class BertModel(nn.Layer):
         self.encoder = nn.TransformerEncoder(layer, cfg.num_layers)
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
+    @staticmethod
+    def _extend_mask(attention_mask):
+        """[B, S] 1/0 (or bool) keep-mask -> additive [B, 1, 1, S]
+        (PaddleNLP BertModel.get_extended_attention_mask semantics)."""
+        if attention_mask is None:
+            return None
+        import paddle_tpu as pt
+        m = attention_mask
+        if len(m.shape) == 2:
+            m = m.unsqueeze(1).unsqueeze(1)
+        keep = m.astype("float32")
+        return (keep - 1.0) * 1e9
+
     def forward(self, input_ids, token_type_ids=None,
                 attention_mask=None, position_ids=None):
         h = self.embeddings(input_ids, token_type_ids, position_ids)
-        seq = self.encoder(h, src_mask=attention_mask)
+        seq = self.encoder(h, src_mask=self._extend_mask(attention_mask))
         pooled = F.tanh(self.pooler(seq[:, 0]))
         return seq, pooled
 

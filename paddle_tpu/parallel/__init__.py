@@ -10,7 +10,7 @@ from .api import (  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all, alltoall,
     barrier, broadcast, get_rank, get_world_size, in_shard_map, new_group,
-    recv, reduce, reduce_scatter, scatter, send, stream, wait,
+    recv, reduce, reduce_scatter, scatter, send, wait,
 )
 from .env import ParallelEnv, init_parallel_env, is_initialized  # noqa: F401
 from .mesh import HybridMesh, P, get_mesh, init_mesh, mesh_scope, set_mesh  # noqa: F401
@@ -35,3 +35,5 @@ def __getattr__(name):
 
 from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401,E402
                          UserDefinedRoleMaker, Role)
+
+from . import stream  # noqa: F401,E402

@@ -25,7 +25,7 @@ from .mesh import get_mesh
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
            "reduce_scatter", "broadcast", "reduce", "scatter", "alltoall",
            "all_to_all", "send", "recv", "isend", "irecv", "barrier",
-           "get_rank", "get_world_size", "new_group", "wait", "stream",
+           "get_rank", "get_world_size", "new_group", "wait",
            "in_shard_map", "axis_or_none", "split_group"]
 
 
@@ -317,12 +317,5 @@ def barrier(group=None):
     env.barrier()
 
 
-class stream:
-    """paddle.distributed.stream.* namespace parity — on TPU comm/compute
-    overlap is XLA's latency-hiding scheduler, so these alias the sync ops."""
-
-    all_reduce = staticmethod(all_reduce)
-    all_gather = staticmethod(all_gather)
-    reduce_scatter = staticmethod(reduce_scatter)
-    broadcast = staticmethod(broadcast)
-    alltoall = staticmethod(all_to_all)
+# the richer task-returning stream namespace lives in parallel/stream.py
+# (reference communication/stream/); collective.py keeps only the core ops

@@ -250,11 +250,11 @@ class ASPOptimizer(_MetaOptimizer):
         w = unwrap(p)
         if w.ndim != 2 or w.shape[1] < self.m:
             return False
+        name = getattr(p, "name", "") or ""
+        if name and name in self.excluded_layers:
+            return False  # explicit exclusion beats the structural check
         if self._prunable_ids is not None:
             return id(p) in self._prunable_ids
-        name = getattr(p, "name", "") or ""
-        if name in self.excluded_layers:
-            return False
         # no model given: fall back to the name heuristic; unnamed params
         # are skipped so embedding tables can't be masked by accident
         return bool(name) and "embed" not in name.lower()

@@ -1,0 +1,88 @@
+"""paddle.distributed.communication.stream parity namespace.
+
+Reference: python/paddle/distributed/communication/stream/ —
+all_reduce.py:24 etc., the stream-controlled collective variants
+(use_calc_stream picks the compute stream instead of the comm stream).
+TPU-native: XLA's latency-hiding scheduler owns stream placement, so
+``use_calc_stream``/``sync_op`` are accepted and ignored; every call
+forwards to the one collective implementation (collective.py). A thin
+Task-like handle keeps `.wait()` call sites working.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "alltoall",
+           "reduce", "scatter", "all_to_all", "send", "recv"]
+
+
+class _DoneTask:
+    """Completed-task handle (the reference returns an async task when
+    sync_op=False; XLA dispatch is already async)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _wrap(result):
+    # the underlying ops mutate the tensor in place and return it; the
+    # stream namespace's contract is a waitable task handle
+    return _DoneTask()
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _wrap(_c.all_reduce(tensor, op=op, group=group,
+                               sync_op=sync_op))
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    return _wrap(_c.all_gather(tensor_or_tensor_list, tensor,
+                               group=group, sync_op=sync_op))
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None,
+                   op=_c.ReduceOp.SUM, group=None, sync_op=True,
+                   use_calc_stream=False):
+    return _wrap(_c.reduce_scatter(tensor, tensor_or_tensor_list, op=op,
+                                   group=group, sync_op=sync_op))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    return _wrap(_c.broadcast(tensor, src=src, group=group,
+                              sync_op=sync_op))
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    return _wrap(_c.reduce(tensor, dst=dst, op=op, group=group,
+                           sync_op=sync_op))
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    return _wrap(_c.scatter(tensor, tensor_or_tensor_list, src=src,
+                            group=group, sync_op=sync_op))
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
+               sync_op=True, use_calc_stream=False):
+    return _wrap(_c.all_to_all(out_tensor_list, in_tensor_list,
+                               group=group, sync_op=sync_op))
+
+
+alltoall = all_to_all  # reference stream namespace exposes both names
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    return _wrap(_c.send(tensor, dst=dst, group=group, sync_op=sync_op))
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    return _wrap(_c.recv(tensor, src=src, group=group, sync_op=sync_op))

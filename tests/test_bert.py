@@ -79,3 +79,18 @@ def test_masked_labels_ignore_index():
     loss = model.loss(mlm, nsp, pt.to_tensor(labels),
                       pt.to_tensor(np.array([0, 1], np.int64)))
     assert np.isfinite(float(loss.numpy()))
+
+
+def test_attention_mask_masks_padding():
+    cfg = bert_tiny()
+    m = BertModel(cfg)
+    m.eval()
+    ids = _ids(2, 8)
+    mask = np.ones((2, 8), np.int64)
+    mask[:, 6:] = 0              # last two tokens are padding
+    full, _ = m(ids)
+    masked, _ = m(ids, attention_mask=pt.to_tensor(mask))
+    # non-padding positions must differ from the unmasked run (padding
+    # was attended before), and outputs stay finite
+    assert np.isfinite(masked.numpy()).all()
+    assert not np.allclose(masked.numpy()[:, :6], full.numpy()[:, :6])

@@ -191,3 +191,68 @@ class TestToStaticIntegration:
         static = convert_to_static(f)
         with pytest.raises(Dy2StaticError, match="scalar"):
             jax.jit(static)(jnp.asarray([1.0, -1.0]))
+
+
+class TestNewTransformers:
+    def test_ifexp_traced(self):
+        def f(x):
+            return (x * 2 if x.sum() > 0 else x * 3) + 1
+
+        _agree(f, np.array([1.0, 2.0], np.float32))
+        _agree(f, np.array([-1.0, -2.0], np.float32))
+
+    def test_assert_eager_raises(self):
+        def f(x):
+            assert x.sum() > 0, "negative!"
+            return x
+
+        static = convert_to_static(f)
+        out = static(np.array([1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [1.0])
+        with pytest.raises(AssertionError, match="negative"):
+            static(np.array([-1.0], np.float32))
+
+    def test_assert_traced_is_noop(self):
+        def f(x):
+            assert x.sum() > -1e9
+            return x * 2
+
+        static = convert_to_static(f)
+        out = jax.jit(static)(jnp.array([2.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [4.0])
+
+    def test_print_traced_compiles(self, capfd):
+        def f(x):
+            print(x)
+            return x + 1
+
+        static = convert_to_static(f)
+        out = jax.jit(static)(jnp.array([1.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0])
+
+    def test_print_eager_passthrough(self, capsys):
+        def f(x):
+            print("value:", x)
+            return x
+
+        static = convert_to_static(f)
+        static(np.array([5.0], np.float32))
+        assert "value:" in capsys.readouterr().out
+
+    def test_ifexp_tuple_branches_traced(self):
+        def f(x):
+            a, b = (x * 2, x + 1) if x.sum() > 0 else (x * 3, x - 1)
+            return a + b
+
+        static = convert_to_static(f)
+        out = jax.jit(static)(jnp.array([1.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [4.0])
+
+    def test_print_label_and_tensor_traced(self):
+        def f(x):
+            print("loss:", x)
+            return x * 2
+
+        static = convert_to_static(f)
+        out = jax.jit(static)(jnp.array([3.0], jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), [6.0])

@@ -161,3 +161,16 @@ def test_rng_tracker_distinct_streams():
     assert not np.allclose(a, b)
     with pytest.raises(ValueError):
         tr.add("global_seed", 3)
+
+
+def test_stream_namespace_parity():
+    """stream.* variants forward to the collective impl and return a
+    waitable task handle (reference communication/stream/all_reduce.py)."""
+    import numpy as np
+    from paddle_tpu.parallel import stream
+    import paddle_tpu as pt
+
+    t = pt.to_tensor(np.ones(4, np.float32))
+    task = stream.all_reduce(t, sync_op=False, use_calc_stream=True)
+    assert task.wait() and task.is_completed()
+    np.testing.assert_allclose(t.numpy(), 1.0)  # 1-proc: identity
