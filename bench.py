@@ -80,6 +80,13 @@ def _build_model(config_name):
         cfg = llama_350m()
         return (LlamaForCausalLM(cfg), cfg,
                 "llama_350m_train_tokens_per_sec_per_chip", 8, 1024)
+    if config_name == "moe":
+        # BASELINE.md MoE row (DeepSeek-MoE / Mixtral family): top-2 of 8
+        # SwiGLU experts, GShard grouped dispatch, aux loss in the step.
+        from paddle_tpu.models.mixtral import MixtralForCausalLM, moe_350m_8e
+        cfg = moe_350m_8e(moe_group_size=1024)
+        return (MixtralForCausalLM(cfg), cfg,
+                "mixtral_8e_top2_train_tokens_per_sec_per_chip", 8, 1024)
     cfg = gpt2_345m(dropout=0.0)
     return (GPTForCausalLM(cfg), cfg,
             "gpt2_345m_train_tokens_per_sec_per_chip", 8, 1024)
@@ -120,10 +127,18 @@ def main(config_name="gpt2"):
         logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
         return -jnp.take_along_axis(logp, lb[..., None], -1).mean()
 
+    is_moe = config_name == "moe"
+
     def step(params, state, ids, i):
         def compute(ps):
             logits = functional_call(model, ps, ids)
-            return loss_fn(logits, ids)
+            l = loss_fn(logits, ids)
+            if is_moe:
+                from paddle_tpu.core.tensor import unwrap
+                aux = model.collect_aux_loss()
+                if aux is not None:
+                    l = l + cfg.aux_loss_coef * unwrap(aux)
+            return l
 
         loss, grads = jax.value_and_grad(compute)(params)
         new_p, new_s = update_fn(grads, params, state, step=i)
@@ -150,7 +165,14 @@ def main(config_name="gpt2"):
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
-    flops_per_token = 6 * n_params
+    n_active = n_params
+    if is_moe:
+        # MoE MFU counts ACTIVE params per token (top_k of num_experts);
+        # capacity padding/drops are overhead, not useful FLOPs.
+        exp = sum(int(np.prod(v.shape)) for k, v in params.items()
+                  if ".experts." in k)
+        n_active = n_params - exp + exp * cfg.top_k / cfg.num_experts
+    flops_per_token = 6 * n_active
     # causal attention flops: 12 * L * S^2 * H per token pair accounting
     attn_flops = 12 * cfg.num_layers * cfg.hidden_size * seq
     mfu = tokens_per_sec * (flops_per_token + attn_flops) / peak_flops_bf16()
@@ -167,5 +189,9 @@ def main(config_name="gpt2"):
 
 
 if __name__ == "__main__":
-    main("llama350m" if "--config=llama350m" in sys.argv[1:] or
-         "llama350m" in sys.argv[1:] else "gpt2")
+    _argv = sys.argv[1:]
+    _cfg = "gpt2"
+    for _name in ("llama350m", "moe"):
+        if f"--config={_name}" in _argv or _name in _argv:
+            _cfg = _name
+    main(_cfg)

@@ -65,7 +65,7 @@ class Node:
     """
 
     __slots__ = ("id", "parents", "n_outputs", "out_ct", "name",
-                 "_treedef", "_raw_vjp", "_out_avals")
+                 "_treedef", "_raw_vjp", "_out_avals", "out_hooks")
 
     def __init__(self, parents, n_outputs, name=""):
         tls = _tls()
@@ -78,6 +78,7 @@ class Node:
         self._treedef = None
         self._raw_vjp = None
         self._out_avals = None      # [(shape, dtype)] for zero-cotangent fill
+        self.out_hooks = None       # out_index -> [(hook_id, fn)] (register_hook)
 
     def release(self):
         self._raw_vjp = None

@@ -74,11 +74,42 @@ def wrap(value, stop_gradient=True):
     t._node = None
     t._out_index = 0
     t.name = None
+    t._hooks = None
     return t
 
 
 def _is_diff(a):
     return isinstance(a, Tensor) and not a.stop_gradient
+
+
+_hook_counter = [0]
+
+
+def _next_hook_id():
+    _hook_counter[0] += 1
+    return _hook_counter[0]
+
+
+class _HookHandle:
+    """Removable handle returned by Tensor.register_hook (reference:
+    paddle.fluid.dygraph.tensor_patch_methods TensorHookRemoveHelper)."""
+
+    __slots__ = ("_hooks", "_hid")
+
+    def __init__(self, hooks, hid):
+        self._hooks = hooks
+        self._hid = hid
+
+    def remove(self):
+        if self._hooks is None:
+            return False
+        for i, (hid, _fn) in enumerate(self._hooks):
+            if hid == self._hid:
+                del self._hooks[i]
+                self._hooks = None
+                return True
+        self._hooks = None
+        return False
 
 
 # Static-graph recorder hook (installed by paddle_tpu.static.graph). When a
@@ -210,16 +241,32 @@ def _ones_like(v):
     return jnp.ones_like(v)
 
 
+def _run_hooks(hooks, g):
+    """Apply register_hook callbacks to a raw cotangent value. A hook gets a
+    Tensor and may return a replacement (Tensor/array) or None (keep)."""
+    for _hid, h in list(hooks):
+        r = h(wrap(g))
+        if r is not None:
+            g = unwrap(r)
+    return g
+
+
 def backward(tensor, grad_tensor=None, retain_graph=False):
     """Reverse-mode traversal (reference: egr::RunBackward, backward.cc:104).
 
     Seeds the cotangent of ``tensor``, walks reachable Nodes in reverse
     creation order, runs each vjp once all its output cotangents are known
     (creation order guarantees readiness), accumulates into leaf ``.grad``.
+    Tensor hooks (register_hook, reference eager/hooks.h TensorHook) fire on
+    the finalized cotangent of their tensor: for intermediates just before
+    the producing node's vjp consumes it, for leaves once per backward with
+    the fully accumulated gradient, before accumulation into ``.grad``.
     """
     if tensor._node is None:
         if not tensor.stop_gradient:
             g = _ones_like(tensor._value) if grad_tensor is None else unwrap(grad_tensor)
+            if tensor._hooks:
+                g = _run_hooks(tensor._hooks, g)
             tensor.grad = wrap(g) if tensor.grad is None else wrap(tensor.grad._value + g)
         return
 
@@ -238,6 +285,7 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
             if p._node is not None:
                 stack.append(p._node)
 
+    pending_leaf = {}  # id(tensor) -> [tensor, accumulated g] for hooked leaves
     for nid in sorted(reachable, reverse=True):
         node = reachable[nid]
         if all(ct is None for ct in node.out_ct):
@@ -247,6 +295,12 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
             else jnp.zeros(node._out_avals[i][0], node._out_avals[i][1])
             for i, ct in enumerate(node.out_ct)
         ]
+        if node.out_hooks:
+            for idx, hooks in node.out_hooks.items():
+                # fire only when gradient actually reached this output
+                # (paddle semantics: no phantom hook calls on zero fills)
+                if node.out_ct[idx] is not None:
+                    cts[idx] = _run_hooks(hooks, cts[idx])
         in_cts = node._raw_vjp(jax.tree_util.tree_unflatten(node._treedef, cts))
         for parent, g in zip(node.parents, in_cts):
             if parent._node is not None and parent._node.id in reachable:
@@ -254,18 +308,31 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
                 cur = slot.out_ct[parent._out_index]
                 slot.out_ct[parent._out_index] = g if cur is None else cur + g
             if parent._node is None or parent.is_leaf:
-                parent.grad = (
-                    wrap(g) if parent.grad is None else wrap(parent.grad._value + g)
-                )
+                if parent._hooks:
+                    ent = pending_leaf.get(id(parent))
+                    if ent is None:
+                        pending_leaf[id(parent)] = [parent, g]
+                    else:
+                        ent[1] = ent[1] + g
+                else:
+                    parent.grad = (
+                        wrap(g) if parent.grad is None else wrap(parent.grad._value + g)
+                    )
         if not retain_graph:
             node.release()
+
+    for parent, g in pending_leaf.values():
+        g = _run_hooks(parent._hooks, g)
+        parent.grad = (
+            wrap(g) if parent.grad is None else wrap(parent.grad._value + g)
+        )
 
 
 class Tensor:
     """Eager tensor. Value semantics follow paddle.Tensor where sensible."""
 
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_index",
-                 "name", "__weakref__")
+                 "name", "_hooks", "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None):
         dtype = dtypes.convert_dtype(dtype)
@@ -281,6 +348,7 @@ class Tensor:
         self._node = None
         self._out_index = 0
         self.name = name
+        self._hooks = None
 
     # -- structural properties ------------------------------------------------
     @property
@@ -362,8 +430,29 @@ class Tensor:
     def clone(self):
         return dispatch(lambda v: v + 0, self, name="clone")
 
-    def register_hook(self, hook):  # minimal parity stub; returns remover
-        raise NotImplementedError("register_hook lands with PyLayer phase")
+    def register_hook(self, hook):
+        """Register a gradient hook (paddle.Tensor.register_hook parity;
+        reference: eager/hooks.h TensorHook + tensor_wrapper registration).
+        ``hook(grad) -> Tensor|None`` runs during backward on this tensor's
+        cotangent; a non-None return replaces the gradient. Returns a
+        removable handle (``handle.remove()``)."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "register_hook on a tensor with stop_gradient=True has no "
+                "effect; set stop_gradient=False first")
+        hid = _next_hook_id()
+        entry = (hid, hook)
+        if self._node is not None:
+            if self._node.out_hooks is None:
+                self._node.out_hooks = {}
+            hooks = self._node.out_hooks.setdefault(self._out_index, [])
+            hooks.append(entry)
+        else:
+            if self._hooks is None:
+                self._hooks = []
+            hooks = self._hooks
+            hooks.append(entry)
+        return _HookHandle(hooks, hid)
 
     # -- mutation (eager convenience; invisible to any recorded graph) --------
     def set_value(self, value):

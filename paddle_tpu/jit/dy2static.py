@@ -211,10 +211,16 @@ def convert_assert(pred, message=None):
     """`assert` statement (reference convert_operators.py convert_assert
     -> Assert op). Eager: real assert. Traced: cannot branch on data —
     matches the reference's behavior of deferring to runtime checks; use
-    paddle_tpu.debugging.enable_check_nan_inf for traced validation."""
+    paddle_tpu.debugging.enable_check_nan_inf for traced validation.
+
+    ``message`` may be a zero-arg callable (the transformer wraps the msg
+    expression in a lambda so it is only evaluated on failure, matching
+    Python's lazy assert-message semantics)."""
     p = _pred(pred)
     if isinstance(p, bool):
         if not p:
+            if callable(message):
+                message = message()
             raise AssertionError(message if message is not None else "")
     return None
 
@@ -224,6 +230,7 @@ def convert_print(*args, **kwargs):
     print at RUN time via jax.debug.print; non-array args (labels etc.)
     fold into the format string since they aren't valid JAX types."""
     if any(_is_traced(a) for a in args):
+        sep = kwargs.get("sep", " ")
         parts, arrays = [], []
         for a in args:
             r = _raw(a)
@@ -232,7 +239,7 @@ def convert_print(*args, **kwargs):
                 arrays.append(r)
             else:
                 parts.append(str(a).replace("{", "{{").replace("}", "}}"))
-        jax.debug.print(" ".join(parts), *arrays)
+        jax.debug.print(sep.join(parts), *arrays)
         return None
     return print(*args, **kwargs)
 
@@ -324,10 +331,15 @@ def _jst_attr(fn):
 # ------------------------------------------------------------ transformer
 
 class _ControlFlowTransformer(ast.NodeTransformer):
-    """The reference's IfElse/Loop/Logical transformers in one pass."""
+    """The reference's IfElse/Loop/Logical transformers in one pass.
 
-    def __init__(self):
+    ``shadowed``: names bound locally in the function being transformed
+    (params + assignments) — builtin rewrites (print) skip these.
+    """
+
+    def __init__(self, shadowed=()):
         self._counter = 0
+        self._shadowed = frozenset(shadowed)
 
     def _fresh(self, kind):
         self._counter += 1
@@ -373,7 +385,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         args = [node.test]
         if node.msg is not None:
-            args.append(node.msg)
+            # lambda-wrap: Python evaluates assert messages lazily (only
+            # on failure) — an eager arg would run side effects/indexing
+            # on the success path too
+            args.append(ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=node.msg))
         return ast.copy_location(ast.Expr(value=ast.Call(
             func=_jst_attr("convert_assert"), args=args, keywords=[])),
             node)
@@ -381,10 +399,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def visit_Call(self, node):
         self.generic_visit(node)
         if isinstance(node.func, ast.Name) and node.func.id == "print" \
-                and not node.keywords:
+                and "print" not in self._shadowed:
             return ast.copy_location(ast.Call(
                 func=_jst_attr("convert_print"), args=node.args,
-                keywords=[]), node)
+                keywords=node.keywords), node)
         return node
 
     # -- if/else ---------------------------------------------------------
@@ -535,7 +553,16 @@ def _transform_to_code(func):
     # drop decorators: the transformed fn is called by the wrapper
     if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         fdef.decorator_list = []
-    tree = _ControlFlowTransformer().visit(tree)
+    shadowed = set()
+    if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fdef.args
+        shadowed = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+        if a.vararg:
+            shadowed.add(a.vararg.arg)
+        if a.kwarg:
+            shadowed.add(a.kwarg.arg)
+        shadowed |= set(_assigned(fdef.body))
+    tree = _ControlFlowTransformer(shadowed=shadowed).visit(tree)
     ast.fix_missing_locations(tree)
     freevars = func.__code__.co_freevars
     if freevars:

@@ -1,5 +1,7 @@
-from . import bert, gpt, llama  # noqa: F401
+from . import bert, gpt, llama, mixtral  # noqa: F401
 from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, BertModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel  # noqa: F401
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .mixtral import (MixtralConfig, MixtralForCausalLM,  # noqa: F401
+                      MixtralModel)

@@ -1,0 +1,30 @@
+"""Shared vision-stem and sharding helpers for the model zoo."""
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import dispatch
+
+__all__ = ["patches_to_seq", "shard_params_by_name"]
+
+
+def patches_to_seq(conv_out):
+    """[B, D, H/p, W/p] conv patch-embed output -> [B, T, D] token seq."""
+    def fn(v):
+        b, d = v.shape[0], v.shape[1]
+        return jnp.transpose(v.reshape(b, d, -1), (0, 2, 1))
+
+    return dispatch(fn, conv_out, name="patch_to_seq")
+
+
+def shard_params_by_name(model, process_mesh, mp_keys):
+    """auto_parallel annotation: 2-D params whose name contains one of
+    ``mp_keys`` are sharded [None, 'mp']; everything else replicated.
+    GSPMD completes the layout (reference flow: Completer/Partitioner on
+    TensorDistAttr, python/paddle/distributed/auto_parallel/completion.py).
+    """
+    from paddle_tpu.parallel.auto_parallel import shard_tensor
+    for name, p in model.named_parameters():
+        if p.ndim == 2 and any(k in name for k in mp_keys):
+            shard_tensor(p, process_mesh, [None, "mp"])
+        else:
+            shard_tensor(p, process_mesh, [None] * p.ndim)
+    return model

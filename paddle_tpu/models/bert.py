@@ -75,12 +75,17 @@ class BertModel(nn.Layer):
         (PaddleNLP BertModel.get_extended_attention_mask semantics)."""
         if attention_mask is None:
             return None
-        import paddle_tpu as pt
         m = attention_mask
         if len(m.shape) == 2:
+            # [B, S] int/float 1-0 keep-mask: broadcast + additive here
+            # (downstream only converts bool masks)
             m = m.unsqueeze(1).unsqueeze(1)
-        keep = m.astype("float32")
-        return (keep - 1.0) * 1e9
+            if "bool" not in str(m.dtype):
+                return (m.astype("float32") - 1.0) * 1e9
+        # bool masks (any rank) and pre-broadcast additive floats pass
+        # through: nn/transformer.py _convert_attention_mask is the single
+        # canonical bool->additive conversion
+        return m
 
     def forward(self, input_ids, token_type_ids=None,
                 attention_mask=None, position_ids=None):

@@ -104,7 +104,11 @@ class Layer:
                          attr=None, is_bias=False):
         dtype = convert_dtype(dtype) or self._dtype
         init = default_initializer
-        if attr is not None and getattr(attr, "initializer", None) is not None:
+        if isinstance(attr, I.Initializer):
+            # paddle.ParamAttr._to_attr parity: a bare Initializer is a
+            # valid weight_attr/bias_attr and wins over the default
+            init = attr
+        elif attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()

@@ -88,6 +88,21 @@ def install_tensor_methods():
                 self._node = out._node
                 self._out_index = out._out_index
                 self.stop_gradient = out.stop_gradient
+                if self._hooks and self._node is not None:
+                    # leaf hooks must survive the inplace rebind: migrate
+                    # them onto the new producing node's output slot so
+                    # they fire on the post-mutation gradient (paddle
+                    # semantics: hooks track the tensor, not the node)
+                    if self._node.out_hooks is None:
+                        self._node.out_hooks = {}
+                    slot = self._node.out_hooks.get(self._out_index)
+                    if slot is None:
+                        # reuse the list so existing _HookHandles still
+                        # remove from the live collection
+                        self._node.out_hooks[self._out_index] = self._hooks
+                    else:
+                        slot.extend(self._hooks)
+                    self._hooks = None
             return self
         return method
 

@@ -109,14 +109,23 @@ class _GateBase(Layer):
 
 
 class NaiveGate(_GateBase):
-    """Reference naive_gate.py: plain top-k softmax, no capacity drops."""
+    """Reference naive_gate.py: plain top-k softmax, no capacity drops.
+
+    Accepts flat tokens [T, D] or grouped tokens [G, g, D] (GShard token
+    groups: capacity is per group, dispatch vmapped over groups)."""
 
     def forward(self, x):
         from ...core.tensor import dispatch
-        cap = self.capacity(x.shape[0] if hasattr(x, "shape") else len(x))
+        shape = x.shape
+        grouped = len(shape) == 3
+        cap = self.capacity(shape[1] if grouped else shape[0])
 
         def fn(xv, wv):
             logits = xv @ wv
+            if grouped:
+                combine, mask, aux = jax.vmap(
+                    lambda l: _top2_dense_dispatch(l, cap))(logits)
+                return combine, mask, aux.mean()
             return _top2_dense_dispatch(logits, cap)
 
         combine, mask, aux = dispatch(fn, x, self.gate.weight,
@@ -141,11 +150,18 @@ class SwitchGate(_GateBase):
 
     def forward(self, x):
         from ...core.tensor import dispatch
-        cap = self.capacity(x.shape[0])
+        shape = x.shape
+        grouped = len(shape) == 3
+        cap = self.capacity(shape[1] if grouped else shape[0])
         training = self.training
 
         def fn(xv, wv):
             logits = xv @ wv
+            if grouped:
+                combine, mask, aux = jax.vmap(
+                    lambda l: _top1_dense_dispatch(l, cap, self.jitter,
+                                                   training))(logits)
+                return combine, mask, aux.mean()
             return _top1_dense_dispatch(logits, cap, self.jitter, training)
 
         combine, mask, aux = dispatch(fn, x, self.gate.weight,

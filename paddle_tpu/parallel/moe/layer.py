@@ -23,7 +23,7 @@ from ..collective import axis_or_none
 from ..mesh import P
 from .gate import GShardGate, NaiveGate, SwitchGate
 
-__all__ = ["MoELayer", "ExpertMLP"]
+__all__ = ["MoELayer", "ExpertMLP", "ExpertSwiGLU"]
 
 
 class ExpertMLP(Layer):
@@ -58,29 +58,69 @@ class ExpertMLP(Layer):
                          name="expert_mlp")
 
 
+class ExpertSwiGLU(Layer):
+    """Stacked SwiGLU experts (Mixtral/DeepSeek-MoE FFN shape): each expert
+    is gate/up/down with silu, weights stacked on a leading expert dim so
+    one einsum batch serves all experts on the MXU."""
+
+    def __init__(self, num_experts, d_model, d_hidden):
+        super().__init__()
+        from ...nn.initializer import XavierNormal
+        self.num_experts = num_experts
+        init = XavierNormal()
+        self.w_gate = self.create_parameter((num_experts, d_model, d_hidden),
+                                            default_initializer=init)
+        self.w_up = self.create_parameter((num_experts, d_model, d_hidden),
+                                          default_initializer=init)
+        self.w_down = self.create_parameter((num_experts, d_hidden, d_model),
+                                            default_initializer=init)
+        for p in (self.w_gate, self.w_up, self.w_down):
+            p._sharding_axes = P("mp")  # expert dim over the model axis
+
+    def forward(self, x):
+        """x: [E, C, D] capacity buckets -> [E, C, D]."""
+        def fn(xv, wg, wu, wd):
+            g = jnp.einsum("ecd,edh->ech", xv, wg)
+            u = jnp.einsum("ecd,edh->ech", xv, wu)
+            return jnp.einsum("ech,ehd->ecd", jax.nn.silu(g) * u, wd)
+
+        return _dispatch(fn, x, self.w_gate, self.w_up, self.w_down,
+                         name="expert_swiglu")
+
+
 class MoELayer(Layer):
     """Reference moe_layer.py:261 MoELayer(d_model, experts, gate, ...).
 
     gate: "naive" | "gshard" | "switch" | Layer instance.
+
+    ``group_size``: GShard-style token grouping. Dense dispatch einsums cost
+    O(T * E * C * D) with C ∝ T/E — quadratic in tokens per dispatch group.
+    Grouping tokens into G groups of ``group_size`` (per sequence is the
+    natural choice) keeps each dispatch small while the expert matmul still
+    sees one large [E, G*C, D] batch for the MXU.
     """
 
     GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
 
     def __init__(self, d_model, experts=None, gate="gshard", num_experts=None,
                  d_hidden=None, top_k=2, capacity_factor=1.25,
-                 moe_group=None, mp_group=None, recompute_interval=0):
+                 moe_group=None, mp_group=None, recompute_interval=0,
+                 group_size=None):
         super().__init__()
         self.d_model = d_model
+        self.group_size = group_size
         if experts is not None and isinstance(experts, (list, LayerList)):
             # reference-style per-expert module list -> stack into ExpertMLP
             num_experts = len(experts)
             self.experts = experts if isinstance(experts, LayerList) else \
                 LayerList(experts)
-            self._stacked = None
+        elif experts is not None and isinstance(experts, Layer):
+            # pre-built stacked expert bank (ExpertMLP / ExpertSwiGLU)
+            num_experts = num_experts or experts.num_experts
+            self.experts = experts
         else:
             self.experts = ExpertMLP(num_experts, d_model,
                                      d_hidden or 4 * d_model)
-            self._stacked = True
         self.num_experts = num_experts
         if isinstance(gate, str):
             gate_cls = self.GATES[gate]
@@ -92,6 +132,16 @@ class MoELayer(Layer):
             self.gate = gate
         self.aux_loss = None
 
+    def _apply_experts(self, buckets):
+        """buckets [E, C, D] -> [E, C, D]. Stacked banks run as one
+        batched einsum; a reference-style per-expert LayerList runs each
+        expert on its bucket slice (E is small and static)."""
+        if isinstance(self.experts, LayerList):
+            from ...ops.manipulation import stack
+            outs = [exp(buckets[e]) for e, exp in enumerate(self.experts)]
+            return stack(outs, axis=0)
+        return self.experts(buckets)
+
     def forward(self, x):
         """x: [B, S, D] -> [B, S, D]; stores aux_loss for the trainer."""
         shape = x.shape
@@ -100,6 +150,18 @@ class MoELayer(Layer):
         for s in shape[:-1]:
             tokens *= s
         xf = x.reshape([tokens, d])
+        g = self.group_size
+        if g and tokens % g == 0 and tokens > g:
+            return self._forward_grouped(xf, tokens // g, g, d).reshape(shape)
+        if g and tokens > g and tokens % g != 0:
+            # tokens <= g is the normal sub-group batch (whole-batch
+            # dispatch is exactly right); only a true partial-group split
+            # changes the capacity/drop profile and deserves a warning
+            import warnings
+            warnings.warn(
+                f"MoELayer group_size={g} does not divide {tokens} tokens; "
+                "falling back to whole-batch dispatch (different capacity "
+                "and drop profile)")
         gate_out = self.gate(xf)
         self.aux_loss = gate_out.aux_loss
 
@@ -116,7 +178,7 @@ class MoELayer(Layer):
             return buckets
 
         buckets = _dispatch(dispatch_tokens, xf, combine, name="moe_dispatch")
-        out_buckets = self.experts(buckets)                  # [E, C, D]
+        out_buckets = self._apply_experts(buckets)           # [E, C, D]
 
         def gather_tokens(ob, comb):
             ep_axis = axis_or_none("ep")
@@ -128,3 +190,41 @@ class MoELayer(Layer):
         out = _dispatch(gather_tokens, out_buckets, combine,
                         name="moe_gather")
         return out.reshape(shape)
+
+    def _forward_grouped(self, xf, n_groups, group, d):
+        """GShard grouped dispatch: xf [T, D] viewed as [G, g, D]; capacity
+        and dispatch are per group, the expert matmul runs once on the
+        concatenated [E, G*C, D] buckets."""
+        xg = xf.reshape([n_groups, group, d])
+        gate_out = self.gate(xg)
+        self.aux_loss = gate_out.aux_loss
+        combine = gate_out.combine            # [G, g, E, C]
+
+        def dispatch_tokens(xv, comb):
+            disp = (comb > 0).astype(xv.dtype)
+            buckets = jnp.einsum("gtec,gtd->gecd", disp, xv)
+            e = buckets.shape[1]
+            flat = jnp.transpose(buckets, (1, 0, 2, 3)).reshape(e, -1, d)
+            ep_axis = axis_or_none("ep")
+            if ep_axis is not None:
+                # expert-parallel exchange (same as the flat path): split
+                # the expert dim across ranks, widen the capacity dim
+                flat = jax.lax.all_to_all(flat, ep_axis, split_axis=0,
+                                          concat_axis=1, tiled=True)
+            return flat
+
+        buckets = _dispatch(dispatch_tokens, xg, combine, name="moe_dispatch")
+        out_buckets = self._apply_experts(buckets)   # [E, G*C, D]
+
+        def gather_tokens(ob, comb):
+            ep_axis = axis_or_none("ep")
+            if ep_axis is not None:
+                ob = jax.lax.all_to_all(ob, ep_axis, split_axis=1,
+                                        concat_axis=0, tiled=True)
+            gg, _t, e, c = comb.shape
+            ob = jnp.transpose(ob.reshape(e, gg, c, -1), (1, 0, 2, 3))
+            return jnp.einsum("gtec,gecd->gtd", comb.astype(ob.dtype), ob)
+
+        out = _dispatch(gather_tokens, out_buckets, combine,
+                        name="moe_gather")
+        return out.reshape([n_groups * group, d])

@@ -256,3 +256,46 @@ class TestNewTransformers:
         static = convert_to_static(f)
         out = jax.jit(static)(jnp.array([3.0], jnp.float32))
         np.testing.assert_allclose(np.asarray(out), [6.0])
+
+
+def test_print_shadowing_not_rewritten():
+    """A local binding of `print` must win over the convert_print rewrite."""
+    import paddle_tpu as pt
+    collected = []
+
+    @pt.jit.to_static
+    def fn(x):
+        print = collected.append   # noqa: A001 - deliberate shadow
+        print(7)
+        return x * 2
+
+    out = fn(pt.to_tensor([3.0]))
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    assert collected == [7]
+
+
+def test_assert_message_lazy():
+    """assert messages evaluate only on failure (Python semantics)."""
+    import paddle_tpu as pt
+    errors = []
+
+    @pt.jit.to_static
+    def fn(x):
+        assert True, f"err: {errors[0]}"   # IndexError if evaluated eagerly
+        return x + 1
+
+    np.testing.assert_allclose(fn(pt.to_tensor([1.0])).numpy(), [2.0])
+
+
+def test_print_with_keywords_converted(capsys):
+    """print(..., flush=True) still routes through convert_print."""
+    import paddle_tpu as pt
+
+    @pt.jit.to_static
+    def fn(x):
+        print("val:", 3, flush=True)
+        return x * 2
+
+    out = fn(pt.to_tensor([2.0]))
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    assert "val: 3" in capsys.readouterr().out

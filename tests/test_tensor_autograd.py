@@ -165,3 +165,75 @@ def test_cast_astype():
     x = pt.to_tensor([1.5, 2.5])
     assert str(x.astype("int32").numpy().dtype) == "int32"
     assert x.astype(pt.bfloat16).dtype == pt.bfloat16
+
+
+class TestRegisterHook:
+    """Tensor.register_hook parity (reference eager/hooks.h TensorHook;
+    python test: test_tensor_register_hook.py)."""
+
+    def test_leaf_hook_scales_grad(self):
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 2)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0], rtol=1e-6)
+
+    def test_leaf_hook_called_once_with_accumulated_grad(self):
+        calls = []
+        x = pt.to_tensor([3.0], stop_gradient=False)
+        x.register_hook(lambda g: calls.append(np.asarray(g.numpy())))
+        y = x * x + x * 4.0   # two uses of x: dy/dx = 2x + 4 = 10
+        y.backward()
+        assert len(calls) == 1
+        np.testing.assert_allclose(calls[0], [10.0], rtol=1e-6)
+        np.testing.assert_allclose(x.grad.numpy(), [10.0], rtol=1e-6)
+
+    def test_intermediate_hook_rewrites_cotangent(self):
+        x = pt.to_tensor([2.0], stop_gradient=False)
+        h = x * 3.0           # intermediate
+        h.register_hook(lambda g: g * 10)
+        y = h * h             # dy/dh = 2h = 12 -> hooked to 120 -> dx = 360
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [360.0], rtol=1e-6)
+
+    def test_none_return_keeps_grad(self):
+        seen = []
+        x = pt.to_tensor([5.0], stop_gradient=False)
+        x.register_hook(lambda g: seen.append(float(g.numpy()[0])))
+        (x * 7.0).backward()
+        assert seen == [7.0]
+        np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+    def test_remove_handle(self):
+        x = pt.to_tensor([1.0], stop_gradient=False)
+        handle = x.register_hook(lambda g: g * 100)
+        assert handle.remove()
+        assert not handle.remove()   # idempotent
+        (x * 2.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0], rtol=1e-6)
+
+    def test_stop_gradient_rejected(self):
+        x = pt.to_tensor([1.0])
+        with pytest.raises(RuntimeError):
+            x.register_hook(lambda g: g)
+
+    def test_no_phantom_hook_on_unreached_output(self):
+        """Hooks fire only when gradient actually reaches the tensor
+        (paddle semantics: no calls on zero-filled sibling cotangents)."""
+        calls = []
+        x = pt.to_tensor([1.0, 2.0, 3.0, 4.0], stop_gradient=False)
+        a, b = pt.ops.split(x, 2)
+        b.register_hook(lambda g: calls.append(1))
+        a.sum().backward()
+        assert calls == []
+        np.testing.assert_allclose(x.grad.numpy(), [1, 1, 0, 0], rtol=1e-6)
+
+    def test_hook_survives_inplace_rebind(self):
+        """register_hook before an inplace op still fires after the op
+        rebinds the tensor's tape node."""
+        calls = []
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        x.register_hook(lambda g: calls.append(1) or g * 3)
+        x.add_(pt.to_tensor([1.0, 1.0]))
+        x.sum().backward()
+        assert calls == [1]
