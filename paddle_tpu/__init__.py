@@ -85,10 +85,6 @@ def is_compiled_with_tpu():
     return True
 
 
-def in_dynamic_mode():
-    return True
-
-
 def get_default_dtype():
     return _dtype_mod.float32
 
@@ -101,12 +97,24 @@ def set_default_dtype(d):
 
 
 def disable_static(place=None):
-    pass
+    """paddle.disable_static parity: leave global static-graph mode."""
+    from .static import graph as _g
+    _g.disable_static_mode()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is trace-based; use paddle_tpu.jit.to_static")
+    """paddle.enable_static parity: ops on static.data Variables record
+    into default_main_program (reference: paddle/fluid/framework.py
+    _dygraph_guard off). Eager Tensors keep working — recording only
+    triggers on symbolic Variables, so the trace-based eager path and the
+    recorded static path coexist."""
+    from .static import graph as _g
+    _g.enable_static_mode()
+
+
+def in_dynamic_mode():
+    from .static import graph as _g
+    return not _g.in_static_mode()
 
 
 def grad(*args, **kwargs):

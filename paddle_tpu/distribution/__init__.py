@@ -329,5 +329,48 @@ def kl_divergence(p, q):
                                   - jnp.log(1 - qq + 1e-12)))
     if isinstance(p, Uniform) and isinstance(q, Uniform):
         return wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        from jax.scipy.special import betaln, digamma
+        a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+        t2 = digamma(a1 + b1)
+        return wrap(betaln(a2, b2) - betaln(a1, b1)
+                    + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                    + (a2 - a1 + b2 - b1) * t2)
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        from jax.scipy.special import digamma, gammaln
+        a1, a2 = p.concentration, q.concentration
+        s1 = jnp.sum(a1, -1)
+        return wrap(gammaln(s1) - jnp.sum(gammaln(a1), -1)
+                    - gammaln(jnp.sum(a2, -1)) + jnp.sum(gammaln(a2), -1)
+                    + jnp.sum((a1 - a2) * (digamma(a1)
+                                           - digamma(s1)[..., None]), -1))
+    if isinstance(p, Exponential) and isinstance(q, Exponential):
+        r = p.rate / q.rate
+        return wrap(jnp.log(r) + 1.0 / r - 1.0)
+    if isinstance(p, Gamma) and isinstance(q, Gamma):
+        from jax.scipy.special import digamma, gammaln
+        a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+        return wrap((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+                    + a2 * (jnp.log(b1) - jnp.log(b2)) + a1 * (b2 / b1 - 1.0))
+    if isinstance(p, Laplace) and isinstance(q, Laplace):
+        d = jnp.abs(p.loc - q.loc)
+        s1, s2 = p.scale, q.scale
+        return wrap(jnp.log(s2 / s1) + (s1 * jnp.exp(-d / s1) + d) / s2 - 1.0)
+    if isinstance(p, Poisson) and isinstance(q, Poisson):
+        r1, r2 = p.rate, q.rate
+        return wrap(r1 * (jnp.log(r1) - jnp.log(r2)) - r1 + r2)
+    if isinstance(p, Gumbel) and isinstance(q, Gumbel):
+        return _kl_gumbel(p, q)
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+def _kl_gumbel(p, q):
+    """KL(Gumbel(m1,b1) || Gumbel(m2,b2)) closed form."""
+    from jax.scipy.special import gammaln
+    euler = 0.5772156649015329
+    b1, b2 = p.scale, q.scale
+    return wrap(jnp.log(b2) - jnp.log(b1) + euler * (b1 / b2 - 1.0)
+                + (p.loc - q.loc) / b2
+                + jnp.expm1((q.loc - p.loc) / b2
+                            + gammaln(1.0 + b1 / b2)))

@@ -156,7 +156,6 @@ class DiT(nn.Layer):
         p = cfg.patch_size
         self.patch_embed = nn.Conv2D(cfg.in_channels, cfg.hidden_size,
                                      kernel_size=p, stride=p)
-        from paddle_tpu.core.tensor import wrap
         from paddle_tpu.nn.initializer import Normal
         self.pos_embed = self.create_parameter(
             (1, cfg.num_patches, cfg.hidden_size),

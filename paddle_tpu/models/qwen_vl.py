@@ -13,12 +13,9 @@ ProcessMesh via `shard_qwen_vl`.
 """
 from dataclasses import dataclass, field
 
-import jax.numpy as jnp
-
 import paddle_tpu.nn as nn
 from paddle_tpu.ops.manipulation import concat as pt_ops_concat
 import paddle_tpu.nn.functional as F
-from paddle_tpu.core.tensor import dispatch
 
 from ._stem import patches_to_seq, shard_params_by_name
 from .llama import LlamaConfig, LlamaModel

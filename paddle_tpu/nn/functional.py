@@ -1059,3 +1059,335 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
         return (jnp.arange(m)[None, :] < lv[..., None]).astype(dtype)
 
     return dispatch(fn, lengths, nondiff_args=(0,), name="sequence_mask")
+
+
+# ----------------------------------------------- round-3 functional tail
+# (reference python/paddle/nn/functional/{common,loss,vision}.py tail)
+
+pad = _OPS["pad"]
+one_hot = _OPS["one_hot"]
+
+
+@register("zeropad2d")
+def zeropad2d(x, padding, data_format="NCHW"):
+    l, r, t, b = padding
+    if data_format == "NCHW":
+        cfg = ((0, 0), (0, 0), (t, b), (l, r))
+    else:
+        cfg = ((0, 0), (t, b), (l, r), (0, 0))
+    return jnp.pad(x, cfg)
+
+
+@register("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(
+        n, c * r * r, h // r, w // r)
+
+
+@register("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    return jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(n, c, h, w)
+
+
+@register("pairwise_distance")
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y + epsilon
+    return jnp.sum(jnp.abs(d) ** p, -1, keepdims=keepdim) ** (1.0 / p)
+
+
+@register("grid_sample", nondiff_args=())
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """NCHW bilinear/nearest sampler (paddle.nn.functional.grid_sample;
+    reference phi grid_sample_kernel). grid in [-1, 1], shape [N,Ho,Wo,2]."""
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * 0.5 * (w - 1)
+        fy = (gy + 1.0) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1.0) * w - 1.0) * 0.5
+        fy = ((gy + 1.0) * h - 1.0) * 0.5
+
+    if padding_mode == "reflection":
+        def reflect(f, size):
+            if align_corners:
+                span = size - 1
+                if span == 0:
+                    return jnp.zeros_like(f)
+                f = jnp.abs(f) % (2 * span)
+                return jnp.where(f > span, 2 * span - f, f)
+            span = size
+            f = jnp.abs(f + 0.5) % (2 * span)
+            f = jnp.where(f > span, 2 * span - f, f)
+            return jnp.clip(f - 0.5, 0, size - 1)
+
+        fx = reflect(fx, w)
+        fy = reflect(fy, h)
+
+    def sample(ix, iy):
+        inb = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+        ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+        # batch gather: v[n, c, Ho, Wo]
+        v = x[jnp.arange(n)[:, None, None], :, iyc, ixc]   # [N,Ho,Wo,C]
+        v = jnp.moveaxis(v, -1, 1)
+        if padding_mode == "zeros":
+            v = v * inb[:, None, :, :]
+        return v
+
+    if mode == "nearest":
+        return sample(jnp.round(fx), jnp.round(fy))
+    x0, y0 = jnp.floor(fx), jnp.floor(fy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = (x1 - fx) * (y1 - fy)
+    wb = (x1 - fx) * (fy - y0)
+    wc = (fx - x0) * (y1 - fy)
+    wd = (fx - x0) * (fy - y0)
+    out = (sample(x0, y0) * wa[:, None] + sample(x0, y1) * wb[:, None]
+           + sample(x1, y0) * wc[:, None] + sample(x1, y1) * wd[:, None])
+    return out
+
+
+@register("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im (paddle.nn.functional.fold): x [N, C*kh*kw, L] -> [N, C, H, W]."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    H, W = _pair(output_sizes)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(n, c, kh, kw, oh, ow)
+    out = jnp.zeros((n, c, H + 2 * ph, W + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hs = i * dh
+            ws = j * dw
+            out = out.at[:, :, hs:hs + sh * oh:sh,
+                         ws:ws + sw * ow:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + H, pw:pw + W]
+
+
+@register("max_unpool2d", nondiff_args=(1,))
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Scatter pooled values back to argmax positions (reference
+    phi unpool_kernel)."""
+    n, c, h, w = x.shape
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = stride or ks
+    st = (st, st) if isinstance(st, int) else tuple(st)
+    if output_size is None:
+        H = (h - 1) * st[0] + ks[0] - 2 * padding
+        W = (w - 1) * st[1] + ks[1] - 2 * padding
+    else:
+        H, W = output_size[-2:]
+    flat = jnp.zeros((n, c, H * W), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(vals)
+    return flat.reshape(n, c, H, W)
+
+
+# ------------------------------------------------------------ loss tail
+
+
+@register("huber_loss")
+def huber_loss(input, label, delta=1.0, reduction="mean"):  # noqa: A002
+    # huber = delta * smooth_l1(delta-form): 0.5*d^2 inside, delta*(|d|-
+    # delta/2) outside (smooth_l1 alone divides the quadratic by delta)
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff <= delta, 0.5 * diff * diff,
+                     delta * (diff - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@register("soft_margin_loss")
+def soft_margin_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+@register("multi_label_soft_margin_loss")
+def multi_label_soft_margin_loss(input, label, weight=None,  # noqa: A002
+                                 reduction="mean"):
+    lg = jax.nn.log_sigmoid(input)
+    lneg = jax.nn.log_sigmoid(-input)
+    loss = -(label * lg + (1 - label) * lneg)
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss.mean(-1), reduction)
+
+
+@register("poisson_nll_loss")
+def poisson_nll_loss(input, label, log_input=True, full=False,  # noqa: A002
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label + epsilon) - label
+                    + 0.5 * jnp.log(2 * jnp.pi * (label + epsilon)))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@register("gaussian_nll_loss")
+def gaussian_nll_loss(input, label, variance, full=False,  # noqa: A002
+                      epsilon=1e-6, reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+    return _reduce(loss, reduction)
+
+
+@register("log_loss")
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    return (-label * jnp.log(input + epsilon)
+            - (1 - label) * jnp.log(1 - input + epsilon))
+
+
+@register("dice_loss")
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    lab = jax.nn.one_hot(label.squeeze(-1), input.shape[-1],
+                         dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, reduce_dims)
+    union = jnp.sum(input, reduce_dims) + jnp.sum(lab, reduce_dims)
+    return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+
+
+@register("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), -1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), -1))) * 0.25
+    sim = anchor @ positive.T
+    lab = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    lab = lab / jnp.sum(lab, -1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, -1)
+    return -jnp.mean(jnp.sum(lab * logp, -1)) + reg
+
+
+@register("triplet_margin_with_distance_loss")
+def triplet_margin_with_distance_loss(input, positive,  # noqa: A002
+                                      negative, distance_function=None,
+                                      margin=1.0, swap=False,
+                                      reduction="mean"):
+    dist = distance_function or (
+        lambda a, b: jnp.sqrt(jnp.sum(jnp.square(a - b), -1) + 1e-12))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+@register("feature_alpha_dropout")
+def feature_alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+    keep = (jax.random.uniform(rnd.next_key(), shape) >= p).astype(x.dtype)
+    a = (1.0 / jnp.sqrt((alpha_p ** 2 * p + 1) * (1 - p))).astype(x.dtype)
+    b = -a * alpha_p * p
+    return a * (x * keep + alpha_p * (1 - keep)) + b
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward algorithm in log space via lax.scan (reference
+    warpctc-backed phi ctc kernel; here the standard alpha recursion is
+    XLA-compiled — TPU-native, no custom kernel needed).
+
+    log_probs: [T, N, C] (paddle layout) raw logits or log-probs; labels
+    [N, S] padded with anything beyond label_lengths.
+    """
+    lp = unwrap(log_probs) if isinstance(log_probs, Tensor) else log_probs
+    lb = unwrap(labels) if isinstance(labels, Tensor) else labels
+    il = unwrap(input_lengths) if isinstance(input_lengths, Tensor) \
+        else input_lengths
+    ll = unwrap(label_lengths) if isinstance(label_lengths, Tensor) \
+        else label_lengths
+
+    def fn(lp, lb, il, ll):
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), -1)
+        T, N, C = lp.shape
+        S = lb.shape[1]
+        # extended label seq: blank, l1, blank, l2, ... blank  (len 2S+1)
+        ext = jnp.full((N, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lb.astype(jnp.int32))
+        ext_len = 2 * ll.astype(jnp.int32) + 1
+        neg_inf = jnp.float32(-1e30)
+
+        # can-skip mask: a[s] may come from a[s-2] when ext[s] != ext[s-2]
+        # and ext[s] != blank
+        skip_ok = jnp.concatenate(
+            [jnp.zeros((N, 2), bool),
+             (ext[:, 2:] != ext[:, :-2]) & (ext[:, 2:] != blank)], axis=1)
+
+        emit0 = lp[0][jnp.arange(N)[:, None], ext]  # [N, 2S+1]
+        alpha0 = jnp.where(jnp.arange(2 * S + 1)[None, :] < 2,
+                           emit0, neg_inf)
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+            a_prev2 = jnp.where(skip_ok, a_prev2, neg_inf)
+            m = jnp.maximum(jnp.maximum(alpha, a_prev1), a_prev2)
+            tot = m + jnp.log(jnp.exp(alpha - m) + jnp.exp(a_prev1 - m)
+                              + jnp.exp(a_prev2 - m) + 1e-38)
+            emit = lp_t[jnp.arange(N)[:, None], ext]
+            return tot + emit, tot + emit
+
+        alphas_last, hist = jax.lax.scan(step, alpha0, lp[1:])
+        hist = jnp.concatenate([alpha0[None], hist], 0)   # [T, N, 2S+1]
+        # pick alpha at t = input_length-1, s in {ext_len-1, ext_len-2}
+        tidx = jnp.clip(il.astype(jnp.int32) - 1, 0, T - 1)
+        at_t = hist[tidx, jnp.arange(N)]                  # [N, 2S+1]
+        aN = at_t[jnp.arange(N), jnp.clip(ext_len - 1, 0, 2 * S)]
+        aN1 = at_t[jnp.arange(N), jnp.clip(ext_len - 2, 0, 2 * S)]
+        # empty targets: ext_len == 1, the final-blank path is the only
+        # one — exclude the clipped duplicate (else loss is log(2) small)
+        aN1 = jnp.where(ext_len >= 2, aN1, neg_inf)
+        m = jnp.maximum(aN, aN1)
+        ll_total = m + jnp.log(jnp.exp(aN - m) + jnp.exp(aN1 - m) + 1e-38)
+        loss = -ll_total
+        if norm_by_times:
+            loss = loss / jnp.maximum(il.astype(jnp.float32), 1.0)
+        return loss
+
+    loss = dispatch(fn, log_probs, labels, input_lengths, label_lengths,
+                    nondiff_args=(1, 2, 3), name="ctc_loss")
+    return _reduce_t(loss, reduction)
+
+
+def _reduce_t(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+from ..ops.registry import register_direct as _rdirect  # noqa: E402
+
+_rdirect("ctc_loss", ctc_loss)

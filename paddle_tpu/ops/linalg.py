@@ -257,3 +257,83 @@ def einsum(equation, *operands):
 from .registry import register_direct  # noqa: E402
 
 register_direct("einsum", einsum)
+
+
+# ------------------------------------------------------- linalg tail
+
+
+@register("addmm", method=True)
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@register("baddbmm", method=True)
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register("vander")
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@register("cdist")
+def cdist(x, y, p=2.0):
+    d = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, -1) + 1e-30)
+    return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+
+@register("pdist")
+def pdist(x, p=2.0):
+    n = x.shape[0]
+    iu, ju = jnp.triu_indices(n, k=1)
+    d = x[iu] - x[ju]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(d * d, -1) + 1e-30)
+    return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+
+@register("renorm", method=True)
+def renorm(x, p, axis, max_norm):
+    xm = jnp.moveaxis(x, axis, 0)
+    flat = xm.reshape(xm.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, -1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-12), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(xm.shape), 0, axis)
+
+
+@register("cholesky_inverse")
+def cholesky_inverse(x, upper=False):
+    a = x @ x.T if not upper else x.T @ x
+    return jnp.linalg.inv(a)
+
+
+@register("lu_unpack", nondiff_args=(1,))
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """paddle.linalg.lu_unpack parity: supports arbitrary batch dims via
+    vmap; honours the unpack flags (None placeholders when off)."""
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+
+    def one(a, piv):
+        L = jnp.tril(a[:, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        U = jnp.triu(a[:k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jnp.eye(m, dtype=a.dtype)[perm].T
+        return P, L, U
+
+    fn = one
+    for _ in lu_data.shape[:-2]:
+        fn = jax.vmap(fn)
+    P, L, U = fn(lu_data, lu_pivots.astype(jnp.int32) - 1)
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)

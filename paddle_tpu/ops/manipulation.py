@@ -576,3 +576,110 @@ def atleast_2d(x):
 @register("atleast_3d")
 def atleast_3d(x):
     return jnp.atleast_3d(x)
+
+
+# ------------------------------------------ reshaping/stacking tail
+# (reference python/paddle/tensor/manipulation.py tail)
+
+
+@register("unflatten", method=True)
+def unflatten(x, axis, shape):
+    ax = axis % x.ndim
+    new = list(x.shape[:ax]) + list(shape) + list(x.shape[ax + 1:])
+    return x.reshape(new)
+
+
+@register("view", method=True)
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return x.reshape(list(shape_or_dtype))
+    return x.view(shape_or_dtype) if hasattr(x, "view") else \
+        x.astype(shape_or_dtype)
+
+
+@register("as_strided", method=True)
+def as_strided(x, shape, stride, offset=0):
+    flat = x.reshape(-1)
+    idx = offset + sum(
+        jnp.arange(s).reshape([-1 if i == d else 1
+                               for i in range(len(shape))]) * st
+        for d, (s, st) in enumerate(zip(shape, stride)))
+    return flat[idx.reshape(-1)].reshape(list(shape))
+
+
+@register("tensor_split", nondiff_args=(1,))
+def tensor_split(x, num_or_indices, axis=0):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=axis)
+    return jnp.split(x, num_or_indices, axis=axis)
+
+
+@register("hsplit", nondiff_args=(1,))
+def hsplit(x, num_or_indices):
+    return jnp.hsplit(x, num_or_indices)
+
+
+@register("vsplit", nondiff_args=(1,))
+def vsplit(x, num_or_indices):
+    return jnp.vsplit(x, num_or_indices)
+
+
+@register("dsplit", nondiff_args=(1,))
+def dsplit(x, num_or_indices):
+    return jnp.dsplit(x, num_or_indices)
+
+
+def _stack_list(fn):
+    def op(x, name=None):
+        from ..core.tensor import Tensor, dispatch
+        vals = list(x)
+        return dispatch(lambda *vs: fn(vs), *vals, name=name)
+    return op
+
+
+from .registry import register_direct as _rd  # noqa: E402
+
+_rd("column_stack", _stack_list(jnp.column_stack))
+_rd("row_stack", _stack_list(jnp.vstack))
+_rd("dstack", _stack_list(jnp.dstack))
+_rd("hstack", _stack_list(jnp.hstack))
+_rd("vstack", _stack_list(jnp.vstack))
+
+
+@register("fliplr", method=True)
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+@register("flipud", method=True)
+def flipud(x):
+    return jnp.flipud(x)
+
+
+@register("block_diag")
+def block_diag(*inputs):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+@register("take", method=True, nondiff_args=(1,))
+def take(x, index, mode="raise"):
+    m = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
+    return jnp.take(x.reshape(-1), index, mode=m)
+
+
+@register("index_fill", method=True, nondiff_args=(1,))
+def index_fill(x, index, axis, value):
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim   # `slice` = the paddle op here
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+@register("masked_scatter", method=True, nondiff_args=(1,))
+def masked_scatter(x, mask, value):
+    # paddle semantics: fill masked slots with value's leading elements in
+    # row-major order
+    flat_m = mask.reshape(-1)
+    pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+    src = value.reshape(-1)[jnp.clip(pos, 0, value.size - 1)]
+    return jnp.where(flat_m, src, x.reshape(-1)).reshape(x.shape)

@@ -598,3 +598,162 @@ def bitwise_left_shift(x, y):
 @register("bitwise_right_shift")
 def bitwise_right_shift(x, y):
     return jnp.right_shift(x, y)
+
+
+# -------------------------------------------------- special/extra elementwise
+# (reference python/paddle/tensor/math.py tail + ops.yaml special functions)
+
+
+@register("deg2rad", method=True)
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@register("rad2deg", method=True)
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@register("xlogy")
+def xlogy(x, y):
+    from jax.scipy.special import xlogy as _x
+    return _x(x, y)
+
+
+@register("sgn", method=True)
+def sgn(x):
+    return jnp.sign(x)
+
+
+@register("positive")
+def positive(x):
+    return jnp.positive(x)
+
+
+@register("negative", method=True)
+def negative(x):
+    return jnp.negative(x)
+
+
+@register("i0", method=True)
+def i0(x):
+    from jax.scipy.special import i0 as _i0
+    return _i0(x)
+
+
+@register("i0e", method=True)
+def i0e(x):
+    from jax.scipy.special import i0e as _i
+    return _i(x)
+
+
+@register("i1", method=True)
+def i1(x):
+    from jax.scipy.special import i1 as _i
+    return _i(x)
+
+
+@register("i1e", method=True)
+def i1e(x):
+    from jax.scipy.special import i1e as _i
+    return _i(x)
+
+
+@register("gammaln", method=True)
+def gammaln(x):
+    from jax.scipy.special import gammaln as _g
+    return _g(x)
+
+
+@register("gammainc", method=True)
+def gammainc(x, y):
+    from jax.scipy.special import gammainc as _g
+    return _g(x, y)
+
+
+@register("gammaincc", method=True)
+def gammaincc(x, y):
+    from jax.scipy.special import gammaincc as _g
+    return _g(x, y)
+
+
+@register("multigammaln")
+def multigammaln(x, p):
+    from jax.scipy.special import multigammaln as _g
+    return _g(x, int(p))
+
+
+@register("nextafter", method=True)
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@register("ldexp", method=True)
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32) if hasattr(y, "astype") else y)
+
+
+@register("frexp", method=True)
+def frexp(x):
+    return jnp.frexp(x)
+
+
+@register("isposinf", method=True)
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@register("isneginf", method=True)
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@register("isreal", method=True)
+def isreal(x):
+    return jnp.isreal(x)
+
+
+@register("isin", nondiff_args=(1,))
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(x, test_x, invert=invert)
+
+
+@register("diff", method=True)
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@register("trapezoid")
+def trapezoid(y, x=None, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, x=x, dx=dx, axis=axis)
+
+
+@register("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    # no jax.scipy cumulative_trapezoid: composed from the trapezoid rule
+    ya = jnp.moveaxis(y, axis, -1)
+    avg = (ya[..., 1:] + ya[..., :-1]) / 2.0
+    if x is not None:
+        xa = jnp.moveaxis(x, axis, -1) if getattr(x, "ndim", 0) else x
+        d = jnp.diff(xa, axis=-1)
+        seg = avg * d
+    else:
+        seg = avg * dx
+    return jnp.moveaxis(jnp.cumsum(seg, -1), -1, axis)
+
+
+@register("quantile", method=True)
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+@register("nanquantile", method=True)
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                           method=interpolation)
+
+
+@register("nanmedian", method=True)
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)

@@ -626,6 +626,117 @@ _add("put_along_axis",
       "values": lambda: np.full((3, 1), 9.0, np.float32)},
      attrs={"axis": 1})
 
+# =================================================== round-3 op-tail batch
+# (reference python/paddle/tensor/{math,manipulation,linalg}.py tail)
+
+_add("deg2rad", np.deg2rad, {"x": F(400)})
+_add("rad2deg", np.rad2deg, {"x": F(401)})
+_add("sgn", np.sign, {"x": F(402)})
+_add("negative", np.negative, {"x": F(403)})
+_add("positive", np.positive, {"x": F(404)})
+_add("nextafter", np.nextafter, {"x": F(405), "y": F(406)})
+_add("ldexp", lambda x, y: np.ldexp(x, y.astype(np.int32)),
+     {"x": F(407), "y": I(408, lo=-3, hi=4, dtype=np.int32)})
+_add("frexp", lambda x: np.frexp(x), {"x": F(409)})
+_add("isposinf",
+     lambda x: np.isposinf(x),
+     {"x": lambda: np.array([1.0, np.inf, -np.inf, np.nan], np.float32)})
+_add("isneginf",
+     lambda x: np.isneginf(x),
+     {"x": lambda: np.array([1.0, np.inf, -np.inf, np.nan], np.float32)})
+_add("isin", lambda x, t: np.isin(x, t),
+     {"x": I(410, hi=6), "test_x": lambda: np.array([1, 3], np.int64)})
+_add("diff", lambda x: np.diff(x), {"x": F(411)})
+_add("trapezoid", lambda y: np.trapz(y), {"y": F(412, (5,))})
+_add("quantile", lambda x: np.quantile(x, 0.5),
+     {"x": F(413)}, attrs={"q": 0.5})
+_add("nanquantile", lambda x: np.nanquantile(x, 0.5),
+     {"x": F(414)}, attrs={"q": 0.5})
+_add("nanmedian", lambda x: np.nanmedian(x),
+     {"x": lambda: np.array([[1.0, np.nan, 3.0],
+                             [4.0, 5.0, np.nan]], np.float32)})
+_add("xlogy", lambda x, y: np.where(x == 0, 0.0, x * np.log(y)),
+     {"x": F(415, shape=(4, 6), lo=0.0, hi=2.0), "y": FP(416)}, atol=1e-4)
+if _sps is not None:
+    _add("gammaln", _sps.gammaln, {"x": FP(417)}, atol=1e-4)
+    _add("gammainc", _sps.gammainc, {"x": FP(418), "y": FP(419)}, atol=1e-4)
+    _add("gammaincc", _sps.gammaincc, {"x": FP(420), "y": FP(421)},
+         atol=1e-4)
+    _add("i0", _sps.i0, {"x": F(422)}, atol=1e-4)
+    _add("i0e", _sps.i0e, {"x": F(423)}, atol=1e-5)
+    _add("i1", _sps.i1, {"x": F(424)}, atol=1e-4)
+    _add("i1e", _sps.i1e, {"x": F(425)}, atol=1e-5)
+    _add("multigammaln", lambda x: _sps.multigammaln(x, 2),
+         {"x": F(426, lo=1.2, hi=4.0)}, attrs={"p": 2}, atol=1e-4)
+_add("unflatten", lambda x: x.reshape(4, 2, 3), {"x": F(427, (4, 6))},
+     attrs={"axis": 1, "shape": (2, 3)})
+_add("fliplr", np.fliplr, {"x": F(428)})
+_add("flipud", np.flipud, {"x": F(429)})
+_add("take", lambda x, i: np.take(x.reshape(-1), i),
+     {"x": F(430), "index": lambda: np.array([0, 5, 11], np.int64)})
+_add("index_fill",
+     lambda x, i: (lambda o: (o.__setitem__((slice(None), i), 7.0), o)[1])(
+         x.copy()),
+     {"x": F(431), "index": lambda: np.array([0, 2], np.int64)},
+     attrs={"axis": 1, "value": 7.0})
+_add("tensor_split", lambda x: tuple(np.array_split(x, 3, 0)),
+     {"x": F(432, (6, 4))}, attrs={"num_or_indices": 3})
+_add("hsplit", lambda x: tuple(np.hsplit(x, 2)), {"x": F(433, (4, 6))},
+     attrs={"num_or_indices": 2})
+_add("vsplit", lambda x: tuple(np.vsplit(x, 2)), {"x": F(434, (4, 6))},
+     attrs={"num_or_indices": 2})
+_add("column_stack", lambda a, b: np.column_stack([a, b]),
+     {"x": F(435, (4,)), "y": F(436, (4,))},
+     call=lambda op, ts, at: op([ts[0], ts[1]]))
+_add("hstack", lambda a, b: np.hstack([a, b]),
+     {"x": F(437, (4,)), "y": F(438, (4,))},
+     call=lambda op, ts, at: op([ts[0], ts[1]]))
+_add("vstack", lambda a, b: np.vstack([a, b]),
+     {"x": F(439, (4,)), "y": F(440, (4,))},
+     call=lambda op, ts, at: op([ts[0], ts[1]]))
+_add("dstack", lambda a, b: np.dstack([a, b]),
+     {"x": F(441, (4,)), "y": F(442, (4,))},
+     call=lambda op, ts, at: op([ts[0], ts[1]]))
+_add("block_diag", lambda a, b: np.block(
+    [[a, np.zeros((a.shape[0], b.shape[1]))],
+     [np.zeros((b.shape[0], a.shape[1])), b]]).astype(np.float32),
+     {"x": F(443, (2, 2)), "y": F(444, (3, 3))},
+     call=lambda op, ts, at: op(ts[0], ts[1]))
+_add("addmm", lambda i, x, y: i + x @ y,
+     {"input": F(445, (4, 4)), "x": F(446, (4, 5)), "y": F(447, (5, 4))},
+     atol=1e-4)
+_add("baddbmm", lambda i, x, y: i + np.matmul(x, y),
+     {"input": F(448, (2, 3, 3)), "x": F(449, (2, 3, 4)),
+      "y": F(450, (2, 4, 3))}, atol=1e-4)
+_add("vander", lambda x: np.vander(x), {"x": F(451, (5,))}, atol=1e-4)
+_add("cdist",
+     lambda a, b: np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                          + 1e-30),
+     {"x": F(452, (4, 3)), "y": F(453, (5, 3))}, atol=1e-4)
+_add("pdist",
+     lambda a: np.sqrt(((a[:, None, :] - a[None, :, :]) ** 2).sum(-1)
+                       + 1e-30)[np.triu_indices(a.shape[0], 1)],
+     {"x": F(454, (5, 3))}, atol=1e-4)
+_add("renorm",
+     lambda x: x * np.minimum(
+         1.0, 1.0 / (np.abs(x ** 2).sum(1) ** 0.5 + 1e-12))[:, None],
+     {"x": F(455, (4, 6))}, attrs={"p": 2.0, "axis": 0, "max_norm": 1.0},
+     atol=1e-4)
+_add("cholesky_inverse",
+     lambda L: np.linalg.inv(L @ L.T),
+     {"x": lambda: np.linalg.cholesky(
+         (lambda a: a @ a.T + 3 * np.eye(3))(
+             _rng(456).randn(3, 3)).astype(np.float32))}, atol=1e-2,
+     rtol=1e-3)
+_add("masked_scatter",
+     lambda x, m, v: (lambda o: (o.__setitem__(
+         m, v.reshape(-1)[:int(m.sum())]), o)[1])(x.copy()),
+     {"x": F(457), "mask": B(458), "value": F(459, (24,))})
+_add("cumulative_trapezoid",
+     lambda y: np.array([np.trapz(y[:i + 2]) for i in range(len(y) - 1)],
+                        np.float32),
+     {"y": F(460, (6,))}, atol=1e-4)
+
 # filter any rows whose ref ended up None (missing scipy)
 TABLE = [c for c in TABLE if c is not None and c.ref is not None]
 

@@ -76,10 +76,11 @@ def install_tensor_methods():
             # identity: the new node's parent must be the old value, not
             # the rebound self (self-referential parent would cut the
             # upstream graph out of backward)
+            old_node, old_idx = self._node, self._out_index
             snapshot = wrap(unwrap(self),
                             stop_gradient=self.stop_gradient)
-            snapshot._node = self._node
-            snapshot._out_index = self._out_index
+            snapshot._node = old_node
+            snapshot._out_index = old_idx
             out = op(snapshot, *args, **kwargs)
             # adopt the output tensor wholesale: raw value (cast_/
             # squeeze_ legally change dtype/shape) AND the tape node
@@ -88,21 +89,33 @@ def install_tensor_methods():
                 self._node = out._node
                 self._out_index = out._out_index
                 self.stop_gradient = out.stop_gradient
-                if self._hooks and self._node is not None:
-                    # leaf hooks must survive the inplace rebind: migrate
-                    # them onto the new producing node's output slot so
-                    # they fire on the post-mutation gradient (paddle
-                    # semantics: hooks track the tensor, not the node)
+                # hooks must survive the inplace rebind and fire on the
+                # POST-mutation gradient (paddle semantics: hooks track the
+                # tensor, not the node). Two sources: leaf hooks stored on
+                # the tensor, and non-leaf hooks on the old node's slot.
+                hooks = self._hooks
+                self._hooks = None
+                if old_node is not None and old_node.out_hooks:
+                    moved = old_node.out_hooks.pop(old_idx, None)
+                    if moved:
+                        # keep list identity where possible so existing
+                        # _HookHandles still remove from the live list
+                        if hooks:
+                            hooks.extend(moved)
+                        else:
+                            hooks = moved
+                if hooks and self._node is not None:
                     if self._node.out_hooks is None:
                         self._node.out_hooks = {}
                     slot = self._node.out_hooks.get(self._out_index)
                     if slot is None:
                         # reuse the list so existing _HookHandles still
                         # remove from the live collection
-                        self._node.out_hooks[self._out_index] = self._hooks
+                        self._node.out_hooks[self._out_index] = hooks
                     else:
-                        slot.extend(self._hooks)
-                    self._hooks = None
+                        slot.extend(hooks)
+                elif hooks:
+                    self._hooks = hooks
             return self
         return method
 

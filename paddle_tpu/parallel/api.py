@@ -105,11 +105,17 @@ def scale_and_shard_batch(batch, mesh: HybridMesh, spec=None):
 
 def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
                         zero_stage=0, remat=False, batch_spec=None,
-                        donate=True, grad_clip_norm=None):
+                        donate=True, grad_clip_norm=None, offload=False):
     """Build (step_fn, params, opt_state, shardings).
 
     step_fn(params, opt_state, batch, step_i, rng) -> (loss, params, state)
     jitted with explicit in/out shardings over `mesh`.
+
+    ``offload=True`` keeps the (sharded) optimizer state in host memory
+    (``pinned_host`` memory kind) between steps — the TPU equivalent of the
+    reference's ZeRO CPU offload (group_sharded_optimizer_stage2.py offload
+    flag): HBM holds only params/grads/activations, and XLA streams the
+    state in/out around the fused update.
     """
     from ..jit import functional_call
 
@@ -117,8 +123,19 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
     init_fn, update_fn = optimizer.functional()
     opt_state = init_fn(params)
     s_shard = opt_state_shardings(opt_state, p_shard, mesh, zero_stage)
+    s_host = None
+    if offload:
+        # host layout: array-shaped state (moments, master weights) in
+        # pinned_host; scalar counters stay on device (they are bytes, and
+        # scalar placement annotations trip the SPMD partitioner)
+        s_host = jax.tree_util.tree_map(
+            lambda leaf, sh: (sh.with_memory_kind("pinned_host")
+                              if getattr(leaf, "ndim", 0) >= 1 else sh),
+            opt_state, s_shard,
+            is_leaf=lambda x: isinstance(x, jax.Array))
     opt_state = jax.tree_util.tree_map(
-        lambda leaf, sh: jax.device_put(leaf, sh), opt_state, s_shard,
+        lambda leaf, sh: jax.device_put(leaf, sh), opt_state,
+        s_host if offload else s_shard,
         is_leaf=lambda x: isinstance(x, jax.Array))
     bspec = batch_spec or P("dp")
 
@@ -149,6 +166,22 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
         out_shardings=out_shardings,
         donate_argnums=(0, 1) if donate else (),
     )
+    if offload:
+        # the jitted step is pure device compute; the wrapper moves state
+        # host->device before and device->host after, so between steps HBM
+        # holds no optimizer state (in-jit memory-kind annotations are not
+        # portable across backends for partially-replicated/scalar leaves)
+        def offload_step(params, opt_state, batch, step_i, rng):
+            opt_state = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.device_put(leaf, sh), opt_state,
+                s_shard, is_leaf=lambda x: isinstance(x, jax.Array))
+            loss, new_p, new_s = jit_step(params, opt_state, batch,
+                                          step_i, rng)
+            new_s = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.device_put(leaf, sh), new_s, s_host,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+            return loss, new_p, new_s
+        return offload_step, params, opt_state, (p_shard, s_host)
     return jit_step, params, opt_state, (p_shard, s_shard)
 
 

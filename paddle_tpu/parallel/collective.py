@@ -230,6 +230,10 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """paddle.distributed.scatter parity. In shard_map: the src rank's
+    stacked inputs are broadcast (all_gather + select, same pattern as
+    broadcast above) and every rank keeps its own slice — XLA folds the
+    redundant transfer into one collective."""
     ax = axis_or_none(group)
     if ax is None:
         if tensor_list:
@@ -238,8 +242,24 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
                 tensor._replace_value(unwrap(val))
             return tensor
         return tensor
-    raise NotImplementedError(
-        "in-shard_map scatter: express as slicing the source shard")
+    if tensor_list is None:
+        raise ValueError("scatter inside shard_map needs tensor_list "
+                         "(stacked array or per-rank list)")
+    if isinstance(tensor_list, (list, tuple)):
+        stacked = jnp.stack([unwrap(t) for t in tensor_list])
+    else:
+        stacked = unwrap(tensor_list)
+
+    def fn(v):
+        v = jax.lax.all_gather(v, ax)[src]      # src rank's stack, everywhere
+        idx = jax.lax.axis_index(ax)
+        return jax.lax.dynamic_index_in_dim(v, idx, keepdims=False)
+
+    out = dispatch(fn, stacked, name="scatter")
+    if isinstance(tensor, Tensor):
+        tensor._replace_value(unwrap(out))
+        return tensor
+    return out
 
 
 def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):

@@ -66,6 +66,7 @@ class _GroupShardedModel:
         self._layer = layer
         self._optimizer = optimizer
         self._stage = _LEVEL_TO_STAGE[level]
+        self._offload = offload
         mesh = get_mesh()
         degree = mesh.degree("sharding") if mesh else 1
         self.partition = GroupShardedPartition(
@@ -83,6 +84,7 @@ class _GroupShardedModel:
 
     def build_train_step(self, loss_fn, mesh=None, **kw):
         mesh = mesh or get_mesh()
+        kw.setdefault("offload", self._offload)
         return parallel_train_step(self._layer, loss_fn, self._optimizer,
                                    mesh, zero_stage=self._stage, **kw)
 

@@ -202,6 +202,33 @@ def in_static_build():
     return _guard_depth > 0
 
 
+_static_mode = False
+
+
+def enable_static_mode():
+    """Global static mode (paddle.enable_static): ops on symbolic Variables
+    record into default_main_program without an explicit program_guard."""
+    global _guard_depth, _static_mode
+    if not _static_mode:
+        _static_mode = True
+        _guard_depth += 1
+        _install()
+
+
+def disable_static_mode():
+    """paddle.disable_static parity; no-op when not enabled."""
+    global _guard_depth, _static_mode
+    if _static_mode:
+        _static_mode = False
+        _guard_depth -= 1
+        if _guard_depth == 0:
+            set_static_recorder(None)
+
+
+def in_static_mode():
+    return _static_mode
+
+
 class _Recorder:
     """dispatch() hook: records ops touching symbolic Variables."""
 

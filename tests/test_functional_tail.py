@@ -1,0 +1,209 @@
+"""Round-3 functional tail: CTC, grid_sample, fold/unfold family, loss zoo
+(torch-CPU oracles, reference python/paddle/nn/functional/{loss,vision}.py).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_ctc_loss_matches_torch(rng):
+    T, N, C, S = 12, 3, 5, 4
+    logits = rng.normal(size=(T, N, C)).astype("float32")
+    labels = rng.integers(1, C, size=(N, S)).astype("int32")
+    il = np.array([12, 10, 8], "int32")
+    ll = np.array([4, 3, 2], "int32")
+    mine = F.ctc_loss(pt.to_tensor(logits), pt.to_tensor(labels),
+                      pt.to_tensor(il), pt.to_tensor(ll), blank=0,
+                      reduction="none")
+    ref = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), -1),
+        torch.tensor(labels.astype("int64")),
+        torch.tensor(il.astype("int64")),
+        torch.tensor(ll.astype("int64")), blank=0, reduction="none")
+    np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_ctc_loss_grad_finite(rng):
+    logits = pt.to_tensor(rng.normal(size=(6, 2, 4)).astype("float32"),
+                          stop_gradient=False)
+    labels = pt.to_tensor(np.array([[1, 2], [3, 1]], "int32"))
+    il = pt.to_tensor(np.array([6, 5], "int32"))
+    ll = pt.to_tensor(np.array([2, 2], "int32"))
+    loss = F.ctc_loss(logits, labels, il, ll)
+    loss.backward()
+    assert np.isfinite(logits.grad.numpy()).all()
+
+
+def test_grid_sample_matches_torch(rng):
+    x = rng.normal(size=(2, 3, 5, 6)).astype("float32")
+    grid = rng.uniform(-1, 1, size=(2, 4, 4, 2)).astype("float32")
+    for align in (True, False):
+        mine = F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid),
+                             align_corners=align)
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), align_corners=align,
+            padding_mode="zeros")
+        np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_grid_sample_nearest(rng):
+    x = rng.normal(size=(1, 2, 4, 4)).astype("float32")
+    grid = rng.uniform(-1, 1, size=(1, 3, 3, 2)).astype("float32")
+    mine = F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid),
+                         mode="nearest", align_corners=True)
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode="nearest",
+        align_corners=True, padding_mode="zeros")
+    np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fold_matches_torch(rng):
+    cols = rng.normal(size=(2, 3 * 2 * 2, 4)).astype("float32")
+    mine = F.fold(pt.to_tensor(cols), (4, 4), (2, 2), strides=2)
+    ref = torch.nn.functional.fold(torch.tensor(cols), (4, 4), (2, 2),
+                                   stride=2)
+    np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_max_unpool2d_matches_torch(rng):
+    xp = torch.tensor(rng.normal(size=(1, 2, 4, 4)).astype("float32"))
+    pooled, idx = torch.nn.functional.max_pool2d(xp, 2, return_indices=True)
+    ref = torch.nn.functional.max_unpool2d(pooled, idx, 2)
+    mine = F.max_unpool2d(pt.to_tensor(pooled.numpy()),
+                          pt.to_tensor(idx.numpy()), 2)
+    np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_loss_zoo_finite_and_reference(rng):
+    a = rng.normal(size=(4, 5)).astype("float32")
+    b = rng.normal(size=(4, 5)).astype("float32")
+    ta, tb = pt.to_tensor(a), pt.to_tensor(b)
+    # huber == torch huber
+    np.testing.assert_allclose(
+        float(F.huber_loss(ta, tb, delta=1.0).numpy()),
+        float(torch.nn.functional.huber_loss(torch.tensor(a),
+                                             torch.tensor(b))), rtol=1e-5)
+    # soft margin == torch
+    y = np.sign(b).astype("float32")
+    np.testing.assert_allclose(
+        float(F.soft_margin_loss(ta, pt.to_tensor(y)).numpy()),
+        float(torch.nn.functional.soft_margin_loss(torch.tensor(a),
+                                                   torch.tensor(y))),
+        rtol=1e-5)
+    # gaussian nll == torch
+    var = (np.abs(rng.normal(size=(4, 5))) + 0.1).astype("float32")
+    np.testing.assert_allclose(
+        float(F.gaussian_nll_loss(ta, tb, pt.to_tensor(var)).numpy()),
+        float(torch.nn.functional.gaussian_nll_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(var))),
+        rtol=1e-4, atol=1e-5)
+    # poisson nll == torch
+    lbl = np.abs(b).astype("float32")
+    np.testing.assert_allclose(
+        float(F.poisson_nll_loss(ta, pt.to_tensor(lbl)).numpy()),
+        float(torch.nn.functional.poisson_nll_loss(
+            torch.tensor(a), torch.tensor(lbl))), rtol=1e-4)
+    # multi-label soft margin == torch
+    ml = (rng.random((4, 5)) > 0.5).astype("float32")
+    np.testing.assert_allclose(
+        float(F.multi_label_soft_margin_loss(ta, pt.to_tensor(ml)).numpy()),
+        float(torch.nn.functional.multilabel_soft_margin_loss(
+            torch.tensor(a), torch.tensor(ml))), rtol=1e-4)
+    # triplet with distance == torch
+    n = rng.normal(size=(4, 5)).astype("float32")
+    np.testing.assert_allclose(
+        float(F.triplet_margin_with_distance_loss(
+            ta, tb, pt.to_tensor(n)).numpy()),
+        float(torch.nn.functional.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(n))), rtol=1e-4)
+
+
+def test_pixel_channel_ops(rng):
+    x = rng.normal(size=(2, 4, 4, 4)).astype("float32")
+    un = F.pixel_unshuffle(pt.to_tensor(x), 2)
+    ref = torch.nn.functional.pixel_unshuffle(torch.tensor(x), 2)
+    np.testing.assert_allclose(un.numpy(), ref.numpy(), rtol=1e-6)
+    cs = F.channel_shuffle(pt.to_tensor(x), 2)
+    ref = torch.nn.functional.channel_shuffle(torch.tensor(x), 2)
+    np.testing.assert_allclose(cs.numpy(), ref.numpy(), rtol=1e-6)
+    zp = F.zeropad2d(pt.to_tensor(x), (1, 2, 3, 4))
+    assert list(zp.shape) == [2, 4, 4 + 7, 4 + 3]
+
+
+def test_pairwise_distance(rng):
+    a = rng.normal(size=(4, 5)).astype("float32")
+    b = rng.normal(size=(4, 5)).astype("float32")
+    mine = F.pairwise_distance(pt.to_tensor(a), pt.to_tensor(b))
+    ref = torch.nn.functional.pairwise_distance(torch.tensor(a),
+                                                torch.tensor(b))
+    np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-4)
+
+
+def test_huber_loss_delta_scaling(rng):
+    a = rng.normal(size=(4, 5)).astype("float32") * 3
+    b = rng.normal(size=(4, 5)).astype("float32")
+    for delta in (0.5, 2.0):
+        mine = float(F.huber_loss(pt.to_tensor(a), pt.to_tensor(b),
+                                  delta=delta).numpy())
+        ref = float(torch.nn.functional.huber_loss(
+            torch.tensor(a), torch.tensor(b), delta=delta))
+        np.testing.assert_allclose(mine, ref, rtol=1e-5)
+
+
+def test_ctc_loss_empty_target(rng):
+    T, N, C = 8, 2, 4
+    logits = rng.normal(size=(T, N, C)).astype("float32")
+    labels = np.array([[1, 2], [0, 0]], "int32")
+    il = np.array([8, 8], "int32")
+    ll = np.array([2, 0], "int32")   # second sample: empty target
+    mine = F.ctc_loss(pt.to_tensor(logits), pt.to_tensor(labels),
+                      pt.to_tensor(il), pt.to_tensor(ll), reduction="none")
+    ref = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), -1),
+        torch.tensor(labels.astype("int64")),
+        torch.tensor(il.astype("int64")),
+        torch.tensor(ll.astype("int64")), reduction="none",
+        zero_infinity=False)
+    np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_grid_sample_reflection_and_border(rng):
+    x = rng.normal(size=(1, 2, 5, 5)).astype("float32")
+    grid = rng.uniform(-1.6, 1.6, size=(1, 4, 4, 2)).astype("float32")
+    for pm in ("reflection", "border"):
+        mine = F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid),
+                             padding_mode=pm, align_corners=True)
+        ref = torch.nn.functional.grid_sample(
+            torch.tensor(x), torch.tensor(grid), padding_mode=pm,
+            align_corners=True)
+        np.testing.assert_allclose(mine.numpy(), ref.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+    with pytest.raises(ValueError):
+        F.grid_sample(pt.to_tensor(x), pt.to_tensor(grid),
+                      padding_mode="bogus")
+
+
+def test_lu_unpack_batched(rng):
+    a = rng.normal(size=(3, 4, 4)).astype("float32")
+    ta = torch.tensor(a)
+    lu, piv = torch.linalg.lu_factor(ta)
+    P, L, U = torch.lu_unpack(lu, piv)
+    import paddle_tpu.ops.linalg as lin
+    mp, ml, mu = pt.ops.lu_unpack(pt.to_tensor(lu.numpy()),
+                                  pt.to_tensor(piv.numpy().astype("int32")))
+    np.testing.assert_allclose(mp.numpy(), P.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ml.numpy(), L.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(mu.numpy(), U.numpy(), rtol=1e-5, atol=1e-5)

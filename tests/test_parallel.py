@@ -174,3 +174,26 @@ def test_stream_namespace_parity():
     task = stream.all_reduce(t, sync_op=False, use_calc_stream=True)
     assert task.wait() and task.is_completed()
     np.testing.assert_allclose(t.numpy(), 1.0)  # 1-proc: identity
+
+
+def test_scatter_inside_shard_map():
+    """dist.scatter: rank r receives src's stacked slice r."""
+    mesh = dist.init_mesh(dp=8)
+
+    def body(stack):
+        out = dist.scatter(None, stack[0], src=0, group="dp")
+        return out[None]
+
+    # every rank holds the same stacked [8, 2] payload; rank r gets row r
+    payload = jnp.arange(16.0).reshape(8, 2)
+    f = jax.shard_map(body, mesh=mesh.mesh,
+                      in_specs=P("dp"),
+                      out_specs=P("dp"))
+    out = f(jnp.broadcast_to(payload, (8, 8, 2)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(payload))
+
+
+def test_scatter_eager_fallback():
+    t = pt.to_tensor([0.0, 0.0])
+    dist.scatter(t, [pt.to_tensor([5.0, 6.0])], src=0)
+    np.testing.assert_allclose(t.numpy(), [5.0, 6.0])

@@ -109,3 +109,28 @@ def test_int_segment_max_empty_segment():
     out = G.segment_max(pt.to_tensor(np.array([5, 7, 9], np.int32)),
                         pt.to_tensor(np.array([0, 0, 2])))
     np.testing.assert_array_equal(out.numpy(), [7, 0, 9])
+
+
+def test_enable_static_global_mode():
+    """paddle.enable_static(): build + run a program with no program_guard
+    (reference workflow: enable_static -> static.data -> Executor.run)."""
+    import paddle_tpu as pt
+    import paddle_tpu.static as static
+
+    main = static.Program()
+    pt.enable_static()
+    try:
+        assert not pt.in_dynamic_mode()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3])
+            y = (x * 2.0 + 1.0)
+        exe = static.Executor()
+        xin = np.arange(6, dtype=np.float32).reshape(2, 3)
+        (out,) = exe.run(main, feed={"x": xin}, fetch_list=[y])
+        np.testing.assert_allclose(out, xin * 2 + 1, rtol=1e-6)
+    finally:
+        pt.disable_static()
+    assert pt.in_dynamic_mode()
+    # eager path restored
+    t = pt.to_tensor([1.0]) * 3
+    np.testing.assert_allclose(t.numpy(), [3.0])

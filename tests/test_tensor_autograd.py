@@ -237,3 +237,15 @@ class TestRegisterHook:
         x.add_(pt.to_tensor([1.0, 1.0]))
         x.sum().backward()
         assert calls == [1]
+
+    def test_nonleaf_hook_survives_inplace_rebind(self):
+        """A hook on a non-leaf tensor follows the tensor through an
+        inplace op (fires on the post-mutation gradient)."""
+        seen = []
+        x = pt.to_tensor([2.0], stop_gradient=False)
+        y = x * 2.0                  # non-leaf
+        y.register_hook(lambda g: seen.append(float(g.numpy()[0])))
+        y.add_(pt.to_tensor([1.0]))  # y = 2x + 1, rebinds y's node
+        (y * 3.0).sum().backward()
+        assert seen == [3.0]         # grad wrt post-mutation y
+        np.testing.assert_allclose(x.grad.numpy(), [6.0], rtol=1e-6)

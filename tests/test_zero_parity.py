@@ -47,3 +47,46 @@ def test_zero_stage_matches_dp(stage):
     zs = _train(stage)
     np.testing.assert_allclose(zs, base, rtol=2e-4, atol=1e-5)
     assert base[-1] < base[0]
+
+
+def test_zero_offload_parity_and_host_placement():
+    """offload=True: optimizer state lives in pinned_host between steps and
+    training matches the on-device run bit-for-bit semantics (reference
+    group_sharded offload flag)."""
+    mesh = dist.init_mesh(dp=2, sharding=2)
+    net, opt = _make(0)
+    from paddle_tpu.parallel.api import parallel_train_step
+    step_fn, params, opt_state, (p_sh, s_sh) = parallel_train_step(
+        net, _loss_fn, opt, mesh, zero_stage=2, offload=True)
+    leaves = [l for l in jax.tree_util.tree_leaves(opt_state)
+              if hasattr(l, "sharding") and l.ndim >= 1]
+    assert leaves and all(
+        l.sharding.memory_kind == "pinned_host" for l in leaves)
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(5):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 8).astype(np.float32)
+        batch = {"inputs": (x,), "labels": (y,)}
+        loss, params, opt_state = step_fn(params, opt_state, batch,
+                                          i + 1, None)
+        losses.append(float(loss))
+    # new state is streamed back to host memory every step
+    leaves = [l for l in jax.tree_util.tree_leaves(opt_state)
+              if hasattr(l, "sharding") and l.ndim >= 1]
+    assert all(l.sharding.memory_kind == "pinned_host" for l in leaves)
+    np.testing.assert_allclose(losses, _train(2), rtol=2e-4, atol=1e-5)
+
+
+def test_group_sharded_offload_api():
+    """group_sharded_parallel(offload=True) plumbs through to the step."""
+    mesh = dist.init_mesh(dp=2, sharding=2)
+    net, opt = _make(1)
+    model, opt2, _ = dist.sharding.group_sharded_parallel(
+        net, opt, "os_g", offload=True)
+    step_fn, params, opt_state, _ = model.build_train_step(_loss_fn,
+                                                           mesh=mesh)
+    leaves = [l for l in jax.tree_util.tree_leaves(opt_state)
+              if hasattr(l, "sharding") and l.ndim >= 1]
+    assert leaves and all(
+        l.sharding.memory_kind == "pinned_host" for l in leaves)
