@@ -128,9 +128,24 @@ def main(config_name="gpt2"):
         return -jnp.take_along_axis(logp, lb[..., None], -1).mean()
 
     is_moe = config_name == "moe"
+    # fused chunked linear+CE (ops/fused_ce.py): avoids materializing the
+    # [B,S,V] fp32 logits; enabled for the dense LM configs
+    import os as _os
+    # default off until A/B-measured on the real chip (flip after
+    # benchmarks/fused_ce_bench.py shows a win)
+    fused_ce = (config_name in ("gpt2", "llama350m")
+                and _os.environ.get("PT_BENCH_FUSED_CE", "0") != "0")
 
     def step(params, state, ids, i):
         def compute(ps):
+            if fused_ce:
+                from paddle_tpu.ops.fused_ce import (
+                    fused_linear_cross_entropy)
+                hidden = functional_call(model, ps, ids, return_hidden=True)
+                w = (ps["lm_head_weight"].T if config_name == "gpt2"
+                     else ps["lm_head.weight"])
+                return fused_linear_cross_entropy(
+                    hidden[:, :-1], w, ids[:, 1:], chunk_size=2046)
             logits = functional_call(model, ps, ids)
             l = loss_fn(logits, ids)
             if is_moe:

@@ -1,0 +1,99 @@
+"""A/B microbench: GPT-2 345M train step, materialized-logits CE vs fused
+chunked linear+CE (ops/fused_ce.py). Run on the real TPU chip:
+
+    python benchmarks/fused_ce_bench.py [batch] [chunk]
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def main(batch=8, chunk=2046):
+    import contextlib
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.jit import functional_call
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_345m
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+    seq = 1024
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except Exception:
+        cpu = None
+    with (jax.default_device(cpu) if cpu is not None
+          else contextlib.nullcontext()):
+        cfg = gpt2_345m(dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.astype("bfloat16")
+        model.eval()
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        init_fn, update_fn = opt.functional()
+        params = model.raw_params()
+        state = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32), init_fn(params))
+    dev = jax.devices()[0]
+    params = jax.device_put(params, dev)
+    state = jax.device_put(state, dev)
+    ids = jax.device_put(
+        np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32),
+        dev)
+
+    def loss_materialized(ps):
+        logits = functional_call(model, ps, ids)
+        lg = logits[:, :-1]
+        lb = ids[:, 1:]
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+        return -jnp.take_along_axis(logp, lb[..., None], -1).mean()
+
+    def loss_fused(ps):
+        hidden = functional_call(model, ps, ids, return_hidden=True)
+        w = ps["lm_head_weight"].T          # tied head: [V,H] -> [H,V]
+        return fused_linear_cross_entropy(hidden[:, :-1], w, ids[:, 1:],
+                                          chunk_size=chunk)
+
+    results = {}
+    for name, loss_fn in (("materialized", loss_materialized),
+                          ("fused", loss_fused)):
+        def step(params, state, i, _loss=loss_fn):
+            loss, grads = jax.value_and_grad(_loss)(params)
+            new_p, new_s = update_fn(grads, params, state, step=i)
+            return loss, new_p, new_s
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        p, s = params, state
+        loss, p, s = jstep(p, s, 1)
+        float(loss)
+        loss, p, s = jstep(p, s, 2)
+        float(loss)
+        iters = 10
+        t0 = time.perf_counter()
+        for i in range(iters):
+            loss, p, s = jstep(p, s, i + 3)
+        lv = float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        toks = batch * seq / dt
+        results[name] = (dt * 1000, toks)
+        print(f"{name}: {dt*1000:.1f} ms/step, {toks:,.0f} tok/s, "
+              f"loss={lv:.4f}", flush=True)
+        # refresh donated buffers for the next variant
+        params = jax.device_put(model.raw_params(), dev)
+        state = jax.device_put(jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32),
+            pt.optimizer.AdamW(learning_rate=1e-4,
+                               parameters=model.parameters())
+            .functional()[0](model.raw_params())), dev)
+
+    m, f = results["materialized"][0], results["fused"][0]
+    print(f"speedup: {m / f:.3f}x")
+
+
+if __name__ == "__main__":
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    c = int(sys.argv[2]) if len(sys.argv) > 2 else 2046
+    main(b, c)

@@ -131,8 +131,12 @@ class GPTForCausalLM(nn.Layer):
         # tied output projection (weight reuse, like the reference example)
         self.lm_head_weight = self.gpt.wte.weight
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, return_hidden=False):
         h = self.gpt(input_ids, position_ids)
+        if return_hidden:
+            # for fused linear+CE losses (ops/fused_ce.py): caller applies
+            # the tied lm head inside the chunked loss
+            return h
         from ..ops.registry import OPS
         return OPS["matmul"](h, self.lm_head_weight, transpose_y=True)
 

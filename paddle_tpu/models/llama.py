@@ -171,8 +171,12 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None):
-        return self.lm_head(self.model(input_ids, position_ids))
+    def forward(self, input_ids, position_ids=None, return_hidden=False):
+        h = self.model(input_ids, position_ids)
+        if return_hidden:
+            # for fused linear+CE losses (ops/fused_ce.py)
+            return h
+        return self.lm_head(h)
 
     def loss(self, logits, labels):
         return F.cross_entropy(logits[:, :-1, :], labels[:, 1:])

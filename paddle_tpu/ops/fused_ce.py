@@ -1,0 +1,59 @@
+"""Fused linear + softmax cross-entropy (chunked, logits never fully
+materialized).
+
+Reference capability: paddle's c_softmax_with_cross_entropy / fused CE
+kernels (paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu
+and phi softmax_with_cross_entropy); on GPU frameworks the same idea ships
+as Liger-style fused-linear-CE. TPU-native design: scan over token chunks —
+each chunk's logits ([chunk, V]) live only inside one scan step (MXU matmul
++ fp32 logsumexp), `jax.checkpoint` makes the backward recompute them per
+chunk, and the dW accumulation rides the scan's reverse pass. Peak HBM for
+the CE drops from O(T*V) fp32 to O(chunk*V).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=1024,
+                               reduction="mean", logits_dtype=None):
+    """loss = cross_entropy(hidden @ weight, labels) without materializing
+    the full [T, V] logits.
+
+    hidden: [T, H] (or [B, S, H] — flattened internally); weight: [H, V];
+    labels: int [T] (or [B, S]). The matmul runs in ``hidden.dtype``
+    (bf16 on TPU → MXU); softmax statistics are fp32.
+    """
+    h2 = hidden.reshape(-1, hidden.shape[-1])
+    lb = labels.reshape(-1).astype(jnp.int32)
+    T = h2.shape[0]
+    c = min(chunk_size, T)
+    n = T // c
+
+    def chunk_loss(h, l):
+        logits = (h @ weight).astype(logits_dtype or jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, l[:, None], axis=-1)[:, 0]
+        return jnp.sum(lse - tgt)
+
+    ckpt = jax.checkpoint(chunk_loss)
+
+    def body(carry, hl):
+        h, l = hl
+        return carry + ckpt(h, l), None
+
+    hs = h2[:n * c].reshape(n, c, h2.shape[-1])
+    ls = lb[:n * c].reshape(n, c)
+    total, _ = lax.scan(body, jnp.float32(0.0), (hs, ls))
+    if T % c != 0:
+        # remainder tail keeps the memory win for non-dividing lengths
+        total = total + ckpt(h2[n * c:], lb[n * c:])
+    if reduction == "mean":
+        return total / T
+    if reduction == "sum":
+        return total
+    raise ValueError("chunked CE supports reduction='mean'|'sum'")

@@ -307,8 +307,12 @@ def renorm(x, p, axis, max_norm):
 
 @register("cholesky_inverse")
 def cholesky_inverse(x, upper=False):
-    a = x @ x.T if not upper else x.T @ x
-    return jnp.linalg.inv(a)
+    """inv(A) from A's Cholesky factor; batched, via triangular solves
+    (cho_solve) rather than generic inv."""
+    import jax.scipy.linalg as jsl
+    eye = jnp.broadcast_to(jnp.eye(x.shape[-1], dtype=x.dtype),
+                           x.shape)
+    return jsl.cho_solve((x, not upper), eye)
 
 
 @register("lu_unpack", nondiff_args=(1,))

@@ -663,6 +663,13 @@ def block_diag(*inputs):
 
 @register("take", method=True, nondiff_args=(1,))
 def take(x, index, mode="raise"):
+    """Flat-index gather. mode='raise' checks bounds eagerly (concrete
+    indices only — under jit, data-dependent raising is impossible and
+    out-of-range indices clamp, diverging from the reference's error)."""
+    if mode == "raise" and not isinstance(index, jax.core.Tracer):
+        n = x.size
+        if bool(jnp.any((index < -n) | (index >= n))):
+            raise IndexError(f"take index out of range for {n} elements")
     m = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
     return jnp.take(x.reshape(-1), index, mode=m)
 
@@ -678,8 +685,15 @@ def index_fill(x, index, axis, value):
 @register("masked_scatter", method=True, nondiff_args=(1,))
 def masked_scatter(x, mask, value):
     # paddle semantics: fill masked slots with value's leading elements in
-    # row-major order
+    # row-major order; too-few source elements is an error (checked
+    # eagerly — under jit the count is data-dependent and clamps instead)
     flat_m = mask.reshape(-1)
+    if not isinstance(flat_m, jax.core.Tracer):
+        needed = int(jnp.sum(flat_m))
+        if value.size < needed:
+            raise ValueError(
+                f"masked_scatter: value has {value.size} elements, mask "
+                f"needs {needed}")
     pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
     src = value.reshape(-1)[jnp.clip(pos, 0, value.size - 1)]
     return jnp.where(flat_m, src, x.reshape(-1)).reshape(x.shape)

@@ -236,11 +236,16 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     redundant transfer into one collective."""
     ax = axis_or_none(group)
     if ax is None:
-        if tensor_list:
-            val = tensor_list[0]
-            if isinstance(tensor, Tensor):
+        # single-process: rank 0 keeps slice 0 (list form or stacked array)
+        if tensor_list is not None:
+            if isinstance(tensor_list, (list, tuple)):
+                val = tensor_list[0] if tensor_list else None
+            else:
+                val = unwrap(tensor_list)[0]
+            if val is not None and isinstance(tensor, Tensor):
                 tensor._replace_value(unwrap(val))
-            return tensor
+            if tensor is None:
+                return val
         return tensor
     if tensor_list is None:
         raise ValueError("scatter inside shard_map needs tensor_list "
