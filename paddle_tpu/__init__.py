@@ -51,7 +51,7 @@ def __getattr__(name):
                 "models", "utils", "incubate", "static", "device", "runtime",
                 "inference", "sparse", "text", "audio", "geometric",
                 "quantization", "distribution", "fft", "signal",
-                "regularizer"):
+                "regularizer", "linalg", "onnx"):
         import importlib
         try:
             mod = importlib.import_module(f".{name}", __name__)
@@ -159,3 +159,248 @@ def summary(net, input_size=None, dtypes=None):
 def flops(net, input_size, custom_ops=None, print_detail=False):
     from .hapi.summary import flops as _flops
     return _flops(net, input_size)
+
+
+# ------------------------------------------------ top-level parity tail
+# (reference python/paddle/__init__.py __all__)
+
+dtype = _dtype_mod.DType if hasattr(_dtype_mod, "DType") else str
+bool = _dtype_mod.bool_          # noqa: A001 — paddle.bool dtype alias
+
+
+def iinfo(dt):
+    import numpy as _np
+    return _np.iinfo(_dtype_mod.convert_dtype(dt))
+
+
+def finfo(dt):
+    import numpy as _np
+    return _np.finfo(_dtype_mod.convert_dtype(dt))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    import jax.numpy as _jnp
+    d = x.dtype if hasattr(x, "dtype") else x
+    return _jnp.issubdtype(_dtype_mod.convert_dtype(d), _jnp.complexfloating)
+
+
+def is_integer(x):
+    import jax.numpy as _jnp
+    d = x.dtype if hasattr(x, "dtype") else x
+    return _jnp.issubdtype(_dtype_mod.convert_dtype(d), _jnp.integer)
+
+
+def is_floating_point(x):
+    import jax.numpy as _jnp
+    d = x.dtype if hasattr(x, "dtype") else x
+    return _jnp.issubdtype(_dtype_mod.convert_dtype(d), _jnp.floating)
+
+
+def rank(x):
+    """paddle.rank: 0-d tensor holding ndim."""
+    import jax.numpy as _jnp
+    v = x._value if isinstance(x, Tensor) else x
+    return to_tensor(_jnp.asarray(v.ndim, _jnp.int32))
+
+
+def is_grad_enabled():
+    from .core.tape import tape_enabled
+    return tape_enabled()
+
+
+def tolist(x):
+    return (x.numpy() if isinstance(x, Tensor) else x).tolist()
+
+
+def floor_mod(x, y):
+    return _OPS["mod"](x, y)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def get_cuda_rng_state():
+    """CUDA-API-shaped alias over the TPU/global RNG state."""
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state[0] if isinstance(state, (list, tuple)) else state)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    pass  # reference installs fault handlers; nothing to disable here
+
+
+class LazyGuard:
+    """paddle.LazyGuard parity: in the reference this defers parameter
+    materialization; initialization here is already cheap/deferred to
+    first use, so the guard is a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(gpu:{self.device_id})"
+
+
+class CUDAPinnedPlace:
+    def __repr__(self):
+        return "Place(gpu_pinned)"
+
+
+class NPUPlace(CUDAPlace):
+    def __repr__(self):
+        return f"Place(npu:{self.device_id})"
+
+
+class TPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(tpu:{self.device_id})"
+
+
+def ParamAttr(name=None, initializer=None, learning_rate=1.0,
+              regularizer=None, trainable=True, do_model_average=True,
+              need_clip=True):
+    from .nn.param_attr import ParamAttr as _PA
+    return _PA(name=name, initializer=initializer,
+               learning_rate=learning_rate, regularizer=regularizer,
+               trainable=trainable, do_model_average=do_model_average,
+               need_clip=need_clip)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter parity (static+eager helper)."""
+    from .nn import initializer as I
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(init(tuple(shape), _dtype_mod.convert_dtype(dtype)))
+    if name:
+        p.name = name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader-decorator (reference python/paddle/batch.py)."""
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return gen
+
+
+def _tensor_method_alias(op, name):
+    def f(x, *args, **kwargs):
+        return _OPS[op](x, *args, **kwargs) if op in _OPS else \
+            getattr(x, name)(*args, **kwargs)
+    f.__name__ = name
+    return f
+
+
+def tanh_(x):
+    return x.tanh_()
+
+
+def scatter_(x, index, updates, overwrite=True):
+    # Tensor method form snapshots the pre-mutation tape identity so the
+    # recorded node's parent is the old value, not the rebound self
+    return x.scatter_(index, updates, overwrite)
+
+
+def reshape_(x, shape):
+    return x.reshape_(shape)
+
+
+def squeeze_(x, axis=None):
+    return x.squeeze_(axis)
+
+
+def unsqueeze_(x, axis):
+    return x.unsqueeze_(axis)
+
+
+def set_flags(flags):
+    from .runtime import set_flags as _sf
+    return _sf(flags)
+
+
+def get_flags(names):
+    from .runtime import get_flags as _gf
+    return _gf(names)
+
+
+def check_shape(x, shape):
+    """Assert a tensor's shape (reference static check helper)."""
+    import builtins
+    got = list(x.shape)
+    want = list(shape)
+    # NB: bare `all` here would hit the re-exported paddle op
+    ok = len(got) == len(want) and builtins.all(
+        w in (-1, None) or g == w for g, w in zip(got, want))
+    if not ok:
+        raise ValueError(f"shape mismatch: got {got}, expected {want}")
+    return x
+
+
+def broadcast_tensors(inputs):
+    """paddle.broadcast_tensors parity: broadcast all to a common shape."""
+    import numpy as _np
+    shapes = [tuple(t.shape) for t in inputs]
+    target = _np.broadcast_shapes(*shapes)
+    return [_OPS["broadcast_to"](t, list(target)) for t in inputs]
+
+
+def index_add_(x, index, axis, value):
+    return x.index_add_(index, axis, value)
+
+
+def index_add(x, index, axis, value):
+    return _OPS["index_add"](x, index, axis, value)

@@ -5,5 +5,9 @@ features/layers.py).
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]

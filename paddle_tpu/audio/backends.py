@@ -1,0 +1,82 @@
+"""paddle.audio.backends parity: WAV load/save/info over the stdlib wave
+module (reference python/paddle/audio/backends/ -> soundfile/wave_backend).
+"""
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend", "set_backend", "AudioInfo"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_frames = num_samples
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name not in ("wave_backend",):
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable (stdlib wave only)")
+
+
+def info(filepath):
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [C, T] (or [T, C]), sample_rate)."""
+    import paddle_tpu as pt
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(count)
+    dt = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if width == 1:
+        data = data.astype(np.float32) / 128.0 - 1.0
+    elif normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    out = data.T if channels_first else data
+    return pt.to_tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    from ..core.tensor import Tensor
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    scaled = np.clip(arr, -1.0, 1.0)
+    pcm = (scaled * (2 ** (bits_per_sample - 1) - 1)).astype(
+        {16: np.int16, 32: np.int32}[bits_per_sample])
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(arr.shape[1])
+        w.setsampwidth(bits_per_sample // 8)
+        w.setframerate(int(sample_rate))
+        w.writeframes(pcm.tobytes())

@@ -1,0 +1,80 @@
+"""paddle.audio.datasets parity (reference python/paddle/audio/datasets/:
+TESS, ESC50). Folder-of-wavs datasets: download is out of scope (zero
+egress) — point `data_dir` at an existing copy.
+"""
+from __future__ import annotations
+
+import os
+
+from ..io.dataloader import Dataset
+from .backends import load
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+class AudioClassificationDataset(Dataset):
+    """Base: (waveform, label) pairs from (files, labels) lists; optional
+    feature transform ('raw' passthrough by default)."""
+
+    def __init__(self, files=None, labels=None, feat_type="raw", **kwargs):
+        self.files = files or []
+        self.labels = labels or []
+        self.feat_type = feat_type
+
+    def __getitem__(self, idx):
+        wav, _sr = load(self.files[idx])
+        return wav, self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class _FolderDataset(AudioClassificationDataset):
+    label_list: list = []
+
+    def __init__(self, data_dir=None, mode="train", split=0.8,
+                 feat_type="raw", **kwargs):
+        files, labels = [], []
+        if data_dir and os.path.isdir(data_dir):
+            for root, _dirs, names in os.walk(data_dir):
+                for n in sorted(names):
+                    if n.lower().endswith(".wav"):
+                        files.append(os.path.join(root, n))
+                        labels.append(self._label_of(n, root))
+            k = int(len(files) * split)
+            if mode == "train":
+                files, labels = files[:k], labels[:k]
+            else:
+                files, labels = files[k:], labels[k:]
+        super().__init__(files, labels, feat_type)
+
+    def _label_of(self, name, root):
+        return 0
+
+
+class TESS(_FolderDataset):
+    """Toronto emotional speech set layout: emotion is the middle token of
+    OAF_word_emotion.wav."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def _label_of(self, name, root):
+        stem = os.path.splitext(name)[0]
+        emo = stem.split("_")[-1].lower()
+        return self.label_list.index(emo) if emo in self.label_list else 0
+
+
+class ESC50(_FolderDataset):
+    """ESC-50 layout: fold-target encoded in the filename
+    (fold-src-take-target.wav)."""
+
+    label_list = [str(i) for i in range(50)]
+
+    def _label_of(self, name, root):
+        stem = os.path.splitext(name)[0]
+        parts = stem.split("-")
+        try:
+            return int(parts[-1])
+        except (ValueError, IndexError):
+            return 0

@@ -61,12 +61,24 @@ class PyLayerContext:
         self.__dict__["_extras"] = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        # saved_tensors_hooks: pack at save time, remember the matching
+        # unpack for use time (hook pair captured, not looked up later)
+        if _saved_tensor_hooks:
+            pack, unpack = _saved_tensor_hooks[-1]
+            self._packed = tuple(pack(t) for t in tensors)
+            self._unpack_hook = unpack
+            self._saved = None
+        else:
+            self._packed = None
+            self._unpack_hook = None
+            self._saved = tensors
 
     def saved_tensor(self):
+        if getattr(self, "_packed", None) is not None:
+            return tuple(self._unpack_hook(p) for p in self._packed)
         return self._saved
 
-    saved_tensors = property(lambda self: self._saved)
+    saved_tensors = property(lambda self: self.saved_tensor())
 
 
 class PyLayerMeta(type):
@@ -208,3 +220,31 @@ def hessian(func, xs, create_graph=False):
     wrapped = jax.tree_util.tree_map(wrap, h)
     return wrapped if isinstance(xs, (list, tuple)) else (
         wrapped[0] if isinstance(wrapped, tuple) else wrapped)
+
+
+_saved_tensor_hooks = []   # stack of (pack, unpack)
+
+
+class saved_tensors_hooks:
+    """paddle.autograd.saved_tensors_hooks parity (reference
+    python/paddle/autograd/saved_tensors_hooks.py).
+
+    Scope: tensors stashed via ``PyLayerContext.save_for_backward`` are
+    run through ``pack_hook`` at save time and ``unpack_hook`` at use
+    time. The built-in op tape stores residuals inside ``jax.vjp``
+    closures (XLA decides rematerialization), so only the PyLayer saved-
+    tensor path is interceptable — matching the reference's documented
+    use (custom offload/compression of saved activations).
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.pop()
+        return False

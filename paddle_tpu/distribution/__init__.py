@@ -374,3 +374,110 @@ def _kl_gumbel(p, q):
                 + (p.loc - q.loc) / b2
                 + jnp.expm1((q.loc - p.loc) / b2
                             + gammaln(1.0 + b1 / b2)))
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    python/paddle/distribution/exponential_family.py). Subclasses define
+    natural parameters + log-normalizer; entropy falls out via the
+    Bregman identity, computed here with jax autodiff."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [jnp.asarray(p, jnp.float32) for p in self._natural_parameters]
+        lg_a, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nat))
+        ent = lg_a - self._mean_carrier_measure
+        for np_, g in zip(nat, grads):
+            ent = ent - jnp.sum(np_ * g)
+        return wrap(ent)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference
+    python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape)
+        k = self.reinterpreted_batch_rank
+        super().__init__(shape[:len(shape) - k],
+                         shape[len(shape) - k:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = unwrap(self.base.log_prob(value))
+        axes = tuple(range(lp.ndim - self.reinterpreted_batch_rank,
+                           lp.ndim))
+        return wrap(jnp.sum(lp, axes))
+
+    def entropy(self):
+        e = unwrap(self.base.entropy())
+        axes = tuple(range(e.ndim - self.reinterpreted_batch_rank, e.ndim))
+        return wrap(jnp.sum(e, axes))
+
+
+class TransformedDistribution(Distribution):
+    """base distribution + bijective transforms (reference
+    python/paddle/distribution/transformed_distribution.py). Transforms
+    need forward(x), inverse(y), forward_log_det_jacobian(x)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = unwrap(self.base.sample(shape))
+        for t in self.transforms:
+            x = unwrap(t.forward(wrap(x))) if hasattr(t, "forward") else t(x)
+        return wrap(x)
+
+    def log_prob(self, value):
+        y = _v(value)
+        lp = jnp.zeros_like(y)
+        for t in reversed(self.transforms):
+            x = unwrap(t.inverse(wrap(y)))
+            lp = lp - unwrap(t.forward_log_det_jacobian(wrap(x)))
+            y = x
+        return wrap(lp + unwrap(self.base.log_prob(y)))
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a custom KL implementation (reference
+    python/paddle/distribution/kl.py:register_kl); user entries take
+    precedence over the built-in closed forms."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+_builtin_kl = kl_divergence
+
+
+def kl_divergence(p, q):  # noqa: F811 — registry-aware wrapper
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    return _builtin_kl(p, q)
+
+
+__all__ += ["ExponentialFamily", "Independent", "TransformedDistribution",
+            "register_kl"]

@@ -5,3 +5,91 @@ from .nn.functional import (  # noqa: E402,F401
     fused_softmax_mask as softmax_mask_fuse,
     fused_softmax_mask_upper_triangle as softmax_mask_fuse_upper_triangle,
 )
+
+
+# ----------------------------------------------- incubate top-level tail
+# (reference python/paddle/incubate/__init__.py __all__)
+
+from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+from ..geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy incubate alias of geometric.send_u_recv (reference
+    python/paddle/incubate/operators/graph_send_recv.py)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference
+    incubate/operators/graph_khop_sampler.py): iterated sample_neighbors
+    with per-hop reindexing onto the growing node frontier."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import sample_neighbors
+
+    def _np(v):
+        return np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+
+    nodes = _np(input_nodes).astype(np.int64).reshape(-1)
+    all_edges_src = []
+    all_edges_dst = []
+    frontier = nodes
+    seen = list(nodes.tolist())
+    seen_set = set(seen)
+    for size in sample_sizes:
+        out = sample_neighbors(row, colptr, frontier, sample_size=size)
+        neigh, cnt = out[0], out[1]
+        neigh = _np(neigh).astype(np.int64)
+        cnt = _np(cnt).astype(np.int64)
+        dst = np.repeat(frontier, cnt)
+        all_edges_src.append(neigh)
+        all_edges_dst.append(dst)
+        new = [n for n in neigh.tolist() if n not in seen_set]
+        seen.extend(new)
+        seen_set.update(new)
+        frontier = np.asarray(new, np.int64)
+        if frontier.size == 0:
+            break
+    import paddle_tpu as pt
+    src = np.concatenate(all_edges_src) if all_edges_src else \
+        np.zeros((0,), np.int64)
+    dst = np.concatenate(all_edges_dst) if all_edges_dst else \
+        np.zeros((0,), np.int64)
+    uniq = np.asarray(seen, np.int64)
+    remap = {int(n): i for i, n in enumerate(uniq)}
+    src_r = np.asarray([remap[int(s)] for s in src], np.int64)
+    dst_r = np.asarray([remap[int(d)] for d in dst], np.int64)
+    return (pt.to_tensor(src_r), pt.to_tensor(dst_r), pt.to_tensor(uniq),
+            pt.to_tensor(np.arange(src_r.size, dtype=np.int64)))
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate.identity_loss (IPU loss marker): reduce + mark."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return x.sum()
+    return x.mean()

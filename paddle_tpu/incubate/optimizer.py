@@ -1,0 +1,110 @@
+"""paddle.incubate LookAhead / ModelAverage optimizer wrappers.
+
+Reference: python/paddle/incubate/optimizer/{lookahead.py,modelaverage.py}.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import unwrap
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, 1 step back (reference lookahead.py:LookAhead).
+
+    Wraps an inner optimizer; every ``k`` inner steps the slow weights
+    catch up: slow += alpha * (fast - slow); fast = slow.
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_count = 0
+        self._slow = None
+        self._params = list(getattr(inner_optimizer, "_parameter_list",
+                                    None) or [])
+
+    def _ensure_slow(self):
+        if self._slow is None:
+            self._slow = [unwrap(p) for p in self._params]
+
+    def step(self):
+        self._ensure_slow()
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = self._slow[i] + self.alpha * (unwrap(p)
+                                                     - self._slow[i])
+                self._slow[i] = slow
+                p._replace_value(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_count = sd.pop("lookahead_step", 0)
+        self.inner_optimizer.set_state_dict(sd)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval (reference
+    modelaverage.py:ModelAverage): accumulate after each step; `apply()`
+    context swaps averaged weights in, `restore()` swaps back.
+    """
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(unwrap(p)) for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights into the average."""
+        for i, p in enumerate(self._params):
+            self._sum[i] = self._sum[i] + unwrap(p)
+        self._count += 1
+
+    # paddle name: minimize()/step() both accumulate after the inner step
+    update = step
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            yield
+            return
+        self._backup = [unwrap(p) for p in self._params]
+        for i, p in enumerate(self._params):
+            p._replace_value(self._sum[i] / self._count)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._replace_value(b)
+            self._backup = None

@@ -2,7 +2,8 @@ from .checkpoint import CheckpointManager, load_sharded, save_sharded  # noqa: F
 from .dataloader import (  # noqa: F401
     BatchSampler, ChainDataset, ComposeDataset, DataLoader, Dataset,
     DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
-    SequenceSampler, Subset, TensorDataset, default_collate_fn,
+    SequenceSampler, Subset, TensorDataset, WeightedRandomSampler,
+    default_collate_fn,
     get_worker_info, random_split,
 )
 from .save_load import load, save  # noqa: F401

@@ -19,6 +19,7 @@ from ..core.tensor import Tensor, wrap
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "Subset",
            "random_split", "ComposeDataset", "ChainDataset", "DataLoader",
            "BatchSampler", "Sampler", "SequenceSampler", "RandomSampler",
+           "WeightedRandomSampler",
            "DistributedBatchSampler", "default_collate_fn", "get_worker_info"]
 
 
@@ -127,6 +128,30 @@ class RandomSampler(Sampler):
         if self.replacement:
             return iter(np.random.randint(0, n, self.num_samples).tolist())
         return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    """Sample indices with given per-sample weights (reference
+    python/paddle/io WeightedRandomSampler)."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        if num_samples <= 0:
+            raise ValueError("num_samples must be positive")
+        if not replacement and num_samples > len(weights):
+            raise ValueError(
+                "num_samples exceeds population for replacement=False")
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = int(num_samples)
+        self.replacement = bool(replacement)
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), size=self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
 
     def __len__(self):
         return self.num_samples

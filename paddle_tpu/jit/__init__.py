@@ -78,6 +78,9 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
         @functools.wraps(fn)
         def wrapper(*args, **kw):
+            if not _to_static_enabled[0]:
+                # jit.enable_to_static(False): run the original eagerly
+                return fn(*args, **kw)
             vals = _unwrap_tree(args)
             out = _jitted(static_fn)(*vals, **kw)
             return _wrap_tree(out)
@@ -194,3 +197,38 @@ def load(path, **configs):
         return prog
     from ..io.save_load import load as _load
     return _load(path + ".pdparams")
+
+
+# ------------------------------------------------- config-surface parity
+# (reference python/paddle/jit/api.py + dy2static logging_utils)
+
+_ignored_modules = []
+_to_static_enabled = [True]
+
+
+def ignore_module(modules):
+    """Reference jit.ignore_module: functions defined in the listed
+    modules are never transformed by to_static (consulted in
+    dy2static.convert_to_static)."""
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    _ignored_modules.extend(modules)
+    return _ignored_modules
+
+
+def enable_to_static(flag=True):
+    """Reference jit.enable_to_static: global switch — when off,
+    to_static-wrapped functions run eagerly untransformed."""
+    _to_static_enabled[0] = bool(flag)
+
+
+_verbosity = [0]
+_code_level = [0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _verbosity[0] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    _code_level[0] = int(level)

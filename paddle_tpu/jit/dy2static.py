@@ -601,6 +601,14 @@ def convert_to_static(func):
     code = getattr(func, "__code__", None)
     if code is None:
         return func
+    # jit.ignore_module registry: functions defined in an ignored module
+    # run untransformed (reference dy2static ignore_module semantics)
+    from . import _ignored_modules
+    mod_name = getattr(func, "__module__", None)
+    for m in _ignored_modules:
+        ignored = getattr(m, "__name__", m)
+        if mod_name == ignored:
+            return func
     cells = getattr(func, "__closure__", None)
     memo_key = (code, tuple(id(c) for c in cells) if cells else None)
     hit = _fn_memo.get(memo_key)

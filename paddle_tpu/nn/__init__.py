@@ -9,10 +9,12 @@ from .rnn import *  # noqa: F401,F403
 from .rnn import __all__ as _rnn_all
 from .transformer import *  # noqa: F401,F403
 from .transformer import __all__ as _transformer_all
+from .layers_tail import *  # noqa: F401,F403
+from .layers_tail import __all__ as _tail_all
 
 __all__ = (
     ["Layer", "LayerList", "Sequential", "ParameterList", "LayerDict",
      "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
      "functional", "initializer"] + list(_basic_all) + list(_rnn_all)
-    + list(_transformer_all)
+    + list(_transformer_all) + list(_tail_all)
 )

@@ -1391,3 +1391,308 @@ def _reduce_t(loss, reduction):
 from ..ops.registry import register_direct as _rdirect  # noqa: E402
 
 _rdirect("ctc_loss", ctc_loss)
+
+
+# -------------------------------------------- round-3 functional tail 2
+
+
+@register("adaptive_max_pool3d")
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", avg=False)
+
+
+def _max_unpool_nd(x, indices, spatial_out):
+    n, c = x.shape[0], x.shape[1]
+    numel = 1
+    for s in spatial_out:
+        numel *= s
+    flat = jnp.zeros((n, c, numel), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = flat.at[jnp.arange(n)[:, None, None],
+                   jnp.arange(c)[None, :, None], idx].set(vals)
+    return flat.reshape((n, c) + tuple(spatial_out))
+
+
+@register("max_unpool1d", nondiff_args=(1,))
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = stride or ks
+    st = st if isinstance(st, int) else st[0]
+    L = (x.shape[-1] - 1) * st + ks - 2 * padding if output_size is None \
+        else output_size[-1]
+    return _max_unpool_nd(x, indices, (L,))
+
+
+@register("max_unpool3d", nondiff_args=(1,))
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    def _triple(v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+    ks = _triple(kernel_size)
+    st = _triple(stride) if stride is not None else ks
+    pd = _triple(padding) if not isinstance(padding, int) \
+        else (padding,) * 3
+    if output_size is None:
+        spatial = tuple((x.shape[2 + i] - 1) * st[i] + ks[i] - 2 * pd[i]
+                        for i in range(3))
+    else:
+        spatial = tuple(output_size[-3:])
+    return _max_unpool_nd(x, indices, spatial)
+
+
+@register("softmax_with_cross_entropy")
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    """Reference paddle.nn.functional.softmax_with_cross_entropy (phi
+    softmax_with_cross_entropy kernel): fused log-softmax + NLL, keepdim
+    label semantics."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lb = label.astype(jnp.int32)
+        squeeze = lb.ndim == logits.ndim
+        idx = lb if squeeze else lb[..., None]
+        picked = jnp.take_along_axis(logp, jnp.clip(idx, 0, None), axis)
+        loss = -picked
+        mask = (idx != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@register("margin_cross_entropy")
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax CE (reference phi
+    margin_cross_entropy; the multi-rank class-parallel form rides
+    ParallelCrossEntropy — this is the single-shard math): the target
+    logit cos(theta) becomes cos(m1*theta + m2) - m3, everything scaled."""
+    lb = label.astype(jnp.int32).reshape(-1)
+    cos = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+    tgt = jnp.take_along_axis(cos, lb[:, None], -1)[:, 0]
+    theta = jnp.arccos(jnp.clip(tgt, -1 + 1e-7, 1 - 1e-7))
+    tgt_m = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(lb, logits.shape[-1], dtype=cos.dtype)
+    out = scale * (cos * (1 - onehot) + tgt_m[:, None] * onehot)
+    logp = jax.nn.log_softmax(out, -1)
+    loss = -jnp.take_along_axis(logp, lb[:, None], -1)[:, 0]
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@register("multi_margin_loss")
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,  # noqa: A002
+                      reduction="mean"):
+    lb = label.astype(jnp.int32)
+    tgt = jnp.take_along_axis(input, lb[:, None], -1)
+    m = jnp.maximum(margin - tgt + input, 0.0)
+    if p == 2:
+        m = m * m
+    if weight is not None:
+        m = m * jnp.take(weight, lb)[:, None]
+    onehot = jax.nn.one_hot(lb, input.shape[-1], dtype=input.dtype)
+    loss = jnp.sum(m * (1 - onehot), -1) / input.shape[-1]
+    return _reduce(loss, reduction)
+
+
+@register("hsigmoid_loss")
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid loss over the default complete binary tree
+    (reference phi hsigmoid_loss kernel / HSigmoidLoss layer). Internal
+    node ids follow the reference's (label + num_classes) >> level walk."""
+    lb = label.astype(jnp.int32).reshape(-1)
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+    codes = []
+    ids = []
+    node = lb + num_classes
+    for _ in range(depth):
+        codes.append((node % 2).astype(jnp.float32))   # left/right bit
+        node = node // 2
+        ids.append(node - 1)                            # internal node row
+    ids = jnp.stack(ids, -1)                            # [B, D]
+    codes = jnp.stack(codes, -1)
+    valid = ids >= 0
+    ids_c = jnp.clip(ids, 0, weight.shape[0] - 1)
+    w = weight[ids_c]                                   # [B, D, H]
+    z = jnp.einsum("bdh,bh->bd", w.astype(jnp.float32),
+                   input.astype(jnp.float32))
+    if bias is not None:
+        z = z + bias.reshape(-1)[ids_c]
+    # P(go in coded direction) = sigmoid(+-z)
+    logp = jax.nn.log_sigmoid(jnp.where(codes > 0, z, -z))
+    return -jnp.sum(jnp.where(valid, logp, 0.0), -1, keepdims=True)
+
+
+@register("gather_tree", nondiff_args=(0, 1))
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference phi gather_tree kernel):
+    ids/parents [T, B, beam] -> full sequences re-threaded by parent."""
+    T = ids.shape[0]
+
+    def body(carry, xs):
+        beam_idx = carry                    # [B, beam]
+        step_ids, step_parents = xs
+        out = jnp.take_along_axis(step_ids, beam_idx, -1)
+        new_idx = jnp.take_along_axis(step_parents, beam_idx, -1)
+        return new_idx, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=ids.dtype),
+                            ids.shape[1:]).astype(jnp.int32)
+    _, outs = jax.lax.scan(body, init,
+                           (ids[::-1], parents[::-1].astype(jnp.int32)))
+    return outs[::-1]
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers + positives (reference phi
+    class_center_sample, PartialFC). Host-side sampling (data-dependent
+    sizes do not trace); returns (remapped_label, sampled_class_index)."""
+    import numpy as np
+    lb = np.asarray(unwrap(label) if isinstance(label, Tensor) else label)
+    pos = np.unique(lb)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    n_extra = max(0, num_samples - pos.size)
+    extra = np.random.choice(rest, size=min(n_extra, rest.size),
+                             replace=False) if n_extra else np.array([], int)
+    sampled = np.concatenate([pos, np.sort(extra)]).astype(np.int64)
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(sampled.size)
+    from ..core.tensor import wrap as _w
+    return (_w(jnp.asarray(remap[lb])), _w(jnp.asarray(sampled)))
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean"):
+    """RNN-Transducer loss (reference paddle.nn.functional.rnnt_loss over
+    warprnnt). TPU-native: the standard log-space alpha recursion over the
+    (T, U) lattice — scan over T, in-row scan over U — XLA-compiled.
+
+    logits: [B, T, U+1, C] joint network outputs (raw); labels [B, U].
+    """
+    lg = unwrap(logits) if isinstance(logits, Tensor) else logits
+    lb = unwrap(labels) if isinstance(labels, Tensor) else labels
+    tl = unwrap(logit_lengths) if isinstance(logit_lengths, Tensor) \
+        else logit_lengths
+    ul = unwrap(label_lengths) if isinstance(label_lengths, Tensor) \
+        else label_lengths
+
+    def fn(lg, lb, tl, ul):
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+        B, T, U1, _C = lp.shape
+        U = U1 - 1
+        neg_inf = jnp.float32(-1e30)
+        blank_lp = lp[..., blank]                       # [B, T, U+1]
+        lbi = lb.astype(jnp.int32)
+        # label emission logprob at (t, u): P(label[u] | t, u), u < U
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lbi[:, None, :, None], -1)[..., 0]  # [B,T,U]
+
+        def row_scan(alpha_prev_t, t):
+            # alpha[t, u] = logadd(alpha[t-1, u] + blank[t-1, u],
+            #                      alpha[t, u-1] + label[t, u-1])
+            from_blank = alpha_prev_t + blank_lp[:, t - 1, :]
+
+            def u_step(carry, u):
+                cur = jnp.logaddexp(
+                    from_blank[:, u],
+                    carry + lab_lp[:, t, u - 1])
+                return cur, cur
+
+            first = from_blank[:, 0]
+            _, rest = jax.lax.scan(u_step, first, jnp.arange(1, U1))
+            row = jnp.concatenate([first[:, None], rest.T], 1)
+            return row
+
+        def t_body(carry, t):
+            row = row_scan(carry, t)
+            return row, row
+
+        # t = 0 row: only label transitions
+        def u0_step(carry, u):
+            cur = carry + lab_lp[:, 0, u - 1]
+            return cur, cur
+
+        a00 = jnp.zeros((B,), jnp.float32)
+        _, row0_rest = jax.lax.scan(u0_step, a00, jnp.arange(1, U1))
+        row0 = jnp.concatenate([a00[:, None], row0_rest.T], 1)
+        _, rows = jax.lax.scan(t_body, row0, jnp.arange(1, T))
+        all_rows = jnp.concatenate([row0[None], rows], 0)  # [T, B, U+1]
+        # final: alpha[tl-1, ul] + blank(tl-1, ul)
+        ti = jnp.clip(tl.astype(jnp.int32) - 1, 0, T - 1)
+        ui = jnp.clip(ul.astype(jnp.int32), 0, U)
+        aT = all_rows[ti, jnp.arange(B), ui]
+        final_blank = blank_lp[jnp.arange(B), ti, ui]
+        return -(aT + final_blank)
+
+    loss = dispatch(fn, logits, labels, logit_lengths, label_lengths,
+                    nondiff_args=(1, 2, 3), name="rnnt_loss")
+    return _reduce_t(loss, reduction)
+
+
+_rdirect("rnnt_loss", rnnt_loss)
+_rdirect("class_center_sample", class_center_sample)
+
+
+# ---------------------------------------------- inplace functional forms
+
+def _inplace_variant(fn_name):
+    def f(x, *args, **kwargs):
+        if isinstance(x, Tensor):
+            # Tensor inplace methods snapshot the pre-mutation tape
+            # identity (ops/registry.py mk_inplace) — required so the
+            # recorded node's parent is the old value, not the rebound
+            # self (self-referential parents break backward)
+            return getattr(x, fn_name + "_")(*args, **kwargs)
+        return _OPS[fn_name](x, *args, **kwargs)
+    f.__name__ = fn_name + "_"
+    return f
+
+
+relu_ = _inplace_variant("relu")
+tanh_ = _inplace_variant("tanh")
+softmax_ = _inplace_variant("softmax")
+elu_ = _inplace_variant("elu")
+
+diag_embed = _OPS["diag_embed"]
+
+
+@register("sparse_attention")
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block/CSR-sparse attention (reference
+    paddle/fluid/operators/sparse_attention_op.cu). TPU-native: the CSR
+    pattern densifies to a boolean mask and runs as masked dense attention
+    — XLA/MXU prefer the dense masked matmul over gather-scatter; memory
+    is the S×S mask (bool), not materialized scores in fp32.
+
+    query/key/value: [B, H, S, D]; offset [B, H, S+1]; columns [B, H, nnz].
+    """
+    b, h, s, d = query.shape
+    # build the dense mask from the CSR pattern per (b, h)
+    nnz = sparse_csr_columns.shape[-1]
+    # entry e belongs to row r iff offset[r] <= e < offset[r+1]
+    ent = jnp.arange(nnz)
+    off = sparse_csr_offset.astype(jnp.int32)
+    rows = (ent[None, None, None, :] >= off[..., :-1, None]) & \
+           (ent[None, None, None, :] < off[..., 1:, None])   # [B,H,S,nnz]
+    cols = sparse_csr_columns.astype(jnp.int32)
+    onehot_cols = jax.nn.one_hot(cols, s, dtype=jnp.float32)  # [B,H,nnz,S]
+    mask = jnp.einsum("bhsn,bhnc->bhsc", rows.astype(jnp.float32),
+                      onehot_cols) > 0
+    scores = jnp.einsum("bhsd,bhtd->bhst",
+                        query.astype(jnp.float32),
+                        key.astype(jnp.float32)) / jnp.sqrt(float(d))
+    scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, -1)
+    probs = jnp.where(mask, probs, 0.0)
+    return jnp.einsum("bhst,bhtd->bhsd", probs,
+                      value.astype(jnp.float32)).astype(query.dtype)

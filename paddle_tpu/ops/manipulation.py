@@ -697,3 +697,85 @@ def masked_scatter(x, mask, value):
     pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
     src = value.reshape(-1)[jnp.clip(pos, 0, value.size - 1)]
     return jnp.where(flat_m, src, x.reshape(-1)).reshape(x.shape)
+
+
+# ------------------------------------------------ top-level parity tail
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference
+    python/paddle/tensor/math.py multiplex): inputs list of [B, ...],
+    index [B, 1] -> out[b] = inputs[index[b]][b]."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    def fn(idx, *cands):
+        stacked = jnp.stack(cands)
+        i = idx.reshape(-1).astype(jnp.int32)
+        return stacked[i, jnp.arange(stacked.shape[1])]
+
+    return dispatch(fn, index, *inputs, nondiff_args=(0,),
+                    name="multiplex")
+
+
+register_direct("multiplex", multiplex)
+
+
+@register("index_sample", nondiff_args=(1,))
+def index_sample(x, index):
+    """Per-row gather (reference tensor/search.py index_sample):
+    x [B, N], index [B, M] -> out[b, m] = x[b, index[b, m]]."""
+    return jnp.take_along_axis(x, index.astype(jnp.int32), -1)
+
+
+@register("increment")
+def increment(x, value=1.0):
+    return x + jnp.asarray(value, x.dtype)
+
+
+@register("shard_index", nondiff_args=())
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    """Re-map global ids to shard-local ids (reference
+    tensor/manipulation.py shard_index)."""
+    size = (index_num + nshards - 1) // nshards
+    lo = shard_id * size
+    inside = (input >= lo) & (input < lo + size)
+    return jnp.where(inside, input - lo, ignore_value)
+
+
+@register("scatter_nd", nondiff_args=(0,))
+def scatter_nd(index, updates, shape):
+    out = jnp.zeros(list(shape), updates.dtype)
+    return out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@register("reverse", method=True)
+def reverse(x, axis):
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    return jnp.flip(x, axes)
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference tensor/math.py add_n)."""
+    from ..core.tensor import dispatch
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    return dispatch(lambda *vs: sum(vs[1:], vs[0]), *inputs, name="add_n")
+
+
+_rd("add_n", add_n)
+
+
+@register("is_empty")
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+@register("shape", nondiff_args=(0,))
+def shape(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+@register("broadcast_shape", nondiff_args=(0, 1))
+def _broadcast_shape_op(x_shape, y_shape):
+    return jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape))

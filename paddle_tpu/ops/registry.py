@@ -60,7 +60,8 @@ INPLACE_OPS = ("add", "subtract", "multiply", "divide", "scale", "clip",
                "exp", "sqrt", "rsqrt", "reciprocal", "floor", "ceil",
                "round", "trunc", "remainder", "lerp", "pow", "tanh",
                "sigmoid", "relu", "squeeze", "unsqueeze", "flatten",
-               "flip", "cast")
+               "flip", "cast", "reshape", "scatter", "index_add",
+               "softmax", "elu")
 
 
 def install_tensor_methods():

@@ -9,8 +9,11 @@ from .api import (  # noqa: F401
 )
 from .collective import (  # noqa: F401
     ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all, alltoall,
-    barrier, broadcast, get_rank, get_world_size, in_shard_map, new_group,
-    recv, reduce, reduce_scatter, scatter, send, wait,
+    alltoall_single, barrier, broadcast, broadcast_object_list,
+    destroy_process_group, get_backend, get_group, get_rank, get_world_size,
+    gloo_barrier, gloo_init_parallel_env, gloo_release, in_shard_map,
+    irecv, is_available, isend, new_group, recv, reduce, reduce_scatter,
+    scatter, scatter_object_list, send, wait,
 )
 from .env import ParallelEnv, init_parallel_env, is_initialized  # noqa: F401
 from .mesh import HybridMesh, P, get_mesh, init_mesh, mesh_scope, set_mesh  # noqa: F401
@@ -37,3 +40,7 @@ from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401,E402
                          UserDefinedRoleMaker, Role)
 
 from . import stream  # noqa: F401,E402
+from .spawn import (CountFilterEntry, InMemoryDataset,  # noqa: F401,E402
+                    ParallelMode, ProbabilityEntry, QueueDataset,
+                    ShowClickEntry, spawn, split)
+from . import io  # noqa: F401,E402

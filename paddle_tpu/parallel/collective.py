@@ -26,7 +26,11 @@ __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
            "reduce_scatter", "broadcast", "reduce", "scatter", "alltoall",
            "all_to_all", "send", "recv", "isend", "irecv", "barrier",
            "get_rank", "get_world_size", "new_group", "wait",
-           "in_shard_map", "axis_or_none", "split_group"]
+           "in_shard_map", "axis_or_none", "split_group",
+           "alltoall_single", "broadcast_object_list",
+           "scatter_object_list", "get_group", "destroy_process_group",
+           "is_available", "get_backend", "gloo_init_parallel_env",
+           "gloo_barrier", "gloo_release"]
 
 
 class ReduceOp:
@@ -344,3 +348,106 @@ def barrier(group=None):
 
 # the richer task-returning stream namespace lives in parallel/stream.py
 # (reference communication/stream/); collective.py keeps only the core ops
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """paddle.distributed.alltoall_single parity: single-tensor all-to-all
+    over the group axis (leading dim split evenly unless sizes given)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "uneven alltoall_single splits are not expressible as one XLA "
+            "all_to_all; pad to even splits or use ragged host exchange")
+    ax = axis_or_none(group)
+    if ax is None:
+        if isinstance(out_tensor, Tensor) and in_tensor is not None:
+            out_tensor._replace_value(unwrap(in_tensor))
+            return out_tensor
+        return in_tensor
+    val = in_tensor if in_tensor is not None else out_tensor
+
+    def fn(v):
+        return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    out = dispatch(fn, val, name="alltoall_single")
+    if isinstance(out_tensor, Tensor):
+        out_tensor._replace_value(unwrap(out))
+        return out_tensor
+    return out
+
+
+def _object_to_tensor(obj):
+    import pickle
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    return jnp.asarray(data), data.size
+
+
+def _tensor_to_object(arr, size):
+    import pickle
+    return pickle.loads(np.asarray(arr)[:int(size)].tobytes())
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """paddle.distributed.broadcast_object_list parity. Single-process
+    (SPMD) semantics: every rank already holds src's objects — pickle
+    round-trip keeps reference behavior (mutating the list in place)."""
+    ax = axis_or_none(group)
+    if ax is None:
+        return object_list
+    raise RuntimeError(
+        "broadcast_object_list inside shard_map is not expressible; "
+        "broadcast tensors instead")
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Single-process semantics: rank 0 keeps element 0."""
+    ax = axis_or_none(group)
+    if ax is None:
+        if in_object_list:
+            del out_object_list[:]
+            out_object_list.append(in_object_list[0])
+        return out_object_list
+    raise RuntimeError(
+        "scatter_object_list inside shard_map is not expressible; "
+        "scatter tensors instead")
+
+
+def get_group(gid=0):
+    """Return the group registered under id (reference collective._get_group)."""
+    return _GROUPS.get(gid)
+
+
+def destroy_process_group(group=None):
+    """Tear down group bookkeeping (XLA collectives hold no persistent
+    comm state to destroy)."""
+    if group is None:
+        for k in list(_GROUPS):
+            if k != 0:
+                del _GROUPS[k]
+    else:
+        _GROUPS.pop(getattr(group, "id", group), None)
+
+
+def is_available():
+    return True
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference gloo CPU barrier bootstrap — the TCPStore rendezvous
+    (runtime/csrc/tcp_store.cc) is the TPU-native replacement."""
+    from .env import init_parallel_env
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    return barrier()
+
+
+def gloo_release():
+    return None

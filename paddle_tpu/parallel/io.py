@@ -1,0 +1,37 @@
+"""paddle.distributed.io parity: save/load for distributed programs.
+
+Reference: python/paddle/distributed/io.py (save_persistables etc. over
+the PS runtime). TPU-native: delegates to the sharded checkpoint layer
+(io/checkpoint.py) / plain save."""
+from __future__ import annotations
+
+__all__ = ["save_persistables", "load_persistables",
+           "is_persistable"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", True))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from ..io.save_load import save
+    from ..static.executor import global_scope
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    state = {name: scope._vars[name] for name in scope.local_var_names()}
+    save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from ..io.save_load import load
+    from ..static.executor import global_scope
+    state = load(os.path.join(dirname,
+                              filename or "persistables.pdparams"))
+    scope = global_scope()
+    for name, val in state.items():
+        scope.var(name).set(val)
+    return state

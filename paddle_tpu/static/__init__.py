@@ -296,3 +296,291 @@ def set_program_state(program, state_dict):
     scope = global_scope()
     for n, v in state_dict.items():
         scope._vars[n] = jnp.asarray(np.asarray(v))
+
+
+# ------------------------------------------------- round-3 static tail
+# (reference python/paddle/static/__init__.py __all__)
+
+
+class BuildStrategy:
+    """Accepted-and-recorded build options (reference BuildStrategy pybind).
+    XLA owns fusion/memory decisions on TPU; the knobs exist for parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+        self.enable_addto = False
+        self.enable_sequential_execution = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class ParallelExecutor:
+    """Legacy ParallelExecutor facade (reference fluid ParallelExecutor):
+    delegates to the single Executor — XLA SPMD replaces graph replication."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """static.Print parity: prints at execution via the recorded op."""
+    from ..jit.dy2static import convert_print
+    convert_print(message or "", input)
+    return input
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+def WeightNormParamAttr(dim=None, name=None, initializer=None,
+                        learning_rate=1.0, regularizer=None,
+                        trainable=True, do_model_average=False,
+                        need_clip=True):
+    """Weight-normalized ParamAttr (reference WeightNormParamAttr); the
+    norm reparameterization applies via nn.utils.weight_norm at layer
+    level — here the attr carries the config."""
+    from ..nn.param_attr import ParamAttr
+    attr = ParamAttr(name=name, initializer=initializer,
+                     learning_rate=learning_rate, regularizer=regularizer,
+                     trainable=trainable, do_model_average=do_model_average,
+                     need_clip=need_clip)
+    attr.weight_norm_dim = dim
+    return attr
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static ExponentialMovingAverage):
+    update() accumulates; apply()/restore() swap shadow weights."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self, parameters=None):
+        from ..core.tensor import unwrap
+        params = parameters or _collect_scope_params()
+        for p in params:
+            key = id(p)
+            v = unwrap(p)
+            if key not in self._shadow:
+                self._shadow[key] = (p, v)
+            else:
+                _, s = self._shadow[key]
+                self._shadow[key] = (p, self._decay * s
+                                     + (1 - self._decay) * v)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        from ..core.tensor import unwrap
+
+        @contextlib.contextmanager
+        def guard():
+            self._backup = {k: unwrap(p) for k, (p, _s)
+                            in self._shadow.items()}
+            for k, (p, s) in self._shadow.items():
+                p._replace_value(s)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for k, (p, _s) in self._shadow.items():
+            if k in self._backup:
+                p._replace_value(self._backup[k])
+        self._backup = {}
+
+
+def _collect_scope_params():
+    scope = global_scope()
+    return [p for p in scope._params.values() if p is not None]
+
+
+# --- program serialization (reference static/io.py) -------------------
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+    program = program or default_main_program()
+    return pickle.dumps({
+        "version": 1,
+        "feeds": [v.name for v in feed_vars],
+        "fetches": [v.name for v in fetch_vars],
+        "desc": [(op.op_type, [getattr(i, "name", None) for i in op.inputs],
+                  list(op.outputs))
+                 for op in program.global_block.ops],
+    })
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    import pickle
+
+    import numpy as _np
+    scope = global_scope()
+    state = {n: _np.asarray(scope._vars[n])
+             for n in scope.local_var_names()}
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    scope = global_scope()
+    for name, val in state.items():
+        scope.var(name).set(val)
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference normalize_program prunes to the feed->fetch subgraph; our
+    executor prunes at run time, so normalization is the identity plus
+    recording the endpoints."""
+    program._normalized_feeds = [v.name for v in feed_vars]
+    program._normalized_fetches = [v.name for v in fetch_vars]
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    from ..io.save_load import load as _load
+    state = _load(model_path if model_path.endswith(".pdparams")
+                  else model_path + ".pdparams")
+    return state
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def npu_places(device_ids=None):
+    return []
+
+
+def mlu_places(device_ids=None):
+    return []
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.enable_fp16 = False
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise NotImplementedError(
+            "IPU backend is not part of the TPU build; use the default "
+            "Executor (XLA) path")
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """static.accuracy op parity: top-k accuracy over a batch."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import dispatch
+
+    def fn(logits, lb):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = (topk == lb.reshape(-1, 1)).any(-1)
+        return hit.mean(dtype=jnp.float32)
+
+    return dispatch(fn, input, label, nondiff_args=(1,), name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """static.auc op parity: returns (auc_value, batch_auc, states...)
+    simplified to the AUC value via the rank statistic."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    probs = np.asarray(input.numpy() if isinstance(input, Tensor)
+                       else input)
+    lb = np.asarray(label.numpy() if isinstance(label, Tensor)
+                    else label).reshape(-1)
+    pos_scores = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+        else probs.reshape(-1)
+    order = np.argsort(pos_scores)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(order) + 1)
+    n_pos = (lb == 1).sum()
+    n_neg = (lb == 0).sum()
+    if n_pos == 0 or n_neg == 0:
+        value = 0.0
+    else:
+        value = (ranks[lb == 1].sum() - n_pos * (n_pos + 1) / 2) \
+            / (n_pos * n_neg)
+    import paddle_tpu as pt
+    v = pt.to_tensor(np.float32(value))
+    return v, v, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """CTR metrics (reference static.ctr_metric_bundle): returns
+    (auc, batch_auc, [stat states])."""
+    return auc(input, label)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy LR schedule fn -> ExponentialDecay scheduler handle."""
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func  # IPU sharding has no TPU meaning; identity

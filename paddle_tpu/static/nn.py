@@ -105,3 +105,438 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 def dropout(x, dropout_prob=0.5, is_test=False):
     return _F().dropout(x, p=dropout_prob, training=not is_test)
+
+
+# ----------------------------------------------- round-3 static.nn tail
+# (reference python/paddle/static/nn/__init__.py __all__)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None):
+    from .nn_shim import apply_act
+    from ..nn import Conv3D
+    layer = Conv3D(input.shape[1], num_filters, filter_size, stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   weight_attr=param_attr, bias_attr=bias_attr)
+    return apply_act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1,  # noqa: A002
+                     padding=0, output_padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     output_size=None, data_format="NCHW", name=None):
+    from .nn_shim import apply_act
+    from ..nn import Conv2DTranspose
+    layer = Conv2DTranspose(input.shape[1], num_filters, filter_size,
+                            stride=stride, padding=padding,
+                            output_padding=output_padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr)
+    return apply_act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1,  # noqa: A002
+                     padding=0, output_padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     output_size=None, data_format="NCDHW", name=None):
+    from .nn_shim import apply_act
+    from ..nn import Conv3DTranspose
+    layer = Conv3DTranspose(input.shape[1], num_filters, filter_size,
+                            stride=stride, padding=padding,
+                            output_padding=output_padding,
+                            dilation=dilation, groups=groups,
+                            weight_attr=param_attr, bias_attr=bias_attr)
+    return apply_act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from .nn_shim import apply_act
+    from ..nn import GroupNorm
+    layer = GroupNorm(groups, input.shape[1], epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr)
+    return apply_act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None,  # noqa: A002
+                  bias_attr=None, name=None):
+    from ..nn import InstanceNorm2D
+    layer = InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              enable_scale_and_shift=False, name=None, **kwargs):
+    """Reference static.nn.data_norm: normalize by running batch stats
+    without learned affine (unless enabled)."""
+    from ..nn import functional as F
+    from .nn_shim import apply_act
+    mean = input.mean(axis=0, keepdim=True)
+    var = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (var + epsilon) ** 0.5
+    return apply_act(out, act)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import PReLU
+    n = 1 if mode == "all" else x.shape[1]
+    return PReLU(num_parameters=n, weight_attr=param_attr)(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn import SpectralNorm
+    layer = SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                         eps=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ..nn import Bilinear
+    from .nn_shim import apply_act
+    layer = Bilinear(x.shape[-1], y.shape[-1], size,
+                     weight_attr=param_attr, bias_attr=bias_attr)
+    return apply_act(layer(x, y), act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    return _deform_conv2d_impl(
+        x, offset, mask, num_filters, filter_size, stride, padding,
+        dilation, groups, deformable_groups, param_attr, bias_attr)
+
+
+def _deform_conv2d_impl(x, offset, mask, num_filters, filter_size, stride,
+                        padding, dilation, groups, deformable_groups,
+                        param_attr, bias_attr):
+    """Deformable conv v2 as grid_sample + dense conv (reference
+    deformable_conv_op.cu capability, TPU-composed): per-output-location
+    sampling offsets warp the input, then a standard conv applies."""
+    import paddle_tpu as pt
+    from ..nn import Conv2D
+    from ..nn import functional as F
+    import numpy as np
+    kh = kw = filter_size if isinstance(filter_size, int) else None
+    if kh is None:
+        kh, kw = filter_size
+    b, c, h, w = x.shape
+    layer = Conv2D(c, num_filters, (kh, kw), stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   weight_attr=param_attr, bias_attr=bias_attr)
+    # sample each kernel tap position with its offset via grid_sample,
+    # then weight by mask and run 1x1-equivalent accumulation through
+    # the conv weights: compose as unfold-with-offsets
+    oh = (h + 2 * padding - dilation * (kh - 1) - 1) // stride + 1
+    ow = (w + 2 * padding - dilation * (kw - 1) - 1) // stride + 1
+    base_y = np.arange(oh) * stride - padding
+    base_x = np.arange(ow) * stride - padding
+    cols = []
+    k = 0
+    for i in range(kh):
+        for j in range(kw):
+            # offset channels: [B, 2*K, oh, ow] ordered (y, x) per tap
+            dy = offset[:, 2 * k]
+            dx = offset[:, 2 * k + 1]
+            gy = pt.to_tensor(
+                np.broadcast_to(base_y[:, None] + i * dilation,
+                                (oh, ow)).astype("float32")) + dy
+            gx = pt.to_tensor(
+                np.broadcast_to(base_x[None, :] + j * dilation,
+                                (oh, ow)).astype("float32")) + dx
+            # normalize to [-1, 1] for grid_sample
+            gxn = gx * (2.0 / max(w - 1, 1)) - 1.0
+            gyn = gy * (2.0 / max(h - 1, 1)) - 1.0
+            grid = pt.ops.stack([gxn, gyn], axis=-1)
+            samp = F.grid_sample(x, grid, align_corners=True)
+            if mask is not None:
+                samp = samp * mask[:, k:k + 1]
+            cols.append(samp)
+            k += 1
+    # cols: K tensors [B, C, oh, ow] -> conv weight [F, C, kh, kw] applies
+    # as sum_k W[:, :, k] . cols[k]
+    wgt = layer.weight  # [F, C/groups, kh, kw]
+    out = None
+    k = 0
+    for i in range(kh):
+        for j in range(kw):
+            contrib = F.conv2d(cols[k], wgt[:, :, i:i + 1, j:j + 1])
+            out = contrib if out is None else out + contrib
+            k += 1
+    if layer.bias is not None:
+        out = out + layer.bias.reshape([1, -1, 1, 1])
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,  # noqa: A002
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static.nn.nce):
+    logistic discrimination of the true class against sampled noise."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from ..nn import functional as F
+    d = input.shape[-1]
+    w = pt.create_parameter([num_total_classes, d], attr=param_attr)
+    bvec = pt.create_parameter([num_total_classes], attr=bias_attr,
+                               is_bias=True)
+    lb = label.reshape([-1])
+    pos_logit = (input * w[lb]).sum(axis=-1) + bvec[lb]
+    neg_idx = pt.to_tensor(np.random.randint(
+        0, num_total_classes, (num_neg_samples,)).astype("int64"))
+    neg_logit = input @ w[neg_idx].T + bvec[neg_idx]
+    pos_loss = F.binary_cross_entropy_with_logits(
+        pos_logit, pt.ones_like(pos_logit))
+    neg_loss = F.binary_cross_entropy_with_logits(
+        neg_logit, pt.zeros_like(neg_logit))
+    # undo BCE's mean over the negatives: NCE sums over noise samples
+    return pos_loss + neg_loss * num_neg_samples
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):  # noqa: A002
+    """Lookahead row convolution (reference static.nn.row_conv): each time
+    step mixes the next `future_context_size` steps per feature."""
+    import paddle_tpu as pt
+    from .nn_shim import apply_act
+    d = input.shape[-1]
+    k = future_context_size + 1
+    w = pt.create_parameter([k, d], attr=param_attr)
+    x = input
+    acc = None
+    for i in range(k):
+        if input.ndim == 3:
+            shifted = pt.ops.concat(
+                [x[:, i:], pt.ops.zeros_like(x[:, :i])], axis=1) if i else x
+            term = shifted * w[i]
+        else:
+            shifted = pt.ops.concat(
+                [x[i:], pt.ops.zeros_like(x[:i])], axis=0) if i else x
+            term = shifted * w[i]
+        acc = term if acc is None else acc + term
+    return apply_act(acc, act)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,  # noqa: A002
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS sparse-table embedding (reference static.nn.sparse_embedding).
+    Single-process path: a dense embedding with the same semantics; under
+    the PS runtime the table lives in parallel/ps.py."""
+    from ..nn import Embedding
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(input)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None):
+    """1-D sequence convolution over padded batches (the reference's LoD
+    sequence ops collapse to dense NLC convs on TPU)."""
+    from ..nn import Conv1D
+    from .nn_shim import apply_act
+    x = input.transpose([0, 2, 1])       # [B, D, T]
+    layer = Conv1D(x.shape[1], num_filters, filter_size,
+                   stride=filter_stride,
+                   padding=(filter_size - 1) // 2 if padding else 0,
+                   weight_attr=param_attr, bias_attr=bias_attr)
+    return apply_act(layer(x).transpose([0, 2, 1]), act)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):  # noqa: A002
+    from ..nn import functional as F
+    return F.softmax(input, axis=-1)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference static.nn.py_func: run a host Python function as an op.
+    Eager/trace: the function is applied directly (jax.pure_callback under
+    jit is the XLA equivalent; here static programs replay eagerly)."""
+    if isinstance(x, (list, tuple)):
+        res = func(*x)
+    else:
+        res = func(x)
+    return res
+
+
+# control flow (reference static/nn/control_flow.py) -------------------
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    from ..jit.dy2static import convert_ifelse
+    return convert_ifelse(pred, true_fn or (lambda: None),
+                          false_fn or (lambda: None), ())
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    for pred, fn in pred_fn_pairs:
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        p = bool(np.asarray(pred.numpy() if isinstance(pred, Tensor)
+                            else pred))
+        if p:
+            return fn()
+    return default() if default is not None else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    idx = int(np.asarray(branch_index.numpy()
+                         if isinstance(branch_index, Tensor)
+                         else branch_index))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) else branch_fns
+    fn = fns.get(idx, default)
+    return fn() if fn is not None else None
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    from ..jit.dy2static import convert_while_loop
+    return convert_while_loop(cond, body, tuple(loop_vars))
+
+
+# --------------------------------------------------- legacy sequence ops
+# (reference static.nn sequence_* — LoD ops; TPU-native equivalents work
+# on dense padded [B, T, ...] batches with optional length vectors, which
+# is how variable-length data reaches XLA anyway)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):  # noqa: A002
+    pool_type = pool_type.lower()
+    if pool_type == "sum":
+        return input.sum(axis=1)
+    if pool_type in ("average", "mean", "avg"):
+        return input.mean(axis=1)
+    if pool_type == "sqrt":
+        t = input.shape[1]
+        return input.sum(axis=1) * (1.0 / (t ** 0.5))
+    if pool_type == "max":
+        return input.max(axis=1)
+    if pool_type == "last":
+        return input[:, -1]
+    if pool_type == "first":
+        return input[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_concat(input, name=None):  # noqa: A002
+    import paddle_tpu as pt
+    return pt.ops.concat(list(input), axis=1)
+
+
+def sequence_first_step(input):  # noqa: A002
+    return input[:, 0]
+
+
+def sequence_last_step(input):  # noqa: A002
+    return input[:, -1]
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    import numpy as np
+
+    import paddle_tpu as pt
+    from ..core.tensor import Tensor
+    off = np.asarray(offset.numpy() if isinstance(offset, Tensor)
+                     else offset).reshape(-1)
+    ln = np.asarray(length.numpy() if isinstance(length, Tensor)
+                    else length).reshape(-1)
+    rows = [input[b, int(off[b]):int(off[b]) + int(ln[b])]
+            for b in range(input.shape[0])]
+    # pad to the max kept length for a dense result
+    m = max(int(v) for v in ln)
+    padded = []
+    for r in rows:
+        if r.shape[0] < m:
+            import paddle_tpu as pt2
+            pad = pt2.ops.zeros([m - r.shape[0]] + list(r.shape[1:]),
+                                dtype=r.dtype)
+            r = pt2.ops.concat([r, pad], axis=0)
+        padded.append(r)
+    return pt.ops.stack(padded, axis=0)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    import paddle_tpu as pt
+    reps = y.shape[1] if y.ndim > 1 else 1
+    return pt.ops.repeat_interleave(x, reps, axis=0)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """x already dense [B, T, ...]: returns (x, lengths)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    lengths = pt.to_tensor(np.full((x.shape[0],), x.shape[1], np.int64))
+    return x, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    ln = np.asarray(length.numpy() if isinstance(length, Tensor)
+                    else length).reshape(-1)
+    m = int(ln.max()) if ln.size else 0
+    return x[:, :m]
+
+
+def sequence_reshape(input, new_dim):  # noqa: A002
+    b = input.shape[0]
+    return input.reshape([b, -1, new_dim])
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    import paddle_tpu as pt
+    return pt.ops.put_along_axis(input, index, updates, 1, reduce="add")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+    """Sliding windows of ids: [B, T] -> [B, T, win_size]."""
+    import paddle_tpu as pt
+    cols = []
+    T = input.shape[-1]
+    for i in range(win_size):
+        if i == 0:
+            cols.append(input)
+        else:
+            import numpy as np
+            pad = pt.ops.full(list(input.shape[:-1]) + [i], pad_value,
+                              dtype=input.dtype)
+            cols.append(pt.ops.concat([input[..., i:], pad], axis=-1))
+    return pt.ops.stack(cols, axis=-1)
+
+
+def sequence_reverse(x, name=None):
+    import paddle_tpu as pt
+    return pt.ops.flip(x, axis=[1])
+
+
+class StaticRNN:
+    """Legacy StaticRNN builder (reference fluid StaticRNN). The builder
+    API captures the step body symbolically inside a sub-block — that
+    legacy protocol is superseded here: use paddle_tpu.nn.SimpleRNN /
+    nn.LSTM / nn.GRU (cuDNN-class recurrences, scan-compiled) or
+    jax.lax.scan over a cell for custom steps. Instantiating is allowed
+    (config introspection); entering step() raises with this guidance."""
+
+    def __init__(self, name=None):
+        self.name = name
+
+    def step(self):
+        raise NotImplementedError(
+            "StaticRNN's sub-block step capture is a fluid-era protocol; "
+            "use paddle_tpu.nn.{SimpleRNN,LSTM,GRU} or lax.scan over a "
+            "cell (same capability, XLA-compiled)")
+
+    step_input = memory = update_memory = step_output = output = step

@@ -1,0 +1,8 @@
+"""Shared helper for static.nn act strings."""
+
+
+def apply_act(x, act):
+    if act is None:
+        return x
+    from ..nn import functional as F
+    return getattr(F, act)(x)

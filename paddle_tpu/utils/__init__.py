@@ -64,3 +64,20 @@ def run_check():
           f"({ndev} device(s) available)")
 
 from . import enforce  # noqa: F401,E402
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version parity against our __version__."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True

@@ -217,6 +217,26 @@ def resnext101_32x4d(pretrained=False, **kw):
                   width_per_group=4, **kw)
 
 
+def resnext50_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], groups=64,
+                  width_per_group=4, **kw)
+
+
+def resnext101_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], groups=64,
+                  width_per_group=4, **kw)
+
+
+def resnext152_32x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=32,
+                  width_per_group=4, **kw)
+
+
+def resnext152_64x4d(pretrained=False, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], groups=64,
+                  width_per_group=4, **kw)
+
+
 def wide_resnet50_2(pretrained=False, **kw):
     return ResNet(BottleneckBlock, [3, 4, 6, 3], width_per_group=128, **kw)
 
@@ -226,4 +246,6 @@ def wide_resnet101_2(pretrained=False, **kw):
 
 
 __all__ += ["vgg11", "vgg13", "vgg19", "resnet152", "resnext50_32x4d",
-            "resnext101_32x4d", "wide_resnet50_2", "wide_resnet101_2"]
+            "resnext101_32x4d", "resnext50_64x4d", "resnext101_64x4d",
+            "resnext152_32x4d", "resnext152_64x4d", "wide_resnet50_2",
+            "wide_resnet101_2"]

@@ -16,6 +16,9 @@ __all__ = [
     "squeezenet1_0", "squeezenet1_1", "DenseNet", "densenet121",
     "densenet161", "densenet169", "densenet201", "GoogLeNet", "googlenet",
     "InceptionV3", "inception_v3", "ShuffleNetV2", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+    "densenet264",
 ]
 
 
@@ -29,6 +32,8 @@ def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1, act="relu"):
         layers.append(nn.ReLU6())
     elif act == "hardswish":
         layers.append(nn.Hardswish())
+    elif act == "swish":
+        layers.append(nn.SiLU())
     return nn.Sequential(*layers)
 
 
@@ -336,7 +341,8 @@ class DenseNet(nn.Layer):
     _cfgs = {121: (64, 32, (6, 12, 24, 16)),
              161: (96, 48, (6, 12, 36, 24)),
              169: (64, 32, (6, 12, 32, 32)),
-             201: (64, 32, (6, 12, 48, 32))}
+             201: (64, 32, (6, 12, 48, 32)),
+             264: (64, 32, (6, 12, 64, 48))}
 
     def __init__(self, layers=121, bn_size=4, dropout=0.0,
                  num_classes=1000, with_pool=True):
@@ -380,6 +386,10 @@ def densenet161(pretrained=False, **kw):
 
 def densenet169(pretrained=False, **kw):
     return DenseNet(169, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(layers=264, **kw)
 
 
 def densenet201(pretrained=False, **kw):
@@ -539,7 +549,8 @@ class _ShuffleUnit(nn.Layer):
 class ShuffleNetV2(nn.Layer):
     """reference shufflenetv2.py (x1.0 config default)."""
 
-    _stage_c = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+    _stage_c = {0.25: (24, 48, 96, 512), 0.33: (32, 64, 128, 512),
+                0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
                 1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
 
     def __init__(self, scale=1.0, act="relu", num_classes=1000,
@@ -569,5 +580,30 @@ class ShuffleNetV2(nn.Layer):
         return self.fc(x.flatten(1))
 
 
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
 def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    kw.setdefault("act", "swish")
     return ShuffleNetV2(scale=1.0, **kw)

@@ -259,3 +259,315 @@ class Pad(BaseTransform):
 
     def _apply_image(self, img):
         return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+# ------------------------------------------------- round-3 transform tail
+# (reference python/paddle/vision/transforms/{transforms,functional}.py)
+# Host-side preprocessing is numpy by design (the device step starts at
+# ToTensor); images are HWC (or HW) arrays as from the cv2 backend.
+
+
+def _hwc(img):
+    a = np.asarray(img)
+    return a[:, :, None] if a.ndim == 2 else a
+
+
+def _clip_like(a, ref):
+    return np.clip(a, 0, 255.0 if np.asarray(ref).max() > 1.5 else 1.0)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a = _hwc(img).astype(np.float32)
+    gray = a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114 \
+        if a.shape[-1] >= 3 else a[..., 0]
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    return out.astype(np.asarray(img).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    a = _hwc(img).astype(np.float32)
+    return _clip_like(a * brightness_factor, img).astype(np.float32)
+
+
+def adjust_contrast(img, contrast_factor):
+    a = _hwc(img).astype(np.float32)
+    mean = to_grayscale(a).mean()
+    return _clip_like((a - mean) * contrast_factor + mean,
+                      img).astype(np.float32)
+
+
+def adjust_saturation(img, saturation_factor):
+    a = _hwc(img).astype(np.float32)
+    gray = to_grayscale(a, 3).astype(np.float32)
+    return _clip_like(gray + saturation_factor * (a - gray),
+                      img).astype(np.float32)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via vectorized RGB<->HSV."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    a = _hwc(img).astype(np.float32)
+    scale = 255.0 if np.asarray(img).max() > 1.5 else 1.0
+    rgb = a[..., :3] / scale
+    mx, mn = rgb.max(-1), rgb.min(-1)
+    d = mx - mn + 1e-12
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(mx == r, ((g - b) / d) % 6,
+                 np.where(mx == g, (b - r) / d + 2, (r - g) / d + 4)) / 6.0
+    s = np.where(mx > 0, d / (mx + 1e-12), 0.0)
+    v = mx
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6).astype(np.int32)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i % 6
+    out = np.choose(i[..., None],
+                    [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+                     np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+                     np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return (out * scale).astype(np.float32)
+
+
+def _warp(img, inv_matrix, fill=0.0):
+    """Inverse-map bilinear warp: out(x) = img(M @ x) for 3x3 M."""
+    a = _hwc(img).astype(np.float32)
+    h, w = a.shape[:2]
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = inv_matrix @ coords
+    sx = src[0] / src[2]
+    sy = src[1] / src[2]
+    x0, y0 = np.floor(sx), np.floor(sy)
+    dx, dy = sx - x0, sy - y0
+
+    def at(ix, iy):
+        inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        v = a[np.clip(iy, 0, h - 1).astype(int),
+              np.clip(ix, 0, w - 1).astype(int)]
+        return np.where(inb[:, None], v, fill)
+
+    out = (at(x0, y0) * ((1 - dx) * (1 - dy))[:, None]
+           + at(x0 + 1, y0) * (dx * (1 - dy))[:, None]
+           + at(x0, y0 + 1) * ((1 - dx) * dy)[:, None]
+           + at(x0 + 1, y0 + 1) * (dx * dy)[:, None])
+    return out.reshape(h, w, a.shape[-1]).astype(np.float32)
+
+
+def _affine_matrix(angle, translate, scale, shear, center):
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) + translate
+    rs = np.array([
+        [np.cos(rot + sy) / np.cos(sy),
+         -np.cos(rot + sy) * np.tan(sx) / np.cos(sy) - np.sin(rot), 0],
+        [np.sin(rot + sy) / np.cos(sy),
+         -np.sin(rot + sy) * np.tan(sx) / np.cos(sy) + np.cos(rot), 0],
+        [0, 0, 1]], np.float32) * scale
+    rs[2, 2] = 1.0
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1]], np.float32)
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float32)
+    return pre @ rs @ post
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    a = _hwc(img)
+    h, w = a.shape[:2]
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    center = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    m = _affine_matrix(angle, translate, scale, shear, center)
+    return _warp(a, np.linalg.inv(m), fill)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    return affine(img, angle=angle, center=center, fill=fill)
+
+
+def _homography(src_pts, dst_pts):
+    A = []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    b = np.array([c for p in dst_pts for c in p], np.float32)
+    h8 = np.linalg.lstsq(np.array(A, np.float32), b, rcond=None)[0]
+    return np.append(h8, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    m = _homography(startpoints, endpoints)   # maps start -> end
+    return _warp(img, np.linalg.inv(m), fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase region [i:i+h, j:j+w] with value v (Tensor/ndarray, CHW or
+    HWC both handled: CHW for Tensors per reference)."""
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        a = img.numpy().copy()
+        a[..., i:i + h, j:j + w] = v
+        import paddle_tpu as pt
+        return pt.to_tensor(a)
+    a = np.asarray(img).copy()
+    if a.ndim == 3 and a.shape[-1] in (1, 3, 4):   # HWC
+        a[i:i + h, j:j + w] = v
+    else:
+        a[..., i:i + h, j:j + w] = v
+    return a
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Randomly order and apply brightness/contrast/saturation/hue."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for t in np.random.permutation(len(self.transforms)):
+            img = self.transforms[int(t)]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        a = _hwc(img)
+        h, w = a.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            sh = 0.0
+        elif np.isscalar(self.shear):
+            sh = np.random.uniform(-self.shear, self.shear)
+        else:
+            sh = np.random.uniform(self.shear[0], self.shear[1])
+        return affine(a, angle, (tx, ty), sc, (sh, 0.0), fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        a = _hwc(img)
+        h, w = a.shape[:2]
+        d = self.distortion_scale
+        dw, dh = int(d * w // 2), int(d * h // 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dw + 1), np.random.randint(0, dh + 1)),
+               (w - 1 - np.random.randint(0, dw + 1),
+                np.random.randint(0, dh + 1)),
+               (w - 1 - np.random.randint(0, dw + 1),
+                h - 1 - np.random.randint(0, dh + 1)),
+               (np.random.randint(0, dw + 1),
+                h - 1 - np.random.randint(0, dh + 1))]
+        return perspective(a, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Reference RandomErasing (cutout-style); operates on HWC/CHW arrays."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        a = _hwc(np.asarray(img))
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return erase(a, i, j, eh, ew, self.value)
+        return a
+
+
+__all__ += ["SaturationTransform", "HueTransform", "ColorJitter",
+            "Grayscale", "RandomRotation", "RandomAffine",
+            "RandomPerspective", "RandomErasing", "to_grayscale",
+            "adjust_brightness", "adjust_contrast", "adjust_saturation",
+            "adjust_hue", "affine", "rotate", "perspective", "erase"]
