@@ -1,0 +1,30 @@
+"""Deploy path: jit.save -> StableHLO archive -> inference.Predictor."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.static import InputSpec
+
+
+def main():
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.GELU(),
+                           pt.nn.Linear(32, 4))
+    net.eval()
+    spec = [InputSpec(shape=[None, 16], dtype="float32", name="x")]
+    pt.jit.save(net, "/tmp/served_model", input_spec=spec)
+
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config("/tmp/served_model")
+    pred = create_predictor(cfg)
+    x = np.random.randn(3, 16).astype("float32")
+    names = pred.get_input_names()
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = net(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    print("predictor output matches eager:", out.shape)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""BERT sequence classification fine-tune (eager loop, tiny config)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+
+STEPS = 10
+
+
+def main():
+    pt.seed(0)
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64, max_position_embeddings=32, dropout=0.0)
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    opt = pt.optimizer.AdamW(learning_rate=3e-3,
+                             parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    first = last = None
+    for i in range(STEPS):
+        ids = rng.randint(0, 128, (8, 16)).astype(np.int64)
+        labels = (ids[:, 0] > 64).astype(np.int64)
+        logits = model(pt.to_tensor(ids))
+        loss = pt.nn.functional.cross_entropy(logits, pt.to_tensor(labels))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = v if first is None else first
+        last = v
+    print(f"bert ft loss {first:.3f} -> {last:.3f}")
+    assert last < first
+
+
+if __name__ == "__main__":
+    main()

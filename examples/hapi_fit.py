@@ -1,0 +1,32 @@
+"""High-level hapi training: paddle.Model.fit with callbacks."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+def main():
+    pt.seed(0)
+    x = np.random.randn(128, 1, 16, 16).astype("float32")
+    y = (x.mean((1, 2, 3)) > 0).astype(np.int64)
+    ds = TensorDataset([pt.to_tensor(x), pt.to_tensor(y)])
+    loader = DataLoader(ds, batch_size=16, shuffle=True)
+
+    net = pt.nn.Sequential(
+        pt.nn.Conv2D(1, 8, 3, padding=1), pt.nn.ReLU(),
+        pt.nn.AdaptiveAvgPool2D(1), pt.nn.Flatten(),
+        pt.nn.Linear(8, 2))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=net.parameters()),
+        loss=pt.nn.CrossEntropyLoss(),
+        metrics=pt.metric.Accuracy())
+    model.fit(loader, epochs=2, verbose=1)
+    res = model.evaluate(loader, verbose=0)
+    print("eval:", res)
+    assert res["acc"] > 0.6
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""DiT diffusion: epsilon-prediction training on toy data, then a short
+DDPM ancestral-sampling loop with the trained net."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models.dit import DiTForDiffusion, dit_tiny
+
+STEPS = 15
+
+
+def main():
+    pt.seed(0)
+    cfg = dit_tiny()
+    model = DiTForDiffusion(cfg, num_train_timesteps=100)
+    opt = pt.optimizer.AdamW(learning_rate=2e-3,
+                             parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    first = last = None
+    for i in range(STEPS):
+        x0 = pt.to_tensor(rng.randn(8, 3, 8, 8).astype("float32") * 0.5)
+        t = pt.to_tensor(rng.randint(0, 100, (8,)).astype("int32"))
+        noise = pt.to_tensor(rng.randn(8, 3, 8, 8).astype("float32"))
+        y = pt.to_tensor(rng.randint(0, cfg.num_classes, (8,)).astype("int32"))
+        loss = model.loss(x0, t, noise, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = v if first is None else first
+        last = v
+        if i % 5 == 0:
+            print(f"step {i:3d} mse {v:.4f}")
+    assert last < first
+
+    # a few DDPM sampling steps (x_t -> x_{t-1})
+    import jax.numpy as jnp
+    x = pt.to_tensor(rng.randn(2, 3, 8, 8).astype("float32"))
+    ac = model.alphas_cumprod
+    for t_i in (99, 66, 33, 0):
+        t = pt.to_tensor(np.array([t_i, t_i], "int32"))
+        eps = model(x, t)
+        a_t = float(ac[t_i])
+        a_prev = float(ac[t_i - 33]) if t_i > 0 else 1.0
+        x0_pred = (x - pt.to_tensor(np.float32((1 - a_t) ** 0.5)) * eps) \
+            / np.float32(a_t ** 0.5)
+        x = pt.to_tensor(np.float32(a_prev ** 0.5)) * x0_pred + \
+            pt.to_tensor(np.float32((1 - a_prev) ** 0.5)) * eps
+    print("sampled", x.shape, "finite:", bool(np.isfinite(x.numpy()).all()))
+    print(f"done: {first:.3f} -> {last:.3f}")
+
+
+if __name__ == "__main__":
+    main()

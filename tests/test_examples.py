@@ -1,0 +1,35 @@
+"""Examples are living documentation: each must run end-to-end.
+
+Marked slow (compile-heavy); default suite runs one representative.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL = ["train_gpt2.py", "finetune_bert.py", "train_moe.py",
+       "train_diffusion.py", "data_parallel.py", "tensor_parallel.py",
+       "export_serve.py", "hapi_fit.py"]
+
+
+def _run(name):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run([sys.executable, os.path.join(REPO, "examples", name)],
+                       capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, f"{name} failed:\n{r.stdout}\n{r.stderr}"
+
+
+def test_example_data_parallel():
+    _run("data_parallel.py")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", [n for n in ALL
+                                  if n != "data_parallel.py"])
+def test_example(name):
+    _run(name)
