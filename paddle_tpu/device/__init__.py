@@ -125,3 +125,108 @@ class _CudaNamespace:
 
 
 cuda = _CudaNamespace()
+
+
+# ------------------------------------------------ reference device shims
+
+
+def get_cudnn_version():
+    return None          # no cuDNN in the TPU build
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return device_type in ("tpu", "axon")
+
+
+def get_available_custom_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class XPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+
+class IPUPlace(XPUPlace):
+    pass
+
+
+class MLUPlace(XPUPlace):
+    pass
+
+
+class _Stream:
+    """Stream facade: XLA orders work per device; sync == block."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+    def wait_stream(self, stream):
+        self.synchronize()
+
+    def wait_event(self, event):
+        self.synchronize()
+
+    def record_event(self, event=None):
+        return event
+
+
+_current_stream = _Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    _current_stream = stream
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _current_stream
+        old, _cur = _current_stream, stream
+        set_stream(stream)
+        try:
+            yield
+        finally:
+            set_stream(old)
+
+    return guard()
+
+
+Stream = _Stream

@@ -1,5 +1,6 @@
 """paddle_tpu.nn — layers, functional, initializers, clip."""
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer import Layer, LayerDict, LayerList, ParameterList, Sequential  # noqa: F401

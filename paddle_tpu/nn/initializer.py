@@ -161,3 +161,61 @@ class Orthogonal(Initializer):
         q = q * jnp.sign(jnp.diagonal(r))
         q = q.T if rows < cols else q
         return (self.gain * q[:rows, :cols]).reshape(shape).astype(d)
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (reference initializer/Bilinear) —
+    the standard init for transposed-conv upsample layers."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+        weight = np.zeros(shape, np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects 4-D weight")
+        f = int(np.ceil(shape[3] / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            w = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[(i // (shape[2] * shape[3])) // shape[1],
+                   (i // (shape[2] * shape[3])) % shape[1], y, x] = w
+        import jax.numpy as jnp
+        return jnp.asarray(weight, convert_dtype(dtype) or jnp.float32)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference initializer/Dirac)."""
+
+    def __init__(self, groups=1, name=None):
+        self._groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+        w = np.zeros(shape, np.float32)
+        out_c, in_c = shape[0], shape[1]
+        mid = tuple(s // 2 for s in shape[2:])
+        # reference semantics: per group g, delta at (g*opg + d, d)
+        opg = out_c // self._groups
+        for g in range(self._groups):
+            for d in range(min(opg, in_c)):
+                w[(g * opg + d, d) + mid] = 1.0
+        import jax.numpy as jnp
+        return jnp.asarray(w, convert_dtype(dtype) or jnp.float32)
+
+
+_global_initializer = [None, None]   # (weight_init, bias_init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference set_global_initializer: overrides layer defaults (used by
+    Layer.create_parameter when no explicit attr/default is given)."""
+    _global_initializer[0] = weight_init
+    _global_initializer[1] = bias_init
+
+
+def get_global_initializer(is_bias=False):
+    return _global_initializer[1 if is_bias else 0]
+
+
+__all__ += ["Bilinear", "Dirac", "set_global_initializer"]

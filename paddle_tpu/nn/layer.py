@@ -104,6 +104,11 @@ class Layer:
                          attr=None, is_bias=False):
         dtype = convert_dtype(dtype) or self._dtype
         init = default_initializer
+        # set_global_initializer overrides layer DEFAULTS (reference
+        # semantics) but never an explicit attr-specified initializer
+        g = I.get_global_initializer(is_bias)
+        if g is not None:
+            init = g
         if isinstance(attr, I.Initializer):
             # paddle.ParamAttr._to_attr parity: a bare Initializer is a
             # valid weight_attr/bias_attr and wins over the default

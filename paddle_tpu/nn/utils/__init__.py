@@ -1,0 +1,141 @@
+"""paddle.nn.utils parity (reference python/paddle/nn/utils/):
+weight_norm / spectral_norm reparameterizations + parameter vector utils.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, unwrap, wrap
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)   # dim=None -> all
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v/||v|| (reference
+    weight_norm_hook.py). Recomputed via a forward-pre hook each call so
+    optimizing g/v flows into the effective weight."""
+    from ..layer import Layer
+    assert isinstance(layer, Layer)
+    w = getattr(layer, name)
+    raw = unwrap(w)
+    # dim=None: paddle norms over ALL axes (scalar g); _norm_except
+    # handles None naturally via its axis filter
+    from ...core.tensor import Parameter
+    g = Parameter(_norm_except(raw, dim))
+    v = Parameter(raw)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the original becomes a derived (non-trainable) buffer value
+    w.stop_gradient = True
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        # derived tensor participates in autograd through v/g
+        from ...core.tensor import dispatch
+        setattr(lyr, name, dispatch(
+            lambda vvv, ggg: ggg * vvv / (_norm_except(vvv, dim) + 1e-12),
+            getattr(lyr, name + "_v"), getattr(lyr, name + "_g"),
+            name="weight_norm"))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (handle, name, dim)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter and drop the hook."""
+    handle, pname, dim = layer._weight_norm_hook
+    if pname != name:
+        raise ValueError(f"weight_norm was registered on {pname!r}")
+    try:
+        handle.remove()
+    except AttributeError:
+        pass
+    vv = unwrap(getattr(layer, name + "_v"))
+    gg = unwrap(getattr(layer, name + "_g"))
+    eff = gg * vv / (_norm_except(vv, dim) + 1e-12)
+    from ...core.tensor import Parameter
+    p = Parameter(eff)
+    layer.__dict__.pop(name, None)   # drop the derived shadow
+    layer.add_parameter(name, p)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if hasattr(layer, name + "_g"):
+        delattr(layer, name + "_g")
+    if hasattr(layer, name + "_v"):
+        delattr(layer, name + "_v")
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization to ``layer.<name>`` via a forward-pre
+    hook over the nn.SpectralNorm power-iteration module."""
+    from ..layers_basic import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(list(w.shape), dim=dim, power_iters=n_power_iterations,
+             eps=eps)
+    layer.add_sublayer(name + "_spectral_norm", sn)
+    orig = layer._parameters.get(name)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+
+    def _recompute(lyr, inputs):
+        setattr(lyr, name, sn(getattr(lyr, name + "_orig")))
+        return None
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [unwrap(p).reshape(-1) for p in parameters]
+    return wrap(jnp.concatenate(vals), stop_gradient=False)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = unwrap(vec)
+    off = 0
+    for p in parameters:
+        n = p.size
+        p._replace_value(v[off:off + n].reshape(p._value.shape))
+        off += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style in-place grad clip (reference nn/utils/clip_grad.py)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    parameters = list(parameters)   # generators: iterate twice below
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return wrap(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(unwrap(g)))
+                                   for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(unwrap(g)) ** norm_type) for g in grads])
+        ) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("non-finite grad norm")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._replace_value(unwrap(p.grad) * scale)
+    return wrap(total)

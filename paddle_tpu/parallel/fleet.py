@@ -237,3 +237,88 @@ def worker_num():
 
 def is_first_worker():
     return fleet.is_first_worker()
+
+
+Fleet = _Fleet   # class name parity (reference fleet/__init__.py Fleet)
+
+
+class UtilBase:
+    """fleet.UtilBase parity (reference fleet/base/util_factory.py):
+    cross-worker helpers; single-process semantics here."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        return input
+
+    def barrier(self, comm_world="worker"):
+        return None
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        return [input]
+
+    def get_file_shard(self, files):
+        from . import env
+        n = env.get_world_size()
+        r = env.get_rank()
+        return files[r::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        from . import env
+        if env.get_rank() == rank_id:
+            print(message)
+
+
+class _DataGeneratorBase:
+    """fleet data generator protocol (reference
+    fleet/data_generator/data_generator.py): subclass implements
+    generate_sample; run_from_* drive it over stdin/files producing
+    (name, values) slot tuples."""
+
+    def __init__(self):
+        self._batch = 1
+
+    def set_batch(self, batch_size):
+        self._batch = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def run_from_memory(self, lines=()):
+        out = []
+        for line in lines:
+            g = self.generate_sample(line)
+            for rec in (g() if callable(g) else g):
+                out.append(self._format(rec))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            g = self.generate_sample(line)
+            for rec in (g() if callable(g) else g):
+                sys.stdout.write(self._line(rec) + "\n")
+
+    def _format(self, rec):
+        return rec
+
+    def _line(self, rec):
+        parts = []
+        for name, values in rec:
+            parts.append(f"{len(values)} " + " ".join(str(v)
+                                                      for v in values))
+        return " ".join(parts)
+
+
+class MultiSlotDataGenerator(_DataGeneratorBase):
+    """Numeric slots (reference MultiSlotDataGenerator)."""
+
+
+class MultiSlotStringDataGenerator(_DataGeneratorBase):
+    """String slots (reference MultiSlotStringDataGenerator)."""
+
+
+from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: E402
+                         UserDefinedRoleMaker)
+
+__all__ += ["Fleet", "UtilBase", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator", "PaddleCloudRoleMaker",
+            "UserDefinedRoleMaker", "Role"]

@@ -203,3 +203,45 @@ def profiler_step_timer():
     t.start()
     yield t
     t.stop()
+
+
+class SortedKeys:
+    """Report sort keys (reference profiler/profiler_statistic.py)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """Report views (reference profiler/profiler.py SummaryView)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(profiler_result, path):
+    """Persist a captured result (reference export_protobuf; the jax trace
+    directory is the TPU-native artifact — we record its path)."""
+    import json
+    with open(path, "w") as f:
+        json.dump({"format": "paddle_tpu-trace-pointer",
+                   "trace_dir": getattr(profiler_result, "trace_dir",
+                                        str(profiler_result))}, f)
+    return path
+
+
+def load_profiler_result(path):
+    import json
+    with open(path) as f:
+        return json.load(f)

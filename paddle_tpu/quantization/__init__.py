@@ -586,3 +586,63 @@ def _set_sublayer(root, dotted, new):
     for p in parts[:-1]:
         obj = getattr(obj, p)
     setattr(obj, parts[-1], new)
+
+
+class Int8InferLinear(Layer):
+    """True-int8 inference Linear (reference capability: the cutlass int8
+    deploy kernels behind PTQ convert). Weights pre-quantized to int8 with
+    per-output-channel scales; forward runs the Pallas int8 MXU matmul
+    (ops/pallas/quant_matmul.py) with activation quantization per batch
+    and fused dequantize."""
+
+    def __init__(self, layer):
+        super().__init__()
+        import jax.numpy as jnp
+
+        from ..core.tensor import unwrap, wrap
+        from ..ops.pallas.quant_matmul import quantize_tensor
+        w = unwrap(layer.weight)
+        qw, sw = quantize_tensor(w, per_channel_axis=1)
+        self.register_buffer("qweight", wrap(qw))
+        self.register_buffer("w_scale", wrap(jnp.asarray(sw)))
+        self.bias = getattr(layer, "bias", None)
+
+    def forward(self, x):
+        from ..core.tensor import dispatch
+        from ..ops.pallas import quant_matmul as qm
+
+        def fn(xv, qw, sw):
+            import jax
+            # deploy-only path: int8 rounding is non-differentiable and the
+            # Pallas kernel has no JVP rule — cut the tangent explicitly
+            xv = jax.lax.stop_gradient(xv)
+            shape = xv.shape
+            x2 = xv.reshape(-1, shape[-1])
+            qx, sx = qm.quantize_tensor(x2)
+            out = qm.quantized_matmul(
+                qx, qw, sx, sw, interpret=not qm.available())
+            return out.reshape(shape[:-1] + (out.shape[-1],)).astype(
+                xv.dtype)
+
+        out = dispatch(fn, x, self.qweight, self.w_scale,
+                       nondiff_args=(1, 2), name="int8_linear")
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def to_int8_inference(model, inplace=False):
+    """Replace (Quanted)Linear layers with true-int8 Int8InferLinear for
+    deployment (the step after convert(); reference: save_quantized_model
+    emitting int8 ops)."""
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+    for name, sub in list(model.named_sublayers()):
+        from ..nn.layers_basic import Linear
+        if isinstance(sub, (Linear, QuantedLinear)):
+            _set_sublayer(model, name, Int8InferLinear(sub))
+    return model
+
+
+__all__ += ["Int8InferLinear", "to_int8_inference"]

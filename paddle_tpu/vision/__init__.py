@@ -1,2 +1,2 @@
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50, vgg16  # noqa: F401

@@ -163,3 +163,118 @@ class ImageFolder(DatasetFolder):
 
     def __len__(self):
         return len(self.samples)
+
+
+class FashionMNIST(MNIST):
+    """Same idx format as MNIST (reference vision/datasets/mnist.py
+    FashionMNIST subclass)."""
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 python pickle format (fine labels)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import pickle
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            self.images = d[b"data"].reshape(-1, 3, 32, 32).astype(
+                np.float32) / 255.0
+            self.labels = np.asarray(d[b"fine_labels"], np.int64)
+        else:
+            fake = FakeData(1000 if mode == "train" else 100, (3, 32, 32),
+                            100)
+            self.images = np.stack([fake[i][0] for i in range(len(fake))])
+            self.labels = np.stack([fake[i][1] for i in range(len(fake))])
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers layout (jpg folder + labels .mat or fake)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        self.transform = transform
+        if data_file and os.path.isdir(data_file):
+            files = sorted(f for f in os.listdir(data_file)
+                           if f.lower().endswith((".jpg", ".jpeg")))
+            self.samples = [os.path.join(data_file, f) for f in files]
+            if label_file:
+                from scipy.io import loadmat
+                labels = loadmat(label_file)["labels"].reshape(-1) - 1
+                if setid_file:
+                    key = {"train": "trnid", "valid": "valid",
+                           "test": "tstid"}[mode]
+                    ids = loadmat(setid_file)[key].reshape(-1) - 1
+                    self.samples = [self.samples[i] for i in ids]
+                    labels = labels[ids]
+                self.labels = labels.astype(np.int64)
+            else:
+                raise ValueError(
+                    "Flowers with real data needs label_file "
+                    "(imagelabels.mat); labels cannot be inferred from "
+                    "filenames")
+        else:
+            fake = FakeData(200 if mode == "train" else 50, (3, 64, 64), 102)
+            self.images = np.stack([fake[i][0] for i in range(len(fake))])
+            self.labels = np.stack([fake[i][1] for i in range(len(fake))])
+            self.samples = None
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        if self.samples is not None:
+            from PIL import Image
+            img = np.asarray(Image.open(self.samples[idx]).convert("RGB"),
+                             np.float32).transpose(2, 0, 1) / 255.0
+        else:
+            img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation layout (JPEGImages/ +
+    SegmentationClass/); fake data without a data_file."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        self.pairs = []
+        if data_file and os.path.isdir(data_file):
+            jdir = os.path.join(data_file, "JPEGImages")
+            sdir = os.path.join(data_file, "SegmentationClass")
+            for f in sorted(os.listdir(sdir)) if os.path.isdir(sdir) else []:
+                stem = os.path.splitext(f)[0]
+                self.pairs.append((os.path.join(jdir, stem + ".jpg"),
+                                   os.path.join(sdir, f)))
+            if not self.pairs:
+                raise ValueError(
+                    f"no segmentation samples under {data_file!r} "
+                    "(expected JPEGImages/ + SegmentationClass/)")
+        else:
+            fake = FakeData(50, (3, 64, 64), 21)
+            self.images = np.stack([fake[i][0] for i in range(len(fake))])
+            self.masks = np.stack(
+                [np.zeros((64, 64), np.int64) for _ in range(len(fake))])
+
+    def __len__(self):
+        return len(self.pairs) if self.pairs else len(self.images)
+
+    def __getitem__(self, idx):
+        if self.pairs:
+            from PIL import Image
+            img = np.asarray(Image.open(self.pairs[idx][0]).convert("RGB"),
+                             np.float32).transpose(2, 0, 1) / 255.0
+            mask = np.asarray(Image.open(self.pairs[idx][1]), np.int64)
+        else:
+            img, mask = self.images[idx], self.masks[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, mask
+
+
+__all__ += ["FashionMNIST", "Cifar100", "Flowers", "VOC2012"]

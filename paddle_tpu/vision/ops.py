@@ -1,0 +1,650 @@
+"""paddle.vision.ops parity: detection operators.
+
+Reference: python/paddle/vision/ops.py over phi kernels
+(nms_kernel.cu, roi_align_kernel.cu, yolo_box_op.cu, ...). TPU-native
+split: dense, fixed-shape math (roi_align/roi_pool/yolo_box/prior_box/
+box_coder/deform_conv2d) is jnp/XLA; data-dependent-size selection ops
+(nms, generate_proposals, distribute_fpn_proposals) run host-side numpy —
+exactly the part the reference also runs synchronously on tiny tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+
+from ..core.tensor import Tensor, dispatch, unwrap, wrap
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "read_file", "decode_jpeg", "roi_pool",
+           "RoIPool", "psroi_pool", "PSRoIPool", "roi_align", "RoIAlign",
+           "nms", "matrix_nms"]
+
+
+def _np(x):
+    return np.asarray(unwrap(x) if isinstance(x, Tensor) else x)
+
+
+# ------------------------------------------------------------------ NMS
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    xx1 = np.maximum(x1[:, None], x1[None, :])
+    yy1 = np.maximum(y1[:, None], y1[None, :])
+    xx2 = np.minimum(x2[:, None], x2[None, :])
+    yy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+    return inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (reference vision/ops.py nms): returns kept indices sorted
+    by score. Category-aware when category_idxs given."""
+    b = _np(boxes).astype(np.float64)
+    n = b.shape[0]
+    sc = _np(scores).astype(np.float64) if scores is not None else \
+        np.arange(n, 0, -1, dtype=np.float64)
+    cats = _np(category_idxs) if category_idxs is not None else \
+        np.zeros(n, np.int64)
+    keep_all = []
+    for c in np.unique(cats):
+        idx = np.where(cats == c)[0]
+        order = np.argsort(-sc[idx])
+        iou = _iou_matrix(b[idx])          # category subset only
+        kept = []
+        suppressed = np.zeros(idx.size, bool)
+        for oi in order:
+            if suppressed[oi]:
+                continue
+            kept.append(idx[oi])
+            suppressed |= iou[oi] > iou_threshold
+            suppressed[oi] = False
+        keep_all.extend(kept)
+    keep_all = sorted(keep_all, key=lambda i: -sc[i])
+    if top_k is not None:
+        keep_all = keep_all[:top_k]
+    return pt.to_tensor(np.asarray(keep_all, np.int64))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference phi matrix_nms): soft decay by max-IoU with
+    higher-scored same-class boxes. Single-image path."""
+    bb = _np(bboxes)
+    sc = _np(scores)
+    if bb.ndim == 3:
+        bb = bb[0]
+    if sc.ndim == 3:
+        sc = sc[0]
+    outs, idxs = [], []
+    C = sc.shape[0]
+    for c in range(C):
+        if c == background_label:
+            continue
+        s = sc[c]
+        sel = np.where(s > score_threshold)[0]
+        if sel.size == 0:
+            continue
+        order = sel[np.argsort(-s[sel])][:nms_top_k]
+        boxes_c = bb[order]
+        iou = _iou_matrix(boxes_c)
+        iou = np.triu(iou, 1)
+        max_iou = iou.max(0, initial=0.0)
+        if use_gaussian:
+            decay = np.exp(-(iou ** 2 - max_iou[None, :] ** 2)
+                           / gaussian_sigma).min(0, initial=1.0)
+        else:
+            decay = ((1 - iou) / (1 - max_iou[None, :] + 1e-10)
+                     ).min(0, initial=1.0)
+        new_s = s[order] * decay
+        ok = new_s > post_threshold
+        for i, o in enumerate(order):
+            if ok[i]:
+                outs.append([c, new_s[i], *bb[o]])
+                idxs.append(o)
+    outs = sorted(zip(outs, idxs), key=lambda t: -t[0][1])[:keep_top_k]
+    det = np.asarray([o for o, _ in outs], np.float32).reshape(-1, 6)
+    index = np.asarray([i for _, i in outs], np.int64)
+    res = [pt.to_tensor(det)]
+    if return_index:
+        res.append(pt.to_tensor(index))
+    if return_rois_num:
+        res.append(pt.to_tensor(np.asarray([det.shape[0]], np.int32)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+# ------------------------------------------------------------- RoI ops
+
+
+def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, sampling_ratio,
+                   aligned):
+    """feat [C, H, W]; roi [4] (x1, y1, x2, y2)."""
+    off = 0.5 if aligned else 0.0
+    x1 = roi[0] * spatial_scale - off
+    y1 = roi[1] * spatial_scale - off
+    x2 = roi[2] * spatial_scale - off
+    y2 = roi[3] * spatial_scale - off
+    # aligned=True permits degenerate rois; unaligned clamps to 1px
+    # (reference roi_align_kernel semantics)
+    min_sz = 1e-3 if aligned else 1.0
+    rw = jnp.maximum(x2 - x1, min_sz)
+    rh = jnp.maximum(y2 - y1, min_sz)
+    bin_h = rh / out_h
+    bin_w = rw / out_w
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample points per bin
+    ys = y1 + (jnp.arange(out_h)[:, None] + (jnp.arange(s)[None, :] + 0.5)
+               / s) * bin_h                      # [out_h, s]
+    xs = x1 + (jnp.arange(out_w)[:, None] + (jnp.arange(s)[None, :] + 0.5)
+               / s) * bin_w                      # [out_w, s]
+    H, W = feat.shape[-2], feat.shape[-1]
+
+    def bilinear(y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(y - y0, 0, 1)
+        wx = jnp.clip(x - x0, 0, 1)
+        v00 = feat[:, y0.astype(int)][:, :, x0.astype(int)]
+        v01 = feat[:, y0.astype(int)][:, :, x1_.astype(int)]
+        v10 = feat[:, y1_.astype(int)][:, :, x0.astype(int)]
+        v11 = feat[:, y1_.astype(int)][:, :, x1_.astype(int)]
+        return (v00 * ((1 - wy)[:, None] * (1 - wx)[None, :])
+                + v01 * ((1 - wy)[:, None] * wx[None, :])
+                + v10 * (wy[:, None] * (1 - wx)[None, :])
+                + v11 * (wy[:, None] * wx[None, :]))
+
+    yflat = ys.reshape(-1)                       # [out_h*s]
+    xflat = xs.reshape(-1)                       # [out_w*s]
+    vals = bilinear(yflat, xflat)                # [C, out_h*s, out_w*s]
+    C = vals.shape[0]
+    vals = vals.reshape(C, out_h, s, out_w, s)
+    return vals.mean((2, 4))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference phi roi_align_kernel): bilinear-sampled average
+    per bin; differentiable (pure jnp gather math)."""
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    bn = _np(boxes_num).astype(np.int64)
+    batch_of_roi = np.repeat(np.arange(bn.size), bn)
+
+    def fn(xv, bv):
+        outs = []
+        for i in range(bv.shape[0]):
+            feat = xv[int(batch_of_roi[i])]
+            outs.append(_roi_align_one(feat, bv[i], out_h, out_w,
+                                       spatial_scale, sampling_ratio,
+                                       aligned))
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, xv.shape[1], out_h, out_w), xv.dtype)
+
+    return dispatch(fn, x, boxes, name="roi_align")
+
+
+class RoIAlign(pt.nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max-pool RoI bins (reference phi roi_pool_kernel): exact masked max
+    over the full feature map per bin (no window-size cap)."""
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    bn = _np(boxes_num).astype(np.int64)
+    batch_of_roi = np.repeat(np.arange(bn.size), bn)
+
+    def fn(xv, bv):
+        H, W = xv.shape[-2], xv.shape[-1]
+        yidx = jnp.arange(H)[:, None]
+        xidx = jnp.arange(W)[None, :]
+        outs = []
+        for i in range(bv.shape[0]):
+            feat = xv[int(batch_of_roi[i])]
+            x1 = jnp.round(bv[i, 0] * spatial_scale)
+            y1 = jnp.round(bv[i, 1] * spatial_scale)
+            x2 = jnp.maximum(jnp.round(bv[i, 2] * spatial_scale), x1 + 1)
+            y2 = jnp.maximum(jnp.round(bv[i, 3] * spatial_scale), y1 + 1)
+            bin_h = (y2 - y1) / out_h
+            bin_w = (x2 - x1) / out_w
+            rows = []
+            for r in range(out_h):
+                cols = []
+                for c in range(out_w):
+                    ys = jnp.floor(y1 + r * bin_h)
+                    ye = jnp.ceil(y1 + (r + 1) * bin_h)
+                    xs = jnp.floor(x1 + c * bin_w)
+                    xe = jnp.ceil(x1 + (c + 1) * bin_w)
+                    m = ((yidx >= ys) & (yidx < ye)
+                         & (xidx >= xs) & (xidx < xe))
+                    cols.append(jnp.max(
+                        jnp.where(m[None], feat, -jnp.inf), axis=(1, 2)))
+                rows.append(jnp.stack(cols, -1))
+            outs.append(jnp.stack(rows, -2))
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, xv.shape[1], out_h, out_w), xv.dtype)
+
+    return dispatch(fn, x, boxes, name="roi_pool")
+
+
+class RoIPool(pt.nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pool (reference phi psroi_pool):
+    channel block (i, j) serves output bin (i, j)."""
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    aligned = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                        sampling_ratio=2, aligned=False)
+
+    def fn(al):
+        n, C, H, W = al.shape
+        c_out = C // (out_h * out_w)
+        # phi layout: input channel (c*out_h + i)*out_w + j serves output
+        # channel c at bin (i, j) — channel-major, then bin-major
+        al = al.reshape(n, c_out, out_h, out_w, H, W)
+        rows = []
+        for i in range(out_h):
+            cols = [al[:, :, i, j, i, j] for j in range(out_w)]
+            rows.append(jnp.stack(cols, -1))       # [n, c_out, out_w]
+        return jnp.stack(rows, -2)                 # [n, c_out, out_h, out_w]
+
+    return dispatch(fn, aligned, name="psroi_pool")
+
+
+class PSRoIPool(pt.nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+# ------------------------------------------------------------- anchors
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference phi prior_box)."""
+    fh, fw = int(input.shape[-2]), int(input.shape[-1])
+    ih, iw = int(image.shape[-2]), int(image.shape[-1])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars = ars + [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * step_w
+            cy = (i + offset) * step_h
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((cx, cy, ms, ms))
+                if max_sizes:
+                    s = (ms * max_sizes[k]) ** 0.5
+                    cell.append((cx, cy, s, s))
+                for a in ars:
+                    if abs(a - 1.0) < 1e-6:
+                        continue
+                    cell.append((cx, cy, ms * a ** 0.5, ms / a ** 0.5))
+            for (ccx, ccy, bw, bh) in cell:
+                boxes.append([(ccx - bw / 2) / iw, (ccy - bh / 2) / ih,
+                              (ccx + bw / 2) / iw, (ccy + bh / 2) / ih])
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return pt.to_tensor(out), pt.to_tensor(var)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference phi box_coder)."""
+    pb = _np(prior_box).astype(np.float32)
+    pv = _np(prior_box_var).astype(np.float32) if prior_box_var is not None \
+        else np.ones_like(pb)
+    tb = _np(target_box).astype(np.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = np.stack([(tcx - pcx) / pw / pv[:, 0],
+                        (tcy - pcy) / ph / pv[:, 1],
+                        np.log(tw / pw) / pv[:, 2],
+                        np.log(th / ph) / pv[:, 3]], -1)
+    else:  # decode_center_size; tb [N, M, 4] or [N, 4]
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+
+        def bc(v):
+            # axis=0: prior i decodes row i (broadcast over dim 1);
+            # axis=1: prior j decodes column j (broadcast over dim 0)
+            return v[:, None] if axis == 0 else v[None, :]
+
+        dcx = bc(pv[:, 0]) * tb[..., 0] * bc(pw) + bc(pcx)
+        dcy = bc(pv[:, 1]) * tb[..., 1] * bc(ph) + bc(pcy)
+        dw = np.exp(bc(pv[:, 2]) * tb[..., 2]) * bc(pw)
+        dh = np.exp(bc(pv[:, 3]) * tb[..., 3]) * bc(ph)
+        out = np.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                        dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], -1)
+        out = out.squeeze(1) if out.shape[1] == 1 else out
+    return pt.to_tensor(out.astype(np.float32))
+
+
+# ------------------------------------------------------------- YOLO
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head outputs to boxes/scores (reference phi
+    yolo_box kernel)."""
+    xv = _np(x).astype(np.float32)
+    n, c, h, w = xv.shape
+    na = len(anchors) // 2
+    an = np.asarray(anchors, np.float32).reshape(na, 2)
+    xv = xv.reshape(n, na, 5 + class_num, h, w)
+    gx = np.arange(w, dtype=np.float32)[None, None, None, :]
+    gy = np.arange(h, dtype=np.float32)[None, None, :, None]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    bx = (sig(xv[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + gx) / w
+    by = (sig(xv[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + gy) / h
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    bw = np.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / in_w
+    bh = np.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / in_h
+    conf = sig(xv[:, :, 4])
+    probs = sig(xv[:, :, 5:])
+    scores = conf[:, :, None] * probs
+    isz = _np(img_size).astype(np.float32)            # [N, 2] (h, w)
+    imh = isz[:, 0].reshape(n, 1, 1, 1)
+    imw = isz[:, 1].reshape(n, 1, 1, 1)
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = np.clip(x1, 0, imw - 1)
+        y1 = np.clip(y1, 0, imh - 1)
+        x2 = np.clip(x2, 0, imw - 1)
+        y2 = np.clip(y2, 0, imh - 1)
+    boxes = np.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+    mask = (conf > conf_thresh).reshape(n, -1, 1)
+    boxes = boxes * mask
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    return pt.to_tensor(boxes.astype(np.float32)), \
+        pt.to_tensor(scores.astype(np.float32))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference phi yolov3_loss), simplified dense
+    form: coordinate MSE + objectness/class BCE against assigned anchors."""
+    shp = x.shape
+    n, c, h, w = shp
+    na = len(anchor_mask)
+    # dense surrogate: push all predictions toward objectness 0 except
+    # cells containing a gt center, where coord/class terms apply
+    gtb = _np(gt_box)                                  # [N, B, 4] cx cy w h
+    gtl = _np(gt_label).astype(np.int64)               # [N, B]
+    obj_target = np.zeros((n, na, h, w), np.float32)
+    coord_target = np.zeros((n, na, 4, h, w), np.float32)
+    cls_target = np.zeros((n, na, class_num, h, w), np.float32)
+    for b in range(n):
+        for k in range(gtb.shape[1]):
+            cx, cy, bw, bh = gtb[b, k]
+            if bw <= 0 or bh <= 0:
+                continue
+            gi = min(int(cx * w), w - 1)
+            gj = min(int(cy * h), h - 1)
+            obj_target[b, :, gj, gi] = 1.0
+            coord_target[b, :, 0, gj, gi] = cx * w - gi
+            coord_target[b, :, 1, gj, gi] = cy * h - gj
+            cls_target[b, :, gtl[b, k], gj, gi] = 1.0
+
+    def fn(xv):
+        xv = xv.reshape(n, na, 5 + class_num, h, w)
+        sig = jax.nn.sigmoid
+        pred_xy = sig(xv[:, :, 0:2])
+        obj_logit = xv[:, :, 4]
+        cls_logit = xv[:, :, 5:]
+        obj_t = jnp.asarray(obj_target)
+        coord_loss = jnp.sum(jnp.square(pred_xy - jnp.asarray(
+            coord_target[:, :, 0:2])) * obj_t[:, :, None])
+        bce = lambda lg, t: jnp.maximum(lg, 0) - lg * t + jnp.log1p(
+            jnp.exp(-jnp.abs(lg)))
+        obj_loss = jnp.sum(bce(obj_logit, obj_t))
+        cls_loss = jnp.sum(bce(cls_logit, jnp.asarray(cls_target))
+                           * obj_t[:, :, None])
+        return (coord_loss + obj_loss + cls_loss) / n
+
+    return dispatch(fn, x, name="yolo_loss")
+
+
+# ---------------------------------------------------------- proposals
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (reference phi
+    distribute_fpn_proposals)."""
+    rois = _np(fpn_rois).astype(np.float32)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.where(lvl == level)[0]
+        outs.append(pt.to_tensor(rois[sel]))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.array([])
+    res_num = [pt.to_tensor(np.asarray([o.shape[0]], np.int32))
+               for o in outs] if rois_num is not None else None
+    return outs, pt.to_tensor(restore.astype(np.int64).reshape(-1, 1)), \
+        res_num
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference phi generate_proposals_v2):
+    decode anchors + deltas, clip, filter small, NMS. Single image."""
+    # scores [A,H,W] -> (H,W,A)-major flat order to pair with deltas/anchors
+    sc = _np(scores)[0].transpose(1, 2, 0).reshape(-1)
+    deltas = _np(bbox_deltas)[0].transpose(1, 2, 0).reshape(-1, 4)
+    an = _np(anchors).reshape(-1, 4)
+    var = _np(variances).reshape(-1, 4)
+    ih, iw = [float(v) for v in _np(img_size)[0][:2]]
+    aw = an[:, 2] - an[:, 0]
+    ah = an[:, 3] - an[:, 1]
+    acx = an[:, 0] + aw / 2
+    acy = an[:, 1] + ah / 2
+    dcx = var[:, 0] * deltas[:, 0] * aw + acx
+    dcy = var[:, 1] * deltas[:, 1] * ah + acy
+    dw = np.exp(np.minimum(var[:, 2] * deltas[:, 2], 10)) * aw
+    dh = np.exp(np.minimum(var[:, 3] * deltas[:, 3], 10)) * ah
+    boxes = np.stack([dcx - dw / 2, dcy - dh / 2,
+                      dcx + dw / 2, dcy + dh / 2], -1)
+    boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw)
+    boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih)
+    keep = np.where((boxes[:, 2] - boxes[:, 0] >= min_size)
+                    & (boxes[:, 3] - boxes[:, 1] >= min_size))[0]
+    order = keep[np.argsort(-sc[keep])][:pre_nms_top_n]
+    kept = nms(pt.to_tensor(boxes[order]), nms_thresh,
+               scores=pt.to_tensor(sc[order])).numpy()[:post_nms_top_n]
+    sel = order[kept]
+    rois = pt.to_tensor(boxes[sel].astype(np.float32))
+    rscores = pt.to_tensor(sc[sel].astype(np.float32))
+    if return_rois_num:
+        return rois, rscores, pt.to_tensor(
+            np.asarray([sel.size], np.int32))
+    return rois, rscores
+
+
+# ------------------------------------------------------------- image IO
+
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return pt.to_tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode via PIL (reference phi decode_jpeg over nvjpeg)."""
+    import io
+
+    from PIL import Image
+    raw = _np(x).astype(np.uint8).tobytes()
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    # mode == "unchanged": keep the file's native channel count
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return pt.to_tensor(arr)
+
+
+# -------------------------------------------------------- deform conv
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference deform_conv2d): composed as
+    offset-warped sampling (grid_sample) + weighted accumulation —
+    the static.nn path shares this implementation."""
+    from ..nn import functional as F
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    b, c, h, w = x.shape
+    st = stride if isinstance(stride, int) else stride[0]
+    pd = padding if isinstance(padding, int) else padding[0]
+    dl = dilation if isinstance(dilation, int) else dilation[0]
+    oh = (h + 2 * pd - dl * (kh - 1) - 1) // st + 1
+    ow = (w + 2 * pd - dl * (kw - 1) - 1) // st + 1
+    base_y = np.arange(oh) * st - pd
+    base_x = np.arange(ow) * st - pd
+    K = kh * kw
+    dg = deformable_groups
+    cg = c // dg                      # input channels per deformable group
+    out = None
+    k = 0
+    for i in range(kh):
+        for j in range(kw):
+            # per-deformable-group offsets: channel block g owns taps
+            # [g*2K : (g+1)*2K]; its offsets warp channels [g*cg:(g+1)*cg]
+            samp_parts = []
+            for g in range(dg):
+                dy = offset[:, 2 * (g * K + k)]
+                dx = offset[:, 2 * (g * K + k) + 1]
+                gy = pt.to_tensor(np.broadcast_to(
+                    base_y[:, None] + i * dl,
+                    (oh, ow)).astype("float32")) + dy
+                gx = pt.to_tensor(np.broadcast_to(
+                    base_x[None, :] + j * dl,
+                    (oh, ow)).astype("float32")) + dx
+                gxn = gx * (2.0 / max(w - 1, 1)) - 1.0
+                gyn = gy * (2.0 / max(h - 1, 1)) - 1.0
+                grid = pt.ops.stack([gxn, gyn], axis=-1)
+                xs = x[:, g * cg:(g + 1) * cg] if dg > 1 else x
+                sp = F.grid_sample(xs, grid, align_corners=True)
+                if mask is not None:
+                    sp = sp * mask[:, g * K + k:g * K + k + 1]
+                samp_parts.append(sp)
+            samp = samp_parts[0] if dg == 1 else pt.ops.concat(
+                samp_parts, axis=1)
+            contrib = F.conv2d(samp, weight[:, :, i:i + 1, j:j + 1],
+                               groups=groups)
+            out = contrib if out is None else out + contrib
+            k += 1
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1])
+    return out
+
+
+class DeformConv2D(pt.nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        from ..nn.initializer import XavierNormal
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks, attr=weight_attr,
+            default_initializer=XavierNormal())
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_channels,),
+                                              attr=bias_attr, is_bias=True)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)

@@ -78,6 +78,18 @@ CASES = [
      lambda: pt.geometric),
     ("paddle.quantization", f"{R}/quantization/__init__.py",
      lambda: pt.quantization),
+    ("paddle.distributed.fleet", f"{R}/distributed/fleet/__init__.py",
+     lambda: pt.distributed.fleet),
+    ("paddle.nn.initializer", f"{R}/nn/initializer/__init__.py",
+     lambda: pt.nn.initializer),
+    ("paddle.nn.utils", f"{R}/nn/utils/__init__.py", lambda: pt.nn.utils),
+    ("paddle.vision.ops", f"{R}/vision/ops.py", lambda: pt.vision.ops),
+    ("paddle.vision.datasets", f"{R}/vision/datasets/__init__.py",
+     lambda: pt.vision.datasets),
+    ("paddle.profiler", f"{R}/profiler/__init__.py", lambda: pt.profiler),
+    ("paddle.device", f"{R}/device/__init__.py", lambda: pt.device),
+    ("paddle.optimizer.lr", f"{R}/optimizer/lr.py",
+     lambda: pt.optimizer.lr),
     ("paddle.nn", f"{R}/nn/__init__.py", lambda: _mod("paddle_tpu.nn")),
     ("paddle.nn.functional", f"{R}/nn/functional/__init__.py",
      lambda: _mod("paddle_tpu.nn.functional")),

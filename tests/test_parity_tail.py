@@ -1,0 +1,185 @@
+"""Behavioral tests for the round-3 parity tail (the api-parity test only
+asserts existence; these assert semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_pulls_slow_weights(self):
+        pt.seed(0)
+        lin = nn.Linear(4, 4)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+        la = pt.incubate.LookAhead(opt, alpha=0.5, k=2)
+        x = pt.to_tensor(np.random.randn(8, 4).astype("float32"))
+        w0 = lin.weight.numpy().copy()
+        for _ in range(4):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert not np.allclose(lin.weight.numpy(), w0)
+        sd = la.state_dict()
+        assert sd["lookahead_step"] == 4
+
+    def test_model_average_apply_restore(self):
+        lin = nn.Linear(3, 3)
+        ma = pt.incubate.ModelAverage(parameters=lin.parameters())
+        ma.step()
+        cur = lin.weight.numpy().copy()
+        import jax.numpy as jnp
+        lin.weight._replace_value(jnp.zeros_like(lin.weight._value))
+        with ma.apply():
+            np.testing.assert_allclose(lin.weight.numpy(), cur, rtol=1e-6)
+        np.testing.assert_allclose(lin.weight.numpy(), 0.0)
+
+
+class TestDistributedTail:
+    def test_spawn_runs_workers(self, tmp_path):
+        import paddle_tpu.parallel as dist
+        marker = str(tmp_path / "w")
+
+        procs = dist.spawn(_spawn_target, args=(marker,), nprocs=2)
+        import os
+        assert os.path.exists(marker + "0") and os.path.exists(marker + "1")
+
+    def test_data_generator_protocol(self):
+        import paddle_tpu.parallel as dist
+
+        class Gen(dist.fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def reader():
+                    toks = [int(t) for t in line.split()]
+                    yield [("ids", toks), ("label", [toks[0] % 2])]
+                return reader
+
+        g = Gen()
+        out = g.run_from_memory(["1 2 3", "4 5 6"])
+        assert len(out) == 2 and out[0][0][0] == "ids"
+
+    def test_entries_and_datasets(self, tmp_path):
+        import paddle_tpu.parallel as dist
+        with pytest.raises(ValueError):
+            dist.CountFilterEntry(-1)
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        p = tmp_path / "d.txt"
+        p.write_text("1 2\n3 4\n5 6\n")
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=2)
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        ds.local_shuffle()
+        assert sum(b.shape[0] for b in ds) == 3
+
+    def test_fleet_util_shard(self):
+        import paddle_tpu.parallel as dist
+        u = dist.fleet.UtilBase()
+        files = [f"f{i}" for i in range(5)]
+        assert u.get_file_shard(files) == files  # world of 1
+
+
+class TestSeq2Seq:
+    def test_beam_search_prefers_high_prob_tokens(self):
+        """A cell whose logits always favour token 3 must decode 3s."""
+
+        class Fixed(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.dummy = nn.Linear(1, 1)
+
+            def __call__(self, emb, states):
+                import jax.numpy as jnp
+
+                from paddle_tpu.core.tensor import wrap
+                b = emb.shape[0]
+                logits = jnp.tile(
+                    jnp.array([[0., 0., 0., 5., 0., 0.]], jnp.float32),
+                    (b, 1))
+                return wrap(logits), states
+
+        dec = nn.BeamSearchDecoder(Fixed(), start_token=0, end_token=5,
+                                   beam_size=2,
+                                   embedding_fn=nn.Embedding(6, 1))
+        ids, lens = nn.dynamic_decode(
+            dec, inits=pt.to_tensor(np.zeros((1, 1), "float32")),
+            max_step_num=4)
+        assert (ids.numpy()[0, 0] == 3).all()
+
+
+class TestStaticTail:
+    def test_fc_program_with_serialization(self):
+        import paddle_tpu.static as static
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4, 8])
+            h = static.nn.fc(x, 5)
+        exe = static.Executor()
+        static.run_startup()
+        (hv,) = exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                        fetch_list=[h])
+        assert hv.shape == (4, 5)
+        blob = static.serialize_program([x], [h], program=main)
+        meta = static.deserialize_program(blob)
+        assert meta["feeds"] == ["x"]
+
+    def test_accuracy_and_auc(self):
+        import paddle_tpu.static as static
+        acc = static.accuracy(
+            pt.to_tensor(np.eye(4, 5, dtype="float32")),
+            pt.to_tensor(np.array([[0], [1], [2], [4]], "int64")))
+        assert 0.7 < float(acc.numpy()) <= 1.0
+        a, _, _ = static.auc(
+            pt.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8],
+                                   [0.3, 0.7], [0.6, 0.4]], "float32")),
+            pt.to_tensor(np.array([0, 1, 1, 0], "int64")))
+        assert float(a.numpy()) == 1.0
+
+    def test_sequence_ops(self):
+        import paddle_tpu.static.nn as snn
+        x = pt.to_tensor(np.arange(24, dtype="float32").reshape(2, 3, 4))
+        assert snn.sequence_pool(x, "max").shape == [2, 4]
+        assert snn.sequence_first_step(x).shape == [2, 4]
+        rev = snn.sequence_reverse(x)
+        np.testing.assert_allclose(rev.numpy()[:, 0], x.numpy()[:, -1])
+        enum = snn.sequence_enumerate(
+            pt.to_tensor(np.array([[1, 2, 3]], "int64")), 2)
+        assert enum.shape == [1, 3, 2]
+
+
+class TestAudioIO:
+    def test_wav_roundtrip(self, tmp_path):
+        sr = 8000
+        sig = (0.5 * np.sin(np.linspace(0, 100, sr))).astype(
+            "float32")[None]
+        p = str(tmp_path / "t.wav")
+        pt.audio.save(p, pt.to_tensor(sig), sr)
+        meta = pt.audio.info(p)
+        assert meta.sample_rate == sr and meta.num_channels == 1
+        wav, sr2 = pt.audio.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(wav.numpy(), sig, atol=2e-4)
+
+
+class TestVisionTransformTail:
+    def test_functional_vs_identity_invariants(self):
+        import paddle_tpu.vision.transforms as T
+        img = (np.random.rand(16, 16, 3) * 255).astype("float32")
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=1.0)
+        ident = T.perspective(img, [(0, 0), (15, 0), (15, 15), (0, 15)],
+                              [(0, 0), (15, 0), (15, 15), (0, 15)])
+        np.testing.assert_allclose(ident, img, atol=1e-2)
+        shifted = T.affine(img, translate=(2, 0))
+        np.testing.assert_allclose(shifted[:, 3, 0], img[:, 1, 0],
+                                   rtol=1e-4)
+        e = T.erase(img, 2, 3, 4, 5, 0.0)
+        assert e[2:6, 3:8].sum() == 0
+
+
+def _spawn_target(marker):
+    import os
+    with open(marker + os.environ["PADDLE_TRAINER_ID"], "w") as f:
+        f.write("ok")

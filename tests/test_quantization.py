@@ -178,3 +178,44 @@ def test_nan_inf_flag_accepts_bool_and_strings():
     assert ct._check_nan_inf is True
     runtime.set_flags({"FLAGS_check_nan_inf": 0})
     assert ct._check_nan_inf is False
+
+
+def test_int8_inference_pallas_matmul():
+    """True-int8 deploy path: Pallas int8 MXU matmul with fused dequant
+    approximates the fp32 network closely."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.quantization import to_int8_inference
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(64, 128), pt.nn.GELU(),
+                           pt.nn.Linear(128, 32))
+    x = pt.to_tensor(np.random.randn(8, 64).astype("float32"))
+    ref = net(x).numpy()
+    q = to_int8_inference(net)
+    out = q(x).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.06
+    np.testing.assert_allclose(net(x).numpy(), ref)   # original untouched
+
+
+def test_quantized_matmul_kernel_accuracy():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.quant_matmul import (quantize_tensor,
+                                                    quantized_matmul)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 512)).astype("float32")
+    w = rng.normal(size=(512, 256)).astype("float32")
+    qx, sx = quantize_tensor(jnp.asarray(x))
+    qw, sw = quantize_tensor(jnp.asarray(w), per_channel_axis=1)
+    out = quantized_matmul(qx, qw, sx, sw, block_m=128, block_n=128,
+                           block_k=128, interpret=True)
+    ref = x @ w
+    rel = np.abs(np.asarray(out) - ref).max() / np.abs(ref).max()
+    assert rel < 0.02
+    # ragged fallback path
+    out2 = quantized_matmul(qx[:100], qw, sx, sw, interpret=True)
+    rel2 = np.abs(np.asarray(out2) - ref[:100]).max() / np.abs(ref).max()
+    assert rel2 < 0.02

@@ -1,0 +1,237 @@
+"""vision.ops detection suite (reference python/paddle/vision/ops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+V = pt.vision.ops
+
+
+@pytest.fixture()
+def boxes():
+    return np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                    "float32")
+
+
+def test_nms_suppresses_overlaps(boxes):
+    keep = V.nms(pt.to_tensor(boxes), 0.5,
+                 pt.to_tensor(np.array([0.9, 0.8, 0.7], "float32"))).numpy()
+    assert keep.tolist() == [0, 2]
+
+
+def test_nms_category_aware(boxes):
+    cats = np.array([0, 1, 0], "int64")
+    keep = V.nms(pt.to_tensor(boxes), 0.5,
+                 pt.to_tensor(np.array([0.9, 0.8, 0.7], "float32")),
+                 category_idxs=pt.to_tensor(cats), categories=[0, 1]).numpy()
+    assert sorted(keep.tolist()) == [0, 1, 2]  # overlap is cross-category
+
+
+def test_roi_align_constant_and_grad():
+    feat = np.ones((1, 3, 8, 8), "float32") * 5
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], "float32")
+    x = pt.to_tensor(feat, stop_gradient=False)
+    out = V.roi_align(x, pt.to_tensor(rois),
+                      pt.to_tensor(np.array([1], "int32")), 2)
+    np.testing.assert_allclose(out.numpy(), 5.0, rtol=1e-5)
+    out.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert abs(x.grad.numpy().sum() - 12.0) < 0.1  # channels x bins, avg weights sum to 1
+
+
+def test_roi_pool_ramp_max():
+    ramp = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    out = V.roi_pool(pt.to_tensor(ramp),
+                     pt.to_tensor(np.array([[0, 0, 8, 8]], "float32")),
+                     pt.to_tensor(np.array([1], "int32")), 2)
+    assert float(out.numpy().max()) == 63.0
+
+
+def test_psroi_pool_shape():
+    feat = pt.to_tensor(np.random.randn(1, 8, 8, 8).astype("float32"))
+    out = V.psroi_pool(feat, pt.to_tensor(np.array([[0, 0, 8, 8]],
+                                                   "float32")),
+                       pt.to_tensor(np.array([1], "int32")), 2)
+    assert out.shape == [1, 2, 2, 2]
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "float32")
+    var = np.ones((2, 4), "float32")
+    targets = np.array([[1, 1, 9, 9], [6, 6, 14, 14]], "float32")
+    enc = V.box_coder(pt.to_tensor(priors), pt.to_tensor(var),
+                      pt.to_tensor(targets))
+    dec = V.box_coder(pt.to_tensor(priors), pt.to_tensor(var), enc,
+                      code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), targets, rtol=1e-4, atol=1e-3)
+
+
+def test_yolo_box_and_loss():
+    x = np.random.randn(1, 3 * 7, 4, 4).astype("float32")
+    yb, ys = V.yolo_box(pt.to_tensor(x),
+                        pt.to_tensor(np.array([[64, 64]], "int32")),
+                        anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                        conf_thresh=0.01)
+    assert yb.shape == [1, 48, 4] and ys.shape == [1, 48, 2]
+    xin = pt.to_tensor(x * 0.1, stop_gradient=False)
+    loss = V.yolo_loss(xin,
+                       pt.to_tensor(np.array([[[0.5, 0.5, 0.2, 0.2]]],
+                                             "float32")),
+                       pt.to_tensor(np.array([[1]], "int64")),
+                       anchors=[10, 13, 16, 30, 33, 23],
+                       anchor_mask=[0, 1, 2], class_num=2,
+                       ignore_thresh=0.5, downsample_ratio=32)
+    loss.backward()
+    assert np.isfinite(xin.grad.numpy()).all()
+
+
+def test_fpn_and_proposals(boxes):
+    rois = np.array([[0, 0, 16, 16], [0, 0, 100, 100], [0, 0, 300, 300]],
+                    "float32")
+    outs, restore, _ = V.distribute_fpn_proposals(pt.to_tensor(rois),
+                                                  2, 5, 4, 224)
+    assert sum(o.shape[0] for o in outs) == 3
+    sc = np.random.rand(1, 3, 4, 4).astype("float32")
+    deltas = np.random.randn(1, 12, 4, 4).astype("float32") * 0.1
+    anchors = np.random.rand(4, 4, 3, 4).astype("float32") * 20
+    anchors[..., 2:] += 25
+    var = np.ones((4, 4, 3, 4), "float32") * 0.1
+    rois2, rsc = V.generate_proposals(
+        pt.to_tensor(sc), pt.to_tensor(deltas),
+        pt.to_tensor(np.array([[64, 64, 1]], "float32")),
+        pt.to_tensor(anchors), pt.to_tensor(var), post_nms_top_n=5)
+    assert rois2.shape[1] == 4 and rois2.shape[0] <= 5
+
+
+def test_image_io_roundtrip(tmp_path):
+    from PIL import Image
+    arr = (np.random.rand(10, 12, 3) * 255).astype("uint8")
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    img = V.decode_jpeg(V.read_file(p))
+    assert img.shape == [3, 10, 12]
+
+
+def test_deform_conv_zero_offset_equals_conv():
+    from paddle_tpu.nn import functional as F
+    dc = V.DeformConv2D(2, 4, 3, padding=1)
+    x = pt.to_tensor(np.random.randn(1, 2, 6, 6).astype("float32"))
+    off = pt.to_tensor(np.zeros((1, 18, 6, 6), "float32"))
+    np.testing.assert_allclose(
+        dc(x, off).numpy(),
+        F.conv2d(x, dc.weight, dc.bias, padding=1).numpy(),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_prior_box_and_matrix_nms(boxes):
+    pb, pv = V.prior_box(pt.to_tensor(np.zeros((1, 3, 4, 4), "float32")),
+                         pt.to_tensor(np.zeros((1, 3, 32, 32), "float32")),
+                         min_sizes=[8.0], aspect_ratios=[1.0, 2.0],
+                         flip=True)
+    assert pb.shape[:2] == [4, 4] and pb.shape[3] == 4
+    det, idx, num = V.matrix_nms(
+        pt.to_tensor(boxes[None]),
+        pt.to_tensor(np.random.rand(1, 3, 3).astype("float32")),
+        0.1, 0.05, 10, 5, return_index=True)
+    assert det.shape[1] == 6
+
+
+def test_nn_utils_weight_norm_and_clip():
+    import paddle_tpu.nn as nn
+    lin = nn.Linear(4, 6)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, "weight", dim=0)
+    x = pt.to_tensor(np.random.randn(3, 4).astype("float32"))
+    np.testing.assert_allclose(lin(x).numpy(),
+                               x.numpy() @ w0 + lin.bias.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                               atol=1e-6)
+    p = pt.to_tensor(np.ones(4, "float32"), stop_gradient=False)
+    (p * 10).sum().backward()
+    nn.utils.clip_grad_norm_([p], max_norm=1.0)
+    np.testing.assert_allclose(float(np.linalg.norm(p.grad.numpy())), 1.0,
+                               rtol=1e-3)
+
+
+def test_roi_pool_exact_large_bins():
+    ramp = np.arange(256, dtype="float32").reshape(1, 1, 16, 16)
+    out = V.roi_pool(pt.to_tensor(ramp),
+                     pt.to_tensor(np.array([[0, 0, 16, 16]], "float32")),
+                     pt.to_tensor(np.array([1], "int32")), 1)
+    assert float(out.numpy().max()) == 255.0
+
+
+def test_psroi_pool_channel_major():
+    C, oh, ow = 8, 2, 2
+    feat = np.zeros((1, C, 4, 4), "float32")
+    for ch in range(C):
+        feat[0, ch] = ch
+    ps = V.psroi_pool(pt.to_tensor(feat),
+                      pt.to_tensor(np.array([[0, 0, 4, 4]], "float32")),
+                      pt.to_tensor(np.array([1], "int32")), 2)
+    want = np.zeros((1, C // 4, oh, ow), "float32")
+    for c in range(C // 4):
+        for i in range(oh):
+            for j in range(ow):
+                want[0, c, i, j] = (c * oh + i) * ow + j
+    np.testing.assert_allclose(ps.numpy(), want)
+
+
+def test_box_coder_axis1_decode():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "float32")
+    var = np.ones((2, 4), "float32")
+    tb = np.zeros((3, 2, 4), "float32")   # zero deltas -> identity decode
+    dec = V.box_coder(pt.to_tensor(priors), pt.to_tensor(var),
+                      pt.to_tensor(tb), code_type="decode_center_size",
+                      axis=1)
+    for n in range(3):
+        np.testing.assert_allclose(dec.numpy()[n], priors, rtol=1e-5)
+
+
+def test_generate_proposals_score_box_pairing():
+    sc = np.zeros((1, 2, 2, 2), "float32")
+    sc[0, 1, 0, 1] = 0.9          # best: anchor 1 at cell (0, 1)
+    deltas = np.zeros((1, 8, 2, 2), "float32")
+    anchors = np.zeros((2, 2, 2, 4), "float32")
+    v = 0
+    for i in range(2):
+        for j in range(2):
+            for a in range(2):
+                anchors[i, j, a] = [v, v, v + 5, v + 5]
+                v += 1
+    var = np.ones((2, 2, 2, 4), "float32")
+    rois, rsc = V.generate_proposals(
+        pt.to_tensor(sc), pt.to_tensor(deltas),
+        pt.to_tensor(np.array([[64, 64, 1]], "float32")),
+        pt.to_tensor(anchors), pt.to_tensor(var), min_size=0.0,
+        post_nms_top_n=1)
+    np.testing.assert_allclose(rois.numpy()[0], [3, 3, 8, 8], atol=1e-4)
+    assert float(rsc.numpy()[0]) == np.float32(0.9)
+
+
+def test_deform_conv_groups():
+    from paddle_tpu.nn import functional as F
+    x = pt.to_tensor(np.random.randn(1, 2, 6, 6).astype("float32"))
+    dcw = np.random.randn(4, 2, 3, 3).astype("float32")
+    off2 = pt.to_tensor(np.zeros((1, 2 * 2 * 9, 4, 4), "float32"))
+    out = V.deform_conv2d(x, off2, pt.to_tensor(dcw), deformable_groups=2)
+    np.testing.assert_allclose(
+        out.numpy(), F.conv2d(x, pt.to_tensor(dcw)).numpy(),
+        rtol=1e-3, atol=1e-4)
+    gw = np.random.randn(4, 1, 3, 3).astype("float32")
+    off1 = pt.to_tensor(np.zeros((1, 18, 4, 4), "float32"))
+    outg = V.deform_conv2d(x, off1, pt.to_tensor(gw), groups=2)
+    np.testing.assert_allclose(
+        outg.numpy(), F.conv2d(x, pt.to_tensor(gw), groups=2).numpy(),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_decode_jpeg_unchanged_grayscale(tmp_path):
+    from PIL import Image
+    g = (np.random.rand(6, 7) * 255).astype("uint8")
+    p = str(tmp_path / "g.jpg")
+    Image.fromarray(g, mode="L").save(p)
+    img = V.decode_jpeg(V.read_file(p))
+    assert img.shape == [1, 6, 7]
