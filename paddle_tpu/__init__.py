@@ -24,12 +24,14 @@ from .core.dtype import (  # noqa: F401
     int16, int32, int64, uint8,
 )
 from .ops.registry import OPS as _OPS
+from .ops.registry import install_method_tail as _install_mt
 from .ops.registry import install_tensor_methods as _install_tm
 
 # second pass: nn.functional etc. registered more ops (relu, softmax, …)
 # after paddle_tpu.ops ran its install — pick up their method/inplace
 # variants too (idempotent)
 _install_tm()
+_install_mt()
 
 # re-export every registered op at top level (paddle.* flat namespace parity)
 _g = globals()
@@ -51,7 +53,8 @@ def __getattr__(name):
                 "models", "utils", "incubate", "static", "device", "runtime",
                 "inference", "sparse", "text", "audio", "geometric",
                 "quantization", "distribution", "fft", "signal",
-                "regularizer", "linalg", "onnx"):
+                "regularizer", "linalg", "onnx", "callbacks", "hub",
+                "sysconfig", "reader", "cost_model"):
         import importlib
         try:
             mod = importlib.import_module(f".{name}", __name__)

@@ -186,3 +186,46 @@ class VisualDL(Callback):
         with open(f"{self.log_dir}/scalars.jsonl", "w") as f:
             for r in self._rows:
                 f.write(json.dumps(r) + "\n")
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference hapi/callbacks.py WandbCallback).
+    Degrades to a JSONL metric log when the wandb package is absent
+    (zero-egress environments)."""
+
+    def __init__(self, project=None, dir=None, **kwargs):  # noqa: A002
+        self._project = project
+        self._dir = dir or "."
+        self._kwargs = kwargs
+        try:
+            import wandb
+            self._wandb = wandb
+        except ImportError:
+            self._wandb = None
+            self._fallback_path = None
+
+    def on_train_begin(self, logs=None):
+        if self._wandb is not None:
+            self._run = self._wandb.init(project=self._project,
+                                         dir=self._dir, **self._kwargs)
+        else:
+            import os
+            self._fallback_path = os.path.join(self._dir,
+                                               "wandb_fallback.jsonl")
+
+    def _log(self, logs):
+        if self._wandb is not None:
+            self._run.log(logs)
+        elif self._fallback_path:
+            import json
+            clean = {k: float(v) for k, v in (logs or {}).items()
+                     if isinstance(v, (int, float))}
+            with open(self._fallback_path, "a") as f:
+                f.write(json.dumps(clean) + "\n")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log(dict(logs or {}, epoch=epoch))
+
+    def on_train_end(self, logs=None):
+        if self._wandb is not None:
+            self._run.finish()

@@ -1,0 +1,49 @@
+"""paddle.hub parity (reference python/paddle/hub.py): load entrypoints
+from a hubconf.py. Local-dir and installed-module sources work fully;
+github sources need egress and raise a clear error here."""
+from __future__ import annotations
+
+__all__ = ["list", "help", "load"]
+
+_builtin_list = list
+
+
+def _load_hubconf(repo_dir, source):
+    import importlib
+    import os
+    import sys
+    if source == "github":
+        raise RuntimeError(
+            "paddle_tpu.hub: github sources need network egress; clone the "
+            "repo and use source='local'")
+    if source == "local":
+        path = os.path.join(repo_dir, "hubconf.py")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+        spec = importlib.util.spec_from_file_location("hubconf", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["hubconf"] = mod
+        spec.loader.exec_module(mod)
+        return mod
+    # source == "pypi"/module name
+    return importlib.import_module(repo_dir)
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir, source)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(
+            f"entrypoint {model!r} not in {sorted(_builtin_list(dir(mod)))}")
+    return fn(**kwargs)

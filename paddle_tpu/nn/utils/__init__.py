@@ -85,7 +85,13 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     from ..layers_basic import SpectralNorm as _SN
     w = getattr(layer, name)
     if dim is None:
-        dim = 0
+        # reference spectral_norm_hook: dim=1 for Linear/Conv*Transpose
+        # (their out-features axis is 1), else 0
+        from ..layers_basic import (Conv1DTranspose, Conv2DTranspose,
+                                    Conv3DTranspose, Linear)
+        dim = 1 if isinstance(layer, (Linear, Conv1DTranspose,
+                                      Conv2DTranspose,
+                                      Conv3DTranspose)) else 0
     sn = _SN(list(w.shape), dim=dim, power_iters=n_power_iterations,
              eps=eps)
     layer.add_sublayer(name + "_spectral_norm", sn)

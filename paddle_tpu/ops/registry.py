@@ -143,3 +143,139 @@ def install_tensor_methods():
         Tensor.zero_ = zero_
     if not hasattr(Tensor, "fill_"):
         Tensor.fill_ = fill_
+
+
+# Tensor-method parity tail (reference python/paddle/tensor/__init__.py
+# tensor_method_func): ops already registered become methods; a few extra
+# in-place random/monkey helpers are defined here.
+_EXTRA_TENSOR_METHODS = (
+    "cov", "corrcoef", "cond", "lstsq", "dist", "histogram", "bincount",
+    "qr", "eigvals", "eigvalsh", "logcumsumexp", "logit", "increment",
+    "stanh", "nansum", "nanmean", "count_nonzero", "amax", "amin",
+    "fmax", "fmin", "kron", "lgamma", "equal_all", "is_empty",
+    "expand_as", "scatter", "scatter_nd_add", "scatter_nd",
+    "shard_index", "slice", "vsplit", "tensordot", "strided_slice",
+    "unique_consecutive", "unstack", "rot90", "where", "index_sample",
+    "digamma", "eig", "multi_dot", "solve", "cholesky_solve",
+    "triangular_solve", "lu", "lu_unpack", "as_complex", "as_real",
+    "gcd", "lcm", "angle", "take_along_axis", "put_along_axis",
+    "heaviside", "index_add", "bucketize",
+)
+
+
+def install_method_tail():
+    import jax.numpy as jnp
+
+    for name in _EXTRA_TENSOR_METHODS:
+        op = OPS.get(name)
+        if op is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, op)
+
+    def _toplevel(name):
+        def f(self, *a, **k):
+            import paddle_tpu as pt
+            return getattr(pt, name)(self, *a, **k)
+        f.__name__ = name
+        return f
+
+    def broadcast_shape(self, y_shape):
+        import paddle_tpu as pt
+        return pt.broadcast_shape(list(self.shape), y_shape)
+
+    def broadcast_tensors_m(self, others=None):
+        import paddle_tpu as pt
+        ts = [self] + list(others or [])
+        return pt.broadcast_tensors(ts)
+
+    if not hasattr(Tensor, "broadcast_shape"):
+        Tensor.broadcast_shape = broadcast_shape
+    if not hasattr(Tensor, "broadcast_tensors"):
+        Tensor.broadcast_tensors = broadcast_tensors_m
+
+    for name in ("multiplex", "add_n", "concat", "stack"):
+        # list-first ops: x.concat(...) applies to [self, ...] per paddle
+        if not hasattr(Tensor, name):
+            op = OPS.get(name)
+            if op is None:
+                continue
+
+            def mk(op_):
+                def f(self, *a, **k):
+                    return op_(self, *a, **k)
+                return f
+
+            setattr(Tensor, name, mk(op))
+
+    def floor_mod(self, y):
+        return OPS["mod"](self, y)
+
+    def rank(self):
+        import paddle_tpu as pt
+        return pt.rank(self)
+
+    def is_tensor(self):
+        return True
+
+    def is_complex(self):
+        return bool(jnp.issubdtype(self._value.dtype, jnp.complexfloating))
+
+    def is_integer(self):
+        return bool(jnp.issubdtype(self._value.dtype, jnp.integer))
+
+    def is_floating_point(self):
+        return bool(jnp.issubdtype(self._value.dtype, jnp.floating))
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):  # noqa: A002
+        import jax
+
+        from ..core import random as rnd
+        self._value = jax.random.uniform(
+            rnd.next_key(), self._value.shape,
+            self._value.dtype if jnp.issubdtype(self._value.dtype,
+                                                jnp.floating)
+            else jnp.float32, min, max)
+        self._node = None
+        return self
+
+    def exponential_(self, lam=1.0):
+        import jax
+
+        from ..core import random as rnd
+        self._value = (jax.random.exponential(
+            rnd.next_key(), self._value.shape) / lam).astype(
+                self._value.dtype)
+        self._node = None
+        return self
+
+    def erfinv_(self):
+        return _inp(self, "erfinv")
+
+    def put_along_axis_(self, indices, values, axis, reduce="assign"):  # noqa: A002
+        out = OPS["put_along_axis"](self, indices, values, axis, reduce)
+        self._value = out._value if isinstance(out, Tensor) else out
+        return self
+
+    def _inp(self, opname):
+        out = OPS[opname](self)
+        self._value = out._value if isinstance(out, Tensor) else out
+        return self
+
+    def create_tensor(self, dtype=None):
+        import paddle_tpu as pt
+        return pt.to_tensor([], dtype=dtype or self.dtype)
+
+    def create_parameter(self, shape, dtype=None, **kw):
+        import paddle_tpu as pt
+        return pt.create_parameter(shape, dtype or "float32", **kw)
+
+    for name, fn in [("floor_mod", floor_mod), ("rank", rank),
+                     ("is_tensor", is_tensor), ("is_complex", is_complex),
+                     ("is_integer", is_integer),
+                     ("is_floating_point", is_floating_point),
+                     ("uniform_", uniform_), ("exponential_", exponential_),
+                     ("erfinv_", erfinv_),
+                     ("put_along_axis_", put_along_axis_),
+                     ("create_tensor", create_tensor),
+                     ("create_parameter", create_parameter)]:
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)

@@ -1,0 +1,126 @@
+"""paddle.reader legacy decorators (reference python/paddle/reader/
+decorator.py): composable reader transforms for the batch()-style API."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "ComposeNotAligned",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    data = None
+
+    def gen():
+        nonlocal data
+        if data is None:
+            data = list(reader())
+        return iter(data)
+
+    return gen
+
+
+def map_readers(func, *readers):
+    def gen():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return gen
+
+
+def shuffle(reader, buf_size):
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+
+    return gen
+
+
+def chain(*readers):
+    def gen():
+        return itertools.chain(*[r() for r in readers])
+
+    return gen
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    def gen():
+        its = [r() for r in readers]
+        _END = object()
+        for items in itertools.zip_longest(*its, fillvalue=_END):
+            if any(it is _END for it in items):
+                if check_alignment and not all(it is _END for it in items):
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                break
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, tuple) else (it,))
+            yield tuple(out)
+
+    return gen
+
+
+def buffered(reader, size):
+    import queue
+    import threading
+
+    def gen():
+        q = queue.Queue(maxsize=size)
+        END = object()
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+                q.put(END)
+            except BaseException as e:   # surface errors, don't deadlock
+                q.put(("__reader_error__", e))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is END:
+                break
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] == "__reader_error__":
+                raise item[1]
+            yield item
+
+    return gen
+
+
+def firstn(reader, n):
+    def gen():
+        return itertools.islice(reader(), n)
+
+    return gen
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    from concurrent.futures import ThreadPoolExecutor
+
+    def gen():
+        with ThreadPoolExecutor(process_num) as ex:
+            yield from ex.map(mapper, reader())
+
+    return gen
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    return chain(*readers)

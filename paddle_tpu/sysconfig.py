@@ -1,0 +1,14 @@
+"""paddle.sysconfig parity."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_ROOT, "runtime", "csrc")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "runtime", "build")

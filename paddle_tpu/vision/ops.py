@@ -97,12 +97,14 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
         boxes_c = bb[order]
         iou = _iou_matrix(boxes_c)
         iou = np.triu(iou, 1)
+        # max_iou[i]: box i's own max overlap with higher-scored boxes —
+        # the compensation term, indexed by the SUPPRESSOR row i
         max_iou = iou.max(0, initial=0.0)
         if use_gaussian:
-            decay = np.exp(-(iou ** 2 - max_iou[None, :] ** 2)
+            decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
                            / gaussian_sigma).min(0, initial=1.0)
         else:
-            decay = ((1 - iou) / (1 - max_iou[None, :] + 1e-10)
+            decay = ((1 - iou) / (1 - max_iou[:, None] + 1e-10)
                      ).min(0, initial=1.0)
         new_s = s[order] * decay
         ok = new_s > post_threshold
@@ -320,14 +322,26 @@ def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
             cy = (i + offset) * step_h
             cell = []
             for k, ms in enumerate(min_sizes):
-                cell.append((cx, cy, ms, ms))
+                # reference phi prior_box order: default
+                # (min, aspect-ratio boxes, max); with
+                # min_max_aspect_ratios_order=True: (min, max, ars)
+                min_box = (cx, cy, ms, ms)
+                max_box = None
                 if max_sizes:
-                    s = (ms * max_sizes[k]) ** 0.5
-                    cell.append((cx, cy, s, s))
-                for a in ars:
-                    if abs(a - 1.0) < 1e-6:
-                        continue
-                    cell.append((cx, cy, ms * a ** 0.5, ms / a ** 0.5))
+                    sz = (ms * max_sizes[k]) ** 0.5
+                    max_box = (cx, cy, sz, sz)
+                ar_boxes = [(cx, cy, ms * a ** 0.5, ms / a ** 0.5)
+                            for a in ars if abs(a - 1.0) >= 1e-6]
+                if min_max_aspect_ratios_order:
+                    cell.append(min_box)
+                    if max_box:
+                        cell.append(max_box)
+                    cell.extend(ar_boxes)
+                else:
+                    cell.append(min_box)
+                    cell.extend(ar_boxes)
+                    if max_box:
+                        cell.append(max_box)
             for (ccx, ccy, bw, bh) in cell:
                 boxes.append([(ccx - bw / 2) / iw, (ccy - bh / 2) / ih,
                               (ccx + bw / 2) / iw, (ccy + bh / 2) / ih])
@@ -392,6 +406,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     n, c, h, w = xv.shape
     na = len(anchors) // 2
     an = np.asarray(anchors, np.float32).reshape(na, 2)
+    ioup = None
+    if iou_aware:
+        # reference layout: [N, na*(6+cls), H, W] — first na channels are
+        # IoU logits, the rest the standard head
+        ioup = xv[:, :na]
+        xv = xv[:, na:]
     xv = xv.reshape(n, na, 5 + class_num, h, w)
     gx = np.arange(w, dtype=np.float32)[None, None, None, :]
     gy = np.arange(h, dtype=np.float32)[None, None, :, None]
@@ -403,6 +423,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     bw = np.exp(xv[:, :, 2]) * an[None, :, 0, None, None] / in_w
     bh = np.exp(xv[:, :, 3]) * an[None, :, 1, None, None] / in_h
     conf = sig(xv[:, :, 4])
+    if ioup is not None:
+        conf = conf ** (1.0 - iou_aware_factor) \
+            * sig(ioup) ** iou_aware_factor
     probs = sig(xv[:, :, 5:])
     scores = conf[:, :, None] * probs
     isz = _np(img_size).astype(np.float32)            # [N, 2] (h, w)
@@ -418,8 +441,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
         x2 = np.clip(x2, 0, imw - 1)
         y2 = np.clip(y2, 0, imh - 1)
     boxes = np.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
-    mask = (conf > conf_thresh).reshape(n, -1, 1)
-    boxes = boxes * mask
+    keep = conf > conf_thresh
+    boxes = boxes * keep.reshape(n, -1, 1)
+    # reference zeroes BOTH the box and its scores below conf_thresh
+    scores = scores * keep[:, :, None]
     scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
     return pt.to_tensor(boxes.astype(np.float32)), \
         pt.to_tensor(scores.astype(np.float32))

@@ -120,3 +120,20 @@ def test_namespace_parity(name, path, get_mod):
     missing = [w for w in want
                if not hasattr(mod, w) and w not in allowed]
     assert not missing, f"{name} missing {len(missing)}: {missing}"
+
+
+def test_tensor_method_parity():
+    """Every name in the reference's tensor_method_func monkey-patch list
+    resolves on our Tensor."""
+    path = f"{R}/tensor/__init__.py"
+    tree = ast.parse(open(path).read())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "tensor_method_func":
+                    names = [ast.literal_eval(e) for e in node.value.elts]
+    assert names, "reference list not found"
+    x = pt.to_tensor([1.0])
+    missing = [n for n in names if not hasattr(x, n)]
+    assert not missing, f"Tensor missing {len(missing)}: {missing}"

@@ -183,3 +183,60 @@ def _spawn_target(marker):
     import os
     with open(marker + os.environ["PADDLE_TRAINER_ID"], "w") as f:
         f.write("ok")
+
+
+class TestTopLevelModules:
+    def test_hub_local_protocol(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def toy(scale=2):\n    'scaler'\n    return lambda x: x * scale\n")
+        d = str(tmp_path)
+        assert "toy" in pt.hub.list(d)
+        assert "scaler" in pt.hub.help(d, "toy")
+        assert pt.hub.load(d, "toy", scale=3)(2) == 6
+        with pytest.raises(RuntimeError):
+            pt.hub.load("owner/repo", "toy", source="github")
+
+    def test_reader_decorators(self):
+        import paddle_tpu.reader as reader
+        r = lambda: iter(range(10))
+        assert list(reader.firstn(r, 3)()) == [0, 1, 2]
+        assert sorted(reader.shuffle(r, 4)()) == list(range(10))
+        assert list(reader.map_readers(lambda a, b: a + b, r, r)())[:3] \
+            == [0, 2, 4]
+        assert len(list(reader.buffered(r, 2)())) == 10
+        assert list(reader.chain(r, r)()) == list(range(10)) * 2
+
+    def test_callbacks_namespace_and_wandb_fallback(self, tmp_path):
+        cb = pt.callbacks.WandbCallback(dir=str(tmp_path))
+        cb.on_train_begin()
+        cb.on_epoch_end(0, {"loss": 1.25})
+        import json
+        rec = json.loads((tmp_path / "wandb_fallback.jsonl").read_text())
+        assert rec["loss"] == 1.25 and rec["epoch"] == 0
+
+    def test_cost_model(self):
+        import paddle_tpu.static as static
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3])
+            _ = x * 2.0
+        costs = pt.cost_model.CostModel().profile_measure(main)
+        assert costs
+
+
+class TestReaderRobustness:
+    def test_buffered_surfaces_reader_errors(self):
+        import paddle_tpu.reader as reader
+
+        def bad():
+            yield 1
+            raise IOError("boom")
+
+        with pytest.raises(IOError):
+            list(reader.buffered(bad, 2)())
+
+    def test_compose_alignment_check(self):
+        import paddle_tpu.reader as reader
+        with pytest.raises(reader.ComposeNotAligned):
+            list(reader.compose(lambda: iter(range(3)),
+                                lambda: iter(range(5)))())

@@ -235,3 +235,43 @@ def test_decode_jpeg_unchanged_grayscale(tmp_path):
     Image.fromarray(g, mode="L").save(p)
     img = V.decode_jpeg(V.read_file(p))
     assert img.shape == [1, 6, 7]
+
+
+def test_matrix_nms_actually_suppresses():
+    boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                      [20, 20, 30, 30]], "float32")
+    sc = np.zeros((1, 2, 3), "float32")
+    sc[0, 1] = [0.9, 0.85, 0.8]
+    det, idx, num = V.matrix_nms(pt.to_tensor(boxes[None]),
+                                 pt.to_tensor(sc), 0.1, 0.5, 10, 5,
+                                 return_index=True)
+    assert det.shape[0] == 2   # overlapping duplicate decays out
+
+
+def test_prior_box_order_flag():
+    args = (pt.to_tensor(np.zeros((1, 3, 1, 1), "float32")),
+            pt.to_tensor(np.zeros((1, 3, 32, 32), "float32")))
+    kw = dict(min_sizes=[8.0], max_sizes=[16.0], aspect_ratios=[1.0, 2.0])
+    b_def = V.prior_box(*args, **kw)[0].numpy().reshape(-1, 4)
+    b_mm = V.prior_box(*args, min_max_aspect_ratios_order=True,
+                       **kw)[0].numpy().reshape(-1, 4)
+    w_def = (b_def[:, 2] - b_def[:, 0]) * 32
+    w_mm = (b_mm[:, 2] - b_mm[:, 0]) * 32
+    maxw = (8 * 16) ** 0.5
+    assert abs(w_def[-1] - maxw) < 1e-2     # default: max box last
+    assert abs(w_mm[1] - maxw) < 1e-2       # mm order: max box second
+
+
+def test_yolo_box_zeroes_scores_and_iou_aware():
+    x = np.random.randn(1, 21, 2, 2).astype("float32") * 0.1 - 5.0
+    _, ys = V.yolo_box(pt.to_tensor(x),
+                       pt.to_tensor(np.array([[64, 64]], "int32")),
+                       anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                       conf_thresh=0.5)
+    assert float(np.abs(ys.numpy()).sum()) == 0.0
+    xiou = np.random.randn(1, 24, 2, 2).astype("float32")
+    yb, _ = V.yolo_box(pt.to_tensor(xiou),
+                       pt.to_tensor(np.array([[64, 64]], "int32")),
+                       anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+                       conf_thresh=0.01, iou_aware=True)
+    assert yb.shape == [1, 12, 4]
