@@ -9,7 +9,7 @@ _builtin_list = list
 
 
 def _load_hubconf(repo_dir, source):
-    import importlib
+    import importlib.util
     import os
     import sys
     if source == "github":

@@ -171,13 +171,6 @@ def install_method_tail():
         if op is not None and not hasattr(Tensor, name):
             setattr(Tensor, name, op)
 
-    def _toplevel(name):
-        def f(self, *a, **k):
-            import paddle_tpu as pt
-            return getattr(pt, name)(self, *a, **k)
-        f.__name__ = name
-        return f
-
     def broadcast_shape(self, y_shape):
         import paddle_tpu as pt
         return pt.broadcast_shape(list(self.shape), y_shape)
@@ -193,18 +186,11 @@ def install_method_tail():
         Tensor.broadcast_tensors = broadcast_tensors_m
 
     for name in ("multiplex", "add_n", "concat", "stack"):
-        # list-first ops: x.concat(...) applies to [self, ...] per paddle
-        if not hasattr(Tensor, name):
-            op = OPS.get(name)
-            if op is None:
-                continue
-
-            def mk(op_):
-                def f(self, *a, **k):
-                    return op_(self, *a, **k)
-                return f
-
-            setattr(Tensor, name, mk(op))
+        # these ops take the tensor (or a list) as their first argument;
+        # the method form forwards self as that argument
+        op = OPS.get(name)
+        if op is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, op)
 
     def floor_mod(self, y):
         return OPS["mod"](self, y)

@@ -113,11 +113,28 @@ def firstn(reader, n):
 
 def xmap_readers(mapper, reader, process_num, buffer_size,
                  order=False):
+    """Threaded map with bounded in-flight items (reference decorator.py
+    xmap_readers): at most ``buffer_size`` futures outstanding — works
+    with infinite readers and bounds memory."""
+    from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     def gen():
-        with ThreadPoolExecutor(process_num) as ex:
-            yield from ex.map(mapper, reader())
+        ex = ThreadPoolExecutor(process_num)
+        pending = deque()
+        try:
+            for item in reader():
+                pending.append(ex.submit(mapper, item))
+                if len(pending) >= max(buffer_size, 1):
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+        finally:
+            for f in pending:
+                f.cancel()
+            # never join worker threads here: an abandoned generator is
+            # finalized during GC/interpreter teardown where joining hangs
+            ex.shutdown(wait=False, cancel_futures=True)
 
     return gen
 

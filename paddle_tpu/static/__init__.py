@@ -19,6 +19,8 @@ from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
 from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
                     default_startup_program, in_static_build, program_guard)
 from . import nn  # noqa: F401
+from . import passes  # noqa: F401
+from .passes import apply_build_strategy, apply_pass  # noqa: F401
 from . import collective  # noqa: F401  # noqa: F401
 
 __all__ = [

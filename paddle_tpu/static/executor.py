@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .graph import Program, Variable, VarRef, default_main_program
+from .graph import (Program, Variable, VarRef, default_main_program,
+                    op_call_kwargs)
 
 
 class _VarHolder:
@@ -81,7 +82,7 @@ def _replay(ops, env, protect=frozenset()):
             continue
         vals = [env[i.name] if isinstance(i, VarRef) else i
                 for i in op.inputs]
-        out = op.fn(*vals, **op.attrs)
+        out = op.fn(*vals, **op_call_kwargs(op))
         flat, _ = jax.tree_util.tree_flatten(out)
         for n, v in zip(op.outputs, flat):
             if n not in protect:

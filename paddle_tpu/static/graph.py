@@ -93,6 +93,12 @@ class OpDesc:
         return f"{{Op({self.op_type}) inputs={ins} outputs={self.outputs}}}"
 
 
+def op_call_kwargs(op):
+    """Execution kwargs for an OpDesc: underscore-prefixed attrs are pass
+    annotations (static/passes.py), never op arguments."""
+    return {k: v for k, v in op.attrs.items() if not k.startswith("_")}
+
+
 class Block:
     def __init__(self, program, idx=0):
         self.program = program

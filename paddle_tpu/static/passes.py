@@ -1,0 +1,246 @@
+"""Static-graph pass infrastructure.
+
+Reference analogue: paddle/fluid/framework/ir/ (Pass/PassRegistry over the
+SSA graph, 253 pass files) and python/paddle/static/apply_pass. TPU-native
+scope: XLA owns device-level fusion/layout/scheduling, so the passes that
+matter here are PROGRAM-level graph cleanups that shrink what we trace —
+dead-op elimination, constant folding, common-subexpression elimination,
+and annotation passes. Passes are pure functions Program -> mutated
+Program, registered by name.
+"""
+from __future__ import annotations
+
+import jax
+
+from .graph import OpDesc, Program, VarRef, op_call_kwargs
+
+__all__ = ["PassRegistry", "register_pass", "get_pass", "apply_pass",
+           "apply_build_strategy"]
+
+
+class PassRegistry:
+    _passes: dict = {}
+
+    @classmethod
+    def register(cls, name, fn):
+        cls._passes[name] = fn
+
+    @classmethod
+    def get(cls, name):
+        if name not in cls._passes:
+            raise ValueError(
+                f"unknown pass {name!r}; registered: {sorted(cls._passes)}")
+        return cls._passes[name]
+
+    @classmethod
+    def list(cls):
+        return sorted(cls._passes)
+
+
+def register_pass(name):
+    def deco(fn):
+        PassRegistry.register(name, fn)
+        return fn
+    return deco
+
+
+def get_pass(name):
+    return PassRegistry.get(name)
+
+
+def apply_pass(program, names):
+    """paddle.static.apply_pass parity: run the named pass(es) over the
+    program's global block, in order."""
+    if isinstance(names, str):
+        names = [names]
+    for n in names:
+        PassRegistry.get(n)(program)
+        program._version += 1
+    return program
+
+
+def _fetch_roots(program):
+    """Names that must stay live: persistables, feeds, declared fetches,
+    grad-request outputs. When no fetches were declared
+    (normalize_program not called), every unconsumed terminal output is
+    a root — pruning would otherwise delete possible fetch targets."""
+    roots = set(program._feed_names)
+    for name, var in program.global_block.vars.items():
+        if getattr(var, "persistable", False):
+            roots.add(name)
+    for _tgt, _wrt, gnames in program._grad_requests:
+        roots.update(gnames)
+    fetches = getattr(program, "_normalized_fetches", None)
+    if fetches:
+        roots.update(fetches)
+    else:
+        ops = program.global_block.ops
+        consumed = {i.name for op in ops for i in op.inputs
+                    if isinstance(i, VarRef)}
+        for op in ops:
+            roots.update(o for o in op.outputs if o not in consumed)
+    return roots
+
+
+@register_pass("dead_code_elimination")
+def dead_code_elimination(program):
+    """Drop ops none of whose outputs are consumed downstream or rooted
+    (reference ir pass: delete_op / graph_to_program pruning)."""
+    block = program.global_block
+    roots = _fetch_roots(program)
+    live = set(roots)
+    # walk backwards: an op is live if any output is live
+    kept = []
+    for op in reversed(block.ops):
+        if any(o in live for o in op.outputs) or not op.outputs:
+            kept.append(op)
+            for i in op.inputs:
+                if isinstance(i, VarRef):
+                    live.add(i.name)
+        # else: dropped
+    kept.reverse()
+    removed = len(block.ops) - len(kept)
+    block.ops = kept
+    return removed
+
+
+@register_pass("constant_folding")
+def constant_folding(program):
+    """Execute ops whose inputs are all literals at pass time and replace
+    them with the computed constant (reference constant_folding_pass)."""
+    block = program.global_block
+    const_vals = {}
+    new_ops = []
+    folded = 0
+    for op in block.ops:
+        ready = []
+        all_const = True
+        for i in op.inputs:
+            if isinstance(i, VarRef):
+                if i.name in const_vals:
+                    ready.append(const_vals[i.name])
+                else:
+                    all_const = False
+                    break
+            else:
+                ready.append(i)
+        if all_const and op.outputs:
+            try:
+                out = op.fn(*ready, **op_call_kwargs(op))
+            except Exception:
+                new_ops.append(op)
+                continue
+            flat, _ = jax.tree_util.tree_flatten(out)
+            for name, val in zip(op.outputs, flat):
+                const_vals[name] = val
+            folded += 1
+        else:
+            new_ops.append(op)
+    if not const_vals:
+        return 0
+    # rewrite remaining ops: replace folded VarRefs with literals
+    for op in new_ops:
+        op.inputs = [const_vals.get(i.name, i) if isinstance(i, VarRef)
+                     else i for i in op.inputs]
+    block.ops = new_ops
+    return folded
+
+
+def _input_key(i):
+    if isinstance(i, VarRef):
+        return ("ref", i.name)
+    try:
+        hash(i)
+        return ("lit", i)
+    except TypeError:
+        return ("obj", id(i))
+
+
+@register_pass("common_subexpression_elimination")
+def common_subexpression_elimination(program):
+    """Merge identical (op_type, inputs, attrs) ops — later duplicates
+    reuse the first op's outputs (reference: ir CSE / fuse passes do this
+    structurally; XLA also CSEs, but pruning here shrinks the trace)."""
+    block = program.global_block
+    seen = {}
+    alias = {}
+    new_ops = []
+    merged = 0
+    for op in block.ops:
+        ins = tuple(_input_key(alias.get(i.name, i)
+                               if isinstance(i, VarRef) else i)
+                    for i in op.inputs)
+        try:
+            key = (op.op_type, ins, tuple(sorted(op.attrs.items())))
+        except TypeError:            # unhashable attr: keep as-is
+            new_ops.append(op)
+            continue
+        prev = seen.get(key)
+        # random/stateful ops must never merge
+        if prev is not None and not _stateful(op):
+            for mine, theirs in zip(op.outputs, prev.outputs):
+                alias[mine] = VarRef(theirs)
+            merged += 1
+            continue
+        seen[key] = op
+        new_ops.append(op)
+    if alias:
+        for op in new_ops:
+            op.inputs = [alias.get(i.name, i) if isinstance(i, VarRef)
+                         else i for i in op.inputs]
+        # aliased names may be fetched: emit identity ops for rooted ones
+        roots = _fetch_roots(program)
+        for old, ref in alias.items():
+            if old in roots:
+                new_ops.append(OpDesc("share_data", lambda v: v,
+                                      [ref], {}, [old],
+                                      jax.tree_util.tree_structure(0)))
+    block.ops = new_ops
+    return merged
+
+
+_STATEFUL_PREFIXES = ("rand", "uniform", "normal", "dropout", "bernoulli",
+                      "poisson", "multinomial", "exponential", "seed")
+
+
+def _stateful(op):
+    t = op.op_type.lower()
+    return any(t.startswith(p) or p in t for p in _STATEFUL_PREFIXES)
+
+
+@register_pass("fuse_elewise_add_act")
+def fuse_elewise_add_act(program):
+    """Annotation pass (reference fuse_elewise_add_act_pass): tags
+    add→activation pairs. XLA performs the actual fusion; the tag records
+    intent and lets tooling count fusion opportunities."""
+    block = program.global_block
+    producers = {}
+    for op in block.ops:
+        for o in op.outputs:
+            producers[o] = op
+    acts = {"relu", "gelu", "sigmoid", "tanh", "silu"}
+    tagged = 0
+    for op in block.ops:
+        if op.op_type in acts and op.inputs:
+            i0 = op.inputs[0]
+            if isinstance(i0, VarRef):
+                p = producers.get(i0.name)
+                if p is not None and p.op_type == "add":
+                    op.attrs = dict(op.attrs, _fused_with_add=True)
+                    tagged += 1
+    return tagged
+
+
+def apply_build_strategy(main_program, startup_program, build_strategy,
+                         pass_attrs=None):
+    """Reference paddle.static.apply_build_strategy: translate strategy
+    flags into pass runs."""
+    names = []
+    if getattr(build_strategy, "enable_inplace", False) or True:
+        names.append("dead_code_elimination")
+    if getattr(build_strategy, "memory_optimize", False):
+        names.append("common_subexpression_elimination")
+        names.append("constant_folding")
+    if getattr(build_strategy, "fuse_elewise_add_act_ops", False):
+        names.append("fuse_elewise_add_act")
+    return apply_pass(main_program, names)

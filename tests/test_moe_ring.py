@@ -2,6 +2,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 import paddle_tpu.parallel as dist
@@ -161,6 +162,7 @@ def test_expert_swiglu_bank():
         assert p.grad is not None and np.isfinite(p.grad.numpy()).all()
 
 
+@pytest.mark.slow
 def test_mixtral_tiny_train_step():
     """Mixtral-family model: forward, CE+aux loss, grads flow to experts."""
     from paddle_tpu.models.mixtral import MixtralForCausalLM, mixtral_tiny

@@ -1,0 +1,69 @@
+"""Static-graph pass infrastructure (reference paddle/fluid/framework/ir
+Pass/PassRegistry; python paddle.static.apply_pass)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.static as static
+
+
+def _build():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3])
+        a = x * 2.0
+        b = x * 2.0          # CSE duplicate
+        _dead = x + 100.0    # dead once fetches are declared
+        c = a + b
+        y = c * 1.0
+    return main, x, y
+
+
+def test_cse_and_dce_shrink_and_preserve_semantics():
+    main, x, y = _build()
+    static.normalize_program(main, [x], [y])
+    n0 = len(main.global_block.ops)
+    static.apply_pass(main, ["common_subexpression_elimination",
+                             "dead_code_elimination"])
+    n1 = len(main.global_block.ops)
+    assert n1 < n0
+    (out,) = static.Executor().run(
+        main, feed={"x": np.ones((2, 3), "float32")}, fetch_list=[y])
+    np.testing.assert_allclose(out, 4.0)
+
+
+def test_dce_conservative_without_declared_fetches():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2])
+        _t1 = x * 1.0
+        _t2 = x * 5.0
+    n0 = len(main.global_block.ops)
+    static.apply_pass(main, "dead_code_elimination")
+    assert len(main.global_block.ops) == n0
+
+
+def test_build_strategy_runs_and_tags_fusion():
+    bs = static.BuildStrategy()
+    bs.memory_optimize = True
+    bs.fuse_elewise_add_act_ops = True
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2])
+        h = pt.nn.functional.relu(x + 1.0)
+    static.normalize_program(main, [x], [h])
+    static.apply_build_strategy(main, None, bs)
+    relu_op = [op for op in main.global_block.ops
+               if op.op_type == "relu"][0]
+    assert relu_op.attrs.get("_fused_with_add")
+    (out,) = static.Executor().run(
+        main, feed={"x": np.array([-2.0, 2.0], "float32")},
+        fetch_list=[h])
+    np.testing.assert_allclose(out, [0.0, 3.0])
+
+
+def test_unknown_pass_raises():
+    import pytest
+
+    main, x, y = _build()
+    with pytest.raises(ValueError):
+        static.apply_pass(main, "nonexistent_pass")
