@@ -104,3 +104,32 @@ def forward_grad(outputs, inputs, grad_inputs=None):
 def grad(outputs, inputs, grad_outputs=None):
     from ...autograd import grad as _grad
     return _grad(outputs, inputs, grad_outputs)
+
+
+_prim_enabled = [False]
+
+
+def enable_prim():
+    """Reference primapi.enable_prim: switch composite/primitive-op AD on.
+    Here jax IS the primitive system — the flag is tracked for parity and
+    gates forward_grad's availability messaging in the reference; all AD
+    in this framework is already primitive-based."""
+    _prim_enabled[0] = True
+
+
+def disable_prim():
+    _prim_enabled[0] = False
+
+
+def prim_enabled():
+    return _prim_enabled[0]
+
+
+def to_prim(blocks=None):
+    """Reference primapi.to_prim: lower ops to primitive ops in a static
+    block. Our recorded programs already execute via jax primitives, so
+    lowering is the identity."""
+    return blocks
+
+
+__all__ += ["enable_prim", "disable_prim", "prim_enabled", "to_prim"]

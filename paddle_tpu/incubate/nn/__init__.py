@@ -1,5 +1,6 @@
 from . import functional  # noqa: F401
 from .fused_transformer import (  # noqa: F401
-    FusedFeedForward, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedBiasDropoutResidualLayerNorm, FusedEcMoe, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
     FusedTransformerEncoderLayer,
 )

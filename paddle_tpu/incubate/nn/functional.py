@@ -12,7 +12,9 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor, dispatch, unwrap
 from ...nn import functional as F
 
-__all__ = ["fused_multi_head_attention", "fused_feedforward",
+__all__ = ["fused_multi_transformer", "fused_matmul_bias",
+           "fused_ec_moe",
+           "fused_multi_head_attention", "fused_feedforward",
            "fused_bias_dropout_residual_layer_norm", "fused_linear",
            "fused_linear_activation", "fused_rotary_position_embedding",
            "fused_rms_norm", "fused_layer_norm", "swiglu",
@@ -188,3 +190,140 @@ def fused_softmax_mask_upper_triangle(x, name=None):
         return jnp.where(keep, out, 0)
 
     return dispatch(fn, x, name="fused_softmax_mask_upper_triangle")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference incubate fused_matmul_bias (cublasLt epilogue): on TPU the
+    Pallas gemm_epilogue / XLA fusion provides the same single-pass
+    matmul+bias."""
+    from ...ops.registry import OPS
+    out = OPS["matmul"](x, y, transpose_x=transpose_x,
+                        transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, num_heads=None,
+                            pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """Functional form of FusedMultiTransformer (reference
+    fused_multi_transformer_op.cu): a stack of fused transformer layers as
+    one jittable composition — XLA fuses the chain.
+
+    ``num_heads`` is required (the reference op reads it from the qkv
+    weight's 4-D layout; flat 2-D weights cannot encode it). With
+    ``cache_kvs`` (list of [2, B, H, T_cache, hd] per layer), attention
+    runs over cache+current and the updated caches are returned:
+    ``(out, new_cache_kvs)``.
+    """
+    if num_heads is None:
+        raise ValueError(
+            "fused_multi_transformer needs num_heads explicitly (flat qkv "
+            "weights cannot encode the head count)")
+    from ...nn import functional as F
+    from ...ops.registry import OPS
+    matmul = OPS["matmul"]
+    concat = OPS["concat"]
+    stack = OPS["stack"]
+    out = x
+    n_layers = len(qkv_weights)
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(n_layers):
+        residual = out
+        d = out.shape[-1]
+        h = F.layer_norm(out, [d], ln_scales[i], ln_biases[i],
+                         epsilon) if pre_layer_norm else out
+        qkv = matmul(h, qkv_weights[i], transpose_y=trans_qkvw)
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            qkv = qkv + qkv_biases[i]
+        b, s = h.shape[0], h.shape[1]
+        hd = d // num_heads
+        qkv = qkv.reshape([b, s, 3, num_heads, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache_kvs is not None:
+            cache = cache_kvs[i]           # [2, B, H, T_cache, hd]
+            ck = cache[0].transpose([0, 2, 1, 3])   # -> [B, T, H, hd]
+            cv = cache[1].transpose([0, 2, 1, 3])
+            k = concat([ck, k], axis=1)
+            v = concat([cv, v], axis=1)
+            new_caches.append(stack(
+                [k.transpose([0, 2, 1, 3]), v.transpose([0, 2, 1, 3])],
+                axis=0))
+            causal = False                 # decoding: attend to full cache
+        else:
+            causal = attn_mask is None
+        att = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             is_causal=causal,
+                                             training=training)
+        att = att.reshape([b, s, d])
+        att = matmul(att, linear_weights[i])
+        if linear_biases is not None and linear_biases[i] is not None:
+            att = att + linear_biases[i]
+        out = residual + att
+        if not pre_layer_norm:
+            # post-norm: LN after the attention residual
+            out = F.layer_norm(out, [d], ln_scales[i], ln_biases[i],
+                               epsilon)
+        residual = out
+        if pre_layer_norm:
+            h = F.layer_norm(out, [d], ffn_ln_scales[i], ffn_ln_biases[i],
+                             epsilon)
+        else:
+            h = out
+        h = matmul(h, ffn1_weights[i])
+        if ffn1_biases is not None and ffn1_biases[i] is not None:
+            h = h + ffn1_biases[i]
+        h = F.gelu(h) if activation == "gelu" else F.relu(h)
+        h = matmul(h, ffn2_weights[i])
+        if ffn2_biases is not None and ffn2_biases[i] is not None:
+            h = h + ffn2_biases[i]
+        out = residual + h
+        if not pre_layer_norm:
+            out = F.layer_norm(out, [d], ffn_ln_scales[i],
+                               ffn_ln_biases[i], epsilon)
+    if cache_kvs is not None:
+        return out, new_caches
+    return out
+
+
+def fused_ec_moe(x, gate, w1, b1, w2, b2, act_type="gelu"):
+    """Expert-choice MoE (reference fused_ec_moe op): experts select their
+    top-C tokens; dense einsum dispatch on the MXU.
+
+    ``gate``: either the gate WEIGHT [hidden, experts] (logits computed
+    internally) or precomputed gate LOGITS [B, S, experts] (the reference
+    op's calling convention)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.tensor import dispatch
+
+    def fn(xv, gv, w1v, b1v, w2v, b2v):
+        b, s, d = xv.shape
+        t = b * s
+        xf = xv.reshape(t, d)
+        E = w1v.shape[0]
+        cap = max(1, t // E)
+        logits = (gv.reshape(t, E) if gv.ndim == 3 else xf @ gv)
+        scores = jax.nn.softmax(logits, axis=-1)       # [T, E]
+        # expert-choice: each expert takes its top-cap tokens
+        topv, topi = jax.lax.top_k(scores.T, cap)      # [E, C]
+        buckets = xf[topi]                             # [E, C, D]
+        h = jnp.einsum("ecd,edh->ech", buckets, w1v) + b1v
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("ech,ehd->ecd", h, w2v) + b2v
+        o = o * topv[..., None]                        # combine weight
+        out = jnp.zeros_like(xf).at[topi.reshape(-1)].add(
+            o.reshape(-1, d))
+        return out.reshape(b, s, d)
+
+    return dispatch(fn, x, gate, w1, b1, w2, b2, name="fused_ec_moe")

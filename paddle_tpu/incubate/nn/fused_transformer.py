@@ -135,3 +135,86 @@ class FusedMultiTransformer(Layer):
         for layer in self.layers:
             x = layer(x, src_mask=attn_mask)
         return x
+
+
+class FusedLinear(Layer):
+    """incubate.nn.FusedLinear (reference fused_linear over
+    fused_gemm_epilogue): linear whose bias (+activation) ride the matmul
+    epilogue — here the Pallas gemm_epilogue kernel on TPU, XLA fusion
+    elsewhere."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ...nn.initializer import XavierNormal
+        shape = (out_features, in_features) if transpose_weight else \
+            (in_features, out_features)
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        from ...incubate.nn.functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """Reference fused_bias_dropout_residual_layer_norm_op.cu capability:
+    y = LayerNorm(residual + dropout(x + bias)) in one fused region."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                             is_bias=True)
+        self.linear_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        from ...incubate.nn.functional import (
+            fused_bias_dropout_residual_layer_norm)
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            self.dropout_rate, self.epsilon, self.training)
+
+
+class FusedEcMoe(Layer):
+    """Reference incubate FusedEcMoe (expert-choice MoE layer over the
+    fused_ec_moe kernel): experts pick their top tokens — capacity is
+    exact by construction, no aux loss needed."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn.initializer import XavierNormal
+        init = XavierNormal()
+        self.gate = self.create_parameter((hidden_size, num_experts),
+                                          attr=weight_attr,
+                                          default_initializer=init)
+        self.w1 = self.create_parameter((num_experts, hidden_size,
+                                         inter_size),
+                                        default_initializer=init)
+        self.b1 = self.create_parameter((num_experts, 1, inter_size),
+                                        is_bias=True)
+        self.w2 = self.create_parameter((num_experts, inter_size,
+                                         hidden_size),
+                                        default_initializer=init)
+        self.b2 = self.create_parameter((num_experts, 1, hidden_size),
+                                        is_bias=True)
+        self.act_type = act_type
+        self.num_experts = num_experts
+
+    def forward(self, x, gate_logits=None):
+        from ...incubate.nn.functional import fused_ec_moe
+        gate = gate_logits if gate_logits is not None else self.gate
+        return fused_ec_moe(x, gate, self.w1, self.b1, self.w2,
+                            self.b2, self.act_type)

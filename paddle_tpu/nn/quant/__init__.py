@@ -1,0 +1,18 @@
+"""paddle.nn.quant parity (reference python/paddle/nn/quant/)."""
+from ...quantization import QuantedConv2D, QuantedLinear  # noqa: F401
+
+__all__ = ["Stub"]
+
+
+class Stub:
+    """Reference nn/quant/stub.py Stub: placeholder marking where an
+    activation quanter should attach; resolved by QuantConfig during
+    quantize()."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+    __call__ = forward

@@ -316,9 +316,10 @@ class MultiSlotStringDataGenerator(_DataGeneratorBase):
     """String slots (reference MultiSlotStringDataGenerator)."""
 
 
+from . import fleet_utils as utils  # noqa: E402
 from .role_maker import (PaddleCloudRoleMaker, Role,  # noqa: E402
                          UserDefinedRoleMaker)
 
 __all__ += ["Fleet", "UtilBase", "MultiSlotDataGenerator",
             "MultiSlotStringDataGenerator", "PaddleCloudRoleMaker",
-            "UserDefinedRoleMaker", "Role"]
+            "UserDefinedRoleMaker", "Role", "utils"]

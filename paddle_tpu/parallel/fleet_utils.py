@@ -1,0 +1,105 @@
+"""fleet.utils parity (reference python/paddle/distributed/fleet/utils/):
+filesystem clients + recompute re-export + DistributedInfer."""
+from __future__ import annotations
+
+import os
+import shutil
+
+from .recompute_util import recompute  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient", "recompute", "DistributedInfer"]
+
+
+class LocalFS:
+    """Reference fs.py LocalFS — local filesystem with the fleet FS API."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for n in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, n))
+             else files).append(n)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """Reference fs.py HDFSClient (hadoop CLI wrapper). No HDFS in this
+    environment: constructing is allowed (config carriers), operations
+    raise with guidance to mount the data locally and use LocalFS."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._hadoop_home = hadoop_home
+        self._configs = configs or {}
+
+    def _unavailable(self, *a, **k):
+        raise RuntimeError(
+            "HDFS is not reachable from this environment (no hadoop "
+            "runtime); stage data locally and use fleet.utils.LocalFS")
+
+    ls_dir = mkdirs = delete = is_file = is_dir = is_exist = upload = \
+        download = mv = touch = _unavailable
+
+
+class DistributedInfer:
+    """Reference utils/ps_util.py DistributedInfer: swaps the sparse-table
+    lookup program for local inference after PS training. Single-process
+    semantics: the trained dense program is already local — init gathers
+    any PS-table weights into the scope."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        if dirname:
+            from .io import load_persistables
+            load_persistables(exe, dirname, self._main)
+
+    def get_dist_infer_program(self):
+        return self._main

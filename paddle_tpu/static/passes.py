@@ -68,8 +68,9 @@ def _fetch_roots(program):
     for name, var in program.global_block.vars.items():
         if getattr(var, "persistable", False):
             roots.add(name)
-    for _tgt, _wrt, gnames in program._grad_requests:
+    for tgt, _wrt, gnames in program._grad_requests:
         roots.update(gnames)
+        roots.add(tgt)      # jax.grad replays the target's producers
     fetches = getattr(program, "_normalized_fetches", None)
     if fetches:
         roots.update(fetches)
@@ -170,11 +171,9 @@ def common_subexpression_elimination(program):
         ins = tuple(_input_key(alias.get(i.name, i)
                                if isinstance(i, VarRef) else i)
                     for i in op.inputs)
-        try:
-            key = (op.op_type, ins, tuple(sorted(op.attrs.items())))
-        except TypeError:            # unhashable attr: keep as-is
-            new_ops.append(op)
-            continue
+        # repr-normalized attrs: hashable for list/dict-valued kwargs
+        key = (op.op_type, ins,
+               tuple(sorted((k, repr(v)) for k, v in op.attrs.items())))
         prev = seen.get(key)
         # random/stateful ops must never merge
         if prev is not None and not _stateful(op):
@@ -235,9 +234,8 @@ def apply_build_strategy(main_program, startup_program, build_strategy,
                          pass_attrs=None):
     """Reference paddle.static.apply_build_strategy: translate strategy
     flags into pass runs."""
-    names = []
-    if getattr(build_strategy, "enable_inplace", False) or True:
-        names.append("dead_code_elimination")
+    # DCE is always safe and always beneficial on the recorded program
+    names = ["dead_code_elimination"]
     if getattr(build_strategy, "memory_optimize", False):
         names.append("common_subexpression_elimination")
         names.append("constant_folding")

@@ -90,6 +90,18 @@ CASES = [
     ("paddle.device", f"{R}/device/__init__.py", lambda: pt.device),
     ("paddle.optimizer.lr", f"{R}/optimizer/lr.py",
      lambda: pt.optimizer.lr),
+    ("paddle.incubate.nn", f"{R}/incubate/nn/__init__.py",
+     lambda: _mod("paddle_tpu.incubate.nn")),
+    ("paddle.incubate.nn.functional",
+     f"{R}/incubate/nn/functional/__init__.py",
+     lambda: _mod("paddle_tpu.incubate.nn.functional")),
+    ("paddle.incubate.autograd", f"{R}/incubate/autograd/__init__.py",
+     lambda: _mod("paddle_tpu.incubate.autograd")),
+    ("paddle.distributed.fleet.utils",
+     f"{R}/distributed/fleet/utils/__init__.py",
+     lambda: pt.distributed.fleet.utils),
+    ("paddle.nn.quant", f"{R}/nn/quant/__init__.py",
+     lambda: _mod("paddle_tpu.nn.quant")),
     ("paddle.nn", f"{R}/nn/__init__.py", lambda: _mod("paddle_tpu.nn")),
     ("paddle.nn.functional", f"{R}/nn/functional/__init__.py",
      lambda: _mod("paddle_tpu.nn.functional")),

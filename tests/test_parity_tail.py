@@ -240,3 +240,63 @@ class TestReaderRobustness:
         with pytest.raises(reader.ComposeNotAligned):
             list(reader.compose(lambda: iter(range(3)),
                                 lambda: iter(range(5)))())
+
+
+class TestIncubateFused:
+    def test_fused_multi_transformer_modes_and_cache(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        D, L, H = 16, 2, 4
+        mk = lambda *s: pt.to_tensor(
+            np.random.randn(*s).astype("float32") * 0.05)
+        args = dict(
+            ln_scales=[mk(D) + 1.0 for _ in range(L)],
+            ln_biases=[mk(D) for _ in range(L)],
+            qkv_weights=[mk(D, 3 * D) for _ in range(L)],
+            qkv_biases=[mk(3 * D) for _ in range(L)],
+            linear_weights=[mk(D, D) for _ in range(L)],
+            linear_biases=[mk(D) for _ in range(L)],
+            ffn_ln_scales=[mk(D) + 1.0 for _ in range(L)],
+            ffn_ln_biases=[mk(D) for _ in range(L)],
+            ffn1_weights=[mk(D, 4 * D) for _ in range(L)],
+            ffn1_biases=[mk(4 * D) for _ in range(L)],
+            ffn2_weights=[mk(4 * D, D) for _ in range(L)],
+            ffn2_biases=[mk(D) for _ in range(L)],
+            trans_qkvw=False, num_heads=H)
+        x = pt.to_tensor(np.random.randn(1, 6, D).astype("float32"))
+        out = IF.fused_multi_transformer(x, **args)
+        out_post = IF.fused_multi_transformer(x, pre_layer_norm=False,
+                                              **args)
+        assert out.shape == [1, 6, D]
+        assert not np.allclose(out.numpy(), out_post.numpy())
+        with pytest.raises(ValueError):
+            IF.fused_multi_transformer(x, **{**args, "num_heads": None})
+        empty = [pt.to_tensor(np.zeros((2, 1, H, 0, D // H), "float32"))
+                 for _ in range(L)]
+        prefill, caches = IF.fused_multi_transformer(x, cache_kvs=empty,
+                                                     **args)
+        step = pt.to_tensor(np.random.randn(1, 1, D).astype("float32"))
+        dec, caches2 = IF.fused_multi_transformer(step, cache_kvs=caches,
+                                                  **args)
+        assert dec.shape == [1, 1, D] and caches2[0].shape[3] == 7
+
+    def test_fused_ec_moe_routes_and_trains(self):
+        from paddle_tpu.incubate.nn import FusedEcMoe
+        moe = FusedEcMoe(16, 32, 4)
+        z = pt.to_tensor(np.random.randn(2, 8, 16).astype("float32"),
+                         stop_gradient=False)
+        out = moe(z)
+        out.sum().backward()
+        assert np.isfinite(moe.w1.grad.numpy()).all()
+        logits = pt.to_tensor(np.random.randn(2, 8, 4).astype("float32"))
+        out2 = moe(z, gate_logits=logits)
+        assert not np.allclose(out.numpy(), out2.numpy())
+
+    def test_fused_linear_and_bias_dropout_ln(self):
+        from paddle_tpu.incubate.nn import (
+            FusedBiasDropoutResidualLayerNorm, FusedLinear)
+        fl = FusedLinear(8, 16)
+        x = pt.to_tensor(np.random.randn(2, 8).astype("float32"))
+        assert fl(x).shape == [2, 16]
+        bdr = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+        y = pt.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        assert bdr(y, y).shape == [2, 4, 8]

@@ -67,3 +67,31 @@ def test_unknown_pass_raises():
     main, x, y = _build()
     with pytest.raises(ValueError):
         static.apply_pass(main, "nonexistent_pass")
+
+
+def test_cse_with_list_valued_attrs():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 3, 4])
+        a = pt.ops.sum(x, axis=[0, 1])
+        b = pt.ops.sum(x, axis=[0, 1])
+        y = a + b
+    static.normalize_program(main, [x], [y])
+    static.apply_pass(main, "common_subexpression_elimination")
+    (out,) = static.Executor().run(
+        main, feed={"x": np.ones((2, 3, 4), "float32")}, fetch_list=[y])
+    np.testing.assert_allclose(out, 12.0)
+
+
+def test_dce_keeps_grad_targets():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3])
+        loss = (x * x).sum()
+        grads = static.gradients([loss], [x])
+    static.normalize_program(main, [x], grads)
+    static.apply_pass(main, "dead_code_elimination")
+    (g,) = static.Executor().run(
+        main, feed={"x": np.array([1., 2., 3.], "float32")},
+        fetch_list=grads)
+    np.testing.assert_allclose(g, [2, 4, 6])
