@@ -194,15 +194,11 @@ def fused_softmax_mask_upper_triangle(x, name=None):
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
                       name=None):
-    """Reference incubate fused_matmul_bias (cublasLt epilogue): on TPU the
-    Pallas gemm_epilogue / XLA fusion provides the same single-pass
-    matmul+bias."""
-    from ...ops.registry import OPS
-    out = OPS["matmul"](x, y, transpose_x=transpose_x,
-                        transpose_y=transpose_y)
-    if bias is not None:
-        out = out + bias
-    return out
+    """Reference incubate fused_matmul_bias (cublasLt epilogue): routes
+    through the same Pallas gemm_epilogue path as fused_linear_activation
+    (single-pass matmul+bias on TPU)."""
+    return fused_linear_activation(x, y, bias, trans_x=transpose_x,
+                                   trans_y=transpose_y, activation=None)
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
@@ -249,8 +245,10 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         hd = d // num_heads
         qkv = qkv.reshape([b, s, 3, num_heads, hd])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        layer_mask = attn_mask
         if cache_kvs is not None:
             cache = cache_kvs[i]           # [2, B, H, T_cache, hd]
+            t_cache = cache.shape[3]
             ck = cache[0].transpose([0, 2, 1, 3])   # -> [B, T, H, hd]
             cv = cache[1].transpose([0, 2, 1, 3])
             k = concat([ck, k], axis=1)
@@ -258,16 +256,29 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             new_caches.append(stack(
                 [k.transpose([0, 2, 1, 3]), v.transpose([0, 2, 1, 3])],
                 axis=0))
-            causal = False                 # decoding: attend to full cache
+            causal = False
+            if layer_mask is None and s > 1:
+                # chunked prefill: current positions see the full cache
+                # but stay causal within the chunk
+                import numpy as _np
+
+                import paddle_tpu as _pt
+                m = _np.full((s, t_cache + s), 0.0, _np.float32)
+                tri = _np.triu(_np.full((s, s), -1e9, _np.float32), 1)
+                m[:, t_cache:] = tri
+                layer_mask = _pt.to_tensor(m[None, None])
         else:
-            causal = attn_mask is None
-        att = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+            causal = layer_mask is None
+        att = F.scaled_dot_product_attention(q, k, v,
+                                             attn_mask=layer_mask,
                                              is_causal=causal,
                                              training=training)
         att = att.reshape([b, s, d])
         att = matmul(att, linear_weights[i])
         if linear_biases is not None and linear_biases[i] is not None:
             att = att + linear_biases[i]
+        if dropout_rate and training:
+            att = F.dropout(att, p=dropout_rate, training=True)
         out = residual + att
         if not pre_layer_norm:
             # post-norm: LN after the attention residual
@@ -286,6 +297,8 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         h = matmul(h, ffn2_weights[i])
         if ffn2_biases is not None and ffn2_biases[i] is not None:
             h = h + ffn2_biases[i]
+        if dropout_rate and training:
+            h = F.dropout(h, p=dropout_rate, training=True)
         out = residual + h
         if not pre_layer_norm:
             out = F.layer_norm(out, [d], ffn_ln_scales[i],

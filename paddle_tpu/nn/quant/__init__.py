@@ -1,18 +1,18 @@
 """paddle.nn.quant parity (reference python/paddle/nn/quant/)."""
+from ...nn.layer import Layer
 from ...quantization import QuantedConv2D, QuantedLinear  # noqa: F401
 
 __all__ = ["Stub"]
 
 
-class Stub:
-    """Reference nn/quant/stub.py Stub: placeholder marking where an
-    activation quanter should attach; resolved by QuantConfig during
-    quantize()."""
+class Stub(Layer):
+    """Reference nn/quant/stub.py Stub: a Layer placeholder marking where
+    an activation quanter should attach; being a Layer it appears in
+    named_sublayers() so QuantConfig/quantize() traversal can resolve it."""
 
     def __init__(self, observer=None):
+        super().__init__()
         self._observer = observer
 
     def forward(self, x):
         return x
-
-    __call__ = forward

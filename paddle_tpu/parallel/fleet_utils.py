@@ -58,7 +58,10 @@ class LocalFS:
         shutil.copy(fs_path, local_path)
 
     def mv(self, src, dst, overwrite=False):
-        if overwrite and os.path.exists(dst):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(
+                    f"mv destination exists: {dst!r} (overwrite=False)")
             self.delete(dst)
         shutil.move(src, dst)
 

@@ -157,6 +157,25 @@ def _input_key(i):
         return ("obj", id(i))
 
 
+def _norm_attr(v):
+    """Hashable, equality-faithful normal form for attr values: containers
+    normalize recursively; ndarrays by exact bytes; other unhashables fall
+    back to identity (never merged — safe)."""
+    import numpy as _np
+    if isinstance(v, (list, tuple)):
+        return ("seq", tuple(_norm_attr(e) for e in v))
+    if isinstance(v, dict):
+        return ("map", tuple(sorted((k, _norm_attr(x))
+                                    for k, x in v.items())))
+    if isinstance(v, _np.ndarray):
+        return ("nd", v.shape, str(v.dtype), v.tobytes())
+    try:
+        hash(v)
+        return ("lit", v)
+    except TypeError:
+        return ("id", id(v))
+
+
 @register_pass("common_subexpression_elimination")
 def common_subexpression_elimination(program):
     """Merge identical (op_type, inputs, attrs) ops — later duplicates
@@ -171,9 +190,12 @@ def common_subexpression_elimination(program):
         ins = tuple(_input_key(alias.get(i.name, i)
                                if isinstance(i, VarRef) else i)
                     for i in op.inputs)
-        # repr-normalized attrs: hashable for list/dict-valued kwargs
+        # normalized attrs: hashable AND equality-faithful (repr would
+        # collide on truncated ndarray prints; identity fallback never
+        # merges distinct unhashable objects)
         key = (op.op_type, ins,
-               tuple(sorted((k, repr(v)) for k, v in op.attrs.items())))
+               tuple(sorted((k, _norm_attr(v))
+                            for k, v in op.attrs.items())))
         prev = seen.get(key)
         # random/stateful ops must never merge
         if prev is not None and not _stateful(op):
