@@ -194,11 +194,21 @@ def fused_softmax_mask_upper_triangle(x, name=None):
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
                       name=None):
-    """Reference incubate fused_matmul_bias (cublasLt epilogue): routes
-    through the same Pallas gemm_epilogue path as fused_linear_activation
-    (single-pass matmul+bias on TPU)."""
-    return fused_linear_activation(x, y, bias, trans_x=transpose_x,
-                                   trans_y=transpose_y, activation=None)
+    """Reference incubate fused_matmul_bias (cublasLt epilogue). 2-D
+    weights route through the Pallas gemm_epilogue path (single-pass
+    matmul+bias on TPU); batched/ND operands fall back to matmul+add,
+    which XLA fuses."""
+    y_is_2d = len(y.shape) == 2
+    if y_is_2d and not transpose_x:
+        return fused_linear_activation(x, y, bias, trans_x=False,
+                                       trans_y=transpose_y,
+                                       activation="none")
+    from ...ops.registry import OPS
+    out = OPS["matmul"](x, y, transpose_x=transpose_x,
+                        transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
@@ -233,6 +243,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     out = x
     n_layers = len(qkv_weights)
     new_caches = [] if cache_kvs is not None else None
+    _prefill_mask = None
     for i in range(n_layers):
         residual = out
         d = out.shape[-1]
@@ -259,14 +270,17 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             causal = False
             if layer_mask is None and s > 1:
                 # chunked prefill: current positions see the full cache
-                # but stay causal within the chunk
-                import numpy as _np
+                # but stay causal within the chunk (mask built once; all
+                # layers share the same cache length)
+                if _prefill_mask is None:
+                    import numpy as _np
 
-                import paddle_tpu as _pt
-                m = _np.full((s, t_cache + s), 0.0, _np.float32)
-                tri = _np.triu(_np.full((s, s), -1e9, _np.float32), 1)
-                m[:, t_cache:] = tri
-                layer_mask = _pt.to_tensor(m[None, None])
+                    import paddle_tpu as _pt
+                    m = _np.full((s, t_cache + s), 0.0, _np.float32)
+                    tri = _np.triu(_np.full((s, s), -1e9, _np.float32), 1)
+                    m[:, t_cache:] = tri
+                    _prefill_mask = _pt.to_tensor(m[None, None])
+                layer_mask = _prefill_mask
         else:
             causal = layer_mask is None
         att = F.scaled_dot_product_attention(q, k, v,
@@ -278,7 +292,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         if linear_biases is not None and linear_biases[i] is not None:
             att = att + linear_biases[i]
         if dropout_rate and training:
-            att = F.dropout(att, p=dropout_rate, training=True)
+            att = F.dropout(att, p=dropout_rate, training=True, mode=mode)
         out = residual + att
         if not pre_layer_norm:
             # post-norm: LN after the attention residual
@@ -298,7 +312,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         if ffn2_biases is not None and ffn2_biases[i] is not None:
             h = h + ffn2_biases[i]
         if dropout_rate and training:
-            h = F.dropout(h, p=dropout_rate, training=True)
+            h = F.dropout(h, p=dropout_rate, training=True, mode=mode)
         out = residual + h
         if not pre_layer_norm:
             out = F.layer_norm(out, [d], ffn_ln_scales[i],

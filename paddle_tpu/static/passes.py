@@ -165,8 +165,9 @@ def _norm_attr(v):
     if isinstance(v, (list, tuple)):
         return ("seq", tuple(_norm_attr(e) for e in v))
     if isinstance(v, dict):
-        return ("map", tuple(sorted((k, _norm_attr(x))
-                                    for k, x in v.items())))
+        # sort by repr of the key: mixed-type keys are not orderable
+        return ("map", tuple(sorted(((repr(k), _norm_attr(x))
+                                     for k, x in v.items()))))
     if isinstance(v, _np.ndarray):
         return ("nd", v.shape, str(v.dtype), v.tobytes())
     try:
