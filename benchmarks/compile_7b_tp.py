@@ -1,4 +1,6 @@
-"""AOT-compile the Llama-2-7B TP(+ZeRO-2) train step on a virtual mesh.
+"""AOT-compile the Llama-2-7B TP train step on a virtual mesh, in both
+ZeRO layouts (stage 2: params replicated over 'sharding'; stage 3:
+params sharded).
 
 BASELINE.md's 7B row needs a multi-chip slice to *measure*; this proves
 the full-size program (real shapes, real TP/sharding layouts) lowers and
@@ -14,24 +16,26 @@ import time
 
 def main(n_devices=8):
     os.environ["JAX_PLATFORMS"] = "cpu"
+    import re
     flags = os.environ.get("XLA_FLAGS", "")
-    if "host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags)
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
     import numpy as np
 
-    import paddle_tpu as pt
     import paddle_tpu.parallel as dist
-    from paddle_tpu.jit import functional_call
     from paddle_tpu.models.llama import LlamaConfig
 
     cfg = LlamaConfig(tensor_parallel=True)          # 7B defaults
-    mesh = dist.init_mesh(mp=4, sharding=2,
+    mp = min(4, max(1, n_devices // 2)) if n_devices > 1 else 1
+    shard_deg = n_devices // mp
+    mesh = dist.init_mesh(mp=mp, sharding=shard_deg,
                           devices=jax.devices()[:n_devices])
 
     # Build the model ABSTRACTLY: construct a tiny clone for structure,
@@ -74,12 +78,9 @@ def main(n_devices=8):
           f"{n_params/1e9:.2f}B params "
           f"({time.perf_counter()-t0:.1f}s)", flush=True)
 
-    # the REAL 7B model instance for tracing: same structure, but its
-    # forward only needs shapes under eval_shape/lower — construct the
-    # full-size module lazily per layer is not possible, so trace through
-    # the tiny module rebuilt at 7B config WITHOUT init: we override the
-    # initializer to zeros-via-eval_shape... simplest robust route: trace
-    # a functional forward defined directly over the param dict.
+    # Trace a functional forward defined directly over the param dict
+    # (constructing a real 7B module would materialize 28 GB of weights).
+    from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.ops.pallas import rope as rope_mod
 
     hd = cfg.head_dim
@@ -105,9 +106,11 @@ def main(n_devices=8):
                 rep = cfg.num_heads // cfg.num_kv_heads
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            from paddle_tpu.ops.pallas import flash_attention as fa
-            att = fa._ref_attention_bshd(q, k, v) if hasattr(
-                fa, "_ref_attention_bshd") else _xla_attn(q, k, v)
+            # the model's real attention routing (Pallas on TPU; on this
+            # CPU mesh it falls back to the reference composition, which
+            # materializes scores — the reported temp bytes are an UPPER
+            # bound on the TPU program's)
+            att = fa.flash_attention(q, k, v, causal=True)
             att = att.reshape(b, s_len, cfg.num_heads * hd)
             x = x + att @ p("self_attn.o_proj.weight")
             h = _rms(x, p("post_attention_layernorm.weight"))
@@ -124,14 +127,6 @@ def main(n_devices=8):
                        keepdims=True)
         return (x * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype) * w
 
-    def _xla_attn(q, k, v):
-        s = q.shape[1]
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask[None, None], scores, -1e9)
-        p = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
-
     def grad_step(params, ids):
         return jax.value_and_grad(fwd)(params, ids)
 
@@ -140,7 +135,7 @@ def main(n_devices=8):
     from paddle_tpu.parallel.api import zero_spec
     from paddle_tpu.parallel.mesh import P
 
-    def spec_of(name, shape):
+    def spec_of(name, shape, stage3):
         if "embed_tokens" in name or "lm_head" in name:
             base = P("mp", None) if "embed" in name else P(None, "mp")
         elif any(k in name for k in ("q_proj", "k_proj", "v_proj",
@@ -150,28 +145,33 @@ def main(n_devices=8):
             base = P("mp", None)
         else:
             base = P()
-        return NamedSharding(mesh.mesh, zero_spec(shape, base, mesh))
+        # stage 2: params stay replicated over 'sharding' (only grads/
+        # optimizer state shard — parallel/api.py param_shardings);
+        # stage 3: params shard over 'sharding' too (zero_spec)
+        spec = zero_spec(shape, base, mesh) if stage3 else base
+        return NamedSharding(mesh.mesh, spec)
 
-    in_shardings = ({n: spec_of(n, s.shape) for n, s in abstract.items()},
-                    None)
     ids_abs = jax.ShapeDtypeStruct((8, 512), jnp.int32)
-
-    t0 = time.perf_counter()
-    lowered = jax.jit(grad_step, in_shardings=in_shardings).lower(
-        abstract, ids_abs)
-    t_lower = time.perf_counter() - t0
-    print(f"lowered 7B TP4xZeRO2 program in {t_lower:.1f}s", flush=True)
-    t0 = time.perf_counter()
-    compiled = lowered.compile()
-    t_comp = time.perf_counter() - t0
-    mem = compiled.memory_analysis()
-    print(f"compiled in {t_comp:.1f}s", flush=True)
-    try:
-        print(f"  per-device argument bytes: "
-              f"{mem.argument_size_in_bytes/1e9:.2f} GB, "
-              f"temp: {mem.temp_size_in_bytes/1e9:.2f} GB", flush=True)
-    except Exception:
-        pass
+    for stage3 in (False, True):
+        tag = "ZeRO-3 (params sharded)" if stage3 else             "ZeRO-2 (params replicated over sharding)"
+        in_shardings = ({n: spec_of(n, s.shape, stage3)
+                         for n, s in abstract.items()}, None)
+        t0 = time.perf_counter()
+        lowered = jax.jit(grad_step, in_shardings=in_shardings).lower(
+            abstract, ids_abs)
+        t_lower = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        t_comp = time.perf_counter() - t0
+        print(f"{tag}: lowered {t_lower:.1f}s, compiled {t_comp:.1f}s",
+              flush=True)
+        try:
+            mem = compiled.memory_analysis()
+            print(f"  per-device arguments "
+                  f"{mem.argument_size_in_bytes/1e9:.2f} GB, "
+                  f"temp {mem.temp_size_in_bytes/1e9:.2f} GB", flush=True)
+        except Exception:
+            pass
     print("7B TP compile-check OK")
 
 
