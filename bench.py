@@ -67,6 +67,13 @@ def peak_flops_bf16():
     return 197e12  # conservative default
 
 
+METRICS = {
+    "gpt2": "gpt2_345m_train_tokens_per_sec_per_chip",
+    "llama350m": "llama_350m_train_tokens_per_sec_per_chip",
+    "moe": "mixtral_8e_top2_train_tokens_per_sec_per_chip",
+}
+
+
 def _build_model(config_name):
     """Returns (model, cfg, metric_name, batch, seq)."""
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_345m
@@ -78,18 +85,15 @@ def _build_model(config_name):
         # same architecture (RMSNorm/rope/SwiGLU/flash-attn path) sized
         # for one chip and reports the same tokens/s/chip metric.
         cfg = llama_350m()
-        return (LlamaForCausalLM(cfg), cfg,
-                "llama_350m_train_tokens_per_sec_per_chip", 8, 1024)
+        return (LlamaForCausalLM(cfg), cfg, METRICS["llama350m"], 8, 1024)
     if config_name == "moe":
         # BASELINE.md MoE row (DeepSeek-MoE / Mixtral family): top-2 of 8
         # SwiGLU experts, GShard grouped dispatch, aux loss in the step.
         from paddle_tpu.models.mixtral import MixtralForCausalLM, moe_350m_8e
         cfg = moe_350m_8e(moe_group_size=1024)
-        return (MixtralForCausalLM(cfg), cfg,
-                "mixtral_8e_top2_train_tokens_per_sec_per_chip", 8, 1024)
+        return (MixtralForCausalLM(cfg), cfg, METRICS["moe"], 8, 1024)
     cfg = gpt2_345m(dropout=0.0)
-    return (GPTForCausalLM(cfg), cfg,
-            "gpt2_345m_train_tokens_per_sec_per_chip", 8, 1024)
+    return (GPTForCausalLM(cfg), cfg, METRICS["gpt2"], 8, 1024)
 
 
 def _probe_device_responsive(timeout_s=180, attempts=3):
@@ -128,13 +132,8 @@ def main(config_name="gpt2"):
     if not _probe_device_responsive():
         # emit a parseable failure line (under the REAL metric name so
         # the driver's records line up) rather than hanging
-        _metrics = {
-            "gpt2": "gpt2_345m_train_tokens_per_sec_per_chip",
-            "llama350m": "llama_350m_train_tokens_per_sec_per_chip",
-            "moe": "mixtral_8e_top2_train_tokens_per_sec_per_chip",
-        }
         print(json.dumps({
-            "metric": _metrics.get(
+            "metric": METRICS.get(
                 config_name, f"{config_name}_train_tokens_per_sec_per_chip"),
             "value": 0,
             "unit": "tokens/s",
