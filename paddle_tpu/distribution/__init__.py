@@ -479,5 +479,16 @@ def kl_divergence(p, q):  # noqa: F811 — registry-aware wrapper
     return _builtin_kl(p, q)
 
 
+from . import transform  # noqa: E402,F401
+from .transform import (AbsTransform, AffineTransform,  # noqa: E402,F401
+                        ChainTransform, ExpTransform, IndependentTransform,
+                        PowerTransform, ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
+
 __all__ += ["ExponentialFamily", "Independent", "TransformedDistribution",
-            "register_kl"]
+            "register_kl", "transform", "Transform", "AbsTransform",
+            "AffineTransform", "ChainTransform", "ExpTransform",
+            "IndependentTransform", "PowerTransform", "ReshapeTransform",
+            "SigmoidTransform", "SoftmaxTransform", "StackTransform",
+            "StickBreakingTransform", "TanhTransform"]

@@ -1,0 +1,292 @@
+"""paddle.distribution.transform parity (reference
+python/paddle/distribution/transform.py): bijective transforms with
+forward/inverse and log-det-Jacobian, composable with
+TransformedDistribution.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap, wrap
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform"]
+
+
+def _v(x):
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x,
+                                                               jnp.float32)
+
+
+class Transform:
+    """Base bijector (reference transform.py Transform)."""
+
+    _domain = "real"
+    _codomain = "real"
+
+    def forward(self, x):
+        return wrap(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return wrap(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return wrap(self._fldj(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return wrap(-self._fldj(self._inverse(_v(y))))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass surface
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective: inverse returns the positive branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims as event dims: log-det sums over
+    them (reference IndependentTransform)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self.base._fldj(x)
+        axes = tuple(range(ldj.ndim - self.rank, ldj.ndim))
+        return jnp.sum(ldj, axes)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+    def forward_shape(self, shape):
+        k = len(shape) - len(self.in_event_shape)
+        return tuple(shape[:k]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        k = len(shape) - len(self.out_event_shape)
+        return tuple(shape[:k]) + self.in_event_shape
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) (not bijective; inverse is log up to an additive
+    constant, matching the reference)."""
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "softmax is not bijective; no log-det-Jacobian")
+
+
+class StackTransform(Transform):
+    """Apply a different transform per slice along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, x, method):
+        n = len(self.transforms)
+        if x.shape[self.axis] != n:
+            raise ValueError(
+                f"StackTransform has {n} transforms but input has "
+                f"{x.shape[self.axis]} slices along axis {self.axis}")
+        parts = []
+        for i, t in enumerate(self.transforms):
+            sl = jnp.take(x, i, axis=self.axis)
+            parts.append(getattr(t, method)(sl))
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._map(x, "_fldj")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^K -> simplex Δ^K (reference
+    StickBreakingTransform)."""
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zcum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), zcum], -1)
+        zfull = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], -1)
+        return zfull * lead
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1.0 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        # d y_i / d x_i terms: log sigmoid'(t) + log of remaining stick
+        rest = jnp.cumprod(1 - z, -1)
+        log_rest = jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype),
+             jnp.log(rest[..., :-1])], -1)
+        return jnp.sum(jax.nn.log_sigmoid(t) + jax.nn.log_sigmoid(-t)
+                       + log_rest, -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

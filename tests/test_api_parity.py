@@ -102,6 +102,8 @@ CASES = [
      lambda: pt.distributed.fleet.utils),
     ("paddle.nn.quant", f"{R}/nn/quant/__init__.py",
      lambda: _mod("paddle_tpu.nn.quant")),
+    ("paddle.distribution.transform", f"{R}/distribution/transform.py",
+     lambda: pt.distribution.transform),
     ("paddle.nn", f"{R}/nn/__init__.py", lambda: _mod("paddle_tpu.nn")),
     ("paddle.nn.functional", f"{R}/nn/functional/__init__.py",
      lambda: _mod("paddle_tpu.nn.functional")),

@@ -33,4 +33,3 @@ def test_bwd_kernel_3d_and_vjp_consistency():
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(dw), np.asarray(rdw),
                                rtol=2e-4, atol=2e-4)
-
