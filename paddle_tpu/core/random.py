@@ -19,7 +19,11 @@ _state = threading.local()
 
 def _tls():
     if not hasattr(_state, "key"):
-        _state.key = jax.random.PRNGKey(0)
+        # ensure_compile_time_eval: first touch may happen inside a trace
+        # (e.g. the static recorder's eval_shape); the global key must be
+        # a concrete array, never a tracer that would leak out of scope
+        with jax.ensure_compile_time_eval():
+            _state.key = jax.random.PRNGKey(0)
         _state.count = 0
         _state.scopes = []
     return _state
@@ -27,7 +31,8 @@ def _tls():
 
 def seed(s: int):
     tls = _tls()
-    tls.key = jax.random.PRNGKey(int(s))
+    with jax.ensure_compile_time_eval():
+        tls.key = jax.random.PRNGKey(int(s))
     tls.count = 0
     return tls.key
 

@@ -211,9 +211,11 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         if mode == "downscale_in_infer" and not training:
             return dispatch(lambda v: v * (1.0 - p), x, name="dropout_infer")
         return x
-    key = rnd.next_key()
 
     def fn(v):
+        # key drawn inside fn: static-graph replay re-samples per run
+        # (Executor activates a per-run rng_scope around each op)
+        key = rnd.next_key()
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else axis
@@ -244,10 +246,9 @@ def alpha_dropout(x, p=0.5, training=True):
     alpha_p = -alpha * scale
     a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
     b = -a * alpha_p * p
-    key = rnd.next_key()
 
     def fn(v):
-        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, v.shape)
         return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
 
     return dispatch(fn, x, name="alpha_dropout")
@@ -1589,49 +1590,62 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
         lp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
         B, T, U1, _C = lp.shape
         U = U1 - 1
-        neg_inf = jnp.float32(-1e30)
         blank_lp = lp[..., blank]                       # [B, T, U+1]
         lbi = lb.astype(jnp.int32)
         # label emission logprob at (t, u): P(label[u] | t, u), u < U
         lab_lp = jnp.take_along_axis(
             lp[:, :, :U, :], lbi[:, None, :, None], -1)[..., 0]  # [B,T,U]
 
-        def row_scan(alpha_prev_t, t):
-            # alpha[t, u] = logadd(alpha[t-1, u] + blank[t-1, u],
-            #                      alpha[t, u-1] + label[t, u-1])
-            from_blank = alpha_prev_t + blank_lp[:, t - 1, :]
+        def nll(blank_lp, lab_lp):
+            def row_scan(alpha_prev_t, t):
+                # alpha[t, u] = logadd(alpha[t-1, u] + blank[t-1, u],
+                #                      alpha[t, u-1] + label[t, u-1])
+                from_blank = alpha_prev_t + blank_lp[:, t - 1, :]
 
-            def u_step(carry, u):
-                cur = jnp.logaddexp(
-                    from_blank[:, u],
-                    carry + lab_lp[:, t, u - 1])
+                def u_step(carry, u):
+                    cur = jnp.logaddexp(
+                        from_blank[:, u],
+                        carry + lab_lp[:, t, u - 1])
+                    return cur, cur
+
+                first = from_blank[:, 0]
+                _, rest = jax.lax.scan(u_step, first, jnp.arange(1, U1))
+                row = jnp.concatenate([first[:, None], rest.T], 1)
+                return row
+
+            def t_body(carry, t):
+                row = row_scan(carry, t)
+                return row, row
+
+            # t = 0 row: only label transitions
+            def u0_step(carry, u):
+                cur = carry + lab_lp[:, 0, u - 1]
                 return cur, cur
 
-            first = from_blank[:, 0]
-            _, rest = jax.lax.scan(u_step, first, jnp.arange(1, U1))
-            row = jnp.concatenate([first[:, None], rest.T], 1)
-            return row
+            a00 = jnp.zeros((B,), jnp.float32)
+            _, row0_rest = jax.lax.scan(u0_step, a00, jnp.arange(1, U1))
+            row0 = jnp.concatenate([a00[:, None], row0_rest.T], 1)
+            _, rows = jax.lax.scan(t_body, row0, jnp.arange(1, T))
+            all_rows = jnp.concatenate([row0[None], rows], 0)  # [T,B,U+1]
+            # final: alpha[tl-1, ul] + blank(tl-1, ul)
+            ti = jnp.clip(tl.astype(jnp.int32) - 1, 0, T - 1)
+            ui = jnp.clip(ul.astype(jnp.int32), 0, U)
+            aT = all_rows[ti, jnp.arange(B), ui]
+            final_blank = blank_lp[jnp.arange(B), ti, ui]
+            return -(aT + final_blank)
 
-        def t_body(carry, t):
-            row = row_scan(carry, t)
-            return row, row
-
-        # t = 0 row: only label transitions
-        def u0_step(carry, u):
-            cur = carry + lab_lp[:, 0, u - 1]
-            return cur, cur
-
-        a00 = jnp.zeros((B,), jnp.float32)
-        _, row0_rest = jax.lax.scan(u0_step, a00, jnp.arange(1, U1))
-        row0 = jnp.concatenate([a00[:, None], row0_rest.T], 1)
-        _, rows = jax.lax.scan(t_body, row0, jnp.arange(1, T))
-        all_rows = jnp.concatenate([row0[None], rows], 0)  # [T, B, U+1]
-        # final: alpha[tl-1, ul] + blank(tl-1, ul)
-        ti = jnp.clip(tl.astype(jnp.int32) - 1, 0, T - 1)
-        ui = jnp.clip(ul.astype(jnp.int32), 0, U)
-        aT = all_rows[ti, jnp.arange(B), ui]
-        final_blank = blank_lp[jnp.arange(B), ti, ui]
-        return -(aT + final_blank)
+        loss = nll(blank_lp, lab_lp)
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148, warprnnt parity): scale the
+            # label-emission gradient by (1 + lambda), blank unchanged.
+            # Re-running the recursion with blank detached yields a value
+            # equal to `loss` whose gradient flows only through lab_lp;
+            # adding lambda*(it - stop_grad(it)) keeps the forward value
+            # while scaling exactly the emission gradient.
+            emit = nll(jax.lax.stop_gradient(blank_lp), lab_lp)
+            loss = loss + fastemit_lambda * (
+                emit - jax.lax.stop_gradient(emit))
+        return loss
 
     loss = dispatch(fn, logits, labels, logit_lengths, label_lengths,
                     nondiff_args=(1, 2, 3), name="rnnt_loss")

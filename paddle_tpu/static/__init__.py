@@ -194,7 +194,12 @@ def _prune_ops(ops, fetch_names):
 
 
 def _program_infer_fn(program, feed_names, fetch_names, scope):
-    """Pure (feed…) -> fetches closure over scope values, for export."""
+    """Pure (feed…) -> fetches closure over scope values, for export.
+
+    Stateful ops (dropout, …) are snapshotted at export: the traced
+    function bakes one sample. Export inference programs (is_test /
+    training=False) — the reference's save_inference_model likewise
+    expects test-mode graphs."""
     from .executor import _replay
     ops = _prune_ops(program.global_block.ops, fetch_names)
     scope_vals = {n: scope._vars[n]

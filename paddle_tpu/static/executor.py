@@ -55,6 +55,15 @@ class Scope:
 
 _global_scope = Scope()
 _scope_stack = [_global_scope]
+_ZERO_KEY = None    # lazily built: placeholder key for stateless programs
+
+
+def _zero_key():
+    global _ZERO_KEY
+    if _ZERO_KEY is None:
+        with jax.ensure_compile_time_eval():
+            _ZERO_KEY = jax.random.PRNGKey(0)
+    return _ZERO_KEY
 
 
 def global_scope():
@@ -70,19 +79,35 @@ def scope_guard(scope):
         _scope_stack.pop()
 
 
-def _replay(ops, env, protect=frozenset()):
+def _replay(ops, env, protect=frozenset(), run_key=None):
     """Replay recorded ops into env. Names in ``protect`` are grad leaves:
     their injected values are never overwritten, and an op is skipped
     entirely only when ALL of its outputs are protected (an op with a
     protected and an unprotected output must still run to produce the
-    sibling — skipping it on a partial match dropped sibling outputs)."""
-    for op in ops:
+    sibling — skipping it on a partial match dropped sibling outputs).
+
+    ``run_key``: per-run PRNG key. Each op replays inside an rng_scope of
+    ``fold_in(run_key, op_index)``, so stateful ops (dropout, ...) draw a
+    fresh sample every Executor.run — reference static-graph semantics
+    (runtime generator state, not a trace-time frozen sample) — while
+    forward and grad replays of the same op stay consistent (the key
+    depends only on (run_key, op index), not on replay-local draw order)."""
+    from ..core import random as rnd
+    from .passes import _stateful
+    for idx, op in enumerate(ops):
         outs = set(op.outputs)
         if outs and outs <= protect:
             continue
         vals = [env[i.name] if isinstance(i, VarRef) else i
                 for i in op.inputs]
-        out = op.fn(*vals, **op_call_kwargs(op))
+        if run_key is not None and _stateful(op):
+            # per-op fold_in only for random ops: stateless ops would
+            # trace a dead fold_in each (key index = op index, so the
+            # sequence stays stable across replays either way)
+            with rnd.rng_scope(jax.random.fold_in(run_key, idx)):
+                out = op.fn(*vals, **op_call_kwargs(op))
+        else:
+            out = op.fn(*vals, **op_call_kwargs(op))
         flat, _ = jax.tree_util.tree_flatten(out)
         for n, v in zip(op.outputs, flat):
             if n not in protect:
@@ -135,9 +160,21 @@ class Executor:
             self._cache[key] = entry
         # entry holds the Program strongly so id(program) can't be reused by
         # a collected-and-reallocated Program hitting a stale cache slot
-        fn, scope_in_names, train, _prog_ref = entry
+        fn, scope_in_names, train, has_stateful, _prog_ref = entry
 
         scope_vals = {n: scope._vars[n] for n in scope_in_names}
+        # per-run PRNG key: program.random_seed pins determinism (reference
+        # Program.random_seed); otherwise draw from the global generator so
+        # paddle.seed(...) reproduces run sequences. Deterministic programs
+        # must not advance the host generator at all (reference executors
+        # only touch generator state for stateful ops).
+        from ..core import random as rnd
+        if not has_stateful:
+            run_key = _zero_key()
+        elif getattr(program, "random_seed", 0):
+            run_key = jax.random.PRNGKey(int(program.random_seed))
+        else:
+            run_key = rnd.next_key()
         if train:
             opt, loss_name, pnames = program._train_spec
             # optimizer state is per-program (not per feed-signature): a new
@@ -152,13 +189,13 @@ class Executor:
             lr = jnp.asarray(float(opt.get_lr()), jnp.float32)
             fetches, new_persist, new_opt_state = fn(
                 feed_vals, scope_vals, opt_state,
-                jnp.asarray(step_count + 1, jnp.int32), lr)
+                jnp.asarray(step_count + 1, jnp.int32), lr, run_key)
             self._opt_states[opt_key] = (new_opt_state, step_count + 1)
             sched = getattr(opt, "_learning_rate", None)
             if hasattr(sched, "step"):
                 sched.step()
         else:
-            fetches, new_persist = fn(feed_vals, scope_vals)
+            fetches, new_persist = fn(feed_vals, scope_vals, run_key)
 
         for n, v in new_persist.items():
             scope._vars[n] = v
@@ -172,6 +209,8 @@ class Executor:
     # ------------------------------------------------------------------
     def _compile(self, program, scope, feed_names, fetch_names, key):
         ops = list(program.global_block.ops)
+        from .passes import _stateful
+        has_stateful = any(_stateful(op) for op in ops)
         block_vars = program.global_block.vars
         scope_in_names = _referenced_scope_names(program, scope)
         persist_out = [n for n in block_vars
@@ -189,7 +228,7 @@ class Executor:
             env.update(zip(feed_names, feed_vals))
             return env
 
-        def add_grads(env):
+        def add_grads(env, run_key):
             for tgt, wrt, gnames in grad_requests:
                 if not any(g in needed_grads for g in gnames):
                     continue
@@ -201,7 +240,8 @@ class Executor:
                     # wrt vars are grad leaves: protect the injected
                     # values (else grad w.r.t. an intermediate is 0),
                     # while ops that also produce non-wrt siblings run
-                    _replay(ops, e, protect=frozenset(_wrt))
+                    _replay(ops, e, protect=frozenset(_wrt),
+                            run_key=run_key)
                     return e[_tgt].sum()
 
                 gs = jax.grad(target_of)([env[n] for n in wrt])
@@ -209,28 +249,29 @@ class Executor:
                     env[gname] = g
 
         if not train:
-            def fn(feed_vals, scope_vals):
+            def fn(feed_vals, scope_vals, run_key):
                 env = build_env(feed_vals, scope_vals)
-                _replay(ops, env)
-                add_grads(env)
+                _replay(ops, env, run_key=run_key)
+                add_grads(env, run_key)
                 fetches = [env[n] for n in fetch_names]
                 # a persistable var no op references never enters env
                 new_persist = {n: env[n] for n in persist_out if n in env}
                 return fetches, new_persist
 
-            return jax.jit(fn), scope_in_names, False, program
+            return (jax.jit(fn), scope_in_names, False, has_stateful,
+                    program)
 
         opt, loss_name, pnames = program._train_spec
         _, update_fn = opt.functional()
         pnames = list(pnames)
 
-        def train_fn(feed_vals, scope_vals, opt_state, step_i, lr):
+        def train_fn(feed_vals, scope_vals, opt_state, step_i, lr, run_key):
             env = build_env(feed_vals, scope_vals)
 
             def loss_of(pvals):
                 e = dict(env)
                 e.update(pvals)
-                _replay(ops, e)
+                _replay(ops, e, run_key=run_key)
                 return e[loss_name].sum(), e
 
             (loss, env2), grads = jax.value_and_grad(
@@ -252,4 +293,4 @@ class Executor:
             return fetches, new_persist, new_state
 
         return (jax.jit(train_fn, donate_argnums=(2,)), scope_in_names,
-                True, program)
+                True, has_stateful, program)

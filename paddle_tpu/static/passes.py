@@ -68,9 +68,10 @@ def _fetch_roots(program):
     for name, var in program.global_block.vars.items():
         if getattr(var, "persistable", False):
             roots.add(name)
-    for tgt, _wrt, gnames in program._grad_requests:
+    for tgt, wrt, gnames in program._grad_requests:
         roots.update(gnames)
         roots.add(tgt)      # jax.grad replays the target's producers
+        roots.update(wrt)   # Executor.add_grads reads env[w] for each leaf
     fetches = getattr(program, "_normalized_fetches", None)
     if fetches:
         roots.update(fetches)
@@ -113,19 +114,26 @@ def constant_folding(program):
     const_vals = {}
     new_ops = []
     folded = 0
+    # grad-wrt leaves act as variables even when their value is constant:
+    # an op consuming one must never fold, or the grad target becomes a
+    # pass-time constant and the gradient silently zeroes
+    wrt_names = {w for _t, wrt, _g in program._grad_requests for w in wrt}
     for op in block.ops:
         ready = []
         all_const = True
         for i in op.inputs:
             if isinstance(i, VarRef):
-                if i.name in const_vals:
+                if i.name in const_vals and i.name not in wrt_names:
                     ready.append(const_vals[i.name])
                 else:
                     all_const = False
                     break
             else:
                 ready.append(i)
-        if all_const and op.outputs:
+        # random/stateful ops must not be executed once at pass time and
+        # frozen to a single sample (mirrors the CSE guard and the
+        # reference constant_folding_pass persistable/stateful skip)
+        if all_const and op.outputs and not _stateful(op):
             try:
                 out = op.fn(*ready, **op_call_kwargs(op))
             except Exception:
@@ -139,11 +147,22 @@ def constant_folding(program):
             new_ops.append(op)
     if not const_vals:
         return 0
-    # rewrite remaining ops: replace folded VarRefs with literals
+    # rewrite remaining ops: replace folded VarRefs with literals (keep
+    # grad-wrt leaves as VarRefs — the Executor's grad replay injects and
+    # protects the leaf value by NAME)
     for op in new_ops:
-        op.inputs = [const_vals.get(i.name, i) if isinstance(i, VarRef)
+        op.inputs = [const_vals.get(i.name, i)
+                     if isinstance(i, VarRef) and i.name not in wrt_names
                      else i for i in op.inputs]
-    block.ops = new_ops
+    # folded names may be fetched (or read as grad leaves): re-emit a
+    # constant producer for rooted ones so Executor.run still finds a
+    # producing op (same pattern as CSE's share_data identity ops).
+    # PREPENDED: consumers that kept a VarRef (wrt leaves) replay later.
+    roots = _fetch_roots(program)
+    const_ops = [OpDesc("share_data", lambda v: v, [val], {}, [name],
+                        jax.tree_util.tree_structure(0))
+                 for name, val in const_vals.items() if name in roots]
+    block.ops = const_ops + new_ops
     return folded
 
 
@@ -222,7 +241,8 @@ def common_subexpression_elimination(program):
 
 
 _STATEFUL_PREFIXES = ("rand", "uniform", "normal", "dropout", "bernoulli",
-                      "poisson", "multinomial", "exponential", "seed")
+                      "poisson", "multinomial", "exponential", "seed",
+                      "gumbel", "shuffle", "rrelu")
 
 
 def _stateful(op):

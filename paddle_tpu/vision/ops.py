@@ -126,9 +126,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
 # ------------------------------------------------------------- RoI ops
 
 
-def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, sampling_ratio,
+def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, s_y, s_x,
                    aligned):
-    """feat [C, H, W]; roi [4] (x1, y1, x2, y2)."""
+    """feat [C, H, W]; roi [4] (x1, y1, x2, y2); s_y/s_x static
+    samples-per-bin counts."""
     off = 0.5 if aligned else 0.0
     x1 = roi[0] * spatial_scale - off
     y1 = roi[1] * spatial_scale - off
@@ -141,12 +142,13 @@ def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, sampling_ratio,
     rh = jnp.maximum(y2 - y1, min_sz)
     bin_h = rh / out_h
     bin_w = rw / out_w
-    s = sampling_ratio if sampling_ratio > 0 else 2
     # sample points per bin
-    ys = y1 + (jnp.arange(out_h)[:, None] + (jnp.arange(s)[None, :] + 0.5)
-               / s) * bin_h                      # [out_h, s]
-    xs = x1 + (jnp.arange(out_w)[:, None] + (jnp.arange(s)[None, :] + 0.5)
-               / s) * bin_w                      # [out_w, s]
+    ys = y1 + (jnp.arange(out_h)[:, None]
+               + (jnp.arange(s_y)[None, :] + 0.5) / s_y
+               ) * bin_h                          # [out_h, s_y]
+    xs = x1 + (jnp.arange(out_w)[:, None]
+               + (jnp.arange(s_x)[None, :] + 0.5) / s_x
+               ) * bin_w                          # [out_w, s_x]
     H, W = feat.shape[-2], feat.shape[-1]
 
     def bilinear(y, x):
@@ -165,11 +167,11 @@ def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, sampling_ratio,
                 + v10 * (wy[:, None] * (1 - wx)[None, :])
                 + v11 * (wy[:, None] * wx[None, :]))
 
-    yflat = ys.reshape(-1)                       # [out_h*s]
-    xflat = xs.reshape(-1)                       # [out_w*s]
-    vals = bilinear(yflat, xflat)                # [C, out_h*s, out_w*s]
+    yflat = ys.reshape(-1)                       # [out_h*s_y]
+    xflat = xs.reshape(-1)                       # [out_w*s_x]
+    vals = bilinear(yflat, xflat)                # [C, out_h*s_y, out_w*s_x]
     C = vals.shape[0]
-    vals = vals.reshape(C, out_h, s, out_w, s)
+    vals = vals.reshape(C, out_h, s_y, out_w, s_x)
     return vals.mean((2, 4))
 
 
@@ -183,14 +185,35 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         out_h, out_w = output_size
     bn = _np(boxes_num).astype(np.int64)
     batch_of_roi = np.repeat(np.arange(bn.size), bn)
+    # sampling_ratio<=0: the reference phi kernel adapts the grid per ROI
+    # (ceil(roi_h/pooled_h) x ceil(roi_w/pooled_w)). Grid sizes must be
+    # static for XLA, so compute them host-side when the boxes are
+    # concrete; under tracing (jit over boxes) fall back to a fixed 2x2
+    # grid — a documented approximation, since data-dependent grid sizes
+    # cannot trace. sampling_ratio>0 needs no host pull at all.
+    import jax.core as _jcore
+    _bval = unwrap(boxes) if isinstance(boxes, Tensor) else boxes
+    if sampling_ratio > 0:
+        grids = [(sampling_ratio, sampling_ratio)] * batch_of_roi.size
+    elif isinstance(_bval, _jcore.Tracer):
+        grids = [(2, 2)] * batch_of_roi.size
+    else:
+        bnp = _np(boxes).astype(np.float64).reshape(-1, 4)
+        min_sz = 1e-3 if aligned else 1.0
+        grids = []
+        for i in range(bnp.shape[0]):
+            rw = max((bnp[i, 2] - bnp[i, 0]) * spatial_scale, min_sz)
+            rh = max((bnp[i, 3] - bnp[i, 1]) * spatial_scale, min_sz)
+            grids.append((max(1, int(np.ceil(rh / out_h))),
+                          max(1, int(np.ceil(rw / out_w)))))
 
     def fn(xv, bv):
         outs = []
         for i in range(bv.shape[0]):
             feat = xv[int(batch_of_roi[i])]
+            s_y, s_x = grids[i]
             outs.append(_roi_align_one(feat, bv[i], out_h, out_w,
-                                       spatial_scale, sampling_ratio,
-                                       aligned))
+                                       spatial_scale, s_y, s_x, aligned))
         return jnp.stack(outs) if outs else jnp.zeros(
             (0, xv.shape[1], out_h, out_w), xv.dtype)
 

@@ -240,3 +240,46 @@ def test_fused_linear_cross_entropy_matches_reference(rng):
                                     l.reshape(4, 12), chunk_size=12,
                                     reduction="sum")
     np.testing.assert_allclose(float(l3), float(lr) * T, rtol=1e-6)
+
+
+def _rnnt_case(rng):
+    B, T, U, C = 2, 4, 3, 5
+    logits = rng.standard_normal((B, T, U + 1, C)).astype("float32")
+    labels = rng.integers(1, C, (B, U)).astype("int32")
+    tl = np.full((B,), T, "int32")
+    ul = np.full((B,), U, "int32")
+
+    def loss_fn(lam):
+        lg = pt.to_tensor(logits, stop_gradient=False)
+        out = F.rnnt_loss(lg, pt.to_tensor(labels), pt.to_tensor(tl),
+                          pt.to_tensor(ul), blank=0, fastemit_lambda=lam,
+                          reduction="sum")
+        out.backward()
+        return float(out), np.asarray(lg.grad.numpy())
+
+    return logits, labels, tl, ul, loss_fn
+
+
+def test_rnnt_loss_fastemit(rng):
+    # FastEmit (ADVICE r3 fix): loss value unchanged; label-emission
+    # gradient scaled by (1 + lambda).
+    _logits, _labels, _tl, _ul, loss_fn = _rnnt_case(rng)
+    v0, g0 = loss_fn(0.0)
+    v1, g1 = loss_fn(0.5)
+    np.testing.assert_allclose(v0, v1, rtol=1e-5)     # value unchanged
+    assert not np.allclose(g0, g1)                     # gradient differs
+
+
+def test_rnnt_loss_fastemit_torchaudio(rng):
+    ta = pytest.importorskip("torchaudio")
+    logits, labels, tl, ul, loss_fn = _rnnt_case(rng)
+    for lam in (0.0, 0.5):
+        tlg = torch.tensor(logits, requires_grad=True)
+        tloss = ta.functional.rnnt_loss(
+            tlg, torch.tensor(labels), torch.tensor(tl), torch.tensor(ul),
+            blank=0, fastemit_lambda=lam, reduction="sum")
+        tloss.backward()
+        _v, g = loss_fn(lam)
+        np.testing.assert_allclose(_v, float(tloss), rtol=1e-4)
+        np.testing.assert_allclose(g, tlg.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)

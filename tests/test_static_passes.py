@@ -95,3 +95,104 @@ def test_dce_keeps_grad_targets():
         main, feed={"x": np.array([1., 2., 3.], "float32")},
         fetch_list=grads)
     np.testing.assert_allclose(g, [2, 4, 6])
+
+
+def _literalize_x(main, xname, value):
+    """Replace VarRef inputs named `xname` with a literal array — mimics a
+    program whose upstream producer was already folded to a constant."""
+    from paddle_tpu.static.graph import VarRef
+    for op in main.global_block.ops:
+        op.inputs = [value if isinstance(i, VarRef) and i.name == xname
+                     else i for i in op.inputs]
+
+
+def test_constant_folding_keeps_fetch_roots():
+    # ADVICE r3: a var produced by a folded op must remain fetchable
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2])
+        f = static.data("f", [2])
+        c = x * 3.0                   # becomes all-literal below
+        y = f + c
+    static.normalize_program(main, [f], [c, y])
+    _literalize_x(main, x.name, np.array([1.0, 1.0], "float32"))
+    static.apply_pass(main, "constant_folding")
+    # the op producing c was folded; c must still be fetchable
+    c_out, y_out = static.Executor().run(
+        main, feed={"f": np.array([1.0, 2.0], "float32")},
+        fetch_list=[c, y])
+    np.testing.assert_allclose(c_out, [3.0, 3.0])
+    np.testing.assert_allclose(y_out, [4.0, 5.0])
+
+
+def test_constant_folding_skips_stateful_ops():
+    # ADVICE r3: random ops must not be frozen to one pass-time sample
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4])
+        f = static.data("f", [4])
+        o = x * 1.0                   # becomes all-literal below
+        r = pt.nn.functional.dropout(o, p=0.5, training=True)
+        y = f + r
+    static.normalize_program(main, [f], [y])
+    _literalize_x(main, x.name, np.ones(4, "float32"))
+    static.apply_pass(main, "constant_folding")
+    assert any("dropout" in op.op_type.lower()
+               for op in main.global_block.ops), \
+        "stateful dropout op was folded away"
+
+
+def test_static_dropout_resamples_per_run():
+    # reference static-graph semantics: runtime generator state, a fresh
+    # sample each Executor.run (not a trace-time frozen mask)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [32])
+        y = pt.nn.functional.dropout(x, p=0.5, training=True)
+    static.normalize_program(main, [x], [y])
+    ex = static.Executor()
+    feed = {"x": np.ones(32, "float32")}
+    draws = {tuple(np.asarray(ex.run(main, feed=feed, fetch_list=[y])[0])
+                   .tolist()) for _ in range(6)}
+    assert len(draws) > 1, "static dropout frozen across runs"
+    # program.random_seed pins the sequence (reference Program.random_seed)
+    main.random_seed = 1234
+    main._version += 1
+    a = ex.run(main, feed=feed, fetch_list=[y])[0]
+    b = ex.run(main, feed=feed, fetch_list=[y])[0]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_constant_folding_keeps_grad_wrt_leaves():
+    # code-review r4: folding the producer of a grad-wrt var must leave a
+    # producer so Executor's add_grads can read the leaf value
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2])
+        f = static.data("f", [2])
+        c = x * 3.0                   # becomes all-literal below
+        y = f + c
+        grads = static.gradients([y], [c])
+    static.normalize_program(main, [f], grads)
+    _literalize_x(main, x.name, np.array([1.0, 1.0], "float32"))
+    static.apply_pass(main, "constant_folding")
+    (g,) = static.Executor().run(
+        main, feed={"f": np.array([1.0, 2.0], "float32")},
+        fetch_list=grads)
+    np.testing.assert_allclose(g, [1.0, 1.0])
+
+
+def test_constant_folding_keeps_grad_chain_through_wrt():
+    # code-review r4 #2: consumers of a grad-wrt leaf must not fold, or
+    # the target becomes a pass-time constant and the gradient zeroes
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2])
+        c = x * 3.0                   # becomes all-literal below
+        y = c * 2.0                   # consumer of the wrt leaf
+        grads = static.gradients([y], [c])
+    static.normalize_program(main, [], grads)
+    _literalize_x(main, x.name, np.array([1.0, 1.0], "float32"))
+    static.apply_pass(main, "constant_folding")
+    (g,) = static.Executor().run(main, feed={}, fetch_list=grads)
+    np.testing.assert_allclose(g, [2.0, 2.0])

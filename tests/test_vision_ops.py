@@ -275,3 +275,62 @@ def test_yolo_box_zeroes_scores_and_iou_aware():
                        anchors=[10, 13, 16, 30, 33, 23], class_num=2,
                        conf_thresh=0.01, iou_aware=True)
     assert yb.shape == [1, 12, 4]
+
+
+def test_roi_align_adaptive_grid_matches_reference():
+    # sampling_ratio=-1: grid adapts per ROI (ceil(bin)) like the phi /
+    # torchvision kernels; fixed 2x2 diverges on big ROIs (ADVICE r3).
+    rng = np.random.default_rng(3)
+    feat = rng.standard_normal((1, 2, 16, 16)).astype("float32")
+    box = np.array([[1.0, 1.0, 13.0, 13.0]], "float32")  # 12x12 -> 2x2 bins
+
+    def ref_roi_align(f, b, out, aligned=True):
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = b * 1.0 - off
+        rw, rh = max(x2 - x1, 1e-3), max(y2 - y1, 1e-3)
+        bh, bw = rh / out, rw / out
+        sy, sx = int(np.ceil(rh / out)), int(np.ceil(rw / out))
+        H, W = f.shape[-2:]
+
+        def bilin(c, y, x):
+            y0, x0 = int(np.clip(np.floor(y), 0, H - 1)), int(np.clip(np.floor(x), 0, W - 1))
+            y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            wy, wx = np.clip(y - y0, 0, 1), np.clip(x - x0, 0, 1)
+            return (f[c, y0, x0] * (1 - wy) * (1 - wx) + f[c, y0, x1_] * (1 - wy) * wx
+                    + f[c, y1_, x0] * wy * (1 - wx) + f[c, y1_, x1_] * wy * wx)
+
+        o = np.zeros((f.shape[0], out, out), "float64")
+        for c in range(f.shape[0]):
+            for i in range(out):
+                for j in range(out):
+                    acc = 0.0
+                    for si in range(sy):
+                        for sj in range(sx):
+                            y = y1 + (i + (si + 0.5) / sy) * bh
+                            x = x1 + (j + (sj + 0.5) / sx) * bw
+                            acc += bilin(c, y, x)
+                    o[c, i, j] = acc / (sy * sx)
+        return o
+
+    out = V.roi_align(pt.to_tensor(feat), pt.to_tensor(box),
+                      pt.to_tensor(np.array([1], "int32")), 2,
+                      sampling_ratio=-1, aligned=True).numpy()
+    expect = ref_roi_align(feat[0], box[0], 2)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_traceable_over_boxes():
+    # code-review r4: default sampling_ratio must stay jit-traceable over
+    # boxes (falls back to the fixed 2x2 grid under tracing)
+    import jax
+    feat = np.ones((1, 1, 8, 8), "float32")
+    bn = pt.to_tensor(np.array([1], "int32"))
+
+    from paddle_tpu.core.tensor import unwrap
+
+    def f(b):
+        return unwrap(V.roi_align(pt.to_tensor(feat), pt.to_tensor(b),
+                                  bn, 2))
+
+    out = jax.jit(f)(np.array([[1.0, 1.0, 6.0, 6.0]], "float32"))
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-5)
