@@ -67,12 +67,6 @@ def opt_state_shardings(state, params_shardings, mesh: HybridMesh,
                         zero_stage=0):
     """Optimizer state mirrors its param sharding; with stage>=1 it is
     additionally sharded over the 'sharding' axis (ZeRO-1)."""
-    def for_param(name):
-        ps = params_shardings[name]
-        if zero_stage >= 1:
-            shape = None  # resolved per leaf below
-        return ps
-
     out = {}
     for stname, tree in state.items():
         out[stname] = {}

@@ -1,0 +1,261 @@
+"""One-program hybrid parallelism: TP(mp) × PP(1F1B) × ZeRO(sharding) × DP.
+
+Reference semantics: `fleet.distributed_model` + HybridParallelOptimizer
+compose mp/pp/sharding/dp process groups around one model
+(python/paddle/distributed/fleet/fleet.py:385-428, base/topology.py:251-330),
+then run separate NCCL loops per axis. TPU-native collapse: ONE mesh with
+axes (dp, pp, sharding, mp) and ONE jitted program containing
+
+- the 1F1B shard_map (pp_1f1b.py): TP psums inside the stage fns ride the
+  innermost "mp" axis, activation/grad ppermutes ride the "pp" ring, and
+  loss/grads pmean over ("dp", "sharding") — the ZeRO axis doubles as a
+  data axis for the forward/backward, exactly like the reference's
+  sharding-degree data feeds (fleet/base/topology.py sharding group);
+- a GSPMD optimizer update whose moments (and, at stage>=3, params) are
+  sharded over "sharding" via `zero_spec` — XLA inserts the
+  reduce-scatter / all-gather that GroupShardedOptimizerStage2 does by
+  hand (group_sharded_optimizer_stage2.py:53).
+
+The ready-made `make_llama_tp_fns` provides mp-aware block/embed/head
+functions (column/row-parallel attention + SwiGLU, vocab-parallel
+embedding and cross-entropy) matching meta_parallel/parallel_layers
+(ColumnParallelLinear / RowParallelLinear / VocabParallelEmbedding /
+ParallelCrossEntropy semantics) for tests, compile checks and benches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from .api import zero_spec
+from .mesh import HybridMesh, P
+from .pp_1f1b import build_1f1b_train_step
+
+__all__ = ["make_llama_tp_fns", "init_llama_tp_params",
+           "build_hybrid_train_step"]
+
+
+# --------------------------------------------------- mp-aware model fns
+
+
+def _rms_norm(x, w, eps=1e-5):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+
+def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
+                      mp_axis="mp"):
+    """(block_fn, embed_fn, head_loss_fn) + param PartitionSpecs.
+
+    All fns expect to run inside shard_map with axis ``mp_axis`` present;
+    they see mp-LOCAL parameter shards and produce mp-replicated
+    activations (row-parallel matmuls psum over the axis). n_heads is the
+    GLOBAL head count; mp_degree must divide it.
+    """
+    assert n_heads % mp_degree == 0, (n_heads, mp_degree)
+    nh_local = n_heads // mp_degree
+    from .mp_ops import c_identity, mp_allreduce
+
+    # Megatron-style autodiff boundaries (reference mp_ops.py _c_identity /
+    # _mp_allreduce PyLayers): c_identity (fwd copy, bwd allreduce) marks
+    # activations ENTERING a column-parallel region — backward psums the
+    # per-rank partial cotangents; mp_allreduce (fwd psum, bwd identity)
+    # closes a row-parallel region. With these, all param grads — including
+    # replicated ln weights — come out full and mp-identical.
+
+    def block_fn(p, x):
+        # x [mb, s, h] replicated over mp
+        mb, s, h = x.shape
+        hn = c_identity(_rms_norm(x, p["ln1"], eps), mp_axis)
+        q = (hn @ p["wq"]).reshape(mb, s, nh_local, -1)
+        k = (hn @ p["wk"]).reshape(mb, s, nh_local, -1)
+        v = (hn @ p["wv"]).reshape(mb, s, nh_local, -1)
+        dh = q.shape[-1]
+        logits = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(dh)
+        if causal:
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        attn = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
+        ctx = jnp.einsum("bnqk,bknd->bqnd", attn, v).reshape(mb, s, -1)
+        # row-parallel out proj: partial sums -> psum over mp
+        x = x + mp_allreduce(ctx @ p["wo"], mp_axis)
+        hn = c_identity(_rms_norm(x, p["ln2"], eps), mp_axis)
+        up = jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])
+        x = x + mp_allreduce(up @ p["wd"], mp_axis)
+        return x
+
+    def embed_fn(p, ids):
+        # vocab-parallel table [V/mp, h]: masked local lookup + psum
+        # (reference VocabParallelEmbedding, mp_layers.py semantics)
+        i = jax.lax.axis_index(mp_axis)
+        vl = p["table"].shape[0]
+        local = ids - i * vl
+        ok = (local >= 0) & (local < vl)
+        emb = p["table"][jnp.clip(local, 0, vl - 1)]
+        return mp_allreduce(jnp.where(ok[..., None], emb, 0.0), mp_axis)
+
+    def head_loss_fn(p, hidden, labels):
+        # column-parallel head -> local vocab shard logits; stable CE via
+        # psum'd max / denom / picked (reference ParallelCrossEntropy,
+        # c_softmax_with_cross_entropy semantics)
+        hidden = c_identity(hidden, mp_axis)
+        lg = (hidden @ p["wo"]).astype(jnp.float32)   # [mb, s, V/mp]
+        i = jax.lax.axis_index(mp_axis)
+        vl = lg.shape[-1]
+        # max-shift is gradient-neutral (cancels in log-softmax); pmax has
+        # no diff rule, so detach its INPUT (symbolic-zero tangents skip
+        # the missing jvp entirely)
+        m = jax.lax.pmax(jax.lax.stop_gradient(lg).max(-1), mp_axis)
+        e = jnp.exp(lg - m[..., None])
+        denom = mp_allreduce(e.sum(-1), mp_axis)
+        local_lb = labels - i * vl
+        ok = (local_lb >= 0) & (local_lb < vl)
+        picked = jnp.take_along_axis(
+            lg, jnp.clip(local_lb, 0, vl - 1)[..., None], -1)[..., 0]
+        picked = mp_allreduce(jnp.where(ok, picked, 0.0), mp_axis)
+        return (jnp.log(denom) + m - picked).mean()
+
+    block_specs = {
+        "ln1": P(), "ln2": P(),
+        "wq": P(None, "mp"), "wk": P(None, "mp"), "wv": P(None, "mp"),
+        "wo": P("mp", None),
+        "wg": P(None, "mp"), "wu": P(None, "mp"), "wd": P("mp", None),
+    }
+    embed_specs = {"table": P("mp", None)}
+    head_specs = {"wo": P(None, "mp")}
+    return ((block_fn, embed_fn, head_loss_fn),
+            (block_specs, embed_specs, head_specs))
+
+
+def init_llama_tp_params(n_layers, hidden, ffn, vocab, rng=None,
+                         dtype=np.float32):
+    """FULL (unsharded) parameter trees for the make_llama_tp_fns model;
+    shard_map's in_specs do the splitting."""
+    rng = rng or np.random.RandomState(0)
+    sd = 0.02
+
+    def w(*shape):
+        return jnp.asarray(rng.randn(*shape).astype(dtype) * sd)
+
+    blocks = [{
+        "ln1": jnp.ones((hidden,), dtype), "ln2": jnp.ones((hidden,), dtype),
+        "wq": w(hidden, hidden), "wk": w(hidden, hidden),
+        "wv": w(hidden, hidden), "wo": w(hidden, hidden),
+        "wg": w(hidden, ffn), "wu": w(hidden, ffn), "wd": w(ffn, hidden),
+    } for _ in range(n_layers)]
+    embed = {"table": w(vocab, hidden)}
+    head = {"wo": w(hidden, vocab)}
+    return blocks, embed, head
+
+
+# --------------------------------------------------- the combined step
+
+
+def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
+                            block_params_list, embed_params, head_params,
+                            mesh: HybridMesh, optimizer, num_micro,
+                            block_param_specs=None, embed_param_specs=None,
+                            head_param_specs=None, zero_stage=1,
+                            interleave=1, block_weights=None,
+                            remat_block=True, donate=True):
+    """ONE jitted train step composing mp × pp × sharding × dp.
+
+    Returns (step_fn, params, opt_state, (p_shard, s_shard)) where
+      step_fn(params, opt_state, ids [B,s], labels [B,s], step_i)
+          -> (loss, new_params, new_opt_state)
+      params = {"blocks": stacked [v,S,C,...], "embed": …, "head": …}
+
+    Matches the reference 4-D hybrid (fleet.py:385-428): the global batch
+    B shards over dp×sharding, stages over pp, tensor shards over mp, and
+    optimizer state over "sharding" (ZeRO-1; stage>=3 also shards params).
+    """
+    grad_fn, (stacked, emb_p, head_p, sched) = build_1f1b_train_step(
+        block_fn, embed_fn, head_loss_fn, block_params_list,
+        embed_params, head_params, mesh, num_micro, interleave=interleave,
+        block_weights=block_weights, remat_block=remat_block,
+        block_param_specs=block_param_specs,
+        embed_param_specs=embed_param_specs,
+        head_param_specs=head_param_specs,
+        batch_axes=("dp", "sharding"))
+
+    params = {"blocks": stacked, "embed": emb_p, "head": head_p}
+    p_spec = {
+        # stacked arrays were device_put by the builder — read specs back
+        "blocks": {n: stacked[n].sharding.spec for n in stacked},
+        "embed": {n: (embed_param_specs or {}).get(n, P())
+                  for n in emb_p},
+        "head": {n: (head_param_specs or {}).get(n, P())
+                 for n in head_p},
+    }
+    if zero_stage >= 3:
+        p_spec = jax.tree_util.tree_map(
+            lambda leaf, sp: zero_spec(tuple(leaf.shape), sp, mesh),
+            params, p_spec,
+            is_leaf=lambda x: isinstance(x, (P, jax.Array)))
+    p_shard = jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh.mesh, sp), p_spec,
+        is_leaf=lambda x: isinstance(x, P))
+    abstract = any(isinstance(leaf, jax.ShapeDtypeStruct)
+                   for leaf in jax.tree_util.tree_leaves(
+                       params, is_leaf=lambda x: isinstance(
+                           x, jax.ShapeDtypeStruct)))
+    init_fn, update_fn = optimizer.functional()
+    if abstract:
+        # AOT compile-check mode: keep everything as ShapeDtypeStructs
+        params = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                                  sharding=sh),
+            params, p_shard,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        opt_state = jax.eval_shape(init_fn, params)
+    else:
+        params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
+        opt_state = init_fn(params)
+
+    def _state_sharding(leaf, path_spec):
+        sp = path_spec
+        if zero_stage >= 1 and zero_stage < 3:
+            sp = zero_spec(tuple(leaf.shape), sp, mesh)
+        return NamedSharding(mesh.mesh, sp)
+
+    s_shard = {
+        st: jax.tree_util.tree_map(
+            lambda leaf, sp: _state_sharding(leaf, sp), tree, p_spec,
+            is_leaf=lambda x: isinstance(
+                x, (P, jax.Array, jax.ShapeDtypeStruct)))
+        for st, tree in opt_state.items()
+    }
+    if abstract:
+        opt_state = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                                  sharding=sh),
+            opt_state, s_shard,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    else:
+        opt_state = jax.tree_util.tree_map(
+            jax.device_put, opt_state, s_shard,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+
+    def step(params, opt_state, ids, labels, step_i, lr):
+        loss, (d_blk, d_emb, d_head) = grad_fn(
+            params["blocks"], params["embed"], params["head"], ids, labels)
+        grads = {"blocks": d_blk, "embed": d_emb, "head": d_head}
+        new_p, new_s = update_fn(grads, params, opt_state, lr=lr,
+                                 step=step_i)
+        return loss, new_p, new_s
+
+    jit_step = jax.jit(
+        step,
+        in_shardings=(p_shard, s_shard, None, None, None, None),
+        out_shardings=(NamedSharding(mesh.mesh, P()), p_shard, s_shard),
+        donate_argnums=(0, 1) if donate else ())
+
+    def step_fn(params, opt_state, ids, labels, step_i):
+        lr = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
+        return jit_step(params, opt_state, ids, labels,
+                        jnp.asarray(step_i, jnp.int32), lr)
+
+    step_fn._jit = jit_step   # AOT handle: ._jit.lower(...).compile()
+    return step_fn, params, opt_state, (p_shard, s_shard)

@@ -71,12 +71,17 @@ def segment_counts(num_blocks, num_virtual_stages, weights=None):
 
 def _stack_blocks(block_params_list, VS, counts, starts):
     """blocks: list of per-block param dicts (identical structure) ->
-    padded stack dict name -> [VS, C, ...]."""
+    padded stack dict name -> [VS, C, ...]. ShapeDtypeStruct leaves stay
+    abstract (AOT compile checks at full model size)."""
     C = int(max(int(c) for c in counts)) or 1
     names = list(block_params_list[0]) if block_params_list else []
     out = {}
     for nme in names:
         proto = block_params_list[0][nme]
+        if isinstance(proto, jax.ShapeDtypeStruct):
+            out[nme] = jax.ShapeDtypeStruct(
+                (VS, C) + tuple(proto.shape), proto.dtype)
+            continue
         stack = np.zeros((VS, C) + tuple(proto.shape), proto.dtype)
         for vs in range(VS):
             for j in range(int(counts[vs])):
@@ -286,14 +291,26 @@ def one_f_one_b_forward_backward(
 def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                           block_params_list, embed_params, head_params,
                           mesh: HybridMesh, num_micro, interleave=1,
-                          block_weights=None, remat_block=True):
+                          block_weights=None, remat_block=True,
+                          block_param_specs=None, embed_param_specs=None,
+                          head_param_specs=None, batch_axes=("dp",)):
     """Assemble the sharded 1F1B loss-and-grad function.
 
     Returns (grad_fn, state) where
       state = (blocks_stacked [v,S,C,...] pp-sharded, embed, head, sched)
       grad_fn(blocks, embed, head, ids [B,s], labels [B,s]) ->
           (loss, (d_blocks, d_embed, d_head))
-    Batch B is sharded over "dp"; microbatching is over the leading axis.
+    Batch B is sharded over ``batch_axes`` (default "dp"); microbatching
+    is over the leading axis.
+
+    TP composition (the reference's mp×pp hybrid,
+    fleet/base/topology.py:251): ``block_param_specs[name]`` gives a
+    PartitionSpec over the RAW per-block param dims (e.g. P(None, "mp")
+    for a column-parallel weight); the stage stacking prepends
+    (None, "pp", None). ``embed_param_specs``/``head_param_specs``
+    likewise shard the embedding/head over "mp". When any of these are
+    set, block_fn/embed_fn/head_loss_fn must be mp-aware (psum over "mp"
+    at row-parallel boundaries) — see parallel.hybrid for ready-made fns.
     """
     S = mesh.degree("pp")
     v = interleave
@@ -302,17 +319,37 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
     counts, starts = segment_counts(L, VS, block_weights)
     stacked_flat, C = _stack_blocks(block_params_list, VS, counts, starts)
     # [VS, C, ...] -> [v, S, C, ...]: device i holds chunks {c*S+i}
-    stacked = {n: a.reshape((v, S, C) + a.shape[2:])
+    stacked = {n: (jax.ShapeDtypeStruct((v, S, C) + a.shape[2:], a.dtype)
+                   if isinstance(a, jax.ShapeDtypeStruct)
+                   else a.reshape((v, S, C) + a.shape[2:]))
                for n, a in stacked_flat.items()}
     counts_dev = jnp.asarray(counts.reshape(v, S))     # [v, S]
     sched = build_schedule(S, num_micro, v)
 
-    blocks_spec = {n: P(None, "pp") for n in stacked}
-    stacked = {n: jax.device_put(a, NamedSharding(mesh.mesh,
-                                                  blocks_spec[n]))
-               for n, a in stacked.items()}
+    def _stacked_spec(name):
+        raw = (block_param_specs or {}).get(name)
+        tail = tuple(raw) if raw is not None else ()
+        return P(None, "pp", None, *tail)
 
-    dp = mesh.degree("dp")
+    blocks_spec = {n: _stacked_spec(n) for n in stacked}
+    abstract = any(isinstance(a, jax.ShapeDtypeStruct)
+                   for a in stacked.values())
+    if not abstract:
+        stacked = {n: jax.device_put(a, NamedSharding(mesh.mesh,
+                                                      blocks_spec[n]))
+                   for n, a in stacked.items()}
+    else:
+        stacked = {n: jax.ShapeDtypeStruct(
+                       a.shape, a.dtype,
+                       sharding=NamedSharding(mesh.mesh, blocks_spec[n]))
+                   for n, a in stacked.items()}
+    embed_spec = {n: (embed_param_specs or {}).get(n, P())
+                  for n in embed_params}
+    head_spec = {n: (head_param_specs or {}).get(n, P())
+                 for n in head_params}
+
+    mean_axes = tuple(ax for ax in batch_axes if mesh.degree(ax) > 1)
+    bspec = P(None, tuple(batch_axes))
 
     def sharded_body(blocks, embed, head, ids_micro, labels_micro):
         # local blocks: [v, 1, C, ...] -> [v, C, ...]
@@ -327,22 +364,17 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
             sched, block_fn, embed_fn, head_loss_fn,
             blocks_local, embed, head, counts_vs,
             ids_micro, labels_micro, (mb, s, h), remat_block=remat_block)
-        # average over dp replicas
-        if dp > 1:
-            loss = jax.lax.pmean(loss, "dp")
-            d_blk = jax.lax.pmean(d_blk, "dp")
-            d_emb = jax.lax.pmean(d_emb, "dp")
-            d_head = jax.lax.pmean(d_head, "dp")
+        # average over data replicas (dp and, in ZeRO hybrids, "sharding")
+        if mean_axes:
+            loss = jax.lax.pmean(loss, mean_axes)
+            d_blk = jax.lax.pmean(d_blk, mean_axes)
+            d_emb = jax.lax.pmean(d_emb, mean_axes)
+            d_head = jax.lax.pmean(d_head, mean_axes)
         d_blk = jax.tree_util.tree_map(lambda a: a[:, None], d_blk)
         return loss, d_blk, d_emb, d_head
 
-    in_specs = (blocks_spec,
-                jax.tree_util.tree_map(lambda _: P(), embed_params),
-                jax.tree_util.tree_map(lambda _: P(), head_params),
-                P(None, "dp"), P(None, "dp"))
-    out_specs = (P(), blocks_spec,
-                 jax.tree_util.tree_map(lambda _: P(), embed_params),
-                 jax.tree_util.tree_map(lambda _: P(), head_params))
+    in_specs = (blocks_spec, embed_spec, head_spec, bspec, bspec)
+    out_specs = (P(), blocks_spec, embed_spec, head_spec)
 
     smapped = jax.shard_map(
         sharded_body, mesh=mesh.mesh, in_specs=in_specs,

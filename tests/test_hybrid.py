@@ -1,0 +1,143 @@
+"""Combined TP×PP×ZeRO(×DP) hybrid step (VERDICT r3 #2).
+
+Reference: fleet.distributed_model composes mp/pp/sharding/dp groups in one
+model (python/paddle/distributed/fleet/fleet.py:385-428); here ONE jitted
+program (shard_map 1F1B with mp psums + GSPMD ZeRO update) does all four.
+Parity oracle: the same model on full weights, sequentially, one device.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.parallel as dist
+from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
+                                        init_llama_tp_params,
+                                        make_llama_tp_fns)
+from paddle_tpu.parallel.pp_1f1b import segment_counts
+
+NH, L, H, F, V = 4, 4, 16, 32, 64
+B, S, M = 4, 8, 2
+
+
+def _ref_block(p, x):
+    def rms(x, w, eps=1e-5):
+        var = jnp.mean(jnp.square(x), -1, keepdims=True)
+        return x * jax.lax.rsqrt(var + eps) * w
+
+    mb, s, h = x.shape
+    hn = rms(x, p["ln1"])
+    q = (hn @ p["wq"]).reshape(mb, s, NH, -1)
+    k = (hn @ p["wk"]).reshape(mb, s, NH, -1)
+    v = (hn @ p["wv"]).reshape(mb, s, NH, -1)
+    dh = q.shape[-1]
+    lg = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(dh)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    lg = jnp.where(mask, lg, jnp.finfo(lg.dtype).min)
+    attn = jax.nn.softmax(lg, -1)
+    ctx = jnp.einsum("bnqk,bknd->bqnd", attn, v).reshape(mb, s, -1)
+    x = x + ctx @ p["wo"]
+    hn = rms(x, p["ln2"])
+    x = x + (jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])) @ p["wd"]
+    return x
+
+
+def _ref_loss(blocks, embed, head, ids, labels):
+    x = embed["table"][ids]
+    for bp in blocks:
+        x = _ref_block(bp, x)
+    lg = (x @ head["wo"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(lg, -1)
+    return -jnp.take_along_axis(logp, labels[..., None], -1).mean()
+
+
+def _setup(zero_stage=1, dp=1, pp=2, sharding=2, mp=2):
+    mesh = dist.init_mesh(dp=dp, pp=pp, sharding=sharding, mp=mp)
+    fns, specs = make_llama_tp_fns(NH, mp)
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(7))
+    opt = pt.optimizer.AdamW(learning_rate=1e-3)
+    step_fn, params, opt_state, shards = build_hybrid_train_step(
+        *fns, blocks, embed, head, mesh, opt, num_micro=M,
+        block_param_specs=specs[0], embed_param_specs=specs[1],
+        head_param_specs=specs[2], zero_stage=zero_stage)
+    return mesh, (blocks, embed, head), step_fn, params, opt_state, shards
+
+
+def test_hybrid_loss_matches_sequential_reference():
+    _mesh, (blocks, embed, head), step_fn, params, opt_state, _sh = _setup()
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    loss, params, opt_state = step_fn(params, opt_state, ids, labels, 1)
+    ref = _ref_loss(blocks, embed, head, ids, labels)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4)
+
+
+def test_hybrid_grads_match_sequential_reference():
+    mesh, (blocks, embed, head), _f, _p, _s, _sh = _setup()
+    fns, specs = make_llama_tp_fns(NH, 2)
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    grad_fn, (stacked, emb_p, head_p, _sched) = build_1f1b_train_step(
+        *fns, blocks, embed, head, mesh, num_micro=M,
+        block_param_specs=specs[0], embed_param_specs=specs[1],
+        head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+    rng = np.random.RandomState(1)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    loss, (d_blk, d_emb, d_head) = jax.jit(grad_fn)(
+        stacked, emb_p, head_p, ids, labels)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda t: _ref_loss(t["blocks"], t["embed"], t["head"], ids,
+                            labels))({"blocks": blocks, "embed": embed,
+                                      "head": head})
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(d_emb["table"]),
+                               np.asarray(ref_grads["embed"]["table"]),
+                               rtol=5e-3, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(d_head["wo"]),
+                               np.asarray(ref_grads["head"]["wo"]),
+                               rtol=5e-3, atol=2e-5)
+    # unstack [v, S, C, ...] -> per-layer and compare every block grad
+    Sdeg = mesh.degree("pp")
+    counts, starts = segment_counts(L, Sdeg)   # VS = S (v=1)
+    for vs in range(Sdeg):
+        for j in range(int(counts[vs])):
+            layer = int(starts[vs]) + j
+            for name in ("wq", "wo", "wd", "ln1"):
+                got = np.asarray(d_blk[name][0, vs, j])
+                want = np.asarray(ref_grads["blocks"][layer][name])
+                np.testing.assert_allclose(
+                    got, want, rtol=5e-3, atol=2e-5,
+                    err_msg=f"layer {layer} {name}")
+
+
+def test_hybrid_zero_shards_opt_state():
+    _m, _t, _f, params, opt_state, (p_sh, s_sh) = _setup(zero_stage=1)
+    # moments sharded over the ZeRO axis; params not
+    assert "sharding" in str(s_sh["m"]["blocks"]["wq"].spec)
+    assert "sharding" not in str(p_sh["blocks"]["wq"].spec)
+    # mp/pp axes shard both
+    assert "mp" in str(p_sh["blocks"]["wq"].spec)
+    assert "pp" in str(p_sh["blocks"]["wq"].spec)
+
+
+def test_hybrid_zero3_shards_params():
+    _m, _t, step_fn, params, opt_state, (p_sh, _s) = _setup(zero_stage=3)
+    assert "sharding" in str(p_sh["blocks"]["wq"].spec)
+    rng = np.random.RandomState(2)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    loss, params, opt_state = step_fn(params, opt_state, ids, ids, 1)
+    assert np.isfinite(float(loss))
+
+
+def test_hybrid_train_loss_decreases():
+    _m, _t, step_fn, params, opt_state, _sh = _setup()
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    losses = []
+    for i in range(1, 6):
+        loss, params, opt_state = step_fn(params, opt_state, ids, ids, i)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
