@@ -288,12 +288,28 @@ def one_f_one_b_forward_backward(
     return loss, d_blk, d_emb, d_head
 
 
+def make_tied_lm_fns():
+    """(embed_fn, head_loss_fn) for ``tie_embed_head=True``: both receive
+    the pp-gathered FULL embedding table and the head is embedᵀ
+    (reference SharedLayerDesc weight tying, pp_layers.py:430-517)."""
+    def embed_fn(p, ids):
+        return p["table"][ids]
+
+    def head_loss_fn(p, hidden, labels):
+        lg = (hidden @ p["table"].T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, labels[..., None], -1).mean()
+
+    return embed_fn, head_loss_fn
+
+
 def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                           block_params_list, embed_params, head_params,
                           mesh: HybridMesh, num_micro, interleave=1,
                           block_weights=None, remat_block=True,
                           block_param_specs=None, embed_param_specs=None,
-                          head_param_specs=None, batch_axes=("dp",)):
+                          head_param_specs=None, batch_axes=("dp",),
+                          tie_embed_head=False):
     """Assemble the sharded 1F1B loss-and-grad function.
 
     Returns (grad_fn, state) where
@@ -311,6 +327,18 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
     likewise shard the embedding/head over "mp". When any of these are
     set, block_fn/embed_fn/head_loss_fn must be mp-aware (psum over "mp"
     at row-parallel boundaries) — see parallel.hybrid for ready-made fns.
+
+    ``tie_embed_head=True`` (reference SharedLayerDesc,
+    meta_parallel/parallel_layers/pp_layers.py:430-517): the head IS the
+    embeddingᵀ and ``head_params`` must be ``{}``. TPU-native storage:
+    the table lives SHARDED over the pp axis ([V/S, h] per stage —
+    params, grads and optimizer state), is all_gathered ONCE per step
+    outside the tick scan (collectives must be tick-uniform), and both
+    embed_fn and head_loss_fn receive the gathered full table (use
+    ``make_tied_lm_fns``). Grads for both uses flow into one [V, h] sum
+    (psum over pp) and are sliced back to the local [V/S, h] shard —
+    beating the reference, which replicates a full fp32 grad accumulator
+    for the shared weight on every stage.
     """
     S = mesh.degree("pp")
     v = interleave
@@ -343,10 +371,25 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                        a.shape, a.dtype,
                        sharding=NamedSharding(mesh.mesh, blocks_spec[n]))
                    for n, a in stacked.items()}
-    embed_spec = {n: (embed_param_specs or {}).get(n, P())
-                  for n in embed_params}
-    head_spec = {n: (head_param_specs or {}).get(n, P())
-                 for n in head_params}
+    if tie_embed_head:
+        assert not head_params, \
+            "tie_embed_head: the head IS embed^T; pass head_params={}"
+        assert set(embed_params) == {"table"}, \
+            "tie_embed_head expects embed_params={'table': [V, h]}"
+        vocab = embed_params["table"].shape[0]
+        assert vocab % S == 0, (vocab, S)
+        embed_spec = {"table": P("pp", None)}
+        head_spec = {}
+        if not isinstance(embed_params["table"], jax.ShapeDtypeStruct):
+            # store the table pp-sharded: [V/S, h] per stage
+            embed_params = {"table": jax.device_put(
+                jnp.asarray(embed_params["table"]),
+                NamedSharding(mesh.mesh, P("pp", None)))}
+    else:
+        embed_spec = {n: (embed_param_specs or {}).get(n, P())
+                      for n in embed_params}
+        head_spec = {n: (head_param_specs or {}).get(n, P())
+                     for n in head_params}
 
     mean_axes = tuple(ax for ax in batch_axes if mesh.degree(ax) > 1)
     bspec = P(None, tuple(batch_axes))
@@ -358,12 +401,30 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         counts_vs = counts_dev[:, i_dev]
         mb = ids_micro.shape[1]
         s = ids_micro.shape[2]
+        if tie_embed_head:
+            # gather the pp-sharded table ONCE, outside the tick scan
+            # (collectives inside device-varying tick roles would not be
+            # uniform); both ends of the model use the gathered copy
+            table_full = jax.lax.all_gather(
+                embed["table"], "pp", axis=0, tiled=True)
+            embed_in = {"table": table_full}
+            head_in = {"table": table_full}
+        else:
+            embed_in, head_in = embed, head
         h = jax.eval_shape(lambda e: embed_fn(e, ids_micro[0]),
-                           embed).shape[-1]
+                           embed_in).shape[-1]
         loss, d_blk, d_emb, d_head = one_f_one_b_forward_backward(
             sched, block_fn, embed_fn, head_loss_fn,
-            blocks_local, embed, head, counts_vs,
+            blocks_local, embed_in, head_in, counts_vs,
             ids_micro, labels_micro, (mb, s, h), remat_block=remat_block)
+        if tie_embed_head:
+            # d_emb/d_head are already psum'd over pp -> global [V, h]
+            # sums; tie them and keep only this stage's vocab slice
+            vl = embed["table"].shape[0]
+            d_tab = d_emb["table"] + d_head["table"]
+            d_emb = {"table": jax.lax.dynamic_slice_in_dim(
+                d_tab, i_dev * vl, vl, 0)}
+            d_head = {}
         # average over data replicas (dp and, in ZeRO hybrids, "sharding")
         if mean_axes:
             loss = jax.lax.pmean(loss, mean_axes)

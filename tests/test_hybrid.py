@@ -141,3 +141,76 @@ def test_hybrid_train_loss_decreases():
         loss, params, opt_state = step_fn(params, opt_state, ids, ids, i)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------- tied embed/head
+
+
+def test_tied_embedding_pp_matches_sequential():
+    """tie_embed_head: head = embed^T, table pp-sharded (VERDICT r3 #3).
+    Reference: SharedLayerDesc (pp_layers.py:430-517)."""
+    from paddle_tpu.parallel.pp_1f1b import (build_1f1b_train_step,
+                                             make_tied_lm_fns)
+    mesh = dist.init_mesh(dp=2, pp=4)
+    rng = np.random.RandomState(11)
+    Lt, Ht, Vt = 8, 16, 64
+    blocks = [{"w": jnp.asarray(rng.randn(Ht, Ht).astype(np.float32) * .3)}
+              for _ in range(Lt)]
+    table = rng.randn(Vt, Ht).astype(np.float32) * 0.3
+    embed = {"table": jnp.asarray(table)}
+
+    def block_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    embed_fn, head_loss_fn = make_tied_lm_fns()
+    grad_fn, (stacked, emb_p, head_p, _s) = build_1f1b_train_step(
+        block_fn, embed_fn, head_loss_fn, blocks, embed, {}, mesh,
+        num_micro=4, tie_embed_head=True)
+    ids = jnp.asarray(rng.randint(0, Vt, size=(8, 8)).astype(np.int32))
+    loss, (d_blk, d_emb, d_head) = jax.jit(grad_fn)(
+        stacked, emb_p, head_p, ids, ids)
+
+    # sequential reference with explicitly tied weights
+    def ref(tb):
+        x = tb[ids]
+        for bp in blocks:
+            x = jnp.tanh(x @ bp["w"])
+        lg = (x @ tb.T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, ids[..., None], -1).mean()
+
+    ref_loss, ref_dtab = jax.value_and_grad(ref)(jnp.asarray(table))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(d_emb["table"]),
+                               np.asarray(ref_dtab), rtol=5e-3, atol=2e-5)
+    assert d_head == {}, "tied mode must emit no separate head grads"
+
+
+def test_tied_embedding_pp_memory_accounting():
+    """Params and grads of the shared table live pp-SHARDED: each stage
+    holds [V/S, h], not a full replica (the reference keeps full fp32
+    grad accumulators for the shared weight on every stage)."""
+    from paddle_tpu.parallel.pp_1f1b import (build_1f1b_train_step,
+                                             make_tied_lm_fns)
+    mesh = dist.init_mesh(dp=2, pp=4)
+    rng = np.random.RandomState(12)
+    Lt, Ht, Vt = 4, 16, 64
+    blocks = [{"w": jnp.asarray(rng.randn(Ht, Ht).astype(np.float32) * .3)}
+              for _ in range(Lt)]
+    embed = {"table": jnp.asarray(rng.randn(Vt, Ht).astype(np.float32))}
+    embed_fn, head_loss_fn = make_tied_lm_fns()
+    grad_fn, (stacked, emb_p, _hp, _s) = build_1f1b_train_step(
+        lambda p, x: jnp.tanh(x @ p["w"]), embed_fn, head_loss_fn,
+        blocks, embed, {}, mesh, num_micro=2, tie_embed_head=True)
+    # stored table is sharded over pp: local shard = V/S rows
+    assert "pp" in str(emb_p["table"].sharding.spec)
+    shard_shapes = {tuple(s.data.shape)
+                    for s in emb_p["table"].addressable_shards}
+    assert shard_shapes == {(Vt // 4, Ht)}, shard_shapes
+    ids = jnp.asarray(rng.randint(0, Vt, size=(4, 8)).astype(np.int32))
+    _loss, (_db, d_emb, d_head) = jax.jit(grad_fn)(
+        stacked, emb_p, {}, ids, ids)
+    assert d_head == {}
+    g_shards = {tuple(s.data.shape)
+                for s in d_emb["table"].addressable_shards}
+    assert g_shards == {(Vt // 4, Ht)}, g_shards
