@@ -181,6 +181,63 @@ class LlamaForCausalLM(nn.Layer):
     def loss(self, logits, labels):
         return F.cross_entropy(logits[:, :-1, :], labels[:, 1:])
 
+    def pipeline_decompose(self):
+        """Decompose into pure fns + param trees for the 1F1B/hybrid
+        builders (reference PipelineLayer's LayerDesc segmentation,
+        meta_parallel/parallel_layers/pp_layers.py): returns
+        ((block_fn, embed_fn, head_loss_fn), (blocks, embed, head))."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import unwrap
+        from ..jit import functional_call
+        if self.cfg.tensor_parallel:
+            raise NotImplementedError(
+                "pipeline_decompose targets the non-TP module; for mp×pp "
+                "use parallel.hybrid.make_llama_tp_fns")
+        proto = self.model.layers[0]
+        blocks = [dict(blk.raw_params()) for blk in self.model.layers]
+        embed = {"table": unwrap(self.model.embed_tokens.weight)}
+        head = {"norm": unwrap(self.model.norm.weight),
+                "wo": unwrap(self.lm_head.weight)}
+        eps = self.cfg.rms_eps
+
+        def block_fn(p, x):
+            return functional_call(proto, p, x)
+
+        def embed_fn(p, ids):
+            return p["table"][ids]
+
+        def head_loss_fn(p, hidden, labels):
+            var = jnp.mean(jnp.square(hidden.astype(jnp.float32)), -1,
+                           keepdims=True)
+            h = (hidden * jax.lax.rsqrt(var + eps).astype(hidden.dtype)
+                 ) * p["norm"]
+            lg = (h @ p["wo"]).astype(jnp.float32)[:, :-1]
+            logp = jax.nn.log_softmax(lg, -1)
+            return -jnp.take_along_axis(
+                logp, labels[:, 1:, None], -1).mean()
+
+        return (block_fn, embed_fn, head_loss_fn), (blocks, embed, head)
+
+    def pipeline_recompose(self, params, layout):
+        """Write trained stage-stacked pipeline params back into this
+        eager module (inverse of pipeline_decompose + the builder's
+        stacking). ``params`` = {"blocks": {name: [v,S,C,...]},
+        "embed": ..., "head": ...}; ``layout`` = (counts, starts, S, v)."""
+        counts, starts, S, v = layout
+        for vs in range(S * v):
+            v_idx, s_idx = vs // S, vs % S
+            for j in range(int(counts[vs])):
+                layer = self.model.layers[int(starts[vs]) + j]
+                layer.load_raw_params(
+                    {n: a[v_idx, s_idx, j]
+                     for n, a in params["blocks"].items()})
+        self.model.embed_tokens.weight._replace_value(
+            params["embed"]["table"])
+        self.model.norm.weight._replace_value(params["head"]["norm"])
+        self.lm_head.weight._replace_value(params["head"]["wo"])
+
 
 def llama2_7b(**kw):
     return LlamaConfig(**kw)

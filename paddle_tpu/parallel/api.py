@@ -99,7 +99,9 @@ def scale_and_shard_batch(batch, mesh: HybridMesh, spec=None):
 
 def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
                         zero_stage=0, remat=False, batch_spec=None,
-                        donate=True, grad_clip_norm=None, offload=False):
+                        donate=True, grad_clip_norm=None, offload=False,
+                        loss_scale=None, grad_accum_steps=1,
+                        accum_avg=True):
     """Build (step_fn, params, opt_state, shardings).
 
     step_fn(params, opt_state, batch, step_i, rng) -> (loss, params, state)
@@ -110,13 +112,36 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
     reference's ZeRO CPU offload (group_sharded_optimizer_stage2.py offload
     flag): HBM holds only params/grads/activations, and XLA streams the
     state in/out around the fused update.
+
+    ``loss_scale``: static fp16 loss scaling (reference GradScaler /
+    fp16_allreduce): the loss is scaled in the backward and grads are
+    unscaled before clipping/update; the RETURNED loss is unscaled.
+
+    ``grad_accum_steps``: gradient merge (reference GradientMerge meta
+    optimizer, meta_optimizers.py): grads accumulate in an fp32 buffer in
+    the optimizer state; the parameter update applies only every k-th
+    step (others are identity). ``accum_avg`` divides by k (avg=True).
     """
     from ..jit import functional_call
 
     params, p_shard = shard_params(layer, mesh, zero_stage)
     init_fn, update_fn = optimizer.functional()
     opt_state = init_fn(params)
-    s_shard = opt_state_shardings(opt_state, p_shard, mesh, zero_stage)
+    k_accum = int(grad_accum_steps)
+    if k_accum > 1:
+        opt_state = {"_opt": opt_state,
+                     "_accum": jax.tree_util.tree_map(
+                         lambda p: jnp.zeros(p.shape, jnp.float32), params)}
+    if k_accum > 1:
+        # accum buffers shard like optimizer state (param spec + ZeRO)
+        s_shard = {
+            "_opt": opt_state_shardings(opt_state["_opt"], p_shard, mesh,
+                                        zero_stage),
+            "_accum": opt_state_shardings(
+                {"a": opt_state["_accum"]}, p_shard, mesh, zero_stage)["a"],
+        }
+    else:
+        s_shard = opt_state_shardings(opt_state, p_shard, mesh, zero_stage)
     s_host = None
     if offload:
         # host layout: array-shaped state (moments, master weights) in
@@ -135,18 +160,47 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
 
     def fwd(ps, batch, rng):
         out = functional_call(layer, ps, *batch["inputs"], rng=rng)
-        return loss_fn(out, *batch.get("labels", ()))
+        l = loss_fn(out, *batch.get("labels", ()))
+        return l * loss_scale if loss_scale else l
 
     fwd_c = jax.checkpoint(fwd) if remat else fwd
+
+    def _clip(grads):
+        if grad_clip_norm is not None:
+            from ..nn.clip import clip_by_global_norm_tree
+            grads, _ = clip_by_global_norm_tree(grads, grad_clip_norm)
+        return grads
 
     def step(params, opt_state, batch, step_i, rng):
         batch = jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh.mesh, bspec)), batch)
         loss, grads = jax.value_and_grad(fwd_c)(params, batch, rng)
-        if grad_clip_norm is not None:
-            from ..nn.clip import clip_by_global_norm_tree
-            grads, _ = clip_by_global_norm_tree(grads, grad_clip_norm)
+        if loss_scale:
+            loss = loss / loss_scale
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) / loss_scale).astype(
+                    g.dtype), grads)
+        if k_accum > 1:
+            # GradientMerge: accumulate fp32; update only every k-th step
+            inner = opt_state["_opt"]
+            acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32),
+                opt_state["_accum"], grads)
+            apply = (step_i % k_accum == 0)
+            eff = _clip(jax.tree_util.tree_map(
+                lambda a, g: ((a / k_accum) if accum_avg else a).astype(
+                    g.dtype), acc, grads))
+            upd_i = jnp.maximum(step_i // k_accum, 1)
+            upd_p, upd_s = update_fn(eff, params, inner, step=upd_i)
+            new_params = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(apply, a, b), upd_p, params)
+            new_inner = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(apply, a, b), upd_s, inner)
+            new_acc = jax.tree_util.tree_map(
+                lambda a: jnp.where(apply, jnp.zeros_like(a), a), acc)
+            return loss, new_params, {"_opt": new_inner, "_accum": new_acc}
+        grads = _clip(grads)
         new_params, new_state = update_fn(grads, params, opt_state,
                                           step=step_i)
         return loss, new_params, new_state
@@ -186,8 +240,15 @@ class DataParallel:
     """paddle.DataParallel parity wrapper (reference parallel.py:200).
 
     On TPU the gradient allreduce is either implicit (GSPMD dp axis) or an
-    explicit psum inside shard_map; single-process eager use is pass-through,
-    matching the reference when world_size == 1.
+    explicit psum inside shard_map; single-process eager use is
+    pass-through, matching the reference when world_size == 1. In a real
+    multi-process run (paddle_tpu.parallel.launch):
+
+    - ``scale_loss`` divides by world size (reference scale_loss when
+      gradient averaging is by-sum-then-scale);
+    - ``no_sync()`` suppresses the allreduce in
+      ``fused_allreduce_gradients`` for its scope (grad accumulation
+      without wire traffic, reference no_sync semantics).
     """
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
@@ -206,11 +267,24 @@ class DataParallel:
         return getattr(self._layers, name)
 
     def scale_loss(self, loss):
-        return loss
+        from . import env
+        world = env.get_world_size()
+        return loss / world if world > 1 else loss
 
     def no_sync(self):
         import contextlib
-        return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def ctx():
+            global _SYNC_SUPPRESSED
+            prev = _SYNC_SUPPRESSED
+            _SYNC_SUPPRESSED = True
+            try:
+                yield
+            finally:
+                _SYNC_SUPPRESSED = prev
+
+        return ctx()
 
     def state_dict(self, *a, **k):
         return self._layers.state_dict(*a, **k)
@@ -219,12 +293,19 @@ class DataParallel:
         return self._layers.set_state_dict(*a, **k)
 
 
+_SYNC_SUPPRESSED = False    # set by DataParallel.no_sync()
+
+
 def fused_allreduce_gradients(parameter_list, hcg=None, fp16_wire=False):
     """Reference: fleet/utils/hybrid_parallel_util.py:206. Inside shard_map
     psums grads over dp; eager single-process: no-op. fp16_wire casts the
     grad to fp16 for the psum and restores fp32 after (the
-    fp16_allreduce meta-optimizer's halved wire bytes)."""
+    fp16_allreduce meta-optimizer's halved wire bytes). Inside a
+    DataParallel.no_sync() scope the allreduce is skipped (grad
+    accumulation without wire traffic)."""
     from .collective import axis_or_none
+    if _SYNC_SUPPRESSED:
+        return
     axis = axis_or_none("dp")
     if axis is None:
         return

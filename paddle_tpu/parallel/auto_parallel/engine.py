@@ -69,10 +69,42 @@ class Engine:
     def _prepare(self):
         if self._step_fn is not None:
             return
-        from ..api import parallel_train_step
+        strat = self._strategy
         mesh = self._mesh()
-        zero = self._strategy.sharding.stage if \
-            self._strategy.sharding.enable else 0
+        zero = strat.sharding.stage if strat.sharding.enable else 0
+
+        # ---- amp pre-pass (reference parallelizer_v2.py:48 _apply_pre):
+        # O2-style dtype conversion; fp16 additionally gets static loss
+        # scaling (GradScaler semantics) with grads unscaled pre-update
+        loss_scale = None
+        if strat.amp.enable:
+            dtype = strat.amp.dtype
+            if dtype not in ("bfloat16", "float16"):
+                raise NotImplementedError(
+                    f"strategy.amp.dtype={dtype!r} is not supported "
+                    "(bfloat16/float16)")
+            self._model.astype(dtype)
+            if dtype == "float16":
+                loss_scale = float(getattr(strat.amp,
+                                           "init_loss_scaling", 2 ** 15))
+        # ---- gradient merge post-pass (GradientMerge meta optimizer)
+        k_steps = strat.gradient_merge.k_steps \
+            if strat.gradient_merge.enable else 1
+        # ---- fused passes: XLA fuses elementwise chains into matmuls
+        # unconditionally, which is what fused_linear/fused_attention
+        # passes do in the reference — enable is inherently satisfied;
+        # an explicit UNKNOWN pass name is a config error
+        if strat.fused_passes.enable:
+            known = {"fused_linear", "fused_attention", "fuse_adamw",
+                     "fused_feedforward", "fuse_elewise_add_act"}
+            extra = set(strat.fused_passes.fused_passes_list or []) - known
+            if extra:
+                raise NotImplementedError(
+                    f"fused_passes {sorted(extra)} have no TPU mapping")
+        if getattr(strat.dataset, "num_shards", 1) != 1:
+            raise NotImplementedError(
+                "strategy.dataset.num_shards: shard the dataset via "
+                "io.DistributedBatchSampler instead")
 
         def loss_fn(outputs, *labels):
             lf = self._loss
@@ -81,12 +113,78 @@ class Engine:
                      *[Tensor(l) for l in labels])
             return unwrap(out) if isinstance(out, Tensor) else out
 
+        if strat.pipeline.enable:
+            # no inert switches: combos the pipeline builder does not yet
+            # carry through must refuse, not silently drop
+            if loss_scale is not None:
+                raise NotImplementedError(
+                    "strategy.amp fp16 loss scaling is not wired through "
+                    "the pipeline builder yet; use amp.dtype='bfloat16'")
+            if k_steps > 1:
+                raise NotImplementedError(
+                    "strategy.gradient_merge with pipeline.enable: use "
+                    "pipeline.accumulate_steps (micro-batching IS the "
+                    "accumulation in 1F1B)")
+            if self._loss is not None and \
+                    getattr(self._loss, "__self__", None) \
+                    is not self._model:
+                raise NotImplementedError(
+                    "Engine(loss=...) with pipeline.enable: the pipeline "
+                    "head computes the model's own loss "
+                    "(pipeline_decompose's head_loss_fn); pass "
+                    "loss=model.loss or None")
+            self._prepare_pipeline(mesh, zero, strat)
+            return
+
+        from ..api import parallel_train_step
         with mesh:
             self._step_fn, self._params, self._opt_state, self._shardings = \
                 parallel_train_step(
                     self._model, loss_fn, self._optimizer, mesh,
                     zero_stage=zero,
-                    remat=self._strategy.recompute.enable)
+                    remat=strat.recompute.enable,
+                    loss_scale=loss_scale,
+                    grad_accum_steps=k_steps,
+                    accum_avg=strat.gradient_merge.avg)
+        self._mesh_obj = mesh
+
+    def _prepare_pipeline(self, mesh, zero, strat):
+        """pipeline.enable: route to the 1F1B builder (reference
+        Parallelizer pipeline pass → PipelineParallel runtime; here the
+        SPMD tick-table program from parallel.pp_1f1b/hybrid)."""
+        from ..hybrid import build_hybrid_train_step
+        if not hasattr(self._model, "pipeline_decompose"):
+            raise NotImplementedError(
+                "strategy.pipeline.enable needs a model exposing "
+                "pipeline_decompose() (see models.llama.LlamaForCausalLM)")
+        if mesh.degree("pp") <= 1:
+            from ..mesh import init_mesh
+            n = len(jax.devices())
+            pp = 2 if n % 2 == 0 and n >= 2 else 1
+            if pp == 1:
+                raise NotImplementedError(
+                    "pipeline parallelism needs an even multi-device mesh")
+            mesh = init_mesh(dp=n // pp, pp=pp)
+        fns, trees = self._model.pipeline_decompose()
+        micro = max(1, int(strat.pipeline.accumulate_steps))
+        with mesh:
+            step_fn, self._params, self._opt_state, self._shardings = \
+                build_hybrid_train_step(
+                    *fns, *trees, mesh, self._optimizer, num_micro=micro,
+                    zero_stage=zero)
+        from ..pp_1f1b import segment_counts
+        S = mesh.degree("pp")
+        counts, starts = segment_counts(len(trees[0]), S)
+        self._pp_layout = (counts, starts, S, 1)
+
+        def wrapped(params, opt_state, batch, step_i, rng):
+            ids = batch["inputs"][0]
+            labels = batch["labels"][0] if batch.get("labels") else ids
+            return step_fn(params, opt_state, jnp.asarray(ids),
+                           jnp.asarray(labels), step_i)
+
+        self._step_fn = wrapped
+        self._pp_mode = True
         self._mesh_obj = mesh
 
     # ------------------------------------------------------------ train
@@ -124,7 +222,18 @@ class Engine:
                         print(f"[auto_parallel] epoch {epoch} step {it} "
                               f"loss {lv:.5f}")
         # write back trained params into the eager layer
-        self._model.load_raw_params(self._params)
+        if getattr(self, "_pp_mode", False):
+            if hasattr(self._model, "pipeline_recompose"):
+                self._model.pipeline_recompose(self._params,
+                                               self._pp_layout)
+            else:
+                raise RuntimeError(
+                    "pipeline fit() finished but the model has no "
+                    "pipeline_recompose(); trained params remain in "
+                    "engine._params (stage-stacked) — add the inverse "
+                    "of pipeline_decompose to write them back")
+        else:
+            self._model.load_raw_params(self._params)
         return logs
 
     def _split_batch(self, batch, split):
@@ -144,6 +253,10 @@ class Engine:
                  steps=None, log_freq=10, collate_fn=None, callbacks=None,
                  verbose=1):
         self._prepare()
+        if getattr(self, "_pp_mode", False):
+            raise NotImplementedError(
+                "evaluate() under strategy.pipeline: params are "
+                "stage-stacked; run fit() or use the pp builders directly")
         from ...jit import functional_call
         mesh = self._mesh_obj
 
@@ -169,6 +282,10 @@ class Engine:
     def predict(self, test_data, test_sample_split=None, batch_size=1,
                 steps=None, collate_fn=None, callbacks=None, verbose=1):
         self._prepare()
+        if getattr(self, "_pp_mode", False):
+            raise NotImplementedError(
+                "predict() under strategy.pipeline: params are "
+                "stage-stacked; run fit() or use the pp builders directly")
         from ...jit import functional_call
 
         @jax.jit
