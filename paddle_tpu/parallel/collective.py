@@ -30,7 +30,8 @@ __all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
            "alltoall_single", "broadcast_object_list",
            "scatter_object_list", "get_group", "destroy_process_group",
            "is_available", "get_backend", "gloo_init_parallel_env",
-           "gloo_barrier", "gloo_release"]
+           "gloo_barrier", "gloo_release", "partial_allgather",
+           "partial_ppermute", "partial_send", "partial_recv"]
 
 
 class ReduceOp:
@@ -353,11 +354,15 @@ def barrier(group=None):
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     """paddle.distributed.alltoall_single parity: single-tensor all-to-all
-    over the group axis (leading dim split evenly unless sizes given)."""
-    if in_split_sizes is not None or out_split_sizes is not None:
-        raise NotImplementedError(
-            "uneven alltoall_single splits are not expressible as one XLA "
-            "all_to_all; pad to even splits or use ragged host exchange")
+    over the group axis (leading dim split evenly unless sizes given).
+
+    Uneven splits (reference alltoall_single with in/out_split_sizes) are
+    compiled as pad-to-max + one XLA all_to_all + static slices: chunk j
+    (rows ``in_split_sizes[j]``) goes to rank j; the output concatenates
+    ``out_split_sizes[j]`` rows received from each rank j. Under one SPMD
+    trace the size lists are trace-constants shared by all ranks (the
+    standard shard_map usage); per-rank ragged lists cannot compile to a
+    single program — use the object/host APIs for those."""
     ax = axis_or_none(group)
     if ax is None:
         if isinstance(out_tensor, Tensor) and in_tensor is not None:
@@ -366,15 +371,135 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         return in_tensor
     val = in_tensor if in_tensor is not None else out_tensor
 
-    def fn(v):
-        return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
-                                  tiled=True)
+    if in_split_sizes is not None and len(in_split_sizes) and \
+            isinstance(in_split_sizes[0], (list, tuple, np.ndarray)):
+        # rank-varying uneven splits: ONE SPMD trace serves every rank,
+        # so the sizes must be the full [world, world] matrix
+        # (sizes[i][j] = rows rank i sends to rank j); offsets become
+        # axis_index-dynamic. Output length = column sum, which must be
+        # uniform across ranks (static shapes) — the reference's fully
+        # ragged case needs per-process programs and maps to the
+        # object/host APIs instead.
+        sizes = np.asarray(in_split_sizes, np.int64)
+        world = jax.lax.axis_size(ax)
+        if sizes.shape != (world, world):
+            raise ValueError(f"size matrix must be [{world}, {world}], "
+                             f"got {sizes.shape}")
+        col = sizes.sum(0)
+        if not (col == col[0]).all():
+            raise ValueError(
+                "uneven alltoall_single needs uniform per-rank output "
+                "rows (equal column sums) to compile to one program; "
+                f"got {col.tolist()}")
+        out_len = int(col[0])
+        m = int(sizes.max()) or 1
+        in_off = np.concatenate(
+            [np.zeros((world, 1), np.int64), np.cumsum(sizes, 1)[:, :-1]],
+            1)
+        out_off = np.concatenate(
+            [np.zeros((1, world), np.int64), np.cumsum(sizes, 0)[:-1]], 0)
 
-    out = dispatch(fn, val, name="alltoall_single")
+        def fn(v):
+            i = jax.lax.axis_index(ax)
+            sz = jnp.asarray(sizes)
+            ioff = jnp.asarray(in_off)
+            ooff = jnp.asarray(out_off)
+            vp = jnp.concatenate(
+                [v, jnp.zeros((m,) + v.shape[1:], v.dtype)], 0)
+            chunks = []
+            for j in range(world):
+                c = jax.lax.dynamic_slice_in_dim(vp, ioff[i, j], m, 0)
+                valid = (jnp.arange(m) < sz[i, j])
+                chunks.append(jnp.where(
+                    valid.reshape((m,) + (1,) * (v.ndim - 1)), c, 0))
+            ex = jax.lax.all_to_all(jnp.stack(chunks), ax, split_axis=0,
+                                    concat_axis=0, tiled=False)
+            # sequential increasing writes: chunk j+1 starts exactly at
+            # offset_j + size_j, overwriting chunk j's zero tail
+            out = jnp.zeros((out_len + m,) + v.shape[1:], v.dtype)
+            for j in range(world):
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, ex[j], ooff[j, i], 0)
+            return out[:out_len]
+
+        out = dispatch(fn, val, name="alltoall_single_uneven")
+    elif in_split_sizes is not None or out_split_sizes is not None:
+        # a FLAT per-rank list is only self-consistent under one SPMD
+        # trace when all sizes are equal (every rank would send the same
+        # list, so rank i receives ins[i] from each peer — not outs[j]);
+        # honoring it would silently return padding. Demand the matrix.
+        raise ValueError(
+            "uneven alltoall_single under SPMD needs the full "
+            "[world, world] size matrix as in_split_sizes "
+            "(sizes[i][j] = rows rank i sends to rank j); a flat "
+            "per-rank list cannot describe rank-varying splits in one "
+            "traced program")
+    else:
+        def fn(v):
+            return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                      tiled=True)
+
+        out = dispatch(fn, val, name="alltoall_single")
     if isinstance(out_tensor, Tensor):
         out_tensor._replace_value(unwrap(out))
         return out_tensor
     return out
+
+
+def partial_allgather(tensor, nranks=None, rank_id=None, group=None):
+    """Reference partial_allgather_op: each rank contributes its own
+    1/nranks segment of the buffer; the gather reassembles the full
+    tensor on every rank. ``rank_id`` defaults to the caller's group
+    rank (the only value the reference op is launched with)."""
+    ax = axis_or_none(group)
+    if ax is None:
+        return tensor
+    world = jax.lax.axis_size(ax)
+    nranks = nranks or world
+    if nranks != world:
+        raise ValueError(f"partial_allgather nranks={nranks} != group "
+                         f"size {world}")
+
+    def fn(v):
+        seg = v.shape[0] // world
+        rid = jax.lax.axis_index(ax) if rank_id is None else rank_id
+        mine = jax.lax.dynamic_slice_in_dim(v, rid * seg, seg, 0)
+        return jax.lax.all_gather(mine, ax, axis=0, tiled=True)
+
+    return dispatch(fn, tensor, name="partial_allgather")
+
+
+def partial_ppermute(tensor, perm, nranks=None, index=None, group=None):
+    """TPU-native form of reference partial_send/partial_recv (the PP
+    wire-compression pair: send only segment ``index`` of the buffer,
+    receive the peer's segment into the same slot). One ppermute moves
+    1/nranks of the bytes; the received segment replaces the local one,
+    everything else is kept. ``index`` defaults to the sender's rank."""
+    ax = axis_or_none(group)
+    if ax is None:
+        return tensor
+    nranks = nranks or jax.lax.axis_size(ax)
+
+    def fn(v):
+        seg = v.shape[0] // nranks
+        idx = jax.lax.axis_index(ax) if index is None else index
+        start = idx * seg
+        mine = jax.lax.dynamic_slice_in_dim(v, start, seg, 0)
+        got = jax.lax.ppermute(mine, ax, perm)
+        return jax.lax.dynamic_update_slice_in_dim(v, got, start, 0)
+
+    return dispatch(fn, tensor, name="partial_ppermute")
+
+
+def partial_send(tensor, dst=0, nranks=1, rank_id=0, group=None):
+    raise RuntimeError(
+        "TPU-native partial p2p is the paired partial_ppermute() (one "
+        "XLA ppermute of the segment); free-form partial_send/recv has "
+        "no single-program equivalent")
+
+
+def partial_recv(tensor, src=0, nranks=1, rank_id=0, group=None):
+    raise RuntimeError("see partial_send()")
 
 
 def _object_to_tensor(obj):
