@@ -27,7 +27,7 @@ from __future__ import annotations
 import dataclasses
 
 __all__ = ["ClusterSpec", "ModelSpec", "Candidate", "ParallelTuner",
-           "RuleBasedTuner", "tune"]
+           "RuleBasedTuner", "tune", "tune_for_program"]
 
 
 @dataclasses.dataclass
@@ -52,6 +52,16 @@ class ModelSpec:
     batch_tokens: int = 4 * 1024 * 1024   # global tokens per step
     bytes_per_param: float = 2.0          # bf16 weights
     optimizer_bytes_per_param: float = 12.0  # fp32 master + m + v
+    # fraction of step FLOPs that are MXU matmuls: TP (mp) splits ONLY
+    # this part — embedding lookups and elementwise work replicate over
+    # mp and see no speedup (they split over the data axes instead)
+    matmul_frac: float = 1.0
+    # HBM bytes of bandwidth-bound lookups (embedding tables) per step;
+    # splits over the data axes only
+    lookup_bytes: float = 0.0
+    # measured per-step FLOPs (overrides the 6*N*tokens estimate when
+    # set, decoupling compute from the n_params memory terms)
+    total_flops: float = 0.0
 
 
 @dataclasses.dataclass
@@ -105,8 +115,17 @@ class ParallelTuner:
     def _score(self, dp, mp, pp, sharding):
         c, m = self.cluster, self.model
         chips = dp * mp * pp * sharding
-        flops = 6.0 * m.n_params * m.batch_tokens
-        compute = flops / (chips * c.peak_flops)
+        flops = m.total_flops or (6.0 * m.n_params * m.batch_tokens)
+        data_ways = max(dp * pp * sharding, 1)
+        # mp splits only the matmul fraction; lookups/elementwise split
+        # over the data axes alone (hence TP wins matmul-bound models,
+        # DP wins embedding-bound ones)
+        mat = flops * m.matmul_frac
+        rest = flops - mat
+        compute = mat / (chips * c.peak_flops) \
+            + rest / (data_ways * c.peak_flops)
+        hbm_bw = getattr(c, "hbm_bandwidth", 8.1e11)  # v5e ~819 GB/s
+        compute += m.lookup_bytes / (data_ways * hbm_bw)
 
         # pipeline bubble (GPipe / interleaved-1F1B)
         if pp > 1:
@@ -181,3 +200,31 @@ class RuleBasedTuner(ParallelTuner):
 def tune(cluster=None, model=None, top_k=5, rule_based=True, **kw):
     cls = RuleBasedTuner if rule_based else ParallelTuner
     return cls(cluster, model, **kw).tune(top_k=top_k)
+
+
+def tune_for_program(program, cluster=None, batch_tokens=None, top_k=5,
+                     **kw):
+    """Measure a recorded static Program with the real per-op cost model
+    (cost_model.CostModel.measure_program — matmul FLOPs vs lookup
+    bytes) and tune the hybrid layout for THAT workload. Reference:
+    auto_parallel/tuner profiles candidate programs; here one analytic
+    measurement parameterizes the closed-form search."""
+    import numpy as _np
+
+    from ...cost_model import CostModel
+    meas = CostModel().measure_program(program)
+    n_params = sum(
+        int(_np.prod(getattr(v, "shape", ()) or (1,)))
+        for v in program.global_block.vars.values()
+        if getattr(v, "persistable", False))
+    model = ModelSpec(
+        n_params=max(n_params, 1),
+        n_layers=1, hidden=1,
+        # TP-allreduce volume: caller-pinned, else the program's
+        # elementwise-bytes proxy
+        batch_tokens=(batch_tokens if batch_tokens
+                      else meas["elementwise_bytes"] / 4.0),
+        total_flops=meas["total_flops"],
+        matmul_frac=meas["matmul_frac"],
+        lookup_bytes=meas["lookup_bytes"])
+    return tune(cluster, model, top_k=top_k, **kw)
