@@ -96,19 +96,29 @@ def _build_model(config_name):
     return (GPTForCausalLM(cfg), cfg, METRICS["gpt2"], 8, 1024)
 
 
-def _probe_device_responsive(timeout_s=180, attempts=3):
+def _probe_device_responsive(timeout_s=75):
     """The relay can wedge AFTER backend init: ops hang forever (observed
     2026-07-30, >7 h outage). Probe with a tiny matmul in a subprocess
     under a hard timeout so the bench fails fast with a JSON line instead
     of hanging the driver.
 
+    Probes are SPREAD across the run window with exponential backoff
+    (15 s → 4 min sleeps, ~13 min total worst case) instead of
+    back-to-back — a relay recovering mid-window gets caught (round-3
+    post-mortem: 3×180 s up-front probes all landed inside one outage).
+    Override via PT_BENCH_PROBE_SLEEPS="15,30,60" (seconds, csv).
+
     Only a TIMEOUT counts as unresponsive — a fast nonzero exit is a
     backend-INIT failure, which _devices_with_retry's backoff/re-exec
     path already knows how to recover; let it run."""
+    import os
     import subprocess
     code = ("import jax, jax.numpy as jnp;"
             "x = jnp.ones((64, 64));"
             "print(float((x @ x).sum()))")
+    sleeps_env = os.environ.get("PT_BENCH_PROBE_SLEEPS", "15,30,60,120,240")
+    sleeps = [int(s) for s in sleeps_env.split(",") if s.strip()]
+    attempts = len(sleeps) + 1
     for i in range(attempts):
         try:
             r = subprocess.run([sys.executable, "-c", code],
@@ -122,7 +132,7 @@ def _probe_device_responsive(timeout_s=180, attempts=3):
             print(f"device probe {i + 1}/{attempts} timed out "
                   f"({timeout_s}s)", file=sys.stderr)
             if i < attempts - 1:
-                time.sleep(30)
+                time.sleep(sleeps[i])
     return False
 
 

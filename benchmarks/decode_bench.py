@@ -1,0 +1,171 @@
+"""Autoregressive decode throughput on the real chip (VERDICT r3 #6).
+
+KV-cache decode through incubate fused_multi_transformer's STATIC-cache
+path (time_step + dynamic_update_slice — one compiled step for every
+position; reference fused_multi_transformer_op.cu serving path), plus an
+int8 weight-only variant over the Pallas quantized_matmul kernel.
+
+Prints one line per config: decode tokens/s (batch x new tokens / wall).
+
+    python benchmarks/decode_bench.py [--steps N]
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def main(steps=128):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.incubate.nn.functional as IF
+
+    # GPT-2 345M shape: 24 layers, 1024 hidden, 16 heads
+    L, D, H, FF = 24, 1024, 16, 4096
+    B, T_PRE, T_MAX = 8, 512, 1024
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16
+
+    def mk(*s):
+        return jnp.asarray(rng.standard_normal(s).astype("float32") * 0.02,
+                           dt)
+
+    weights = dict(
+        ln_scales=[jnp.ones((D,), dt) for _ in range(L)],
+        ln_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+        qkv_weights=[mk(D, 3 * D) for _ in range(L)],
+        qkv_biases=[jnp.zeros((3 * D,), dt) for _ in range(L)],
+        linear_weights=[mk(D, D) for _ in range(L)],
+        linear_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+        ffn_ln_scales=[jnp.ones((D,), dt) for _ in range(L)],
+        ffn_ln_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+        ffn1_weights=[mk(D, FF) for _ in range(L)],
+        ffn1_biases=[jnp.zeros((FF,), dt) for _ in range(L)],
+        ffn2_weights=[mk(FF, D) for _ in range(L)],
+        ffn2_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+    )
+    n_params = sum(int(np.prod(w.shape)) for ws in weights.values()
+                   for w in ws)
+
+    def step_fn(x, caches, t, ws):
+        out, new_caches = IF.fused_multi_transformer(
+            x, num_heads=H, trans_qkvw=False, cache_kvs=caches,
+            time_step=t, **ws)
+        return out, new_caches
+
+    jit_step = jax.jit(step_fn, donate_argnums=(1,))
+
+    caches = [jnp.zeros((2, B, H, T_MAX, D // H), dt) for _ in range(L)]
+    x_pre = mk(B, T_PRE, D)
+    x_dec = mk(B, 1, D)
+
+    # prefill (chunked-prefill path at t=0)
+    t0 = time.perf_counter()
+    out, caches = jit_step(x_pre, caches, jnp.int32(0), weights)
+    out.block_until_ready()
+    t_prefill = time.perf_counter() - t0
+
+    # warm the decode-shape compile
+    out, caches = jit_step(x_dec, caches, jnp.int32(T_PRE), weights)
+    out.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(1, steps):
+        out, caches = jit_step(x_dec, caches, jnp.int32(T_PRE + i), weights)
+    out.block_until_ready()
+    dt_dec = time.perf_counter() - t0
+    toks = B * (steps - 1) / dt_dec
+    print(f"bf16 decode: {toks:,.0f} tok/s "
+          f"({dt_dec / (steps - 1) * 1000:.2f} ms/step, B={B}, "
+          f"{n_params / 1e6:.0f}M params, prefill {T_PRE} in "
+          f"{t_prefill:.2f}s)", flush=True)
+
+    # ---- int8 weight-only variant over Pallas quantized_matmul ---------
+    from paddle_tpu.ops.pallas.quant_matmul import (available,
+                                                    quantized_matmul,
+                                                    quantize_tensor)
+    if not available():
+        print("int8 decode: skipped (no TPU pallas)", flush=True)
+        return
+
+    qw = {}
+    for key in ("qkv_weights", "linear_weights", "ffn1_weights",
+                "ffn2_weights"):
+        qw[key] = [quantize_tensor(w.astype(jnp.float32),
+                                   per_channel_axis=1)
+                   for w in weights[key]]
+
+    def qmm(x2d, wq):
+        w_i8, s_w = wq
+        x_q, s_x = quantize_tensor(x2d.astype(jnp.float32),
+                                   per_channel_axis=0)
+        return quantized_matmul(x_q, w_i8, s_x, s_w)
+
+    def int8_step(x, caches, t):
+        b, s, _ = x.shape
+        out = x
+        new_caches = []
+        for i in range(L):
+            res = out
+            h = _ln(out, weights["ln_scales"][i], weights["ln_biases"][i])
+            qkv = qmm(h.reshape(b * s, D),
+                      qw["qkv_weights"][i]).reshape(b, s, 3 * D)
+            qkv = (qkv + weights["qkv_biases"][i]).reshape(
+                b, s, 3, H, D // H)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            c = caches[i]
+            kt = jnp.transpose(k, (0, 2, 1, 3)).astype(c.dtype)
+            vt = jnp.transpose(v, (0, 2, 1, 3)).astype(c.dtype)
+            ck = jax.lax.dynamic_update_slice_in_dim(c[0], kt, t, 2)
+            cv = jax.lax.dynamic_update_slice_in_dim(c[1], vt, t, 2)
+            new_caches.append(jnp.stack([ck, cv], 0))
+            pos = jnp.arange(T_MAX)[None, :]
+            row = jnp.arange(s)[:, None]
+            mask = jnp.where(pos <= (t + row), 0.0, -1e9)[None, None]
+            lg = jnp.einsum("bqhd,bhkd->bhqk", q.astype(jnp.float32),
+                            ck.astype(jnp.float32))
+            lg = lg / np.sqrt(D // H) + mask
+            att = jax.nn.softmax(lg, -1).astype(v.dtype)
+            o = jnp.einsum("bhqk,bhkd->bqhd", att, cv).reshape(b, s, D)
+            o = qmm(o.reshape(b * s, D),
+                    qw["linear_weights"][i]).reshape(b, s, D)
+            out = res + (o + weights["linear_biases"][i]).astype(dt)
+            res = out
+            h = _ln(out, weights["ffn_ln_scales"][i],
+                    weights["ffn_ln_biases"][i])
+            h = qmm(h.reshape(b * s, D),
+                    qw["ffn1_weights"][i]).reshape(b, s, FF)
+            h = jax.nn.gelu(h + weights["ffn1_biases"][i])
+            h = qmm(h.reshape(b * s, FF),
+                    qw["ffn2_weights"][i]).reshape(b, s, D)
+            out = res + (h + weights["ffn2_biases"][i]).astype(dt)
+        return out, new_caches
+
+    def _ln(x, g, b_):
+        m = x.mean(-1, keepdims=True).astype(jnp.float32)
+        v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+        return ((x - m) * jax.lax.rsqrt(v + 1e-5)).astype(x.dtype) * g + b_
+
+    jit_q = jax.jit(int8_step, donate_argnums=(1,))
+    caches = [jnp.zeros((2, B, H, T_MAX, D // H), dt) for _ in range(L)]
+    out, caches = jit_q(x_pre, caches, jnp.int32(0))
+    out.block_until_ready()
+    out, caches = jit_q(x_dec, caches, jnp.int32(T_PRE))
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(1, steps):
+        out, caches = jit_q(x_dec, caches, jnp.int32(T_PRE + i))
+    out.block_until_ready()
+    dt_q = time.perf_counter() - t0
+    toks_q = B * (steps - 1) / dt_q
+    print(f"int8 decode: {toks_q:,.0f} tok/s "
+          f"({dt_q / (steps - 1) * 1000:.2f} ms/step, "
+          f"{toks_q / toks:.2f}x bf16)", flush=True)
+
+
+if __name__ == "__main__":
+    steps = 128
+    if "--steps" in sys.argv:
+        steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    main(steps)

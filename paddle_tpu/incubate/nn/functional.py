@@ -257,7 +257,50 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         qkv = qkv.reshape([b, s, 3, num_heads, hd])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         layer_mask = attn_mask
-        if cache_kvs is not None:
+        if cache_kvs is not None and time_step is not None:
+            # STATIC-cache decode (reference op's time_step input): the
+            # cache buffer [2, B, H, T_max, hd] keeps a fixed shape; k/v
+            # are written at [time_step, time_step+s) via
+            # dynamic_update_slice and attention masks positions beyond
+            # time_step+row — ONE compiled program serves every decode
+            # position (no per-step recompiles from growing concat).
+            cache = cache_kvs[i]           # [2, B, H, T_max, hd] fixed
+            t_max = cache.shape[3]
+
+            def _upd(c, k_, v_, ts_):
+                kt = jnp.transpose(k_, (0, 2, 1, 3)).astype(c.dtype)
+                vt = jnp.transpose(v_, (0, 2, 1, 3)).astype(c.dtype)
+                ck = jax.lax.dynamic_update_slice_in_dim(c[0], kt, ts_, 2)
+                cv = jax.lax.dynamic_update_slice_in_dim(c[1], vt, ts_, 2)
+                return jnp.stack([ck, cv], 0)
+
+            new_cache = dispatch(_upd, cache, k, v, time_step,
+                                 nondiff_args=(3,),
+                                 name="decode_cache_update")
+            new_caches.append(new_cache)
+            k = dispatch(lambda nc: jnp.transpose(nc[0], (0, 2, 1, 3)),
+                         new_cache, name="cache_k")
+            v = dispatch(lambda nc: jnp.transpose(nc[1], (0, 2, 1, 3)),
+                         new_cache, name="cache_v")
+            causal = False
+            if _prefill_mask is None:
+                def _mk_mask(ts_):
+                    pos = jnp.arange(t_max)[None, :]
+                    row = jnp.arange(s)[:, None]
+                    ok = pos <= (ts_ + row)
+                    return jnp.where(ok, 0.0, -1e9).astype(
+                        jnp.float32)[None, None]
+
+                _prefill_mask = dispatch(_mk_mask, time_step,
+                                         nondiff_args=(0,),
+                                         name="decode_mask")
+                if attn_mask is not None:
+                    # reference time_step path honors the caller's mask
+                    # (e.g. left-padding): additive combine with the
+                    # validity mask
+                    _prefill_mask = _prefill_mask + attn_mask
+            layer_mask = _prefill_mask
+        elif cache_kvs is not None:
             cache = cache_kvs[i]           # [2, B, H, T_cache, hd]
             t_cache = cache.shape[3]
             ck = cache[0].transpose([0, 2, 1, 3])   # -> [B, T, H, hd]

@@ -112,10 +112,12 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None,
     v_ = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                             tiled=True)
     if attn_fn is None:
-        from .flash_attention import _ref_attention
+        # default to the Pallas flash kernel (auto-falls back to the
+        # reference composition off-TPU / on non-block-aligned shapes)
+        from .flash_attention import _flash
         if sm_scale is None:
             sm_scale = 1.0 / math.sqrt(q.shape[-1])
-        out = _ref_attention(q_, k_, v_, sm_scale, causal)
+        out = _flash(q_, k_, v_, sm_scale, causal)
     else:
         out = attn_fn(q_, k_, v_)
     # back: split seq, gather heads

@@ -300,3 +300,88 @@ class TestIncubateFused:
         bdr = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
         y = pt.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
         assert bdr(y, y).shape == [2, 4, 8]
+
+
+class TestStaticCacheDecode:
+    def test_static_cache_matches_growing_cache(self):
+        """time_step path (reference fused_multi_transformer_op time_step
+        input): fixed-shape cache + dynamic_update_slice must produce the
+        same tokens as the growing-concat path."""
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.default_rng(0)
+        D, L, H, T_MAX = 16, 2, 4, 12
+        mk = lambda *s: pt.to_tensor(
+            rng.standard_normal(s).astype("float32") * 0.05)
+        args = dict(
+            ln_scales=[mk(D) + 1.0 for _ in range(L)],
+            ln_biases=[mk(D) for _ in range(L)],
+            qkv_weights=[mk(D, 3 * D) for _ in range(L)],
+            qkv_biases=[mk(3 * D) for _ in range(L)],
+            linear_weights=[mk(D, D) for _ in range(L)],
+            linear_biases=[mk(D) for _ in range(L)],
+            ffn_ln_scales=[mk(D) + 1.0 for _ in range(L)],
+            ffn_ln_biases=[mk(D) for _ in range(L)],
+            ffn1_weights=[mk(D, 4 * D) for _ in range(L)],
+            ffn1_biases=[mk(4 * D) for _ in range(L)],
+            ffn2_weights=[mk(4 * D, D) for _ in range(L)],
+            ffn2_biases=[mk(D) for _ in range(L)],
+            trans_qkvw=False, num_heads=H)
+        x = pt.to_tensor(rng.standard_normal((1, 4, D)).astype("float32"))
+        steps = [pt.to_tensor(rng.standard_normal((1, 1, D))
+                              .astype("float32")) for _ in range(3)]
+
+        # growing-concat reference
+        empty = [pt.to_tensor(np.zeros((2, 1, H, 0, D // H), "float32"))
+                 for _ in range(L)]
+        ref_out, caches = IF.fused_multi_transformer(
+            x, cache_kvs=empty, **args)
+        ref_tokens = []
+        for s in steps:
+            o, caches = IF.fused_multi_transformer(s, cache_kvs=caches,
+                                                   **args)
+            ref_tokens.append(o.numpy())
+
+        # static-cache path: prefill at t=0, decode at t=4,5,6
+        fixed = [pt.to_tensor(np.zeros((2, 1, H, T_MAX, D // H),
+                                       "float32")) for _ in range(L)]
+        out0, fixed = IF.fused_multi_transformer(
+            x, cache_kvs=fixed, time_step=0, **args)
+        np.testing.assert_allclose(out0.numpy(), ref_out.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        for t, (s, want) in enumerate(zip(steps, ref_tokens)):
+            o, fixed = IF.fused_multi_transformer(
+                s, cache_kvs=fixed, time_step=4 + t, **args)
+            assert fixed[0].shape[3] == T_MAX, "cache must stay fixed-size"
+            np.testing.assert_allclose(o.numpy(), want, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_static_cache_decode_honors_attn_mask():
+    """code-review r4: the time_step path must combine a caller-supplied
+    attn_mask (e.g. left-padding) with the validity mask."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(4)
+    D, L, H, T_MAX = 16, 1, 4, 8
+    mk = lambda *s: pt.to_tensor(
+        rng.standard_normal(s).astype("float32") * 0.05)
+    args = dict(
+        ln_scales=[mk(D) + 1.0], ln_biases=[mk(D)],
+        qkv_weights=[mk(D, 3 * D)], qkv_biases=[mk(3 * D)],
+        linear_weights=[mk(D, D)], linear_biases=[mk(D)],
+        ffn_ln_scales=[mk(D) + 1.0], ffn_ln_biases=[mk(D)],
+        ffn1_weights=[mk(D, 4 * D)], ffn1_biases=[mk(4 * D)],
+        ffn2_weights=[mk(4 * D, D)], ffn2_biases=[mk(D)],
+        trans_qkvw=False, num_heads=H)
+    x = pt.to_tensor(rng.standard_normal((1, 1, D)).astype("float32"))
+    fixed = [pt.to_tensor(np.zeros((2, 1, H, T_MAX, D // H), "float32"))]
+    # pretend positions 0-2 are left-padding: mask them out
+    pad_mask = np.zeros((1, 1, 1, T_MAX), "float32")
+    pad_mask[..., :3] = -1e9
+    o_masked, _ = IF.fused_multi_transformer(
+        x, cache_kvs=[c for c in fixed], time_step=4,
+        attn_mask=pt.to_tensor(pad_mask), **args)
+    o_plain, _ = IF.fused_multi_transformer(
+        x, cache_kvs=[c for c in fixed], time_step=4, **args)
+    # cache holds zeros; with a nonzero current token the masked and
+    # unmasked attention normalize over different support -> different out
+    assert not np.allclose(o_masked.numpy(), o_plain.numpy())
