@@ -262,3 +262,38 @@ def test_static_save_load_params(tmp_path):
     st.load(main, prefix)
     np.testing.assert_allclose(
         np.asarray(st.global_scope()._vars[pname]), orig)
+
+
+def test_predictor_config_knobs_functional(tmp_path):
+    """VERDICT r3 #9: Config switches must act or raise, never sit inert."""
+    import pytest
+
+    main, startup = fresh_programs()
+    with st.program_guard(main, startup):
+        x = st.data("x", [1, 4], "float32")
+        out = st.nn.fc(x, 2, activation="relu")
+    exe = st.Executor()
+    exe.run(startup)
+    prefix = str(tmp_path / "m" / "infer")
+    st.save_inference_model(prefix, [x], [out], exe)
+
+    from paddle_tpu import inference as paddle_infer
+    xv = np.random.rand(1, 4).astype("float32")
+
+    # memory_optim -> donated compiled call, same numbers
+    cfg0 = paddle_infer.Config(prefix)
+    ref = paddle_infer.create_predictor(cfg0).run([xv])[0]
+    cfg = paddle_infer.Config(prefix)
+    cfg.enable_memory_optim()
+    cfg.enable_profile()
+    pred = paddle_infer.create_predictor(cfg)
+    got = pred.run([xv.copy()])[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    assert cfg.profile_stats()["runs"] == 1
+    assert cfg.profile_stats()["total_ms"] > 0
+    assert cfg.summary()["memory_optim"] is True
+
+    # ir_optim cannot be switched off on XLA: raises, not ignores
+    with pytest.raises(NotImplementedError):
+        paddle_infer.Config(prefix).switch_ir_optim(False)
+    paddle_infer.Config(prefix).switch_ir_optim(True)   # default: fine
