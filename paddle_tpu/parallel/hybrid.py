@@ -45,6 +45,38 @@ def _rms_norm(x, w, eps=1e-5):
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
 
 
+def _vocab_parallel_embed(table, ids, mp_axis):
+    """Masked local lookup over a [V/mp, h] contiguous vocab shard + psum
+    (reference VocabParallelEmbedding, mp_layers.py semantics)."""
+    from .mp_ops import mp_allreduce
+    i = jax.lax.axis_index(mp_axis)
+    vl = table.shape[0]
+    local = ids - i * vl
+    ok = (local >= 0) & (local < vl)
+    emb = table[jnp.clip(local, 0, vl - 1)]
+    return mp_allreduce(jnp.where(ok[..., None], emb, 0.0), mp_axis)
+
+
+def _vocab_parallel_ce(lg, labels, mp_axis):
+    """Stable cross-entropy over vocab-shard logits [mb, s, V/mp]: psum'd
+    max / denom / picked (reference ParallelCrossEntropy,
+    c_softmax_with_cross_entropy semantics). Max-shift is
+    gradient-neutral; pmax has no diff rule, so its INPUT is detached
+    (symbolic-zero tangents skip the missing jvp)."""
+    from .mp_ops import mp_allreduce
+    i = jax.lax.axis_index(mp_axis)
+    vl = lg.shape[-1]
+    m = jax.lax.pmax(jax.lax.stop_gradient(lg).max(-1), mp_axis)
+    e = jnp.exp(lg - m[..., None])
+    denom = mp_allreduce(e.sum(-1), mp_axis)
+    local_lb = labels - i * vl
+    ok = (local_lb >= 0) & (local_lb < vl)
+    picked = jnp.take_along_axis(
+        lg, jnp.clip(local_lb, 0, vl - 1)[..., None], -1)[..., 0]
+    picked = mp_allreduce(jnp.where(ok, picked, 0.0), mp_axis)
+    return (jnp.log(denom) + m - picked).mean()
+
+
 def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
                       mp_axis="mp"):
     """(block_fn, embed_fn, head_loss_fn) + param PartitionSpecs.
@@ -87,35 +119,13 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
         return x
 
     def embed_fn(p, ids):
-        # vocab-parallel table [V/mp, h]: masked local lookup + psum
-        # (reference VocabParallelEmbedding, mp_layers.py semantics)
-        i = jax.lax.axis_index(mp_axis)
-        vl = p["table"].shape[0]
-        local = ids - i * vl
-        ok = (local >= 0) & (local < vl)
-        emb = p["table"][jnp.clip(local, 0, vl - 1)]
-        return mp_allreduce(jnp.where(ok[..., None], emb, 0.0), mp_axis)
+        return _vocab_parallel_embed(p["table"], ids, mp_axis)
 
     def head_loss_fn(p, hidden, labels):
-        # column-parallel head -> local vocab shard logits; stable CE via
-        # psum'd max / denom / picked (reference ParallelCrossEntropy,
-        # c_softmax_with_cross_entropy semantics)
+        # column-parallel head -> local vocab shard logits
         hidden = c_identity(hidden, mp_axis)
         lg = (hidden @ p["wo"]).astype(jnp.float32)   # [mb, s, V/mp]
-        i = jax.lax.axis_index(mp_axis)
-        vl = lg.shape[-1]
-        # max-shift is gradient-neutral (cancels in log-softmax); pmax has
-        # no diff rule, so detach its INPUT (symbolic-zero tangents skip
-        # the missing jvp entirely)
-        m = jax.lax.pmax(jax.lax.stop_gradient(lg).max(-1), mp_axis)
-        e = jnp.exp(lg - m[..., None])
-        denom = mp_allreduce(e.sum(-1), mp_axis)
-        local_lb = labels - i * vl
-        ok = (local_lb >= 0) & (local_lb < vl)
-        picked = jnp.take_along_axis(
-            lg, jnp.clip(local_lb, 0, vl - 1)[..., None], -1)[..., 0]
-        picked = mp_allreduce(jnp.where(ok, picked, 0.0), mp_axis)
-        return (jnp.log(denom) + m - picked).mean()
+        return _vocab_parallel_ce(lg, labels, mp_axis)
 
     block_specs = {
         "ln1": P(), "ln2": P(),
@@ -127,6 +137,26 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
     head_specs = {"wo": P(None, "mp")}
     return ((block_fn, embed_fn, head_loss_fn),
             (block_specs, embed_specs, head_specs))
+
+
+def make_tied_tp_lm_fns(n_heads, mp_degree, causal=True, eps=1e-5,
+                        mp_axis="mp"):
+    """Tied-embedding TP fns for ``tie_embed_head=True`` hybrids: both
+    embed_fn and head_loss_fn receive the pp-gathered table, which under
+    the builder's ("mp","pp")-major sharding is this mp rank's CONTIGUOUS
+    vocab-parallel slice [V/mp, h]. The head is the transposed slice
+    (reference SharedLayerDesc + VocabParallelEmbedding composed)."""
+    (block_fn, embed_fn, _head), (block_specs, _es, _hs) = \
+        make_llama_tp_fns(n_heads, mp_degree, causal=causal, eps=eps,
+                          mp_axis=mp_axis)
+    from .mp_ops import c_identity
+
+    def head_loss_fn(p, hidden, labels):
+        hidden = c_identity(hidden, mp_axis)
+        lg = (hidden @ p["table"].T).astype(jnp.float32)  # [mb,s,V/mp]
+        return _vocab_parallel_ce(lg, labels, mp_axis)
+
+    return (block_fn, embed_fn, head_loss_fn), block_specs
 
 
 def init_llama_tp_params(n_layers, hidden, ffn, vocab, rng=None,
@@ -159,7 +189,8 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
                             block_param_specs=None, embed_param_specs=None,
                             head_param_specs=None, zero_stage=1,
                             interleave=1, block_weights=None,
-                            remat_block=True, donate=True):
+                            remat_block=True, donate=True,
+                            tie_embed_head=False):
     """ONE jitted train step composing mp × pp × sharding × dp.
 
     Returns (step_fn, params, opt_state, (p_shard, s_shard)) where
@@ -178,16 +209,25 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         block_param_specs=block_param_specs,
         embed_param_specs=embed_param_specs,
         head_param_specs=head_param_specs,
-        batch_axes=("dp", "sharding"))
+        batch_axes=("dp", "sharding"),
+        tie_embed_head=tie_embed_head)
 
     params = {"blocks": stacked, "embed": emb_p, "head": head_p}
+    if tie_embed_head:
+        # the 1F1B builder owns the tied layout — read it back (same
+        # pattern as the "blocks" line below)
+        embed_specs_eff = {"table": emb_p["table"].sharding.spec}
+        head_specs_eff = {}
+    else:
+        embed_specs_eff = {n: (embed_param_specs or {}).get(n, P())
+                           for n in emb_p}
+        head_specs_eff = {n: (head_param_specs or {}).get(n, P())
+                          for n in head_p}
     p_spec = {
         # stacked arrays were device_put by the builder — read specs back
         "blocks": {n: stacked[n].sharding.spec for n in stacked},
-        "embed": {n: (embed_param_specs or {}).get(n, P())
-                  for n in emb_p},
-        "head": {n: (head_param_specs or {}).get(n, P())
-                 for n in head_p},
+        "embed": embed_specs_eff,
+        "head": head_specs_eff,
     }
     if zero_stage >= 3:
         p_spec = jax.tree_util.tree_map(

@@ -91,6 +91,20 @@ def _stack_blocks(block_params_list, VS, counts, starts):
     return out, C
 
 
+def _remat_wrap(block_fn, remat_block):
+    """remat_block: False (save everything), True (full remat — the 1F1B
+    memory bound), or "dots" (jax.checkpoint_policies: save MXU matmul
+    outputs, recompute the cheap elementwise tail — trades a little HBM
+    for skipping the recompute of the FLOP-heavy ops)."""
+    if not remat_block:
+        return block_fn
+    if remat_block == "dots":
+        return jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(block_fn)
+
+
 def one_f_one_b_forward_backward(
         sched: Schedule, block_fn, embed_fn, head_loss_fn,
         blocks_local, embed_params, head_params, counts_vs,
@@ -114,7 +128,7 @@ def one_f_one_b_forward_backward(
     mb, s, h = hidden_shape
     dt = jax.tree_util.tree_leaves(blocks_local)[0].dtype
 
-    bf = jax.checkpoint(block_fn) if remat_block else block_fn
+    bf = _remat_wrap(block_fn, remat_block)
 
     def apply_blocks(chunk_params, x, n):
         C = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
@@ -289,9 +303,13 @@ def one_f_one_b_forward_backward(
 
 
 def make_tied_lm_fns():
-    """(embed_fn, head_loss_fn) for ``tie_embed_head=True``: both receive
-    the pp-gathered FULL embedding table and the head is embedᵀ
-    (reference SharedLayerDesc weight tying, pp_layers.py:430-517)."""
+    """(embed_fn, head_loss_fn) for ``tie_embed_head=True`` on meshes
+    with mp degree 1: both receive the pp-gathered FULL embedding table
+    and the head is embedᵀ (reference SharedLayerDesc weight tying,
+    pp_layers.py:430-517). On mp>1 meshes the gathered table is only
+    this mp rank's [V/mp, h] vocab-parallel slice — use the mp-aware
+    ``parallel.hybrid.make_tied_tp_lm_fns`` instead (the builder
+    enforces this)."""
     def embed_fn(p, ids):
         return p["table"][ids]
 
@@ -331,14 +349,16 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
     ``tie_embed_head=True`` (reference SharedLayerDesc,
     meta_parallel/parallel_layers/pp_layers.py:430-517): the head IS the
     embeddingᵀ and ``head_params`` must be ``{}``. TPU-native storage:
-    the table lives SHARDED over the pp axis ([V/S, h] per stage —
-    params, grads and optimizer state), is all_gathered ONCE per step
-    outside the tick scan (collectives must be tick-uniform), and both
-    embed_fn and head_loss_fn receive the gathered full table (use
-    ``make_tied_lm_fns``). Grads for both uses flow into one [V, h] sum
-    (psum over pp) and are sliced back to the local [V/S, h] shard —
-    beating the reference, which replicates a full fp32 grad accumulator
-    for the shared weight on every stage.
+    the table lives SHARDED over ("mp","pp") rows (params, grads and
+    optimizer state), is all_gathered over "pp" ONCE per step outside
+    the tick scan (collectives must be tick-uniform), and embed_fn /
+    head_loss_fn receive the gathered table: the FULL [V, h] on mp=1
+    meshes (use ``make_tied_lm_fns``) or this mp rank's contiguous
+    vocab-parallel [V/mp, h] slice on mp>1 (use the mp-aware
+    ``parallel.hybrid.make_tied_tp_lm_fns``; enforced). Grads for both
+    uses flow into one psum over pp and are sliced back to the local
+    shard — beating the reference, which replicates a full fp32 grad
+    accumulator for the shared weight on every stage.
     """
     S = mesh.degree("pp")
     v = interleave
@@ -377,14 +397,29 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         assert set(embed_params) == {"table"}, \
             "tie_embed_head expects embed_params={'table': [V, h]}"
         vocab = embed_params["table"].shape[0]
-        assert vocab % S == 0, (vocab, S)
-        embed_spec = {"table": P("pp", None)}
+        mp_deg = mesh.degree("mp")
+        assert vocab % (S * mp_deg) == 0, (vocab, S, mp_deg)
+        if mp_deg > 1 and "make_tied_lm_fns" in getattr(
+                embed_fn, "__qualname__", ""):
+            raise ValueError(
+                "tie_embed_head on an mp>1 mesh: the pp-gathered table "
+                "is this mp rank's [V/mp, h] vocab-parallel slice, not "
+                "the full table — use parallel.hybrid.make_tied_tp_lm_fns")
+        # mp-MAJOR row sharding: gathering over "pp" then yields each mp
+        # rank its CONTIGUOUS vocab-parallel slice [V/mp, h] — tied TP
+        # embedding/head compose for free (mp=1 degenerates to pp-only)
+        tied_spec = P(("mp", "pp"), None)
+        embed_spec = {"table": tied_spec}
         head_spec = {}
-        if not isinstance(embed_params["table"], jax.ShapeDtypeStruct):
-            # store the table pp-sharded: [V/S, h] per stage
+        if isinstance(embed_params["table"], jax.ShapeDtypeStruct):
+            t = embed_params["table"]
+            embed_params = {"table": jax.ShapeDtypeStruct(
+                t.shape, t.dtype,
+                sharding=NamedSharding(mesh.mesh, tied_spec))}
+        else:
             embed_params = {"table": jax.device_put(
                 jnp.asarray(embed_params["table"]),
-                NamedSharding(mesh.mesh, P("pp", None)))}
+                NamedSharding(mesh.mesh, tied_spec))}
     else:
         embed_spec = {n: (embed_param_specs or {}).get(n, P())
                       for n in embed_params}

@@ -214,3 +214,137 @@ def test_tied_embedding_pp_memory_accounting():
     g_shards = {tuple(s.data.shape)
                 for s in d_emb["table"].addressable_shards}
     assert g_shards == {(Vt // 4, Ht)}, g_shards
+
+
+def test_tied_tp_hybrid_matches_sequential():
+    """tie_embed_head composed WITH TP inside the full hybrid step
+    (mp2 x pp2 x sharding2): the 70B configuration with a shared
+    vocab-parallel embedding. Oracle: sequential tied reference."""
+    from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
+                                            make_tied_tp_lm_fns)
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    rng = np.random.RandomState(21)
+    blocks, embed, _head = init_llama_tp_params(L, H, F, V, rng=rng)
+    fns, block_specs = make_tied_tp_lm_fns(NH, 2)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3)
+    step_fn, params, opt_state, (p_sh, s_sh) = build_hybrid_train_step(
+        *fns, blocks, embed, {}, mesh, opt, num_micro=M,
+        block_param_specs=block_specs, zero_stage=1, tie_embed_head=True)
+    # storage: table sharded over mp AND pp; no head tree
+    assert "mp" in str(p_sh["embed"]["table"].spec)
+    assert "pp" in str(p_sh["embed"]["table"].spec)
+    assert params["head"] == {}
+    shard_shapes = {tuple(s.data.shape)
+                    for s in params["embed"]["table"].addressable_shards}
+    assert shard_shapes == {(V // 4, H)}, shard_shapes
+
+    rng2 = np.random.RandomState(22)
+    ids = jnp.asarray(rng2.randint(0, V, size=(B, S)).astype(np.int32))
+    labels = jnp.asarray(rng2.randint(0, V, size=(B, S)).astype(np.int32))
+    loss, params, opt_state = step_fn(params, opt_state, ids, labels, 1)
+
+    def ref(tb):
+        x = tb[ids]
+        for bp in blocks:
+            x = _ref_block(bp, x)
+        lg = (x @ tb.T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, labels[..., None], -1).mean()
+
+    ref_loss = ref(embed["table"])
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+
+
+def test_tied_tp_hybrid_grads_match_sequential():
+    from paddle_tpu.parallel.hybrid import make_tied_tp_lm_fns
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    rng = np.random.RandomState(23)
+    blocks, embed, _head = init_llama_tp_params(L, H, F, V, rng=rng)
+    fns, block_specs = make_tied_tp_lm_fns(NH, 2)
+    grad_fn, (stacked, emb_p, head_p, _s) = build_1f1b_train_step(
+        *fns, blocks, embed, {}, mesh, num_micro=M,
+        block_param_specs=block_specs, batch_axes=("dp", "sharding"),
+        tie_embed_head=True)
+    rng2 = np.random.RandomState(24)
+    ids = jnp.asarray(rng2.randint(0, V, size=(B, S)).astype(np.int32))
+    loss, (d_blk, d_emb, d_head) = jax.jit(grad_fn)(
+        stacked, emb_p, head_p, ids, ids)
+    assert d_head == {}
+
+    def ref(tb):
+        x = tb[ids]
+        for bp in blocks:
+            x = _ref_block(bp, x)
+        lg = (x @ tb.T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, ids[..., None], -1).mean()
+
+    ref_loss, ref_dtab = jax.value_and_grad(ref)(embed["table"])
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(d_emb["table"]),
+                               np.asarray(ref_dtab), rtol=5e-3,
+                               atol=2e-5)
+
+
+def test_hybrid_interleaved_virtual_stages_match():
+    """interleave=2 (virtual pipeline stages, reference interleaved-1F1B
+    pipeline_parallel.py:461) composed with TP: parity vs sequential."""
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    fns, specs = make_llama_tp_fns(NH, 2)
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(31))
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    grad_fn, (stacked, emb_p, head_p, _s) = build_1f1b_train_step(
+        *fns, blocks, embed, head, mesh, num_micro=4, interleave=2,
+        block_param_specs=specs[0], embed_param_specs=specs[1],
+        head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+    rng = np.random.RandomState(32)
+    ids = jnp.asarray(rng.randint(0, V, size=(8, S)).astype(np.int32))
+    loss, _grads = jax.jit(grad_fn)(stacked, emb_p, head_p, ids, ids)
+    ref = _ref_loss(blocks, embed, head, ids, ids)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-4)
+
+
+def test_hybrid_remat_dots_policy_matches():
+    """remat_block='dots' (save MXU outputs, recompute elementwise) must
+    not change numbers, only the memory/recompute tradeoff."""
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    fns, specs = make_llama_tp_fns(NH, 2)
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(41))
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    rng = np.random.RandomState(42)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    outs = {}
+    for mode in (True, "dots", False):
+        grad_fn, (stacked, emb_p, head_p, _s) = build_1f1b_train_step(
+            *fns, blocks, embed, head, mesh, num_micro=M,
+            block_param_specs=specs[0], embed_param_specs=specs[1],
+            head_param_specs=specs[2], batch_axes=("dp", "sharding"),
+            remat_block=mode)
+        loss, (d_blk, _de, _dh) = jax.jit(grad_fn)(
+            stacked, emb_p, head_p, ids, ids)
+        outs[str(mode)] = (float(loss), np.asarray(d_blk["wq"]))
+    l0, g0 = outs["True"]
+    for k in ("dots", "False"):
+        l, g = outs[k]
+        np.testing.assert_allclose(l, l0, rtol=1e-5)
+        np.testing.assert_allclose(g, g0, rtol=1e-4, atol=1e-6)
+
+
+def test_tied_non_mp_fns_on_mp_mesh_raise():
+    """code-review r4: make_tied_lm_fns assumes the FULL gathered table;
+    on mp>1 meshes the builder must refuse it (the gather yields only
+    [V/mp, h] and lookups would silently clamp)."""
+    import pytest
+    from paddle_tpu.parallel.pp_1f1b import (build_1f1b_train_step,
+                                             make_tied_lm_fns)
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    blocks, embed, _h = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(51))
+    embed_fn, head_loss_fn = make_tied_lm_fns()
+    with pytest.raises(ValueError, match="make_tied_tp_lm_fns"):
+        build_1f1b_train_step(
+            lambda p, x: x, embed_fn, head_loss_fn, blocks, embed, {},
+            mesh, num_micro=2, tie_embed_head=True)
