@@ -19,15 +19,16 @@ import time
 
 
 CONFIGS = {
-    # name: (layers, hidden, ffn, vocab, heads, dp, pp, sharding, mp,
-    #        batch, seq, micro)
-    "7b": (32, 4096, 11008, 32000, 32, 1, 2, 2, 2, 8, 512, 4),
-    "70b": (80, 8192, 28672, 32000, 64, 1, 4, 2, 4, 16, 512, 8),
+    # name: (layers, hidden, ffn, vocab, heads, kv_heads, dp, pp,
+    #        sharding, mp, batch, seq, micro)
+    "7b": (32, 4096, 11008, 32000, 32, 32, 1, 2, 2, 2, 8, 512, 4),
+    # real Llama-2-70B: GQA with 8 kv heads; flash attention + RoPE
+    "70b": (80, 8192, 28672, 32000, 64, 8, 1, 4, 2, 4, 16, 512, 8),
 }
 
 
 def run(name):
-    (L, H, F, V, NH, dp, pp, sharding, mp, B, S, M) = CONFIGS[name]
+    (L, H, F, V, NH, NKV, dp, pp, sharding, mp, B, S, M) = CONFIGS[name]
     n_devices = dp * pp * sharding * mp
 
     import jax
@@ -41,18 +42,21 @@ def run(name):
 
     mesh = dist.init_mesh(dp=dp, pp=pp, sharding=sharding, mp=mp,
                           devices=jax.devices()[:n_devices])
-    fns, specs = make_llama_tp_fns(NH, mp)
+    fns, specs = make_llama_tp_fns(NH, mp, n_kv_heads=NKV,
+                                   use_flash=True, rope_theta=10000.0)
 
+    KV = H // NH * NKV
     sds = jax.ShapeDtypeStruct
     blk = {"ln1": sds((H,), jnp.bfloat16), "ln2": sds((H,), jnp.bfloat16),
-           "wq": sds((H, H), jnp.bfloat16), "wk": sds((H, H), jnp.bfloat16),
-           "wv": sds((H, H), jnp.bfloat16), "wo": sds((H, H), jnp.bfloat16),
+           "wq": sds((H, H), jnp.bfloat16), "wk": sds((H, KV), jnp.bfloat16),
+           "wv": sds((H, KV), jnp.bfloat16), "wo": sds((H, H), jnp.bfloat16),
            "wg": sds((H, F), jnp.bfloat16), "wu": sds((H, F), jnp.bfloat16),
            "wd": sds((F, H), jnp.bfloat16)}
     blocks = [blk] * L
     embed = {"table": sds((V, H), jnp.bfloat16)}
     head = {"wo": sds((H, V), jnp.bfloat16)}
-    n_params = (L * (2 * H + 4 * H * H + 3 * H * F) + 2 * V * H)
+    n_params = (L * (2 * H + 2 * H * H + 2 * H * KV + 3 * H * F)
+                + 2 * V * H)
     print(f"[{name}] {n_params/1e9:.2f}B params, mesh dp={dp} pp={pp} "
           f"sharding={sharding} mp={mp} ({n_devices} devices)", flush=True)
 

@@ -24,6 +24,8 @@ ParallelCrossEntropy semantics) for tests, compile checks and benches.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -77,17 +79,42 @@ def _vocab_parallel_ce(lg, labels, mp_axis):
     return (jnp.log(denom) + m - picked).mean()
 
 
+@functools.lru_cache(maxsize=16)
+def _rope_tables_np(head_dim, seq, theta):
+    from ..ops.pallas import rope as rope_mod
+    # cache NUMPY (host) tables: first call may happen inside a trace
+    # (remat of block_fn) and under some mesh — cached values must carry
+    # neither tracers nor a mesh-typed aval
+    with jax.ensure_compile_time_eval():
+        cos, sin = rope_mod.precompute_freqs(head_dim, seq, theta)
+        return np.asarray(cos), np.asarray(sin)
+
+
+def _rope_tables(head_dim, seq, theta):
+    cos, sin = _rope_tables_np(head_dim, seq, theta)
+    return jnp.asarray(cos), jnp.asarray(sin)
+
+
 def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
-                      mp_axis="mp"):
+                      mp_axis="mp", n_kv_heads=None, use_flash=False,
+                      rope_theta=None):
     """(block_fn, embed_fn, head_loss_fn) + param PartitionSpecs.
 
     All fns expect to run inside shard_map with axis ``mp_axis`` present;
     they see mp-LOCAL parameter shards and produce mp-replicated
     activations (row-parallel matmuls psum over the axis). n_heads is the
-    GLOBAL head count; mp_degree must divide it.
+    GLOBAL head count; mp_degree must divide it (and n_kv_heads, when
+    given — GQA with kv repeated to the query heads, reference
+    fused_rope/GQA semantics). ``use_flash`` routes attention through the
+    Pallas flash kernel (auto-fallback off-TPU); ``rope_theta`` applies
+    rotary position embeddings.
     """
+    n_kv = n_kv_heads or n_heads
     assert n_heads % mp_degree == 0, (n_heads, mp_degree)
+    assert n_kv % mp_degree == 0, (n_kv, mp_degree)
     nh_local = n_heads // mp_degree
+    nkv_local = n_kv // mp_degree
+    assert nh_local % nkv_local == 0, (nh_local, nkv_local)
     from .mp_ops import c_identity, mp_allreduce
 
     # Megatron-style autodiff boundaries (reference mp_ops.py _c_identity /
@@ -102,15 +129,32 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
         mb, s, h = x.shape
         hn = c_identity(_rms_norm(x, p["ln1"], eps), mp_axis)
         q = (hn @ p["wq"]).reshape(mb, s, nh_local, -1)
-        k = (hn @ p["wk"]).reshape(mb, s, nh_local, -1)
-        v = (hn @ p["wv"]).reshape(mb, s, nh_local, -1)
+        k = (hn @ p["wk"]).reshape(mb, s, nkv_local, -1)
+        v = (hn @ p["wv"]).reshape(mb, s, nkv_local, -1)
         dh = q.shape[-1]
-        logits = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(dh)
-        if causal:
-            mask = jnp.tril(jnp.ones((s, s), bool))
-            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-        attn = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
-        ctx = jnp.einsum("bnqk,bknd->bqnd", attn, v).reshape(mb, s, -1)
+        if rope_theta:
+            from ..ops.pallas import rope as rope_mod
+            cos, sin = _rope_tables(dh, s, float(rope_theta))
+            q = rope_mod.apply_rotary(q, cos, sin)
+            k = rope_mod.apply_rotary(k, cos, sin)
+        if nkv_local != nh_local:
+            rep = nh_local // nkv_local
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if use_flash:
+            from ..ops.pallas.flash_attention import _flash
+            ctx = _flash(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                         v.transpose(0, 2, 1, 3), 1.0 / np.sqrt(dh),
+                         causal).transpose(0, 2, 1, 3).reshape(mb, s, -1)
+        else:
+            logits = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(dh)
+            if causal:
+                mask = jnp.tril(jnp.ones((s, s), bool))
+                logits = jnp.where(mask, logits,
+                                   jnp.finfo(logits.dtype).min)
+            attn = jax.nn.softmax(logits.astype(jnp.float32),
+                                  -1).astype(x.dtype)
+            ctx = jnp.einsum("bnqk,bknd->bqnd", attn, v).reshape(mb, s, -1)
         # row-parallel out proj: partial sums -> psum over mp
         x = x + mp_allreduce(ctx @ p["wo"], mp_axis)
         hn = c_identity(_rms_norm(x, p["ln2"], eps), mp_axis)
@@ -160,19 +204,22 @@ def make_tied_tp_lm_fns(n_heads, mp_degree, causal=True, eps=1e-5,
 
 
 def init_llama_tp_params(n_layers, hidden, ffn, vocab, rng=None,
-                         dtype=np.float32):
+                         dtype=np.float32, n_heads=None, n_kv_heads=None):
     """FULL (unsharded) parameter trees for the make_llama_tp_fns model;
-    shard_map's in_specs do the splitting."""
+    shard_map's in_specs do the splitting. GQA (n_kv_heads < n_heads)
+    shrinks the k/v projections to n_kv_heads * head_dim."""
     rng = rng or np.random.RandomState(0)
     sd = 0.02
+    kv_dim = hidden if not (n_heads and n_kv_heads) \
+        else hidden // n_heads * n_kv_heads
 
     def w(*shape):
         return jnp.asarray(rng.randn(*shape).astype(dtype) * sd)
 
     blocks = [{
         "ln1": jnp.ones((hidden,), dtype), "ln2": jnp.ones((hidden,), dtype),
-        "wq": w(hidden, hidden), "wk": w(hidden, hidden),
-        "wv": w(hidden, hidden), "wo": w(hidden, hidden),
+        "wq": w(hidden, hidden), "wk": w(hidden, kv_dim),
+        "wv": w(hidden, kv_dim), "wo": w(hidden, hidden),
         "wg": w(hidden, ffn), "wu": w(hidden, ffn), "wd": w(ffn, hidden),
     } for _ in range(n_layers)]
     embed = {"table": w(vocab, hidden)}

@@ -348,3 +348,35 @@ def test_tied_non_mp_fns_on_mp_mesh_raise():
         build_1f1b_train_step(
             lambda p, x: x, embed_fn, head_loss_fn, blocks, embed, {},
             mesh, num_micro=2, tie_embed_head=True)
+
+
+def test_hybrid_gqa_rope_flash_paths_agree():
+    """Production block options: GQA (2 kv heads for 4 q heads), RoPE,
+    and the flash attention route must agree with the einsum route
+    (flash falls back to the reference composition on CPU — independent
+    code, same math)."""
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(61), n_heads=NH,
+        n_kv_heads=2)
+    rng = np.random.RandomState(62)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    outs = {}
+    for flash in (False, True):
+        fns, specs = make_llama_tp_fns(NH, 2, n_kv_heads=2,
+                                       use_flash=flash,
+                                       rope_theta=10000.0)
+        grad_fn, (stacked, emb_p, head_p, _s) = build_1f1b_train_step(
+            *fns, blocks, embed, head, mesh, num_micro=M,
+            block_param_specs=specs[0], embed_param_specs=specs[1],
+            head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+        loss, (d_blk, _de, _dh) = jax.jit(grad_fn)(
+            stacked, emb_p, head_p, ids, ids)
+        outs[flash] = (float(loss), np.asarray(d_blk["wk"]))
+    l0, g0 = outs[False]
+    l1, g1 = outs[True]
+    np.testing.assert_allclose(l1, l0, rtol=1e-5)
+    np.testing.assert_allclose(g1, g0, rtol=1e-3, atol=1e-6)
+    # GQA actually shrank the kv projections
+    assert g0.shape[-1] == H // NH * 2
