@@ -20,16 +20,21 @@ import time
 
 CONFIGS = {
     # name: (layers, hidden, ffn, vocab, heads, kv_heads, dp, pp,
-    #        sharding, mp, batch, seq, micro)
-    "7b": (32, 4096, 11008, 32000, 32, 32, 1, 2, 2, 2, 8, 512, 4),
+    #        sharding, mp, sp, batch, seq, micro)
+    "7b": (32, 4096, 11008, 32000, 32, 32, 1, 2, 2, 2, 1, 8, 512, 4),
     # real Llama-2-70B: GQA with 8 kv heads; flash attention + RoPE
-    "70b": (80, 8192, 28672, 32000, 64, 8, 1, 4, 2, 4, 16, 512, 8),
+    "70b": (80, 8192, 28672, 32000, 64, 8, 1, 4, 2, 4, 1, 16, 512, 8),
+    # long-context: 7B at seq 32768 with ring attention over sp=2
+    # composed with tp2 x pp2 in the same program (SURVEY north star)
+    "7b-32k": (32, 4096, 11008, 32000, 32, 32, 1, 2, 1, 2, 2, 2, 32768,
+               2),
 }
 
 
 def run(name):
-    (L, H, F, V, NH, NKV, dp, pp, sharding, mp, B, S, M) = CONFIGS[name]
-    n_devices = dp * pp * sharding * mp
+    (L, H, F, V, NH, NKV, dp, pp, sharding, mp, sp, B, S, M) = \
+        CONFIGS[name]
+    n_devices = dp * pp * sharding * mp * sp
 
     import jax
     import jax.numpy as jnp
@@ -40,10 +45,11 @@ def run(name):
     from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
                                             make_llama_tp_fns)
 
-    mesh = dist.init_mesh(dp=dp, pp=pp, sharding=sharding, mp=mp,
+    mesh = dist.init_mesh(dp=dp, pp=pp, sharding=sharding, mp=mp, sp=sp,
                           devices=jax.devices()[:n_devices])
-    fns, specs = make_llama_tp_fns(NH, mp, n_kv_heads=NKV,
-                                   use_flash=True, rope_theta=10000.0)
+    fns, specs = make_llama_tp_fns(
+        NH, mp, n_kv_heads=NKV, use_flash=True, rope_theta=10000.0,
+        sp_axis="sp" if sp > 1 else None, sp_degree=sp)
 
     KV = H // NH * NKV
     sds = jax.ShapeDtypeStruct
@@ -58,14 +64,16 @@ def run(name):
     n_params = (L * (2 * H + 2 * H * H + 2 * H * KV + 3 * H * F)
                 + 2 * V * H)
     print(f"[{name}] {n_params/1e9:.2f}B params, mesh dp={dp} pp={pp} "
-          f"sharding={sharding} mp={mp} ({n_devices} devices)", flush=True)
+          f"sharding={sharding} mp={mp} sp={sp} seq={S} "
+          f"({n_devices} devices)", flush=True)
 
     opt = pt.optimizer.AdamW(learning_rate=1e-4)
     t0 = time.perf_counter()
     step_fn, params, opt_state, (p_sh, s_sh) = build_hybrid_train_step(
         *fns, blocks, embed, head, mesh, opt, num_micro=M,
         block_param_specs=specs[0], embed_param_specs=specs[1],
-        head_param_specs=specs[2], zero_stage=1)
+        head_param_specs=specs[2], zero_stage=1,
+        seq_axis="sp" if sp > 1 else None)
     t_build = time.perf_counter() - t0
 
     ids = sds((B, S), jnp.int32)
@@ -86,16 +94,17 @@ def run(name):
               f"temp {mem.temp_size_in_bytes/1e9:.2f} GB", flush=True)
     except Exception:
         pass
-    assert "sharding" in str(s_sh["m"]["blocks"]["wq"].spec), \
-        "ZeRO-1: moments must shard over 'sharding'"
-    print(f"[{name}] hybrid tp{mp}×pp{pp}×zero1 compile-check OK",
-          flush=True)
+    if sharding > 1:
+        assert "sharding" in str(s_sh["m"]["blocks"]["wq"].spec), \
+            "ZeRO-1: moments must shard over 'sharding'"
+    tag = f"tp{mp}×pp{pp}×zero1" + (f"×sp{sp}" if sp > 1 else "")
+    print(f"[{name}] hybrid {tag} compile-check OK", flush=True)
 
 
 def main(which="all"):
     names = list(CONFIGS) if which == "all" else [which]
-    n_max = max(CONFIGS[n][5] * CONFIGS[n][6] * CONFIGS[n][7]
-                * CONFIGS[n][8] for n in names)
+    n_max = max(CONFIGS[n][6] * CONFIGS[n][7] * CONFIGS[n][8]
+                * CONFIGS[n][9] * CONFIGS[n][10] for n in names)
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)

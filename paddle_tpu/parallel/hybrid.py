@@ -97,7 +97,7 @@ def _rope_tables(head_dim, seq, theta):
 
 def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
                       mp_axis="mp", n_kv_heads=None, use_flash=False,
-                      rope_theta=None):
+                      rope_theta=None, sp_axis=None, sp_degree=1):
     """(block_fn, embed_fn, head_loss_fn) + param PartitionSpecs.
 
     All fns expect to run inside shard_map with axis ``mp_axis`` present;
@@ -108,6 +108,13 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
     fused_rope/GQA semantics). ``use_flash`` routes attention through the
     Pallas flash kernel (auto-fallback off-TPU); ``rope_theta`` applies
     rotary position embeddings.
+
+    ``sp_axis`` (+``sp_degree``) turns on SEQUENCE/context parallelism:
+    activations arrive [mb, s_local, h] sharded over the sp axis,
+    attention runs as ring attention around it (each ring step = one
+    flash-kernel block against the KV shard currently held, overlapping
+    ICI transfer), and RoPE positions are offset by the sp rank — long
+    context composes with tp × pp × zero in the same program.
     """
     n_kv = n_kv_heads or n_heads
     assert n_heads % mp_degree == 0, (n_heads, mp_degree)
@@ -125,7 +132,7 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
     # replicated ln weights — come out full and mp-identical.
 
     def block_fn(p, x):
-        # x [mb, s, h] replicated over mp
+        # x [mb, s, h] replicated over mp (s = local shard under sp)
         mb, s, h = x.shape
         hn = c_identity(_rms_norm(x, p["ln1"], eps), mp_axis)
         q = (hn @ p["wq"]).reshape(mb, s, nh_local, -1)
@@ -134,14 +141,27 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
         dh = q.shape[-1]
         if rope_theta:
             from ..ops.pallas import rope as rope_mod
-            cos, sin = _rope_tables(dh, s, float(rope_theta))
-            q = rope_mod.apply_rotary(q, cos, sin)
-            k = rope_mod.apply_rotary(k, cos, sin)
+            cos, sin = _rope_tables(dh, s * sp_degree, float(rope_theta))
+            if sp_axis:
+                pos = jax.lax.axis_index(sp_axis) * s + jnp.arange(s)
+                pos = jnp.broadcast_to(pos[None], (mb, s))
+                q = rope_mod.apply_rotary(q, cos, sin, position_ids=pos)
+                k = rope_mod.apply_rotary(k, cos, sin, position_ids=pos)
+            else:
+                q = rope_mod.apply_rotary(q, cos, sin)
+                k = rope_mod.apply_rotary(k, cos, sin)
         if nkv_local != nh_local:
             rep = nh_local // nkv_local
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        if use_flash:
+        if sp_axis:
+            from ..ops.pallas.ring_attention import ring_attention
+            ctx = ring_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), axis_name=sp_axis,
+                causal=causal, sm_scale=1.0 / np.sqrt(dh),
+            ).transpose(0, 2, 1, 3).reshape(mb, s, -1)
+        elif use_flash:
             from ..ops.pallas.flash_attention import _flash
             ctx = _flash(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                          v.transpose(0, 2, 1, 3), 1.0 / np.sqrt(dh),
@@ -237,7 +257,7 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
                             head_param_specs=None, zero_stage=1,
                             interleave=1, block_weights=None,
                             remat_block=True, donate=True,
-                            tie_embed_head=False):
+                            tie_embed_head=False, seq_axis=None):
     """ONE jitted train step composing mp × pp × sharding × dp.
 
     Returns (step_fn, params, opt_state, (p_shard, s_shard)) where
@@ -257,7 +277,7 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         embed_param_specs=embed_param_specs,
         head_param_specs=head_param_specs,
         batch_axes=("dp", "sharding"),
-        tie_embed_head=tie_embed_head)
+        tie_embed_head=tie_embed_head, seq_axis=seq_axis)
 
     params = {"blocks": stacked, "embed": emb_p, "head": head_p}
     if tie_embed_head:

@@ -108,7 +108,8 @@ def _remat_wrap(block_fn, remat_block):
 def one_f_one_b_forward_backward(
         sched: Schedule, block_fn, embed_fn, head_loss_fn,
         blocks_local, embed_params, head_params, counts_vs,
-        ids_micro, labels_micro, hidden_shape, remat_block=True):
+        ids_micro, labels_micro, hidden_shape, remat_block=True,
+        uniform_collectives=False):
     """Run the 1F1B schedule. MUST be called inside shard_map with axis
     "pp" of size sched.S.
 
@@ -121,6 +122,20 @@ def one_f_one_b_forward_backward(
     hidden_shape: (mb, s, h) static
     Returns (loss_mean, d_blocks_local, d_embed, d_head) — loss/d_embed/
     d_head are psum-replicated over pp; d_blocks_local stays per-device.
+
+    ``uniform_collectives=True``: every rank executes embed and the full
+    block stack (forward AND backward) every tick, selecting the role's
+    result via ``where`` — grads to unselected branches vanish through
+    the select. Required when block_fn contains collectives that must
+    run in lockstep across pipeline roles — concretely RING ATTENTION
+    over an "sp" axis: under the default role `cond`s, ranks in
+    different roles would execute different numbers of sp ppermutes per
+    tick and deadlock. The head vjp stays role-gated (its mp-only
+    collective groups never cross pp coordinates, so the cond predicate
+    is uniform within them — same argument as the default path). Cost:
+    embed every tick (cheap) + idle-role block compute (bounded by the
+    padded chunk size C, which the default path pays inside fori_loop
+    anyway).
     """
     S, M, v = sched.S, sched.M, sched.v
     VS = S * v
@@ -133,10 +148,16 @@ def one_f_one_b_forward_backward(
     def apply_blocks(chunk_params, x, n):
         C = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
 
-        def body(j, xx):
-            blk = jax.tree_util.tree_map(lambda a: a[j], chunk_params)
-            return jax.lax.cond(j < n, lambda q: bf(blk, q),
-                                lambda q: q, xx)
+        if uniform_collectives:
+            def body(j, xx):
+                blk = jax.tree_util.tree_map(lambda a: a[j], chunk_params)
+                out = bf(blk, xx)
+                return jnp.where(j < n, out, xx)
+        else:
+            def body(j, xx):
+                blk = jax.tree_util.tree_map(lambda a: a[j], chunk_params)
+                return jax.lax.cond(j < n, lambda q: bf(blk, q),
+                                    lambda q: q, xx)
 
         return jax.lax.fori_loop(0, C, body, x)
 
@@ -183,11 +204,19 @@ def one_f_one_b_forward_backward(
             return zero_hidden  # last vstage sends nothing; bwd recomputes
 
         case_f = jnp.where(f_vs == 0, 0, jnp.where(f_vs == VS - 1, 2, 1))
-        y = jax.lax.cond(
-            do_f,
-            lambda _: jax.lax.switch(case_f, [role_f_first, role_f_mid,
-                                              role_f_last], None),
-            lambda _: zero_hidden, None)
+        if uniform_collectives:
+            # every rank runs embed + blocks every tick; result selected
+            hdn_f = embed_fn(embed_params, ids_f).astype(dt)
+            x0f = jnp.where(case_f == 0, hdn_f, x_in)
+            y_all = apply_blocks(chunk_of(chunk_f), x0f, n_f)
+            y = jnp.where(do_f & (case_f != 2), y_all, zero_hidden)
+        else:
+            y = jax.lax.cond(
+                do_f,
+                lambda _: jax.lax.switch(case_f, [role_f_first,
+                                                  role_f_mid,
+                                                  role_f_last], None),
+                lambda _: zero_hidden, None)
         # save this fwd's input for the bwd recompute (vs > 0 only)
         slot_s = g("f_save")
         x_buf = jnp.where(
@@ -251,13 +280,59 @@ def one_f_one_b_forward_backward(
                     lv.astype(jnp.float32) * M)
 
         case_b = jnp.where(b_vs == 0, 0, jnp.where(b_vs == VS - 1, 2, 1))
-        dck, dep, dhp, dx, lval = jax.lax.cond(
-            do_b,
-            lambda _: jax.lax.switch(case_b, [role_b_first, role_b_mid,
-                                              role_b_last], None),
-            lambda _: (zero_ck, zero_emb, zero_hd, zero_hidden,
-                       jnp.float32(0)),
-            None)
+        if uniform_collectives:
+            # Uniform BLOCK vjp (the sp rings live in block_fn, so its
+            # forward+backward must run identically on every rank every
+            # tick); the HEAD vjp — the model's largest matmul, with only
+            # mp collectives whose groups never cross pp coordinates —
+            # stays role-gated under a cond, exactly like the default
+            # path. `where` routes embed vs saved-input; grads to the
+            # unselected branch are hard zeros through the select.
+            is_first_b = case_b == 0
+            is_last_b = case_b == 2
+
+            def f_blocks(ck, ep, xx):
+                x0b = jnp.where(is_first_b,
+                                embed_fn(ep, ids_b).astype(dt), xx)
+                return apply_blocks(ck, x0b, n_b)
+
+            hdn_b, vjp_blocks = jax.vjp(f_blocks, ck_b, embed_params,
+                                        x_sv)
+
+            def head_branch(_):
+                lv, vjp_h = jax.vjp(
+                    lambda hp, hd: head_loss_fn(hp, hd, lbl_b) / M,
+                    head_params, hdn_b)
+                dhp_, ct_ = vjp_h(jnp.ones_like(lv))
+                f32_ = lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32), t)
+                return (f32_(dhp_), ct_.astype(dt),
+                        lv.astype(jnp.float32))
+
+            def nohead_branch(_):
+                return zero_hd, g_in, jnp.float32(0)
+
+            dhp, ct_h, head_val = jax.lax.cond(
+                is_last_b, head_branch, nohead_branch, None)
+            dck, dep, dx = vjp_blocks(ct_h)
+            f32 = lambda t: jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32), t)
+            gate = lambda t: jax.tree_util.tree_map(
+                lambda a: jnp.where(do_b, a, jnp.zeros_like(a)), t)
+            dck = gate(f32(dck))
+            dep = gate(f32(dep))
+            dhp = gate(dhp)
+            dx = jnp.where(do_b & ~is_first_b, dx.astype(dt), zero_hidden)
+            lval = jnp.where(do_b & is_last_b,
+                             head_val * M, jnp.float32(0))
+        else:
+            dck, dep, dhp, dx, lval = jax.lax.cond(
+                do_b,
+                lambda _: jax.lax.switch(case_b, [role_b_first, role_b_mid,
+                                                  role_b_last], None),
+                lambda _: (zero_ck, zero_emb, zero_hd, zero_hidden,
+                           jnp.float32(0)),
+                None)
 
         # accumulate grads (scatter-add this chunk's block grads)
         d_blk = jax.tree_util.tree_map(
@@ -327,7 +402,8 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                           block_weights=None, remat_block=True,
                           block_param_specs=None, embed_param_specs=None,
                           head_param_specs=None, batch_axes=("dp",),
-                          tie_embed_head=False):
+                          tie_embed_head=False, seq_axis=None,
+                          uniform_collectives=None):
     """Assemble the sharded 1F1B loss-and-grad function.
 
     Returns (grad_fn, state) where
@@ -426,8 +502,15 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         head_spec = {n: (head_param_specs or {}).get(n, P())
                      for n in head_params}
 
-    mean_axes = tuple(ax for ax in batch_axes if mesh.degree(ax) > 1)
-    bspec = P(None, tuple(batch_axes))
+    # ring attention's per-block sp collectives must execute uniformly
+    # across pipeline roles — auto-enable the uniform tick under seq_axis
+    uniform = (uniform_collectives if uniform_collectives is not None
+               else seq_axis is not None)
+    data_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
+    mean_axes = tuple(ax for ax in data_axes if mesh.degree(ax) > 1)
+    # batch over the batch axes; with seq_axis, the SEQUENCE dim shards
+    # over it too (context parallel — block fns must run ring attention)
+    bspec = P(None, tuple(batch_axes), seq_axis)
 
     def sharded_body(blocks, embed, head, ids_micro, labels_micro):
         # local blocks: [v, 1, C, ...] -> [v, C, ...]
@@ -451,7 +534,8 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         loss, d_blk, d_emb, d_head = one_f_one_b_forward_backward(
             sched, block_fn, embed_fn, head_loss_fn,
             blocks_local, embed_in, head_in, counts_vs,
-            ids_micro, labels_micro, (mb, s, h), remat_block=remat_block)
+            ids_micro, labels_micro, (mb, s, h), remat_block=remat_block,
+            uniform_collectives=uniform)
         if tie_embed_head:
             # d_emb/d_head are already psum'd over pp -> global [V, h]
             # sums; tie them and keep only this stage's vocab slice

@@ -380,3 +380,70 @@ def test_hybrid_gqa_rope_flash_paths_agree():
     np.testing.assert_allclose(g1, g0, rtol=1e-3, atol=1e-6)
     # GQA actually shrank the kv projections
     assert g0.shape[-1] == H // NH * 2
+
+
+def test_hybrid_sequence_parallel_ring_matches():
+    """Context parallelism composed into the hybrid: sequence sharded
+    over sp, ring attention inside the pipeline blocks, RoPE offset by
+    sp rank (SURVEY north star: long context x tp x pp x zero). Parity
+    vs the same model without sp."""
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(71))
+    rng = np.random.RandomState(72)
+    S_long = 16
+    ids = jnp.asarray(rng.randint(0, V, size=(4, S_long)).astype(np.int32))
+
+    # reference: mp-only mesh, flash path, same global sequence
+    mesh0 = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    fns0, specs0 = make_llama_tp_fns(NH, 2, rope_theta=10000.0,
+                                     use_flash=True)
+    g0, (st0, e0, h0, _) = build_1f1b_train_step(
+        *fns0, blocks, embed, head, mesh0, num_micro=2,
+        block_param_specs=specs0[0], embed_param_specs=specs0[1],
+        head_param_specs=specs0[2], batch_axes=("dp", "sharding"))
+    loss0, (db0, _de0, _dh0) = jax.jit(g0)(st0, e0, h0, ids, ids)
+
+    # sp: sequence sharded over 2 ranks, ring attention
+    mesh1 = dist.init_mesh(dp=1, pp=2, sharding=1, sp=2, mp=2)
+    fns1, specs1 = make_llama_tp_fns(NH, 2, rope_theta=10000.0,
+                                     sp_axis="sp", sp_degree=2)
+    g1, (st1, e1, h1, _) = build_1f1b_train_step(
+        *fns1, blocks, embed, head, mesh1, num_micro=2,
+        block_param_specs=specs1[0], embed_param_specs=specs1[1],
+        head_param_specs=specs1[2], batch_axes=("dp", "sharding"),
+        seq_axis="sp")
+    loss1, (db1, _de1, _dh1) = jax.jit(g1)(st1, e1, h1, ids, ids)
+
+    np.testing.assert_allclose(float(loss1), float(loss0), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(db1["wq"]),
+                               np.asarray(db0["wq"]), rtol=5e-3,
+                               atol=2e-5)
+
+
+def test_uniform_collectives_tick_matches_cond_tick():
+    """The uniform tick (compute-all + select) must equal the role-cond
+    tick exactly on a non-sp config — same schedule, same numbers."""
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    fns, specs = make_llama_tp_fns(NH, 2)
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(81))
+    rng = np.random.RandomState(82)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    outs = {}
+    for uni in (False, True):
+        grad_fn, (st, ep, hp, _s) = build_1f1b_train_step(
+            *fns, blocks, embed, head, mesh, num_micro=M,
+            block_param_specs=specs[0], embed_param_specs=specs[1],
+            head_param_specs=specs[2], batch_axes=("dp", "sharding"),
+            uniform_collectives=uni)
+        loss, (d_blk, d_emb, d_head) = jax.jit(grad_fn)(st, ep, hp,
+                                                        ids, ids)
+        outs[uni] = (float(loss), np.asarray(d_blk["wq"]),
+                     np.asarray(d_emb["table"]),
+                     np.asarray(d_head["wo"]))
+    np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=1e-6)
+    for i in (1, 2, 3):
+        np.testing.assert_allclose(outs[True][i], outs[False][i],
+                                   rtol=1e-4, atol=1e-7)
