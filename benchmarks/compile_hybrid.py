@@ -18,22 +18,31 @@ import sys
 import time
 
 
+_BASE = dict(dp=1, sharding=1, sp=1, kv_heads=None, experts=0, top_k=2)
 CONFIGS = {
-    # name: (layers, hidden, ffn, vocab, heads, kv_heads, dp, pp,
-    #        sharding, mp, sp, batch, seq, micro)
-    "7b": (32, 4096, 11008, 32000, 32, 32, 1, 2, 2, 2, 1, 8, 512, 4),
+    "7b": dict(_BASE, L=32, H=4096, F=11008, V=32000, NH=32,
+               pp=2, sharding=2, mp=2, B=8, S=512, M=4),
     # real Llama-2-70B: GQA with 8 kv heads; flash attention + RoPE
-    "70b": (80, 8192, 28672, 32000, 64, 8, 1, 4, 2, 4, 1, 16, 512, 8),
+    "70b": dict(_BASE, L=80, H=8192, F=28672, V=32000, NH=64, kv_heads=8,
+                pp=4, sharding=2, mp=4, B=16, S=512, M=8),
     # long-context: 7B at seq 32768 with ring attention over sp=2
     # composed with tp2 x pp2 in the same program (SURVEY north star)
-    "7b-32k": (32, 4096, 11008, 32000, 32, 32, 1, 2, 1, 2, 2, 2, 32768,
-               2),
+    "7b-32k": dict(_BASE, L=32, H=4096, F=11008, V=32000, NH=32,
+                   pp=2, mp=2, sp=2, B=2, S=32768, M=2),
+    # Mixtral-8x7B-shaped MoE: 8 experts top-2, EP over the mp axis
+    "8x7b": dict(_BASE, L=32, H=4096, F=14336, V=32000, NH=32,
+                 kv_heads=8, experts=8, pp=2, sharding=2, mp=2, B=8,
+                 S=512, M=4),
 }
 
 
 def run(name):
-    (L, H, F, V, NH, NKV, dp, pp, sharding, mp, sp, B, S, M) = \
-        CONFIGS[name]
+    c = CONFIGS[name]
+    L, H, F, V, NH = c["L"], c["H"], c["F"], c["V"], c["NH"]
+    NKV = c["kv_heads"] or NH
+    dp, pp, sharding, mp, sp = (c["dp"], c["pp"], c["sharding"], c["mp"],
+                                c["sp"])
+    B, S, M, E = c["B"], c["S"], c["M"], c["experts"]
     n_devices = dp * pp * sharding * mp * sp
 
     import jax
@@ -43,25 +52,39 @@ def run(name):
     import paddle_tpu as pt
     import paddle_tpu.parallel as dist
     from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
-                                            make_llama_tp_fns)
+                                            make_llama_tp_fns,
+                                            make_moe_tp_fns)
 
     mesh = dist.init_mesh(dp=dp, pp=pp, sharding=sharding, mp=mp, sp=sp,
                           devices=jax.devices()[:n_devices])
-    fns, specs = make_llama_tp_fns(
-        NH, mp, n_kv_heads=NKV, use_flash=True, rope_theta=10000.0,
-        sp_axis="sp" if sp > 1 else None, sp_degree=sp)
+    kw = dict(n_kv_heads=NKV, use_flash=True, rope_theta=10000.0,
+              sp_axis="sp" if sp > 1 else None, sp_degree=sp)
+    if E:
+        fns, specs = make_moe_tp_fns(NH, mp, num_experts=E,
+                                     top_k=c["top_k"], **kw)
+    else:
+        fns, specs = make_llama_tp_fns(NH, mp, **kw)
 
     KV = H // NH * NKV
     sds = jax.ShapeDtypeStruct
     blk = {"ln1": sds((H,), jnp.bfloat16), "ln2": sds((H,), jnp.bfloat16),
            "wq": sds((H, H), jnp.bfloat16), "wk": sds((H, KV), jnp.bfloat16),
-           "wv": sds((H, KV), jnp.bfloat16), "wo": sds((H, H), jnp.bfloat16),
-           "wg": sds((H, F), jnp.bfloat16), "wu": sds((H, F), jnp.bfloat16),
-           "wd": sds((F, H), jnp.bfloat16)}
+           "wv": sds((H, KV), jnp.bfloat16), "wo": sds((H, H), jnp.bfloat16)}
+    if E:
+        blk.update({"w_gate": sds((H, E), jnp.bfloat16),
+                    "we_g": sds((E, H, F), jnp.bfloat16),
+                    "we_u": sds((E, H, F), jnp.bfloat16),
+                    "we_d": sds((E, F, H), jnp.bfloat16)})
+        ffn_params = E * 3 * H * F + H * E
+    else:
+        blk.update({"wg": sds((H, F), jnp.bfloat16),
+                    "wu": sds((H, F), jnp.bfloat16),
+                    "wd": sds((F, H), jnp.bfloat16)})
+        ffn_params = 3 * H * F
     blocks = [blk] * L
     embed = {"table": sds((V, H), jnp.bfloat16)}
     head = {"wo": sds((H, V), jnp.bfloat16)}
-    n_params = (L * (2 * H + 2 * H * H + 2 * H * KV + 3 * H * F)
+    n_params = (L * (2 * H + 2 * H * H + 2 * H * KV + ffn_params)
                 + 2 * V * H)
     print(f"[{name}] {n_params/1e9:.2f}B params, mesh dp={dp} pp={pp} "
           f"sharding={sharding} mp={mp} sp={sp} seq={S} "
@@ -97,14 +120,16 @@ def run(name):
     if sharding > 1:
         assert "sharding" in str(s_sh["m"]["blocks"]["wq"].spec), \
             "ZeRO-1: moments must shard over 'sharding'"
-    tag = f"tp{mp}×pp{pp}×zero1" + (f"×sp{sp}" if sp > 1 else "")
+    tag = f"tp{mp}×pp{pp}×zero1" + (f"×sp{sp}" if sp > 1 else "") \
+        + (f"×ep{mp}({E}experts)" if E else "")
     print(f"[{name}] hybrid {tag} compile-check OK", flush=True)
 
 
 def main(which="all"):
     names = list(CONFIGS) if which == "all" else [which]
-    n_max = max(CONFIGS[n][6] * CONFIGS[n][7] * CONFIGS[n][8]
-                * CONFIGS[n][9] * CONFIGS[n][10] for n in names)
+    n_max = max(CONFIGS[n]["dp"] * CONFIGS[n]["pp"]
+                * CONFIGS[n]["sharding"] * CONFIGS[n]["mp"]
+                * CONFIGS[n]["sp"] for n in names)
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)

@@ -59,17 +59,23 @@ def ring_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None):
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    # GQA: permute the RAW kv shards (ICI bytes stay at the kv-head
+    # size); repeat to the query head count only inside each step
+    rep = h // k.shape[1]
+    assert h % k.shape[1] == 0, (h, k.shape[1])
     perm = [(i, (i + 1) % n) for i in range(n)]  # kv travels to next rank
 
     def step(carry, i):
         (k_i, v_i), o_run, lse_run = carry
         src = (my - i) % n  # rank where the held kv block originated
+        k_r = jnp.repeat(k_i, rep, axis=1) if rep > 1 else k_i
+        v_r = jnp.repeat(v_i, rep, axis=1) if rep > 1 else v_i
 
         def full(_):
-            return flash_attention_with_lse(q, k_i, v_i, sm_scale, False)
+            return flash_attention_with_lse(q, k_r, v_r, sm_scale, False)
 
         def diag(_):
-            return flash_attention_with_lse(q, k_i, v_i, sm_scale, True)
+            return flash_attention_with_lse(q, k_r, v_r, sm_scale, True)
 
         def masked(_):
             return (jnp.zeros((b, h, sq, d), q.dtype),

@@ -35,7 +35,8 @@ from .api import zero_spec
 from .mesh import HybridMesh, P
 from .pp_1f1b import build_1f1b_train_step
 
-__all__ = ["make_llama_tp_fns", "init_llama_tp_params",
+__all__ = ["make_llama_tp_fns", "make_tied_tp_lm_fns", "make_moe_tp_fns",
+           "init_llama_tp_params", "init_moe_tp_params",
            "build_hybrid_train_step"]
 
 
@@ -131,8 +132,8 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
     # closes a row-parallel region. With these, all param grads — including
     # replicated ln weights — come out full and mp-identical.
 
-    def block_fn(p, x):
-        # x [mb, s, h] replicated over mp (s = local shard under sp)
+    def attn_part(p, x):
+        # column-parallel attention: x [mb, s, h] -> residual added ctx
         mb, s, h = x.shape
         hn = c_identity(_rms_norm(x, p["ln1"], eps), mp_axis)
         q = (hn @ p["wq"]).reshape(mb, s, nh_local, -1)
@@ -150,7 +151,10 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
             else:
                 q = rope_mod.apply_rotary(q, cos, sin)
                 k = rope_mod.apply_rotary(k, cos, sin)
-        if nkv_local != nh_local:
+        if nkv_local != nh_local and not sp_axis:
+            # GQA repeat for the local attention paths; under sp the ring
+            # permutes the RAW kv shards and repeats per step (ICI bytes
+            # stay at the GQA size)
             rep = nh_local // nkv_local
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
@@ -176,11 +180,17 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
                                   -1).astype(x.dtype)
             ctx = jnp.einsum("bnqk,bknd->bqnd", attn, v).reshape(mb, s, -1)
         # row-parallel out proj: partial sums -> psum over mp
-        x = x + mp_allreduce(ctx @ p["wo"], mp_axis)
+        return x + mp_allreduce(ctx @ p["wo"], mp_axis)
+
+    def block_fn(p, x):
+        x = attn_part(p, x)
         hn = c_identity(_rms_norm(x, p["ln2"], eps), mp_axis)
         up = jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])
         x = x + mp_allreduce(up @ p["wd"], mp_axis)
         return x
+
+    block_fn._attn_part = attn_part   # shared by the MoE factory
+    block_fn._sp_axis = sp_axis       # builder asserts seq_axis matches
 
     def embed_fn(p, ids):
         return _vocab_parallel_embed(p["table"], ids, mp_axis)
@@ -221,6 +231,98 @@ def make_tied_tp_lm_fns(n_heads, mp_degree, causal=True, eps=1e-5,
         return _vocab_parallel_ce(lg, labels, mp_axis)
 
     return (block_fn, embed_fn, head_loss_fn), block_specs
+
+
+def make_moe_tp_fns(n_heads, mp_degree, num_experts, top_k=2,
+                    causal=True, eps=1e-5, mp_axis="mp", n_kv_heads=None,
+                    use_flash=False, rope_theta=None, sp_axis=None,
+                    sp_degree=1):
+    """MoE hybrid block: TP attention + EXPERT-PARALLEL SwiGLU MoE FFN
+    (reference Mixtral/DeepSeek-MoE under fleet EP, moe/layer.py). The
+    expert banks shard over the mp axis (expert dim): each rank computes
+    its E/mp experts' contributions for every token (dense GShard-style
+    dispatch on the MXU, no capacity drops) and the combine psums over
+    mp — EP rides the same axis/collectives as TP, composing with
+    pp/sharding/sp like the dense block. The gate weight is replicated
+    with a c_identity boundary so its grad psums to full.
+
+    Params per block: llama attention tensors + w_gate [h, E] and expert
+    banks we_g/we_u [E, h, f], we_d [E, f, h] (sharded P("mp") on dim 0).
+    """
+    assert num_experts % mp_degree == 0, (num_experts, mp_degree)
+    e_local = num_experts // mp_degree
+    (dense_block, embed_fn, head_loss_fn), (dense_specs, embed_specs,
+                                            head_specs) = \
+        make_llama_tp_fns(n_heads, mp_degree, causal=causal, eps=eps,
+                          mp_axis=mp_axis, n_kv_heads=n_kv_heads,
+                          use_flash=use_flash, rope_theta=rope_theta,
+                          sp_axis=sp_axis, sp_degree=sp_degree)
+    attn_part = dense_block._attn_part
+    from .mp_ops import c_identity, mp_allreduce
+
+    def block_fn(p, x):
+        x = attn_part(p, x)
+        mb, s, h = x.shape
+        hn = c_identity(_rms_norm(x, p["ln2"], eps), mp_axis)
+        # gate: replicated weight, identical logits on every rank; its
+        # grad contributions are per-rank partial (local experts only),
+        # so the weight itself gets a c_identity psum boundary
+        logits = hn @ c_identity(p["w_gate"], mp_axis)   # [mb, s, E]
+        topv, topi = jax.lax.top_k(logits, top_k)
+        probs = jax.nn.softmax(topv.astype(jnp.float32), -1)
+        # dense combine weights [mb, s, E]
+        oh = jax.nn.one_hot(topi, num_experts, dtype=jnp.float32)
+        comb = (oh * probs[..., None]).sum(-2)
+        # local experts: rank i owns [i*e_local, (i+1)*e_local)
+        i = jax.lax.axis_index(mp_axis)
+        w_local = jax.lax.dynamic_slice_in_dim(
+            comb, i * e_local, e_local, 2)               # [mb, s, E/mp]
+        up = jnp.einsum("bsh,ehf->ebsf", hn, p["we_g"])
+        up = jax.nn.silu(up) * jnp.einsum("bsh,ehf->ebsf", hn, p["we_u"])
+        down = jnp.einsum("ebsf,efh->ebsh", up, p["we_d"])
+        y_local = jnp.einsum("ebsh,bse->bsh",
+                             down.astype(jnp.float32),
+                             w_local).astype(x.dtype)
+        return x + mp_allreduce(y_local, mp_axis)
+
+    block_fn._sp_axis = sp_axis       # builder asserts seq_axis matches
+
+    block_specs = dict(dense_specs)
+    for k in ("wg", "wu", "wd"):
+        block_specs.pop(k, None)
+    block_specs.update({
+        "w_gate": P(),
+        "we_g": P("mp"), "we_u": P("mp"), "we_d": P("mp"),
+    })
+    return ((block_fn, embed_fn, head_loss_fn),
+            (block_specs, embed_specs, head_specs))
+
+
+def init_moe_tp_params(n_layers, hidden, ffn, vocab, num_experts,
+                       rng=None, dtype=np.float32, n_heads=None,
+                       n_kv_heads=None):
+    """FULL parameter trees for make_moe_tp_fns; GQA shrinks k/v like
+    init_llama_tp_params."""
+    rng = rng or np.random.RandomState(0)
+    sd = 0.02
+    kv_dim = hidden if not (n_heads and n_kv_heads) \
+        else hidden // n_heads * n_kv_heads
+
+    def w(*shape):
+        return jnp.asarray(rng.randn(*shape).astype(dtype) * sd)
+
+    blocks = [{
+        "ln1": jnp.ones((hidden,), dtype), "ln2": jnp.ones((hidden,), dtype),
+        "wq": w(hidden, hidden), "wk": w(hidden, kv_dim),
+        "wv": w(hidden, kv_dim), "wo": w(hidden, hidden),
+        "w_gate": w(hidden, num_experts),
+        "we_g": w(num_experts, hidden, ffn),
+        "we_u": w(num_experts, hidden, ffn),
+        "we_d": w(num_experts, ffn, hidden),
+    } for _ in range(n_layers)]
+    embed = {"table": w(vocab, hidden)}
+    head = {"wo": w(hidden, vocab)}
+    return blocks, embed, head
 
 
 def init_llama_tp_params(n_layers, hidden, ffn, vocab, rng=None,

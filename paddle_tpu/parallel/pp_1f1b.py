@@ -506,6 +506,14 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
     # across pipeline roles — auto-enable the uniform tick under seq_axis
     uniform = (uniform_collectives if uniform_collectives is not None
                else seq_axis is not None)
+    # seq_axis and the block fns' sp wiring MUST agree: sequence-sharded
+    # inputs into non-ring attention would silently train a wrong model
+    fn_sp = getattr(block_fn, "_sp_axis", "unknown")
+    if fn_sp != "unknown" and fn_sp != seq_axis:
+        raise ValueError(
+            f"seq_axis={seq_axis!r} but the block fns were built with "
+            f"sp_axis={fn_sp!r} (make_llama_tp_fns/make_moe_tp_fns "
+            "sp_axis must match the builder's seq_axis)")
     data_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
     mean_axes = tuple(ax for ax in data_axes if mesh.degree(ax) > 1)
     # batch over the batch axes; with seq_axis, the SEQUENCE dim shards
