@@ -359,7 +359,8 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
                             head_param_specs=None, zero_stage=1,
                             interleave=1, block_weights=None,
                             remat_block=True, donate=True,
-                            tie_embed_head=False, seq_axis=None):
+                            tie_embed_head=False, seq_axis=None,
+                            offload=False):
     """ONE jitted train step composing mp × pp × sharding × dp.
 
     Returns (step_fn, params, opt_state, (p_shard, s_shard)) where
@@ -460,6 +461,36 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         in_shardings=(p_shard, s_shard, None, None, None, None),
         out_shardings=(NamedSharding(mesh.mesh, P()), p_shard, s_shard),
         donate_argnums=(0, 1) if donate else ())
+
+    if offload and not abstract:
+        # ZeRO host offload for the hybrid step (same contract as
+        # parallel_train_step): between steps HBM holds no optimizer
+        # state — the wrapper streams it pinned_host <-> device around
+        # the jitted update
+        s_host = jax.tree_util.tree_map(
+            lambda leaf, sh: (sh.with_memory_kind("pinned_host")
+                              if getattr(leaf, "ndim", 0) >= 1 else sh),
+            opt_state, s_shard,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        opt_state = jax.tree_util.tree_map(
+            lambda leaf, sh: jax.device_put(leaf, sh), opt_state, s_host,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+
+        def step_fn(params, opt_state, ids, labels, step_i):
+            lr = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
+            opt_state = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.device_put(leaf, sh), opt_state,
+                s_shard, is_leaf=lambda x: isinstance(x, jax.Array))
+            loss, new_p, new_s = jit_step(
+                params, opt_state, ids, labels,
+                jnp.asarray(step_i, jnp.int32), lr)
+            new_s = jax.tree_util.tree_map(
+                lambda leaf, sh: jax.device_put(leaf, sh), new_s, s_host,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+            return loss, new_p, new_s
+
+        step_fn._jit = jit_step
+        return step_fn, params, opt_state, (p_shard, s_host)
 
     def step_fn(params, opt_state, ids, labels, step_i):
         lr = jnp.asarray(float(optimizer.get_lr()), jnp.float32)
