@@ -37,7 +37,7 @@ from .pp_1f1b import build_1f1b_train_step
 
 __all__ = ["make_llama_tp_fns", "make_tied_tp_lm_fns", "make_moe_tp_fns",
            "init_llama_tp_params", "init_moe_tp_params",
-           "build_hybrid_train_step"]
+           "build_hybrid_train_step", "unstack_blocks", "restack_blocks"]
 
 
 # --------------------------------------------------- mp-aware model fns
@@ -347,6 +347,41 @@ def init_llama_tp_params(n_layers, hidden, ffn, vocab, rng=None,
     embed = {"table": w(vocab, hidden)}
     head = {"wo": w(hidden, vocab)}
     return blocks, embed, head
+
+
+# ------------------------------------------- checkpoint mesh-change
+
+
+def unstack_blocks(stacked, n_layers, pp_degree, interleave=1,
+                   block_weights=None):
+    """Stage-stacked block params [v, S, C, ...] -> canonical per-layer
+    list (the mesh-independent checkpoint layout; reference
+    auto_parallel/converter.py re-slices by layer the same way)."""
+    from .pp_1f1b import segment_counts
+    counts, starts = segment_counts(n_layers, pp_degree * interleave,
+                                    block_weights)
+    S = pp_degree
+    out = [None] * n_layers
+    for vs in range(pp_degree * interleave):
+        v_idx, s_idx = vs // S, vs % S
+        for j in range(int(counts[vs])):
+            out[int(starts[vs]) + j] = {
+                n: np.asarray(a[v_idx, s_idx, j])
+                for n, a in stacked.items()}
+    return out
+
+
+def restack_blocks(blocks_list, mesh, interleave=1, block_weights=None):
+    """Canonical per-layer list -> [v, S, C, ...] stacks sharded for
+    THIS mesh's pp degree — restoring a checkpoint onto a different
+    pipeline configuration (pp2 -> pp4 etc.)."""
+    from .pp_1f1b import _stack_blocks, segment_counts
+    S = mesh.degree("pp")
+    VS = S * interleave
+    counts, starts = segment_counts(len(blocks_list), VS, block_weights)
+    stacked_flat, C = _stack_blocks(blocks_list, VS, counts, starts)
+    return {n: a.reshape((interleave, S, C) + a.shape[2:])
+            for n, a in stacked_flat.items()}
 
 
 # --------------------------------------------------- the combined step

@@ -594,3 +594,55 @@ def test_hybrid_offload_keeps_state_on_host():
                 == "pinned_host"
         losses[off] = (float(l1), float(l2))
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+
+def test_hybrid_checkpoint_restacks_onto_different_pp():
+    """Mesh-change restore for the hybrid step (reference
+    auto_parallel/converter semantics): train on pp2, unstack to the
+    canonical per-layer layout, restack onto pp4 — losses and grads
+    carry over exactly. Optimizer moments restack with the same
+    helpers (same tree layout as params)."""
+    from paddle_tpu.parallel.hybrid import restack_blocks, unstack_blocks
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    Lc = 8
+    blocks, embed, head = init_llama_tp_params(
+        Lc, H, F, V, rng=np.random.RandomState(111))
+    rng = np.random.RandomState(112)
+    ids = jnp.asarray(rng.randint(0, V, size=(8, S)).astype(np.int32))
+
+    fns, specs = make_llama_tp_fns(NH, 2)
+    kw = dict(block_param_specs=specs[0], embed_param_specs=specs[1],
+              head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+
+    mesh2 = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    g2, (st2, e2, h2, _) = build_1f1b_train_step(
+        *fns, blocks, embed, head, mesh2, num_micro=4, **kw)
+    loss2, (db2, _d, _h) = jax.jit(g2)(st2, e2, h2, ids, ids)
+
+    # checkpoint: canonical layout from the pp2 stacks
+    canon = unstack_blocks(st2, Lc, pp_degree=2)
+    for layer in range(Lc):        # canonical layout == original params
+        for nme in ("wq", "ln1"):
+            np.testing.assert_array_equal(canon[layer][nme],
+                                          np.asarray(blocks[layer][nme]))
+
+    # restore onto pp4 x mp2
+    mesh4 = dist.init_mesh(dp=1, pp=4, sharding=1, mp=2)
+    g4, (st4, e4, h4, _) = build_1f1b_train_step(
+        *fns, canon, embed, head, mesh4, num_micro=4,
+        block_param_specs=specs[0], embed_param_specs=specs[1],
+        head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+    # restack_blocks produces the same stacks the builder makes
+    restacked = restack_blocks(canon, mesh4)
+    for nme in st4:
+        np.testing.assert_array_equal(np.asarray(restacked[nme]),
+                                      np.asarray(st4[nme]))
+    loss4, (db4, _d4, _h4) = jax.jit(g4)(st4, e4, h4, ids, ids)
+    np.testing.assert_allclose(float(loss4), float(loss2), rtol=1e-5)
+    # grads agree layer-by-layer across the two pipeline layouts
+    d2 = unstack_blocks(db2, Lc, pp_degree=2)
+    d4 = unstack_blocks(db4, Lc, pp_degree=4)
+    for layer in (0, 3, 7):
+        np.testing.assert_allclose(d4[layer]["wq"], d2[layer]["wq"],
+                                   rtol=1e-4, atol=1e-7,
+                                   err_msg=f"layer {layer}")
