@@ -39,7 +39,7 @@ def __getattr__(name):
 from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401,E402
                          UserDefinedRoleMaker, Role)
 
-from . import stream  # noqa: F401,E402
+from . import fleet_executor, stream  # noqa: F401,E402
 from .spawn import (CountFilterEntry, InMemoryDataset,  # noqa: F401,E402
                     ParallelMode, ProbabilityEntry, QueueDataset,
                     ShowClickEntry, spawn, split)
