@@ -146,6 +146,75 @@ class GPTForCausalLM(nn.Layer):
         lb = labels[:, 1:]
         return F.cross_entropy(lg, lb)
 
+    def pipeline_decompose(self):
+        """Pure fns + param trees for the 1F1B/hybrid builders, WITH the
+        tied lm head (reference SharedLayerDesc GPT demo): the embedding
+        table is the shared weight, so the builder gets
+        tie_embed_head=True and stores it pp/mp-sharded; wpe and the
+        final LN ride along as replicated extras.
+
+        Returns ((block_fn, embed_fn, head_loss_fn),
+                 (blocks, embed, head), {"tie_embed_head": True}).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import unwrap
+        from ..jit import functional_call
+        if self.cfg.tensor_parallel:
+            raise NotImplementedError(
+                "pipeline_decompose targets the non-TP module; for mp×pp "
+                "use parallel.hybrid factories")
+        proto = self.gpt.blocks[0]
+        blocks = [dict(blk.raw_params()) for blk in self.gpt.blocks]
+        embed = {"table": unwrap(self.gpt.wte.weight),
+                 "wpe": unwrap(self.gpt.wpe.weight)}
+        head = {"ln_g": unwrap(self.gpt.ln_f.weight),
+                "ln_b": unwrap(self.gpt.ln_f.bias)}
+        eps = self.cfg.layer_norm_eps
+
+        def block_fn(p, x):
+            return functional_call(proto, p, x)
+
+        def embed_fn(p, ids):
+            s = ids.shape[-1]
+            return p["table"][ids] + p["wpe"][:s][None]
+
+        def head_loss_fn(p, hidden, labels):
+            mu = hidden.mean(-1, keepdims=True)
+            var = jnp.var(hidden.astype(jnp.float32), -1, keepdims=True)
+            h = ((hidden - mu) * jax.lax.rsqrt(var + eps)
+                 ) * p["ln_g"] + p["ln_b"]
+            lg = (h @ p["table"].T).astype(jnp.float32)[:, :-1]
+            logp = jax.nn.log_softmax(lg, -1)
+            return -jnp.take_along_axis(
+                logp, labels[:, 1:, None], -1).mean()
+
+        return ((block_fn, embed_fn, head_loss_fn),
+                (blocks, embed, head), {"tie_embed_head": True})
+
+    def pipeline_recompose(self, params, layout):
+        """Inverse of pipeline_decompose + stacking: write trained
+        stage-stacked params back into this module (the tied table
+        writes once — lm_head_weight aliases wte.weight)."""
+        counts, starts, S, v = layout
+        for vs in range(S * v):
+            v_idx, s_idx = vs // S, vs % S
+            for j in range(int(counts[vs])):
+                layer = self.gpt.blocks[int(starts[vs]) + j]
+                layer.load_raw_params(
+                    {n: a[v_idx, s_idx, j]
+                     for n, a in params["blocks"].items()})
+        import numpy as _np
+        self.gpt.wte.weight._replace_value(
+            _np.asarray(params["embed"]["table"]))
+        self.gpt.wpe.weight._replace_value(
+            _np.asarray(params["embed"]["wpe"]))
+        self.gpt.ln_f.weight._replace_value(
+            _np.asarray(params["head"]["ln_g"]))
+        self.gpt.ln_f.bias._replace_value(
+            _np.asarray(params["head"]["ln_b"]))
+
 
 def gpt2_345m(**kw):
     return GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,

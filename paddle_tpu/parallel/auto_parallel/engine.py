@@ -165,13 +165,15 @@ class Engine:
                 raise NotImplementedError(
                     "pipeline parallelism needs an even multi-device mesh")
             mesh = init_mesh(dp=n // pp, pp=pp)
-        fns, trees = self._model.pipeline_decompose()
+        out = self._model.pipeline_decompose()
+        fns, trees = out[0], out[1]
+        opts = out[2] if len(out) > 2 else {}
         micro = max(1, int(strat.pipeline.accumulate_steps))
         with mesh:
             step_fn, self._params, self._opt_state, self._shardings = \
                 build_hybrid_train_step(
                     *fns, *trees, mesh, self._optimizer, num_micro=micro,
-                    zero_stage=zero)
+                    zero_stage=zero, **opts)
         from ..pp_1f1b import segment_counts
         S = mesh.degree("pp")
         counts, starts = segment_counts(len(trees[0]), S)

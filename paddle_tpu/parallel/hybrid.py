@@ -420,9 +420,13 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
     params = {"blocks": stacked, "embed": emb_p, "head": head_p}
     if tie_embed_head:
         # the 1F1B builder owns the tied layout — read it back (same
-        # pattern as the "blocks" line below)
-        embed_specs_eff = {"table": emb_p["table"].sharding.spec}
-        head_specs_eff = {}
+        # pattern as the "blocks" line below); extras stay replicated
+        embed_specs_eff = {
+            n: (emb_p["table"].sharding.spec if n == "table"
+                else (embed_param_specs or {}).get(n, P()))
+            for n in emb_p}
+        head_specs_eff = {n: (head_param_specs or {}).get(n, P())
+                          for n in head_p}
     else:
         embed_specs_eff = {n: (embed_param_specs or {}).get(n, P())
                            for n in emb_p}

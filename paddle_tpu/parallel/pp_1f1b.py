@@ -468,10 +468,11 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                        sharding=NamedSharding(mesh.mesh, blocks_spec[n]))
                    for n, a in stacked.items()}
     if tie_embed_head:
-        assert not head_params, \
-            "tie_embed_head: the head IS embed^T; pass head_params={}"
-        assert set(embed_params) == {"table"}, \
-            "tie_embed_head expects embed_params={'table': [V, h]}"
+        assert "table" not in head_params, \
+            "tie_embed_head: the head reuses embed's table; extra " \
+            "replicated head params (final LN, ...) are fine"
+        assert "table" in embed_params, \
+            "tie_embed_head expects embed_params['table'] = [V, h]"
         vocab = embed_params["table"].shape[0]
         mp_deg = mesh.degree("mp")
         assert vocab % (S * mp_deg) == 0, (vocab, S, mp_deg)
@@ -483,19 +484,23 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                 "the full table — use parallel.hybrid.make_tied_tp_lm_fns")
         # mp-MAJOR row sharding: gathering over "pp" then yields each mp
         # rank its CONTIGUOUS vocab-parallel slice [V/mp, h] — tied TP
-        # embedding/head compose for free (mp=1 degenerates to pp-only)
+        # embedding/head compose for free (mp=1 degenerates to pp-only).
+        # Non-table params (positional embeddings, final LN, ...) stay
+        # replicated alongside.
         tied_spec = P(("mp", "pp"), None)
-        embed_spec = {"table": tied_spec}
-        head_spec = {}
-        if isinstance(embed_params["table"], jax.ShapeDtypeStruct):
-            t = embed_params["table"]
-            embed_params = {"table": jax.ShapeDtypeStruct(
+        embed_spec = {n: (tied_spec if n == "table"
+                          else (embed_param_specs or {}).get(n, P()))
+                      for n in embed_params}
+        head_spec = {n: (head_param_specs or {}).get(n, P())
+                     for n in head_params}
+        t = embed_params["table"]
+        if isinstance(t, jax.ShapeDtypeStruct):
+            embed_params = dict(embed_params, table=jax.ShapeDtypeStruct(
                 t.shape, t.dtype,
-                sharding=NamedSharding(mesh.mesh, tied_spec))}
+                sharding=NamedSharding(mesh.mesh, tied_spec)))
         else:
-            embed_params = {"table": jax.device_put(
-                jnp.asarray(embed_params["table"]),
-                NamedSharding(mesh.mesh, tied_spec))}
+            embed_params = dict(embed_params, table=jax.device_put(
+                jnp.asarray(t), NamedSharding(mesh.mesh, tied_spec)))
     else:
         embed_spec = {n: (embed_param_specs or {}).get(n, P())
                       for n in embed_params}
@@ -530,11 +535,12 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         if tie_embed_head:
             # gather the pp-sharded table ONCE, outside the tick scan
             # (collectives inside device-varying tick roles would not be
-            # uniform); both ends of the model use the gathered copy
+            # uniform); both ends of the model use the gathered copy,
+            # plus their own replicated extras
             table_full = jax.lax.all_gather(
                 embed["table"], "pp", axis=0, tiled=True)
-            embed_in = {"table": table_full}
-            head_in = {"table": table_full}
+            embed_in = dict(embed, table=table_full)
+            head_in = dict(head, table=table_full)
         else:
             embed_in, head_in = embed, head
         h = jax.eval_shape(lambda e: embed_fn(e, ids_micro[0]),
@@ -546,12 +552,13 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
             uniform_collectives=uniform)
         if tie_embed_head:
             # d_emb/d_head are already psum'd over pp -> global [V, h]
-            # sums; tie them and keep only this stage's vocab slice
+            # sums; tie them and keep only this stage's vocab slice.
+            # Extras (positional embeds, final LN) keep their own grads.
             vl = embed["table"].shape[0]
             d_tab = d_emb["table"] + d_head["table"]
-            d_emb = {"table": jax.lax.dynamic_slice_in_dim(
-                d_tab, i_dev * vl, vl, 0)}
-            d_head = {}
+            d_emb = dict(d_emb, table=jax.lax.dynamic_slice_in_dim(
+                d_tab, i_dev * vl, vl, 0))
+            d_head = {n: g_ for n, g_ in d_head.items() if n != "table"}
         # average over data replicas (dp and, in ZeRO hybrids, "sharding")
         if mean_axes:
             loss = jax.lax.pmean(loss, mean_axes)

@@ -168,3 +168,37 @@ def test_dataset_shards_raises():
     eng, _ = _engine(strat)
     with pytest.raises(NotImplementedError):
         eng._prepare()
+
+
+def test_gpt_tied_pipeline_matches_eager():
+    """GPT through the Engine pipeline keeps its WEIGHT TYING (the
+    reference SharedLayerDesc GPT demo): the builder stores the shared
+    table pp-sharded and the pipeline loss matches the eager model."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+    dist.init_mesh(dp=4, pp=2)
+    cfg = gpt2_tiny(dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    strat = Strategy()
+    strat.pipeline.enable = True
+    strat.pipeline.accumulate_steps = 2
+    eng = Engine(model=model, loss=model.loss,
+                 optimizer=pt.optimizer.AdamW(
+                     learning_rate=1e-4, parameters=model.parameters()),
+                 strategy=strat)
+    eng._prepare()
+    assert eng._params["head"].keys() == {"ln_g", "ln_b"}, \
+        "tied pipeline must carry no separate lm head weight"
+    assert "pp" in str(eng._shardings[0]["embed"]["table"].spec)
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype("int32")
+    # eager reference BEFORE training (same weights)
+    ref = float(model.loss(model(pt.to_tensor(ids)),
+                           pt.to_tensor(ids)).numpy())
+    loss, new_p, new_s = eng._step_fn(
+        eng._params, eng._opt_state,
+        {"inputs": (ids,), "labels": (ids,)}, 1, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(loss), ref, rtol=2e-4)
+    # write-back keeps the tie: lm_head_weight IS wte.weight
+    eng._params = new_p
+    model.pipeline_recompose(eng._params, eng._pp_layout)
+    assert model.lm_head_weight is model.gpt.wte.weight
