@@ -22,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["HybridMesh", "init_mesh", "get_mesh", "set_mesh", "mesh_scope",
+__all__ = ["HybridMesh", "init_mesh", "init_multislice_mesh", "get_mesh", "set_mesh", "mesh_scope",
            "P", "NamedSharding"]
 
 _GLOBAL_MESH: "HybridMesh | None" = None
@@ -91,6 +91,41 @@ def init_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, ep=None, devices=None,
     hm = HybridMesh(mesh, dict(zip(names, shape)))
     if ep:
         hm.degrees["ep"] = ep
+    set_mesh(hm)
+    return hm
+
+
+def init_multislice_mesh(dcn_dp, dp=1, mp=1, pp=1, sharding=1, sp=1,
+                         devices=None) -> HybridMesh:
+    """Multi-slice mesh: ``dcn_dp`` data-parallel replicas ACROSS slices
+    (gradients ride DCN) with the full hybrid (dp×pp×sharding×sp×mp)
+    INSIDE each slice (everything else rides ICI) — the scaling-book
+    recipe and the reference's slice-aware dp placement.
+
+    Uses jax.experimental.mesh_utils.create_hybrid_device_mesh when the
+    runtime reports slice topology (real multi-slice TPU); otherwise
+    (single slice, CPU) falls back to a plain reshape with dcn_dp as the
+    outermost factor so the program is identical either way. The
+    returned mesh's leading "dp" axis has degree dcn_dp*dp; collective
+    layouts need no changes — XLA routes the slice-crossing portion of
+    the dp reductions over DCN.
+    """
+    devices = devices if devices is not None else jax.devices()
+    ici = (dp, pp, sharding, sp, mp)
+    want = int(np.prod(ici)) * dcn_dp
+    if want != len(devices):
+        raise ValueError(f"{want} devices needed, have {len(devices)}")
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (dp,) + ici[1:], (dcn_dp, 1, 1, 1, 1), devices=devices)
+    except Exception:
+        # no slice topology (CPU / single slice): outermost-major layout
+        arr = np.array(devices).reshape((dcn_dp * dp,) + ici[1:])
+    arr = np.asarray(arr).reshape((dcn_dp * dp,) + ici[1:])
+    mesh = Mesh(arr, AXES)
+    hm = HybridMesh(mesh, dict(zip(AXES, (dcn_dp * dp,) + ici[1:])))
+    hm.degrees["dcn_dp"] = dcn_dp
     set_mesh(hm)
     return hm
 

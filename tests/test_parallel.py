@@ -308,3 +308,21 @@ def test_dataparallel_scale_loss_and_no_sync():
         assert papi._SYNC_SUPPRESSED
         dist.fused_allreduce_gradients(layer.parameters())  # skipped
     assert not papi._SYNC_SUPPRESSED
+
+
+def test_multislice_mesh_dp_over_dcn():
+    """init_multislice_mesh: dcn_dp replicas outermost, full hybrid
+    inside each 'slice'; a dp-sharded train step runs unchanged."""
+    mesh = dist.init_mesh  # noqa: F841 (module imported below)
+    from paddle_tpu.parallel.mesh import init_multislice_mesh
+    hm = init_multislice_mesh(dcn_dp=2, dp=1, mp=2, sharding=2)
+    assert hm.degree("dp") == 2 and hm.degrees["dcn_dp"] == 2
+    assert hm.degree("mp") == 2 and hm.degree("sharding") == 2
+    # one psum over dp inside shard_map covers the DCN-crossing replicas
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    x = jnp.arange(2.0).reshape(2, 1)
+    out = jax.shard_map(body, mesh=hm.mesh, in_specs=P("dp"),
+                        out_specs=P("dp"), check_vma=False)(x)
+    np.testing.assert_allclose(np.asarray(out), [[1.0], [1.0]])
