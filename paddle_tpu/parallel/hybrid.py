@@ -395,7 +395,7 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
                             interleave=1, block_weights=None,
                             remat_block=True, donate=True,
                             tie_embed_head=False, seq_axis=None,
-                            offload=False):
+                            offload=False, grad_clip_norm=None):
     """ONE jitted train step composing mp × pp × sharding × dp.
 
     Returns (step_fn, params, opt_state, (p_shard, s_shard)) where
@@ -491,6 +491,12 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         loss, (d_blk, d_emb, d_head) = grad_fn(
             params["blocks"], params["embed"], params["head"], ids, labels)
         grads = {"blocks": d_blk, "embed": d_emb, "head": d_head}
+        if grad_clip_norm is not None:
+            # global-norm clip across ALL shards: the grads are GSPMD
+            # global arrays here, so the norm reduction spans pp/mp/
+            # sharding automatically
+            from ..nn.clip import clip_by_global_norm_tree
+            grads, _ = clip_by_global_norm_tree(grads, grad_clip_norm)
         new_p, new_s = update_fn(grads, params, opt_state, lr=lr,
                                  step=step_i)
         return loss, new_p, new_s
