@@ -98,7 +98,8 @@ def _rope_tables(head_dim, seq, theta):
 
 def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
                       mp_axis="mp", n_kv_heads=None, use_flash=False,
-                      rope_theta=None, sp_axis=None, sp_degree=1):
+                      rope_theta=None, sp_axis=None, sp_degree=1,
+                      sp_mode="ring"):
     """(block_fn, embed_fn, head_loss_fn) + param PartitionSpecs.
 
     All fns expect to run inside shard_map with axis ``mp_axis`` present;
@@ -123,6 +124,10 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
     nh_local = n_heads // mp_degree
     nkv_local = n_kv // mp_degree
     assert nh_local % nkv_local == 0, (nh_local, nkv_local)
+    if sp_axis and sp_mode == "ulysses":
+        assert nh_local % sp_degree == 0, \
+            f"ulysses splits heads: {nh_local} local heads must divide " \
+            f"by sp={sp_degree}"
     from .mp_ops import c_identity, mp_allreduce
 
     # Megatron-style autodiff boundaries (reference mp_ops.py _c_identity /
@@ -158,7 +163,22 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
             rep = nh_local // nkv_local
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        if sp_axis:
+        if sp_axis and sp_mode == "ulysses":
+            # DeepSpeed-Ulysses: all_to_all heads<->sequence, full flash
+            # attention locally over H/sp heads, all_to_all back. Needs
+            # local heads divisible by sp; GQA kv pre-repeated here (the
+            # head axis is what gets split)
+            from ..ops.pallas.ring_attention import ulysses_attention
+            if k.shape[2] != nh_local:     # shape-guarded: never double
+                rep = nh_local // k.shape[2]
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            ctx = ulysses_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), axis_name=sp_axis,
+                causal=causal, sm_scale=1.0 / np.sqrt(dh),
+            ).transpose(0, 2, 1, 3).reshape(mb, s, -1)
+        elif sp_axis:
             from ..ops.pallas.ring_attention import ring_attention
             ctx = ring_attention(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),

@@ -677,3 +677,32 @@ def test_hybrid_grad_clip_matches_sequential():
         for g in jax.tree_util.tree_leaves(ref_grads))))
     assert gnorm > clip, "pick a clip below the actual norm"
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+
+
+def test_hybrid_ulysses_sp_matches_ring():
+    """sp_mode='ulysses' (all_to_all heads<->sequence) inside the hybrid
+    pipeline equals the ring mode numerically — with GQA (1 kv head per
+    mp rank) to pin the kv-repeat guard."""
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(131), n_heads=NH,
+        n_kv_heads=2)
+    rng = np.random.RandomState(132)
+    ids = jnp.asarray(rng.randint(0, V, size=(4, 16)).astype(np.int32))
+    outs = {}
+    for mode in ("ring", "ulysses"):
+        mesh = dist.init_mesh(dp=1, pp=2, sharding=1, sp=2, mp=2)
+        fns, specs = make_llama_tp_fns(NH, 2, rope_theta=10000.0,
+                                       n_kv_heads=2, sp_axis="sp",
+                                       sp_degree=2, sp_mode=mode)
+        g, (st, ep, hp, _) = build_1f1b_train_step(
+            *fns, blocks, embed, head, mesh, num_micro=2,
+            block_param_specs=specs[0], embed_param_specs=specs[1],
+            head_param_specs=specs[2], batch_axes=("dp", "sharding"),
+            seq_axis="sp")
+        loss, (d_blk, _de, _dh) = jax.jit(g)(st, ep, hp, ids, ids)
+        outs[mode] = (float(loss), np.asarray(d_blk["wq"]))
+    np.testing.assert_allclose(outs["ulysses"][0], outs["ring"][0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs["ulysses"][1], outs["ring"][1],
+                               rtol=1e-3, atol=1e-6)
