@@ -576,7 +576,16 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         out_specs=out_specs, check_vma=False)
 
     def grad_fn(blocks, embed, head, ids, labels):
-        B = ids.shape[0]
+        B, seq = ids.shape[0], ids.shape[-1]
+        data_ways = int(np.prod([mesh.degree(a) for a in batch_axes]))
+        if B % (num_micro * data_ways):
+            raise ValueError(
+                f"batch {B} must divide by num_micro*|{batch_axes}| = "
+                f"{num_micro}*{data_ways}")
+        if seq_axis and seq % mesh.degree(seq_axis):
+            raise ValueError(
+                f"sequence {seq} must divide by the {seq_axis} degree "
+                f"{mesh.degree(seq_axis)}")
         mb = B // num_micro
         ids_micro = ids.reshape(num_micro, mb, -1)
         labels_micro = labels.reshape(num_micro, mb, -1)
