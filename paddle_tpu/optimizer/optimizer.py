@@ -172,6 +172,15 @@ class Optimizer:
     def set_state_dict(self, sd):
         self._step_count = sd.get("step", 0)
         if "state" in sd:
+            if getattr(self, "_multi_precision", False) \
+                    and isinstance(sd["state"], dict) \
+                    and "master" not in sd["state"]:
+                raise ValueError(
+                    "multi_precision=True but the checkpoint has no "
+                    "'master' tree (saved without multi_precision): "
+                    "silently training without fp32 masters would defeat "
+                    "the flag — resave with multi_precision or construct "
+                    "the optimizer without it")
             self._opt_state = sd["state"]
         if "LR_Scheduler" in sd and isinstance(self._lr, LRScheduler):
             self._lr.set_state_dict(sd["LR_Scheduler"])
@@ -260,13 +269,26 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._eps = epsilon
         self._decoupled_wd = False  # Adam: L2-regularization style
+        self._multi_precision = multi_precision
 
     def init_state(self, params):
-        return {"m": _zeros_tree(params), "v": _zeros_tree(params)}
+        st = {"m": _zeros_tree(params), "v": _zeros_tree(params)}
+        if self._multi_precision:
+            # fp32 MASTER weights for low-precision params (reference
+            # multi_precision adam: master copy accumulates updates the
+            # bf16/fp16 storage would round away); fp32 params keep a
+            # 0-size sentinel instead of a wasteful duplicate
+            st["master"] = jax.tree_util.tree_map(
+                lambda q: (q.astype(jnp.float32)
+                           if q.dtype != jnp.float32
+                           else jnp.zeros((0,), jnp.float32)), params)
+        return st
 
     def _update_leaf(self, g, p, state, lr, step, wd):
         g32 = g.astype(jnp.float32)
-        p32 = p.astype(jnp.float32)
+        master = state.get("master")
+        use_master = master is not None and master.size
+        p32 = master if use_master else p.astype(jnp.float32)
         if wd and not self._decoupled_wd:
             g32 = g32 + wd * p32
         m = self._beta1 * state["m"] + (1 - self._beta1) * g32
@@ -276,7 +298,11 @@ class Adam(Optimizer):
         upd = mhat / (jnp.sqrt(vhat) + self._eps)
         if wd and self._decoupled_wd:
             upd = upd + wd * p32
-        return (p32 - lr * upd).astype(p.dtype), {"m": m, "v": v}
+        new_p32 = p32 - lr * upd
+        out = {"m": m, "v": v}
+        if master is not None:
+            out["master"] = new_p32 if use_master else master
+        return new_p32.astype(p.dtype), out
 
 
 class AdamW(Adam):
@@ -287,7 +313,8 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip)
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._decoupled_wd = True
         self._apply_decay_param_fun = apply_decay_param_fun
 

@@ -63,6 +63,17 @@ def param_shardings(layer, mesh: HybridMesh, zero_stage=0):
     return out
 
 
+def state_leaf_spec(leaf, base_spec, mesh: HybridMesh, zero_stage=0):
+    """Spec for one optimizer-state leaf: mirrors the param spec, ZeRO-
+    shards it at stage 1-2, and replicates the 0-size master-weight
+    sentinels (fp32 params keep a (0,) placeholder in the master tree)."""
+    if getattr(leaf, "size", 1) == 0:
+        return P()
+    if zero_stage >= 1 and zero_stage < 3:
+        return zero_spec(tuple(leaf.shape), base_spec, mesh)
+    return base_spec
+
+
 def opt_state_shardings(state, params_shardings, mesh: HybridMesh,
                         zero_stage=0):
     """Optimizer state mirrors its param sharding; with stage>=1 it is
@@ -71,10 +82,10 @@ def opt_state_shardings(state, params_shardings, mesh: HybridMesh,
     for stname, tree in state.items():
         out[stname] = {}
         for name, leaf in tree.items():
-            base = params_shardings[name].spec
-            if zero_stage >= 1 and zero_stage < 3:
-                base = zero_spec(tuple(leaf.shape), base, mesh)
-            out[stname][name] = NamedSharding(mesh.mesh, base)
+            out[stname][name] = NamedSharding(
+                mesh.mesh,
+                state_leaf_spec(leaf, params_shardings[name].spec, mesh,
+                                zero_stage))
     return out
 
 

@@ -483,11 +483,12 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
         opt_state = init_fn(params)
 
+    from .api import state_leaf_spec
+
     def _state_sharding(leaf, path_spec):
-        sp = path_spec
-        if zero_stage >= 1 and zero_stage < 3:
-            sp = zero_spec(tuple(leaf.shape), sp, mesh)
-        return NamedSharding(mesh.mesh, sp)
+        return NamedSharding(mesh.mesh,
+                             state_leaf_spec(leaf, path_spec, mesh,
+                                             zero_stage))
 
     s_shard = {
         st: jax.tree_util.tree_map(
