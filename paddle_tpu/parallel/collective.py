@@ -461,6 +461,11 @@ def partial_allgather(tensor, nranks=None, rank_id=None, group=None):
                          f"size {world}")
 
     def fn(v):
+        if v.shape[0] % world != 0:
+            raise ValueError(
+                f"partial_allgather: leading dim {v.shape[0]} not "
+                f"divisible by nranks {world} — the tail rows would be "
+                f"silently dropped; pad the buffer")
         seg = v.shape[0] // world
         rid = jax.lax.axis_index(ax) if rank_id is None else rank_id
         mine = jax.lax.dynamic_slice_in_dim(v, rid * seg, seg, 0)
@@ -481,6 +486,11 @@ def partial_ppermute(tensor, perm, nranks=None, index=None, group=None):
     nranks = nranks or jax.lax.axis_size(ax)
 
     def fn(v):
+        if v.shape[0] % nranks != 0:
+            raise ValueError(
+                f"partial_ppermute: leading dim {v.shape[0]} not "
+                f"divisible by nranks {nranks} — the tail rows would be "
+                f"silently dropped; pad the buffer")
         seg = v.shape[0] // nranks
         idx = jax.lax.axis_index(ax) if index is None else index
         start = idx * seg

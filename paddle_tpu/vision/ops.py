@@ -188,14 +188,17 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     # sampling_ratio<=0: the reference phi kernel adapts the grid per ROI
     # (ceil(roi_h/pooled_h) x ceil(roi_w/pooled_w)). Grid sizes must be
     # static for XLA, so compute them host-side when the boxes are
-    # concrete; under tracing (jit over boxes) fall back to a fixed 2x2
-    # grid — a documented approximation, since data-dependent grid sizes
-    # cannot trace. sampling_ratio>0 needs no host pull at all.
+    # concrete; under jit tracing AND under the static-graph recorder
+    # fall back to a fixed 2x2 grid — a documented approximation, since
+    # data-dependent grid sizes cannot trace, and a recorded Program
+    # replays with fresh box feeds so record-time boxes must not bake
+    # the grid. sampling_ratio>0 needs no host pull at all.
     import jax.core as _jcore
+    from ..static.graph import in_static_build
     _bval = unwrap(boxes) if isinstance(boxes, Tensor) else boxes
     if sampling_ratio > 0:
         grids = [(sampling_ratio, sampling_ratio)] * batch_of_roi.size
-    elif isinstance(_bval, _jcore.Tracer):
+    elif isinstance(_bval, _jcore.Tracer) or in_static_build():
         grids = [(2, 2)] * batch_of_roi.size
     else:
         bnp = _np(boxes).astype(np.float64).reshape(-1, 4)
