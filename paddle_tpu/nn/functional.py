@@ -259,17 +259,24 @@ def alpha_dropout(x, p=0.5, training=True):
 
 @register("layer_norm")
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    # statistics in fp32 for low-precision inputs (reference phi
+    # layer_norm_kernel keeps fp32 mean/var under fp16/bf16 AMP): the
+    # BACKWARD divides by sigma^3 — for unit-scale-ish fp16 activations
+    # (var ~ 4e-4 at embedding init) that is ~6e4, right at fp16 max,
+    # and overflows to inf for smaller rows
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    low_prec = x.dtype in (jnp.float16, jnp.bfloat16)
+    xc = x.astype(jnp.float32) if low_prec else x
+    mean = jnp.mean(xc, axis=axes, keepdims=True)
+    var = jnp.var(xc, axis=axes, keepdims=True)
+    out = (xc - mean) * jax.lax.rsqrt(var + epsilon)
     if weight is not None:
-        out = out * weight
+        out = out * (weight.astype(out.dtype) if low_prec else weight)
     if bias is not None:
-        out = out + bias
-    return out
+        out = out + (bias.astype(out.dtype) if low_prec else bias)
+    return out.astype(x.dtype) if low_prec else out
 
 
 @register("rms_norm_ref")

@@ -83,7 +83,9 @@ class Optimizer:
                               for name, st in sub_state.items()}
                 np_, ns = self._update_leaf(
                     tree_g[k], tree_p[k], leaf_state, lr, step, wd_of[k])
-                new_p[k] = np_
+                # fp32 moments (see _zeros_tree) must not promote the
+                # stored param dtype through `p - lr * upd`
+                new_p[k] = np_.astype(tree_p[k].dtype)
                 for name, v in ns.items():
                     new_state[name][k] = v
             return new_p, new_state
@@ -211,7 +213,8 @@ class Optimizer:
                 np_, ns = self._update_leaf(
                     g, p, leaf_state, lr_, step,
                     self._weight_decay if m else 0.0)
-                new_p.append(np_)
+                # fp32 moments must not promote the stored param dtype
+                new_p.append(np_.astype(p.dtype))
                 new_leafstates.append(ns)
             out_state = {}
             for name in state:
@@ -224,7 +227,15 @@ class Optimizer:
 
 
 def _zeros_tree(params):
-    return jax.tree_util.tree_map(jnp.zeros_like, params)
+    # moments/velocities live in fp32 even for fp16/bf16 params
+    # (reference phi adam/momentum kernels under AMP): fp16 moments
+    # flush v ~ g^2 < 6e-8 to zero and mhat/(sqrt(0)+eps) explodes
+    def z(p):
+        dt = jnp.float32 if p.dtype in (jnp.float16, jnp.bfloat16) \
+            else p.dtype
+        return jnp.zeros(p.shape, dt)
+
+    return jax.tree_util.tree_map(z, params)
 
 
 class SGD(Optimizer):
@@ -353,8 +364,14 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def init_state(self, params):
-        return {"moment": jax.tree_util.tree_map(
-            lambda p: jnp.full_like(p, self._init_acc), params)}
+        # fp32 accumulator for low-precision params (same reasoning as
+        # _zeros_tree: fp16 flushes g^2 < 6e-8 to zero -> 1e6x updates)
+        def full(p):
+            dt = jnp.float32 if p.dtype in (jnp.float16, jnp.bfloat16) \
+                else p.dtype
+            return jnp.full(p.shape, self._init_acc, dt)
+
+        return {"moment": jax.tree_util.tree_map(full, params)}
 
     def _update_leaf(self, g, p, state, lr, step, wd):
         if wd:
