@@ -180,18 +180,28 @@ class GPTForCausalLM(nn.Layer):
             s = ids.shape[-1]
             return p["table"][ids] + p["wpe"][:s][None]
 
-        def head_loss_fn(p, hidden, labels):
+        def _final_ln(p, hidden):
             mu = hidden.mean(-1, keepdims=True)
             var = jnp.var(hidden.astype(jnp.float32), -1, keepdims=True)
-            h = ((hidden - mu) * jax.lax.rsqrt(var + eps)
-                 ) * p["ln_g"] + p["ln_b"]
-            lg = (h @ p["table"].T).astype(jnp.float32)[:, :-1]
+            return ((hidden - mu) * jax.lax.rsqrt(var + eps)
+                    ) * p["ln_g"] + p["ln_b"]
+
+        def head_loss_fn(p, hidden, labels):
+            lg = (_final_ln(p, hidden) @ p["table"].T
+                  ).astype(jnp.float32)[:, :-1]
             logp = jax.nn.log_softmax(lg, -1)
             return -jnp.take_along_axis(
                 logp, labels[:, 1:, None], -1).mean()
 
+        def head_out_fn(p, hidden, labels):
+            # Engine.predict through the pipeline: full-seq logits via
+            # the tied table (the builder injects p["table"] gathered)
+            return (_final_ln(p, hidden) @ p["table"].T
+                    ).astype(jnp.float32)
+
         return ((block_fn, embed_fn, head_loss_fn),
-                (blocks, embed, head), {"tie_embed_head": True})
+                (blocks, embed, head),
+                {"tie_embed_head": True, "head_out_fn": head_out_fn})
 
     def pipeline_recompose(self, params, layout):
         """Inverse of pipeline_decompose + stacking: write trained

@@ -208,17 +208,25 @@ class LlamaForCausalLM(nn.Layer):
         def embed_fn(p, ids):
             return p["table"][ids]
 
-        def head_loss_fn(p, hidden, labels):
+        def _final_norm(p, hidden):
             var = jnp.mean(jnp.square(hidden.astype(jnp.float32)), -1,
                            keepdims=True)
-            h = (hidden * jax.lax.rsqrt(var + eps).astype(hidden.dtype)
-                 ) * p["norm"]
-            lg = (h @ p["wo"]).astype(jnp.float32)[:, :-1]
+            return (hidden * jax.lax.rsqrt(var + eps).astype(hidden.dtype)
+                    ) * p["norm"]
+
+        def head_loss_fn(p, hidden, labels):
+            lg = (_final_norm(p, hidden) @ p["wo"]
+                  ).astype(jnp.float32)[:, :-1]
             logp = jax.nn.log_softmax(lg, -1)
             return -jnp.take_along_axis(
                 logp, labels[:, 1:, None], -1).mean()
 
-        return (block_fn, embed_fn, head_loss_fn), (blocks, embed, head)
+        def head_out_fn(p, hidden, labels):
+            # Engine.predict through the pipeline: full-seq logits
+            return (_final_norm(p, hidden) @ p["wo"]).astype(jnp.float32)
+
+        return ((block_fn, embed_fn, head_loss_fn),
+                (blocks, embed, head), {"head_out_fn": head_out_fn})
 
     def pipeline_recompose(self, params, layout):
         """Write trained stage-stacked pipeline params back into this

@@ -112,7 +112,8 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
                         zero_stage=0, remat=False, batch_spec=None,
                         donate=True, grad_clip_norm=None, offload=False,
                         loss_scale=None, grad_accum_steps=1,
-                        accum_avg=True):
+                        accum_avg=True, init_loss_scaling=None,
+                        scale_window=1000):
     """Build (step_fn, params, opt_state, shardings).
 
     step_fn(params, opt_state, batch, step_i, rng) -> (loss, params, state)
@@ -139,18 +140,27 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
     init_fn, update_fn = optimizer.functional()
     opt_state = init_fn(params)
     k_accum = int(grad_accum_steps)
-    if k_accum > 1:
-        opt_state = {"_opt": opt_state,
-                     "_accum": jax.tree_util.tree_map(
-                         lambda p: jnp.zeros(p.shape, jnp.float32), params)}
-    if k_accum > 1:
-        # accum buffers shard like optimizer state (param spec + ZeRO)
-        s_shard = {
-            "_opt": opt_state_shardings(opt_state["_opt"], p_shard, mesh,
-                                        zero_stage),
-            "_accum": opt_state_shardings(
-                {"a": opt_state["_accum"]}, p_shard, mesh, zero_stage)["a"],
-        }
+    dynamic_scale = loss_scale == "dynamic"
+    init_scale = float(init_loss_scaling or 2.0 ** 15)  # GradScaler init
+    if k_accum > 1 or dynamic_scale:
+        base_shard = opt_state_shardings(opt_state, p_shard, mesh,
+                                         zero_stage)
+        wrapped_state = {"_opt": opt_state}
+        s_shard = {"_opt": base_shard}
+        if k_accum > 1:
+            # accum buffers shard like optimizer state (param spec + ZeRO)
+            accum = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            wrapped_state["_accum"] = accum
+            s_shard["_accum"] = opt_state_shardings(
+                {"a": accum}, p_shard, mesh, zero_stage)["a"]
+        if dynamic_scale:
+            repl = NamedSharding(mesh.mesh, P())
+            wrapped_state["_scale"] = jnp.asarray(init_scale, jnp.float32)
+            wrapped_state["_growth"] = jnp.asarray(0, jnp.int32)
+            s_shard["_scale"] = repl
+            s_shard["_growth"] = repl
+        opt_state = wrapped_state
     else:
         s_shard = opt_state_shardings(opt_state, p_shard, mesh, zero_stage)
     s_host = None
@@ -169,10 +179,10 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
         is_leaf=lambda x: isinstance(x, jax.Array))
     bspec = batch_spec or P("dp")
 
-    def fwd(ps, batch, rng):
+    def fwd(ps, batch, rng, sc):
         out = functional_call(layer, ps, *batch["inputs"], rng=rng)
         l = loss_fn(out, *batch.get("labels", ()))
-        return l * loss_scale if loss_scale else l
+        return l * sc if sc is not None else l
 
     fwd_c = jax.checkpoint(fwd) if remat else fwd
 
@@ -186,35 +196,84 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
         batch = jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh.mesh, bspec)), batch)
-        loss, grads = jax.value_and_grad(fwd_c)(params, batch, rng)
-        if loss_scale:
-            loss = loss / loss_scale
+        wrapped = k_accum > 1 or dynamic_scale
+        inner = opt_state["_opt"] if wrapped else opt_state
+        if dynamic_scale:
+            sc = opt_state["_scale"]
+        elif loss_scale:
+            sc = jnp.asarray(loss_scale, jnp.float32)
+        else:
+            sc = None
+        loss, grads = jax.value_and_grad(fwd_c)(params, batch, rng, sc)
+        if sc is not None:
+            loss = loss / sc
             grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) / loss_scale).astype(
-                    g.dtype), grads)
+                lambda g: (g.astype(jnp.float32) / sc).astype(g.dtype),
+                grads)
+        finite = None
+        if dynamic_scale:
+            # reference DynamicLossScaler (amp/grad_scaler.py): inf/nan
+            # grads -> zero them, halve the scale, skip the update
+            import functools as _ft
+            finite = _ft.reduce(
+                jnp.logical_and,
+                [jnp.all(jnp.isfinite(g))
+                 for g in jax.tree_util.tree_leaves(grads)])
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
         if k_accum > 1:
             # GradientMerge: accumulate fp32; update only every k-th step
-            inner = opt_state["_opt"]
             acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(jnp.float32),
                 opt_state["_accum"], grads)
             apply = (step_i % k_accum == 0)
+            # feed the fp32 accumulator straight to the optimizer: a
+            # cast back to bf16/fp16 would re-round away the precision
+            # the fp32 buffer held (and fp16 can overflow k-step sums)
             eff = _clip(jax.tree_util.tree_map(
-                lambda a, g: ((a / k_accum) if accum_avg else a).astype(
-                    g.dtype), acc, grads))
+                lambda a: (a / k_accum) if accum_avg else a, acc))
             upd_i = jnp.maximum(step_i // k_accum, 1)
             upd_p, upd_s = update_fn(eff, params, inner, step=upd_i)
+            # fp32 eff grads must not promote the stored param or
+            # optimizer-state dtypes (Adam casts params back itself;
+            # SGD/Momentum would leak fp32 params, and a promoted inner
+            # state would double its memory and break checkpoint dtypes)
+            upd_p = jax.tree_util.tree_map(
+                lambda a, b: a.astype(b.dtype), upd_p, params)
+            upd_s = jax.tree_util.tree_map(
+                lambda a, b: a.astype(b.dtype), upd_s, inner)
             new_params = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(apply, a, b), upd_p, params)
             new_inner = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(apply, a, b), upd_s, inner)
             new_acc = jax.tree_util.tree_map(
                 lambda a: jnp.where(apply, jnp.zeros_like(a), a), acc)
-            return loss, new_params, {"_opt": new_inner, "_accum": new_acc}
-        grads = _clip(grads)
-        new_params, new_state = update_fn(grads, params, opt_state,
-                                          step=step_i)
-        return loss, new_params, new_state
+            out_state = {"_opt": new_inner, "_accum": new_acc}
+        else:
+            grads = _clip(grads)
+            upd_p, upd_s = update_fn(grads, params, inner, step=step_i)
+            if dynamic_scale:
+                upd_p = jax.tree_util.tree_map(
+                    lambda a, b: a.astype(b.dtype), upd_p, params)
+                upd_s = jax.tree_util.tree_map(
+                    lambda a, b: a.astype(b.dtype), upd_s, inner)
+                new_params = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), upd_p, params)
+                new_inner = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), upd_s, inner)
+                out_state = {"_opt": new_inner}
+            else:
+                return loss, upd_p, upd_s
+        if dynamic_scale:
+            growth = jnp.where(finite, opt_state["_growth"] + 1, 0)
+            grow_now = growth >= scale_window
+            new_scale = jnp.where(
+                finite, jnp.where(grow_now, sc * 2.0, sc),
+                jnp.maximum(sc * 0.5, 1.0))
+            out_state["_scale"] = jnp.minimum(new_scale,
+                                              jnp.float32(2.0 ** 24))
+            out_state["_growth"] = jnp.where(grow_now, 0, growth)
+        return loss, new_params, out_state
 
     out_shardings = (NamedSharding(mesh.mesh, P()),
                      p_shard,

@@ -77,6 +77,7 @@ class Engine:
         # O2-style dtype conversion; fp16 additionally gets static loss
         # scaling (GradScaler semantics) with grads unscaled pre-update
         loss_scale = None
+        init_scaling = None
         if strat.amp.enable:
             dtype = strat.amp.dtype
             if dtype not in ("bfloat16", "float16"):
@@ -85,8 +86,16 @@ class Engine:
                     "(bfloat16/float16)")
             self._model.astype(dtype)
             if dtype == "float16":
-                loss_scale = float(getattr(strat.amp,
-                                           "init_loss_scaling", 2 ** 15))
+                # reference GradScaler defaults to DYNAMIC scaling —
+                # the only robust choice for fp16's ±65504 range; a
+                # static init_loss_scaling is honored when dynamic is
+                # explicitly disabled
+                init_scaling = float(getattr(strat.amp,
+                                             "init_loss_scaling", 2 ** 15))
+                if getattr(strat.amp, "use_dynamic_loss_scaling", True):
+                    loss_scale = "dynamic"
+                else:
+                    loss_scale = init_scaling
         # ---- gradient merge post-pass (GradientMerge meta optimizer)
         k_steps = strat.gradient_merge.k_steps \
             if strat.gradient_merge.enable else 1
@@ -114,17 +123,6 @@ class Engine:
             return unwrap(out) if isinstance(out, Tensor) else out
 
         if strat.pipeline.enable:
-            # no inert switches: combos the pipeline builder does not yet
-            # carry through must refuse, not silently drop
-            if loss_scale is not None:
-                raise NotImplementedError(
-                    "strategy.amp fp16 loss scaling is not wired through "
-                    "the pipeline builder yet; use amp.dtype='bfloat16'")
-            if k_steps > 1:
-                raise NotImplementedError(
-                    "strategy.gradient_merge with pipeline.enable: use "
-                    "pipeline.accumulate_steps (micro-batching IS the "
-                    "accumulation in 1F1B)")
             if self._loss is not None and \
                     getattr(self._loss, "__self__", None) \
                     is not self._model:
@@ -133,7 +131,10 @@ class Engine:
                     "head computes the model's own loss "
                     "(pipeline_decompose's head_loss_fn); pass "
                     "loss=model.loss or None")
-            self._prepare_pipeline(mesh, zero, strat)
+            self._prepare_pipeline(mesh, zero, strat,
+                                   loss_scale=loss_scale,
+                                   k_steps=k_steps,
+                                   init_scaling=init_scaling)
             return
 
         from ..api import parallel_train_step
@@ -144,11 +145,13 @@ class Engine:
                     zero_stage=zero,
                     remat=strat.recompute.enable,
                     loss_scale=loss_scale,
+                    init_loss_scaling=init_scaling,
                     grad_accum_steps=k_steps,
                     accum_avg=strat.gradient_merge.avg)
         self._mesh_obj = mesh
 
-    def _prepare_pipeline(self, mesh, zero, strat):
+    def _prepare_pipeline(self, mesh, zero, strat, loss_scale=None,
+                          k_steps=1, init_scaling=None):
         """pipeline.enable: route to the 1F1B builder (reference
         Parallelizer pipeline pass → PipelineParallel runtime; here the
         SPMD tick-table program from parallel.pp_1f1b/hybrid)."""
@@ -167,13 +170,21 @@ class Engine:
             mesh = init_mesh(dp=n // pp, pp=pp)
         out = self._model.pipeline_decompose()
         fns, trees = out[0], out[1]
-        opts = out[2] if len(out) > 2 else {}
+        opts = dict(out[2]) if len(out) > 2 else {}
+        # evaluate/predict-only extras ride the opts dict but are not
+        # builder kwargs
+        self._pp_head_out_fn = opts.pop("head_out_fn", None)
         micro = max(1, int(strat.pipeline.accumulate_steps))
         with mesh:
             step_fn, self._params, self._opt_state, self._shardings = \
                 build_hybrid_train_step(
                     *fns, *trees, mesh, self._optimizer, num_micro=micro,
-                    zero_stage=zero, **opts)
+                    zero_stage=zero, loss_scale=loss_scale,
+                    init_loss_scaling=init_scaling,
+                    grad_accum_steps=k_steps,
+                    accum_avg=strat.gradient_merge.avg, **opts)
+        self._pp_fns, self._pp_trees, self._pp_opts = fns, trees, opts
+        self._pp_micro = micro
         from ..pp_1f1b import segment_counts
         S = mesh.degree("pp")
         counts, starts = segment_counts(len(trees[0]), S)
@@ -256,9 +267,8 @@ class Engine:
                  verbose=1):
         self._prepare()
         if getattr(self, "_pp_mode", False):
-            raise NotImplementedError(
-                "evaluate() under strategy.pipeline: params are "
-                "stage-stacked; run fit() or use the pp builders directly")
+            return self._evaluate_pp(valid_data, valid_sample_split,
+                                     batch_size, steps, collate_fn)
         from ...jit import functional_call
         mesh = self._mesh_obj
 
@@ -285,9 +295,8 @@ class Engine:
                 steps=None, collate_fn=None, callbacks=None, verbose=1):
         self._prepare()
         if getattr(self, "_pp_mode", False):
-            raise NotImplementedError(
-                "predict() under strategy.pipeline: params are "
-                "stage-stacked; run fit() or use the pp builders directly")
+            return self._predict_pp(test_data, test_sample_split,
+                                    batch_size, steps, collate_fn)
         from ...jit import functional_call
 
         @jax.jit
@@ -303,6 +312,71 @@ class Engine:
                 break
             inputs, _ = self._split_batch(batch, test_sample_split)
             outs.append(np.asarray(pred_step(self._params, tuple(inputs))))
+        return outs
+
+    # ------------------------------------------------ pp-mode eval/pred
+    def _pp_forward_fn(self, head_fn, out_batch_dims=None):
+        """Build a forward-only tick-table fn over the SAME stacking/
+        sharding layout as the train step, so self._params feed in
+        directly (reference engine.py:1328 — evaluate/predict work
+        under every strategy incl. pipeline)."""
+        from ..pp_1f1b import build_pp_forward_step
+        block_fn, embed_fn, _hl = self._pp_fns
+        with self._mesh_obj:
+            fwd, _state = build_pp_forward_step(
+                block_fn, embed_fn, head_fn, *self._pp_trees,
+                self._mesh_obj, num_micro=self._pp_micro,
+                batch_axes=("dp", "sharding"),
+                out_batch_dims=out_batch_dims, **self._pp_opts)
+        return jax.jit(fwd)
+
+    def _evaluate_pp(self, valid_data, split, batch_size, steps,
+                     collate_fn):
+        if not hasattr(self, "_pp_eval_fn"):
+            self._pp_eval_fn = self._pp_forward_fn(self._pp_fns[2])
+        losses = []
+        from ...io.dataloader import DataLoader, Dataset
+        loader = valid_data if not isinstance(valid_data, Dataset) else \
+            DataLoader(valid_data, batch_size=batch_size,
+                       collate_fn=collate_fn)
+        p = self._params
+        for it, batch in enumerate(loader):
+            if steps and it >= steps:
+                break
+            inputs, labels = self._split_batch(batch, split)
+            ids = jnp.asarray(inputs[0])
+            lbl = jnp.asarray(labels[0]) if labels else ids
+            mb_losses = self._pp_eval_fn(p["blocks"], p["embed"],
+                                         p["head"], ids, lbl)
+            losses.append(float(jnp.mean(mb_losses)))
+        return {"eval_loss": float(np.mean(losses)) if losses else None}
+
+    def _predict_pp(self, test_data, split, batch_size, steps,
+                    collate_fn):
+        if self._pp_head_out_fn is None:
+            raise NotImplementedError(
+                "predict() under strategy.pipeline needs the model's "
+                "pipeline_decompose() to provide opts['head_out_fn'] "
+                "(head logits without the loss — see models.llama/gpt)")
+        if not hasattr(self, "_pp_pred_fn"):
+            self._pp_pred_fn = self._pp_forward_fn(
+                self._pp_head_out_fn, out_batch_dims=(0, 1))
+        outs = []
+        from ...io.dataloader import DataLoader, Dataset
+        loader = test_data if not isinstance(test_data, Dataset) else \
+            DataLoader(test_data, batch_size=batch_size,
+                       collate_fn=collate_fn)
+        p = self._params
+        for it, batch in enumerate(loader):
+            if steps and it >= steps:
+                break
+            inputs, _ = self._split_batch(batch, split)
+            ids = jnp.asarray(inputs[0])
+            stacked = self._pp_pred_fn(p["blocks"], p["embed"],
+                                       p["head"], ids, ids)
+            # [M, mb, ...] -> [B, ...]
+            outs.append(np.asarray(stacked).reshape(
+                (-1,) + stacked.shape[2:]))
         return outs
 
     # ------------------------------------------------------------ io

@@ -221,6 +221,12 @@ def make_llama_tp_fns(n_heads, mp_degree, causal=True, eps=1e-5,
         lg = (hidden @ p["wo"]).astype(jnp.float32)   # [mb, s, V/mp]
         return _vocab_parallel_ce(lg, labels, mp_axis)
 
+    # these fns operate on each mp rank's [V/mp, h] vocab slice; the
+    # 1F1B builder's tie_embed_head guard requires this marker on mp>1
+    # meshes (a plain full-table lookup would silently read a slice)
+    embed_fn._mp_aware = True
+    head_loss_fn._mp_aware = True
+
     block_specs = {
         "ln1": P(), "ln2": P(),
         "wq": P(None, "mp"), "wk": P(None, "mp"), "wv": P(None, "mp"),
@@ -250,6 +256,7 @@ def make_tied_tp_lm_fns(n_heads, mp_degree, causal=True, eps=1e-5,
         lg = (hidden @ p["table"].T).astype(jnp.float32)  # [mb,s,V/mp]
         return _vocab_parallel_ce(lg, labels, mp_axis)
 
+    head_loss_fn._mp_aware = True     # consumes the [V/mp, h] slice
     return (block_fn, embed_fn, head_loss_fn), block_specs
 
 
@@ -415,7 +422,10 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
                             interleave=1, block_weights=None,
                             remat_block=True, donate=True,
                             tie_embed_head=False, seq_axis=None,
-                            offload=False, grad_clip_norm=None):
+                            offload=False, grad_clip_norm=None,
+                            loss_scale=None, grad_accum_steps=1,
+                            accum_avg=True, init_loss_scaling=None,
+                            dynamic_scale_window=1000):
     """ONE jitted train step composing mp × pp × sharding × dp.
 
     Returns (step_fn, params, opt_state, (p_shard, s_shard)) where
@@ -426,7 +436,24 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
     Matches the reference 4-D hybrid (fleet.py:385-428): the global batch
     B shards over dp×sharding, stages over pp, tensor shards over mp, and
     optimizer state over "sharding" (ZeRO-1; stage>=3 also shards params).
+
+    ``loss_scale``: fp16 loss scaling THROUGH the pipeline (reference
+    strategy.amp + GradScaler). A number = STATIC scale: the backward
+    is seeded with it inside the tick table, grads unscale before
+    clip/update, the returned loss is unscaled. ``"dynamic"`` = the
+    reference DynamicLossScaler (amp/grad_scaler.py): scale lives in
+    the optimizer state, halves and SKIPS the update on inf/nan grads,
+    doubles after ``dynamic_scale_window`` consecutive finite steps —
+    the only robust choice for fp16, whose ±65504 range a static 2^15
+    seed can overflow through LayerNorm backprop.
+
+    ``grad_accum_steps`` k>1: gradient merge over pipeline steps
+    (reference GradientMerge composing with pipeline): fp32 accumulators
+    shard like params; the optimizer applies every k-th call.
     """
+    dynamic_scale = loss_scale == "dynamic"
+    init_scale = float(init_loss_scaling or 2.0 ** 15)  # GradScaler init
+    k_accum = int(grad_accum_steps)
     grad_fn, (stacked, emb_p, head_p, sched) = build_1f1b_train_step(
         block_fn, embed_fn, head_loss_fn, block_params_list,
         embed_params, head_params, mesh, num_micro, interleave=interleave,
@@ -508,19 +535,129 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
             jax.device_put, opt_state, s_shard,
             is_leaf=lambda x: isinstance(x, jax.Array))
 
-    def step(params, opt_state, ids, labels, step_i, lr):
-        loss, (d_blk, d_emb, d_head) = grad_fn(
-            params["blocks"], params["embed"], params["head"], ids, labels)
-        grads = {"blocks": d_blk, "embed": d_emb, "head": d_head}
+    if k_accum > 1 or dynamic_scale:
+        wrapped_state = {"_opt": opt_state}
+        wrapped_shard = {"_opt": s_shard}
+        repl = NamedSharding(mesh.mesh, P())
+        if k_accum > 1:
+            # GradientMerge through the pipeline: fp32 accumulators
+            # shard exactly like the params (incl. ZeRO-3 splits)
+            if abstract:
+                accum = jax.tree_util.tree_map(
+                    lambda leaf, sh: jax.ShapeDtypeStruct(
+                        leaf.shape, jnp.float32, sharding=sh),
+                    params, p_shard,
+                    is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+            else:
+                accum = jax.tree_util.tree_map(
+                    lambda leaf, sh: jax.device_put(
+                        jnp.zeros(leaf.shape, jnp.float32), sh),
+                    params, p_shard)
+            wrapped_state["_accum"] = accum
+            wrapped_shard["_accum"] = p_shard
+        if dynamic_scale:
+            if abstract:
+                wrapped_state["_scale"] = jax.ShapeDtypeStruct(
+                    (), jnp.float32, sharding=repl)
+                wrapped_state["_growth"] = jax.ShapeDtypeStruct(
+                    (), jnp.int32, sharding=repl)
+            else:
+                wrapped_state["_scale"] = jax.device_put(
+                    jnp.asarray(init_scale, jnp.float32), repl)
+                wrapped_state["_growth"] = jax.device_put(
+                    jnp.asarray(0, jnp.int32), repl)
+            wrapped_shard["_scale"] = repl
+            wrapped_shard["_growth"] = repl
+        opt_state, s_shard = wrapped_state, wrapped_shard
+
+    def _clip(grads):
         if grad_clip_norm is not None:
             # global-norm clip across ALL shards: the grads are GSPMD
             # global arrays here, so the norm reduction spans pp/mp/
             # sharding automatically
             from ..nn.clip import clip_by_global_norm_tree
             grads, _ = clip_by_global_norm_tree(grads, grad_clip_norm)
-        new_p, new_s = update_fn(grads, params, opt_state, lr=lr,
-                                 step=step_i)
-        return loss, new_p, new_s
+        return grads
+
+    def step(params, opt_state, ids, labels, step_i, lr):
+        wrapped = k_accum > 1 or dynamic_scale
+        inner = opt_state["_opt"] if wrapped else opt_state
+        if dynamic_scale:
+            sc = opt_state["_scale"]
+        elif loss_scale:
+            sc = jnp.asarray(loss_scale, jnp.float32)
+        else:
+            sc = None
+        loss, (d_blk, d_emb, d_head) = grad_fn(
+            params["blocks"], params["embed"], params["head"], ids,
+            labels, scale=sc)
+        grads = {"blocks": d_blk, "embed": d_emb, "head": d_head}
+        if sc is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g_: g_ / sc, grads)           # builder grads: fp32
+        finite = None
+        if dynamic_scale:
+            # reference DynamicLossScaler: inf/nan grads -> zero this
+            # step's contribution, halve the scale, skip the update
+            import functools as _ft
+            finite = _ft.reduce(
+                jnp.logical_and,
+                [jnp.all(jnp.isfinite(g_))
+                 for g_ in jax.tree_util.tree_leaves(grads)])
+            grads = jax.tree_util.tree_map(
+                lambda g_: jnp.where(finite, g_, jnp.zeros_like(g_)),
+                grads)
+
+        if k_accum > 1:
+            acc = jax.tree_util.tree_map(
+                lambda a, g_: a + g_.astype(jnp.float32),
+                opt_state["_accum"], grads)
+            apply = (step_i % k_accum == 0)
+            eff = _clip(jax.tree_util.tree_map(
+                lambda a: (a / k_accum) if accum_avg else a, acc))
+            upd_i = jnp.maximum(step_i // k_accum, 1)
+            upd_p, upd_s = update_fn(eff, params, inner, lr=lr,
+                                     step=upd_i)
+            # fp32 eff grads must not promote stored param/state dtypes
+            upd_p = jax.tree_util.tree_map(
+                lambda a, b: a.astype(b.dtype), upd_p, params)
+            upd_s = jax.tree_util.tree_map(
+                lambda a, b: a.astype(b.dtype), upd_s, inner)
+            new_p = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(apply, a, b), upd_p, params)
+            new_inner = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(apply, a, b), upd_s, inner)
+            new_acc = jax.tree_util.tree_map(
+                lambda a: jnp.where(apply, jnp.zeros_like(a), a), acc)
+            out_state = {"_opt": new_inner, "_accum": new_acc}
+        else:
+            grads = _clip(grads)
+            upd_p, upd_s = update_fn(grads, params, inner, lr=lr,
+                                     step=step_i)
+            if dynamic_scale:
+                upd_p = jax.tree_util.tree_map(
+                    lambda a, b: a.astype(b.dtype), upd_p, params)
+                upd_s = jax.tree_util.tree_map(
+                    lambda a, b: a.astype(b.dtype), upd_s, inner)
+                new_p = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), upd_p, params)
+                new_inner = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(finite, a, b), upd_s, inner)
+            else:
+                new_p, new_inner = upd_p, upd_s
+            out_state = {"_opt": new_inner} if wrapped else new_inner
+
+        if dynamic_scale:
+            growth = jnp.where(finite, opt_state["_growth"] + 1, 0)
+            grow_now = growth >= dynamic_scale_window
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grow_now, sc * 2.0, sc),
+                jnp.maximum(sc * 0.5, 1.0))
+            out_state["_scale"] = jnp.minimum(new_scale,
+                                              jnp.float32(2.0 ** 24))
+            out_state["_growth"] = jnp.where(grow_now, 0, growth)
+        return loss, new_p, out_state
 
     jit_step = jax.jit(
         step,

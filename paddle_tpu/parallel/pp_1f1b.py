@@ -37,10 +37,11 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from .mesh import HybridMesh, P
-from .pp_schedules import Schedule, build_schedule
+from .pp_schedules import (Schedule, build_schedule, FwdSchedule,
+                           build_forward_schedule)
 
 __all__ = ["segment_counts", "one_f_one_b_forward_backward",
-           "build_1f1b_train_step"]
+           "build_1f1b_train_step", "pp_forward", "build_pp_forward_step"]
 
 
 def segment_counts(num_blocks, num_virtual_stages, weights=None):
@@ -109,7 +110,7 @@ def one_f_one_b_forward_backward(
         sched: Schedule, block_fn, embed_fn, head_loss_fn,
         blocks_local, embed_params, head_params, counts_vs,
         ids_micro, labels_micro, hidden_shape, remat_block=True,
-        uniform_collectives=False):
+        uniform_collectives=False, ct_scale=None):
     """Run the 1F1B schedule. MUST be called inside shard_map with axis
     "pp" of size sched.S.
 
@@ -273,7 +274,9 @@ def one_f_one_b_forward_backward(
                 return head_loss_fn(hp, hdn, lbl_b) / M
 
             lv, vjp = jax.vjp(f, ck_b, head_params, x_sv)
-            dck, dhp, dx = vjp(jnp.ones_like(lv))
+            seed = (jnp.ones_like(lv) if ct_scale is None
+                    else jnp.full_like(lv, ct_scale))
+            dck, dhp, dx = vjp(seed)
             f32 = lambda t: jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.float32), t)
             return (f32(dck), zero_emb, f32(dhp), dx.astype(dt),
@@ -303,7 +306,9 @@ def one_f_one_b_forward_backward(
                 lv, vjp_h = jax.vjp(
                     lambda hp, hd: head_loss_fn(hp, hd, lbl_b) / M,
                     head_params, hdn_b)
-                dhp_, ct_ = vjp_h(jnp.ones_like(lv))
+                seed = (jnp.ones_like(lv) if ct_scale is None
+                        else jnp.full_like(lv, ct_scale))
+                dhp_, ct_ = vjp_h(seed)
                 f32_ = lambda t: jax.tree_util.tree_map(
                     lambda a: a.astype(jnp.float32), t)
                 return (f32_(dhp_), ct_.astype(dt),
@@ -377,6 +382,220 @@ def one_f_one_b_forward_backward(
     return loss, d_blk, d_emb, d_head
 
 
+def pp_forward(sched: FwdSchedule, block_fn, embed_fn, head_fn,
+               blocks_local, embed_params, head_params, counts_vs,
+               ids_micro, labels_micro, hidden_shape,
+               uniform_collectives=False):
+    """Forward-only pipeline pass (Engine.evaluate/predict under pp —
+    reference PipelineParallel.eval_batch, pipeline_parallel.py:357).
+    MUST be called inside shard_map with axis "pp" of size sched.S.
+
+    head_fn(head_params, hidden, labels_mb) -> per-microbatch output:
+    a scalar loss for evaluate, [mb, s', V] logits for predict — any
+    pytree of arrays. Returns the [M, ...]-stacked outputs,
+    psum-replicated over "pp" (only the device hosting the last virtual
+    stage computes them; everyone else contributes zeros).
+
+    ``uniform_collectives`` has the same contract as the train executor:
+    block_fn collectives (sp rings) run on every rank every tick with
+    where-selected results; the head stays cond-gated (mp-only groups
+    never cross pp coordinates).
+    """
+    S, M, v = sched.S, sched.M, sched.v
+    VS = S * v
+    i_dev = jax.lax.axis_index("pp")
+    mb, s, h = hidden_shape
+    dt = jax.tree_util.tree_leaves(blocks_local)[0].dtype
+
+    def apply_blocks(chunk_params, x, n):
+        C = jax.tree_util.tree_leaves(chunk_params)[0].shape[0]
+
+        if uniform_collectives:
+            def body(j, xx):
+                blk = jax.tree_util.tree_map(lambda a: a[j], chunk_params)
+                out = block_fn(blk, xx)
+                return jnp.where(j < n, out, xx)
+        else:
+            def body(j, xx):
+                blk = jax.tree_util.tree_map(lambda a: a[j], chunk_params)
+                return jax.lax.cond(j < n, lambda q: block_fn(blk, q),
+                                    lambda q: q, xx)
+
+        return jax.lax.fori_loop(0, C, body, x)
+
+    def chunk_of(c):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, c, 0, False),
+            blocks_local)
+
+    perm_up = [(i, (i + 1) % S) for i in range(S)]
+    zero_hidden = jnp.zeros((mb, s, h), dt)
+
+    out_aval = jax.eval_shape(
+        lambda hp, lb: head_fn(hp, zero_hidden, lb),
+        head_params, jax.tree_util.tree_map(lambda a: a[0], labels_micro))
+    zero_out = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), out_aval)
+
+    tables = {k: jnp.asarray(getattr(sched, k))
+              for k in ("f_vs", "f_mb", "f_read", "recv_a")}
+
+    def tick(carry, row):
+        a_buf, out_buf = carry
+        g = lambda key: row[key][i_dev]
+        f_vs, f_mb_ = g("f_vs"), g("f_mb")
+        do_f = f_vs >= 0
+        chunk_f = jnp.maximum(f_vs, 0) // S
+        n_f = counts_vs[chunk_f]
+        ids_f = jax.lax.dynamic_index_in_dim(
+            ids_micro, jnp.maximum(f_mb_, 0), 0, False)
+        lbl_f = jax.lax.dynamic_index_in_dim(
+            labels_micro, jnp.maximum(f_mb_, 0), 0, False)
+        x_in = jax.lax.dynamic_index_in_dim(
+            a_buf, jnp.maximum(g("f_read"), 0), 0, False)
+        is_first = f_vs == 0
+        is_last = f_vs == VS - 1
+
+        if uniform_collectives:
+            hdn = embed_fn(embed_params, ids_f).astype(dt)
+            x0 = jnp.where(is_first, hdn, x_in)
+            y_all = apply_blocks(chunk_of(chunk_f), x0, n_f)
+        else:
+            def run(_):
+                x0 = jax.lax.cond(
+                    is_first,
+                    lambda _: embed_fn(embed_params, ids_f).astype(dt),
+                    lambda _: x_in, None)
+                return apply_blocks(chunk_of(chunk_f), x0, n_f)
+
+            y_all = jax.lax.cond(do_f, run, lambda _: zero_hidden, None)
+
+        out_mb = jax.lax.cond(
+            do_f & is_last,
+            lambda _: head_fn(head_params, y_all, lbl_f),
+            lambda _: zero_out, None)
+        out_buf = jax.tree_util.tree_map(
+            lambda buf, o: jnp.where(
+                do_f & is_last,
+                jax.lax.dynamic_update_index_in_dim(
+                    buf, o, jnp.maximum(f_mb_, 0), 0), buf),
+            out_buf, out_mb)
+
+        # ---------------- communicate (unconditional collective)
+        y = jnp.where(do_f & ~is_last, y_all, zero_hidden)
+        a_arr = jax.lax.ppermute(y, "pp", perm_up)
+        ra = g("recv_a")
+        a_buf = jnp.where(
+            ra >= 0,
+            jax.lax.dynamic_update_index_in_dim(
+                a_buf, a_arr, jnp.maximum(ra, 0), 0), a_buf)
+        return (a_buf, out_buf), None
+
+    a0 = jnp.zeros((sched.n_aslots, mb, s, h), dt)
+    out0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, a.dtype), out_aval)
+    (_a, out_buf), _ = jax.lax.scan(tick, (a0, out0), tables)
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.psum(a, "pp"), out_buf)
+
+
+def build_pp_forward_step(block_fn, embed_fn, head_fn,
+                          block_params_list, embed_params, head_params,
+                          mesh: HybridMesh, num_micro, interleave=1,
+                          block_weights=None, block_param_specs=None,
+                          embed_param_specs=None, head_param_specs=None,
+                          batch_axes=("dp",), tie_embed_head=False,
+                          seq_axis=None, uniform_collectives=None,
+                          out_batch_dims=None):
+    """Assemble the sharded forward-only pipeline function
+    (Engine.evaluate/predict under strategy.pipeline — reference
+    engine.py:1328 evaluate/predict run every strategy).
+
+    Returns (fwd_fn, (stacked, embed, head, sched)) where
+      fwd_fn(blocks, embed, head, ids [B,s], labels [B,s]) ->
+          [M, ...]-stacked head_fn outputs (psum-replicated over pp).
+    The param trees use the SAME stacking and sharding layout as
+    build_1f1b_train_step, so params produced by the train builder (or
+    build_hybrid_train_step) feed straight in.
+
+    ``out_batch_dims``: dims of head_fn's output that carry the
+    microbatch/sequence (after the stacked M axis) — e.g. (0, 1) for
+    [mb, s', V] logits. They shard over batch_axes/seq_axis in the
+    assembled global output; scalar outputs (losses) replicate.
+    """
+    st = _prepare_pp_state(
+        block_fn, embed_fn, head_fn, block_params_list,
+        embed_params, head_params, mesh, num_micro, interleave,
+        block_weights, block_param_specs, embed_param_specs,
+        head_param_specs, batch_axes, tie_embed_head, seq_axis,
+        uniform_collectives, forward_only=True)
+    S, counts_dev, sched = st["S"], st["counts_dev"], st["sched"]
+    stacked, blocks_spec = st["stacked"], st["blocks_spec"]
+    embed_params, embed_spec = st["embed_params"], st["embed_spec"]
+    head_params, head_spec = st["head_params"], st["head_spec"]
+    uniform, mean_axes, bspec = st["uniform"], st["mean_axes"], st["bspec"]
+    tie = tie_embed_head
+
+    if out_batch_dims:
+        tail = [None] * (1 + max(out_batch_dims))
+        tail[out_batch_dims[0]] = tuple(batch_axes)
+        if len(out_batch_dims) > 1 and seq_axis:
+            tail[out_batch_dims[1]] = seq_axis
+        out_spec = P(None, *tail)
+    else:
+        out_spec = P()
+
+    def sharded_body(blocks, embed, head, ids_micro, labels_micro):
+        blocks_local = jax.tree_util.tree_map(lambda a: a[:, 0], blocks)
+        i_dev = jax.lax.axis_index("pp")
+        counts_vs = counts_dev[:, i_dev]
+        mb = ids_micro.shape[1]
+        s = ids_micro.shape[2]
+        if tie:
+            table_full = jax.lax.all_gather(
+                embed["table"], "pp", axis=0, tiled=True)
+            embed_in = dict(embed, table=table_full)
+            head_in = dict(head, table=table_full)
+        else:
+            embed_in, head_in = embed, head
+        h = jax.eval_shape(lambda e: embed_fn(e, ids_micro[0]),
+                           embed_in).shape[-1]
+        out = pp_forward(
+            sched, block_fn, embed_fn, head_fn, blocks_local, embed_in,
+            head_in, counts_vs, ids_micro, labels_micro, (mb, s, h),
+            uniform_collectives=uniform)
+        if mean_axes and not out_batch_dims:
+            # scalar (loss) outputs average over data replicas; sharded
+            # outputs reassemble through out_specs instead
+            out = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, mean_axes), out)
+        return out
+
+    in_specs = (blocks_spec, embed_spec, head_spec, bspec, bspec)
+
+    smapped = jax.shard_map(
+        sharded_body, mesh=mesh.mesh, in_specs=in_specs,
+        out_specs=out_spec, check_vma=False)
+
+    def fwd_fn(blocks, embed, head, ids, labels):
+        B, seq = ids.shape[0], ids.shape[-1]
+        data_ways = int(np.prod([mesh.degree(a) for a in batch_axes]))
+        if B % (num_micro * data_ways):
+            raise ValueError(
+                f"batch {B} must divide by num_micro*|{batch_axes}| = "
+                f"{num_micro}*{data_ways}")
+        if seq_axis and seq % mesh.degree(seq_axis):
+            raise ValueError(
+                f"sequence {seq} must divide by the {seq_axis} degree "
+                f"{mesh.degree(seq_axis)}")
+        mb = B // num_micro
+        ids_micro = ids.reshape(num_micro, mb, -1)
+        labels_micro = labels.reshape(num_micro, mb, -1)
+        return smapped(blocks, embed, head, ids_micro, labels_micro)
+
+    return fwd_fn, (stacked, embed_params, head_params, sched)
+
+
 def make_tied_lm_fns():
     """(embed_fn, head_loss_fn) for ``tie_embed_head=True`` on meshes
     with mp degree 1: both receive the pp-gathered FULL embedding table
@@ -396,46 +615,15 @@ def make_tied_lm_fns():
     return embed_fn, head_loss_fn
 
 
-def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
-                          block_params_list, embed_params, head_params,
-                          mesh: HybridMesh, num_micro, interleave=1,
-                          block_weights=None, remat_block=True,
-                          block_param_specs=None, embed_param_specs=None,
-                          head_param_specs=None, batch_axes=("dp",),
-                          tie_embed_head=False, seq_axis=None,
-                          uniform_collectives=None):
-    """Assemble the sharded 1F1B loss-and-grad function.
-
-    Returns (grad_fn, state) where
-      state = (blocks_stacked [v,S,C,...] pp-sharded, embed, head, sched)
-      grad_fn(blocks, embed, head, ids [B,s], labels [B,s]) ->
-          (loss, (d_blocks, d_embed, d_head))
-    Batch B is sharded over ``batch_axes`` (default "dp"); microbatching
-    is over the leading axis.
-
-    TP composition (the reference's mp×pp hybrid,
-    fleet/base/topology.py:251): ``block_param_specs[name]`` gives a
-    PartitionSpec over the RAW per-block param dims (e.g. P(None, "mp")
-    for a column-parallel weight); the stage stacking prepends
-    (None, "pp", None). ``embed_param_specs``/``head_param_specs``
-    likewise shard the embedding/head over "mp". When any of these are
-    set, block_fn/embed_fn/head_loss_fn must be mp-aware (psum over "mp"
-    at row-parallel boundaries) — see parallel.hybrid for ready-made fns.
-
-    ``tie_embed_head=True`` (reference SharedLayerDesc,
-    meta_parallel/parallel_layers/pp_layers.py:430-517): the head IS the
-    embeddingᵀ and ``head_params`` must be ``{}``. TPU-native storage:
-    the table lives SHARDED over ("mp","pp") rows (params, grads and
-    optimizer state), is all_gathered over "pp" ONCE per step outside
-    the tick scan (collectives must be tick-uniform), and embed_fn /
-    head_loss_fn receive the gathered table: the FULL [V, h] on mp=1
-    meshes (use ``make_tied_lm_fns``) or this mp rank's contiguous
-    vocab-parallel [V/mp, h] slice on mp>1 (use the mp-aware
-    ``parallel.hybrid.make_tied_tp_lm_fns``; enforced). Grads for both
-    uses flow into one psum over pp and are sliced back to the local
-    shard — beating the reference, which replicates a full fp32 grad
-    accumulator for the shared weight on every stage.
-    """
+def _prepare_pp_state(block_fn, embed_fn, head_loss_fn,
+                      block_params_list, embed_params, head_params,
+                      mesh, num_micro, interleave, block_weights,
+                      block_param_specs, embed_param_specs,
+                      head_param_specs, batch_axes, tie_embed_head,
+                      seq_axis, uniform_collectives, forward_only=False):
+    """Shared state prep for the train and forward-only pp builders:
+    segment + stack the blocks, device_put with pp (and tied) specs,
+    validate mp/sp fn contracts, build the tick schedule."""
     S = mesh.degree("pp")
     v = interleave
     VS = S * v
@@ -448,7 +636,8 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
                    else a.reshape((v, S, C) + a.shape[2:]))
                for n, a in stacked_flat.items()}
     counts_dev = jnp.asarray(counts.reshape(v, S))     # [v, S]
-    sched = build_schedule(S, num_micro, v)
+    sched = (build_forward_schedule(S, num_micro, v) if forward_only
+             else build_schedule(S, num_micro, v))
 
     def _stacked_spec(name):
         raw = (block_param_specs or {}).get(name)
@@ -476,12 +665,15 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         vocab = embed_params["table"].shape[0]
         mp_deg = mesh.degree("mp")
         assert vocab % (S * mp_deg) == 0, (vocab, S, mp_deg)
-        if mp_deg > 1 and "make_tied_lm_fns" in getattr(
-                embed_fn, "__qualname__", ""):
+        if mp_deg > 1 and not (getattr(embed_fn, "_mp_aware", False) and
+                               getattr(head_loss_fn, "_mp_aware", False)):
             raise ValueError(
                 "tie_embed_head on an mp>1 mesh: the pp-gathered table "
                 "is this mp rank's [V/mp, h] vocab-parallel slice, not "
-                "the full table — use parallel.hybrid.make_tied_tp_lm_fns")
+                "the full table, so embed/head fns must be built for "
+                "vocab-parallel lookup (marked _mp_aware) — use "
+                "parallel.hybrid.make_tied_tp_lm_fns, not a plain "
+                "full-table decompose")
         # mp-MAJOR row sharding: gathering over "pp" then yields each mp
         # rank its CONTIGUOUS vocab-parallel slice [V/mp, h] — tied TP
         # embedding/head compose for free (mp=1 degenerates to pp-only).
@@ -524,8 +716,67 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
     # batch over the batch axes; with seq_axis, the SEQUENCE dim shards
     # over it too (context parallel — block fns must run ring attention)
     bspec = P(None, tuple(batch_axes), seq_axis)
+    return dict(S=S, v=v, VS=VS, counts_dev=counts_dev, sched=sched,
+                stacked=stacked, blocks_spec=blocks_spec,
+                embed_params=embed_params, embed_spec=embed_spec,
+                head_params=head_params, head_spec=head_spec,
+                uniform=uniform, mean_axes=mean_axes, bspec=bspec)
 
-    def sharded_body(blocks, embed, head, ids_micro, labels_micro):
+
+def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
+                          block_params_list, embed_params, head_params,
+                          mesh: HybridMesh, num_micro, interleave=1,
+                          block_weights=None, remat_block=True,
+                          block_param_specs=None, embed_param_specs=None,
+                          head_param_specs=None, batch_axes=("dp",),
+                          tie_embed_head=False, seq_axis=None,
+                          uniform_collectives=None):
+    """Assemble the sharded 1F1B loss-and-grad function.
+
+    Returns (grad_fn, state) where
+      state = (blocks_stacked [v,S,C,...] pp-sharded, embed, head, sched)
+      grad_fn(blocks, embed, head, ids [B,s], labels [B,s]) ->
+          (loss, (d_blocks, d_embed, d_head))
+    Batch B is sharded over ``batch_axes`` (default "dp"); microbatching
+    is over the leading axis.
+
+    TP composition (the reference's mp×pp hybrid,
+    fleet/base/topology.py:251): ``block_param_specs[name]`` gives a
+    PartitionSpec over the RAW per-block param dims (e.g. P(None, "mp")
+    for a column-parallel weight); the stage stacking prepends
+    (None, "pp", None). ``embed_param_specs``/``head_param_specs``
+    likewise shard the embedding/head over "mp". When any of these are
+    set, block_fn/embed_fn/head_loss_fn must be mp-aware (psum over "mp"
+    at row-parallel boundaries) — see parallel.hybrid for ready-made fns.
+
+    ``tie_embed_head=True`` (reference SharedLayerDesc,
+    meta_parallel/parallel_layers/pp_layers.py:430-517): the head IS the
+    embeddingᵀ and ``head_params`` must be ``{}``. TPU-native storage:
+    the table lives SHARDED over ("mp","pp") rows (params, grads and
+    optimizer state), is all_gathered over "pp" ONCE per step outside
+    the tick scan (collectives must be tick-uniform), and embed_fn /
+    head_loss_fn receive the gathered table: the FULL [V, h] on mp=1
+    meshes (use ``make_tied_lm_fns``) or this mp rank's contiguous
+    vocab-parallel [V/mp, h] slice on mp>1 (use the mp-aware
+    ``parallel.hybrid.make_tied_tp_lm_fns``; enforced). Grads for both
+    uses flow into one psum over pp and are sliced back to the local
+    shard — beating the reference, which replicates a full fp32 grad
+    accumulator for the shared weight on every stage.
+    """
+    st = _prepare_pp_state(
+        block_fn, embed_fn, head_loss_fn, block_params_list,
+        embed_params, head_params, mesh, num_micro, interleave,
+        block_weights, block_param_specs, embed_param_specs,
+        head_param_specs, batch_axes, tie_embed_head, seq_axis,
+        uniform_collectives)
+    S, counts_dev, sched = st["S"], st["counts_dev"], st["sched"]
+    stacked, blocks_spec = st["stacked"], st["blocks_spec"]
+    embed_params, embed_spec = st["embed_params"], st["embed_spec"]
+    head_params, head_spec = st["head_params"], st["head_spec"]
+    uniform, mean_axes, bspec = st["uniform"], st["mean_axes"], st["bspec"]
+
+    def sharded_body(blocks, embed, head, ids_micro, labels_micro,
+                     ct_scale):
         # local blocks: [v, 1, C, ...] -> [v, C, ...]
         blocks_local = jax.tree_util.tree_map(lambda a: a[:, 0], blocks)
         i_dev = jax.lax.axis_index("pp")
@@ -549,7 +800,7 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
             sched, block_fn, embed_fn, head_loss_fn,
             blocks_local, embed_in, head_in, counts_vs,
             ids_micro, labels_micro, (mb, s, h), remat_block=remat_block,
-            uniform_collectives=uniform)
+            uniform_collectives=uniform, ct_scale=ct_scale)
         if tie_embed_head:
             # d_emb/d_head are already psum'd over pp -> global [V, h]
             # sums; tie them and keep only this stage's vocab slice.
@@ -568,14 +819,17 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         d_blk = jax.tree_util.tree_map(lambda a: a[:, None], d_blk)
         return loss, d_blk, d_emb, d_head
 
-    in_specs = (blocks_spec, embed_spec, head_spec, bspec, bspec)
+    in_specs = (blocks_spec, embed_spec, head_spec, bspec, bspec, P())
     out_specs = (P(), blocks_spec, embed_spec, head_spec)
 
     smapped = jax.shard_map(
         sharded_body, mesh=mesh.mesh, in_specs=in_specs,
         out_specs=out_specs, check_vma=False)
 
-    def grad_fn(blocks, embed, head, ids, labels):
+    def grad_fn(blocks, embed, head, ids, labels, scale=None):
+        """``scale``: optional backward seed (loss-scaling for fp16 —
+        reference GradScaler): grads come back MULTIPLIED by it; the
+        returned loss stays unscaled. None = 1."""
         B, seq = ids.shape[0], ids.shape[-1]
         data_ways = int(np.prod([mesh.degree(a) for a in batch_axes]))
         if B % (num_micro * data_ways):
@@ -589,8 +843,9 @@ def build_1f1b_train_step(block_fn, embed_fn, head_loss_fn,
         mb = B // num_micro
         ids_micro = ids.reshape(num_micro, mb, -1)
         labels_micro = labels.reshape(num_micro, mb, -1)
+        ct = jnp.asarray(1.0 if scale is None else scale, jnp.float32)
         loss, d_blk, d_emb, d_head = smapped(
-            blocks, embed, head, ids_micro, labels_micro)
+            blocks, embed, head, ids_micro, labels_micro, ct)
         return loss, (d_blk, d_emb, d_head)
 
     return grad_fn, (stacked, embed_params, head_params, sched)

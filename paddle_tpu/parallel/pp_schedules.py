@@ -26,7 +26,8 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["Schedule", "build_schedule", "bubble_fraction",
+__all__ = ["Schedule", "build_schedule", "FwdSchedule",
+           "build_forward_schedule", "bubble_fraction",
            "gpipe_bubble_fraction"]
 
 
@@ -236,3 +237,99 @@ def bubble_fraction(sched: Schedule):
 def gpipe_bubble_fraction(S, M):
     """Fill-drain wave: T = 2*(M + S - 1), busy = 2*M per device."""
     return 1.0 - (2.0 * M) / (2.0 * (M + S - 1))
+
+
+@dataclasses.dataclass
+class FwdSchedule:
+    """Forward-only tick tables (evaluate/predict through the pipeline).
+
+    Same conventions as Schedule: int32 [T, S], -1 = inactive; virtual
+    stage j lives on device j % S, chunk j // S.
+    """
+    S: int
+    M: int
+    v: int
+    T: int
+    f_vs: np.ndarray
+    f_mb: np.ndarray
+    f_read: np.ndarray
+    recv_a: np.ndarray
+    n_aslots: int
+
+    @property
+    def VS(self):
+        return self.S * self.v
+
+
+def build_forward_schedule(S, M, v=1):
+    """Simulate the forward-only pipeline wave (reference
+    PipelineParallel.eval_batch, pipeline_parallel.py:117 forward
+    passes without backward) and emit dense tables. Every device runs
+    one forward op per tick when ready; activations ride the same
+    single up-ring ppermute as the 1F1B executor."""
+    VS = S * v
+    if M < 1:
+        raise ValueError("need at least one microbatch")
+    f_done = {}
+    act_avail = {}                  # (consumer vs, m) -> (tick, slot)
+    apool = _SlotPool()
+    rows = []
+    t = 0
+    total_ops = VS * M
+    done_ops = 0
+    while done_ops < total_ops:
+        if t > 10 * (total_ops + VS):
+            raise RuntimeError("fwd schedule simulation did not converge")
+        row = {k: [-1] * S for k in ("f_vs", "f_mb", "f_read", "recv_a")}
+        sends_a = []
+        for i in range(S):
+            chosen = None
+            cands = []
+            for c in range(v):
+                vs = c * S + i
+                for m in range(M):
+                    if (vs, m) in f_done:
+                        continue
+                    if vs == 0:
+                        ready, a = True, None
+                    else:
+                        aa = act_avail.get((vs, m))
+                        ready = aa is not None and aa[0] <= t
+                        a = aa[1] if ready else None
+                    if m > 0 and (vs, m - 1) not in f_done:
+                        ready = False
+                    if ready:
+                        cands.append(((m // S, c, m % S), vs, m, a))
+                    break               # first unfinished m per chunk
+            if cands:
+                chosen = min(cands)[1:]
+            if chosen is None:
+                continue
+            vs, m, slot = chosen
+            row["f_vs"][i] = vs
+            row["f_mb"][i] = m
+            if vs > 0:
+                row["f_read"][i] = slot
+                apool.release((vs, m))
+                del act_avail[(vs, m)]
+            f_done[(vs, m)] = t
+            done_ops += 1
+            if vs < VS - 1:
+                sends_a.append((i, vs, m))
+        for (i, vs, m) in sends_a:
+            dst = (vs + 1) % S
+            slot = apool.alloc((vs + 1, m))
+            act_avail[(vs + 1, m)] = (t + 1, slot)
+            row["recv_a"][dst] = slot
+        rows.append(row)
+        t += 1
+
+    T = len(rows)
+
+    def tbl(key):
+        return np.array([r[key] for r in rows], np.int32)
+
+    return FwdSchedule(
+        S=S, M=M, v=v, T=T, f_vs=tbl("f_vs"), f_mb=tbl("f_mb"),
+        f_read=tbl("f_read"), recv_a=tbl("recv_a"),
+        n_aslots=max(apool.next, 1))

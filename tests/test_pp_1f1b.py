@@ -185,3 +185,121 @@ def test_1f1b_parity_vs_sequential(v, weights):
                                    np.asarray(ref_grads["b"][l]["w"]),
                                    rtol=2e-4, atol=2e-5,
                                    err_msg=f"block {l}")
+
+
+# ------------------------------------------------------- forward-only pp
+
+def test_pp_forward_eval_loss_parity():
+    """Forward-only tick table (Engine.evaluate under pp — reference
+    PipelineParallel.eval_batch): per-microbatch losses match the
+    sequential model exactly."""
+    from paddle_tpu.parallel.pp_1f1b import build_pp_forward_step
+    mesh = dist.init_mesh(dp=2, pp=4)
+    rng = np.random.RandomState(3)
+    L, H, V = 8, 16, 32
+    blocks = [{"w": jnp.asarray(rng.randn(H, H).astype(np.float32) * .3)}
+              for _ in range(L)]
+    embed = {"table": jnp.asarray(rng.randn(V, H).astype(np.float32) * .3)}
+    head = {"wo": jnp.asarray(rng.randn(H, V).astype(np.float32) * .3)}
+
+    def block_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def embed_fn(p, ids):
+        return p["table"][ids]
+
+    def head_loss_fn(p, hidden, labels):
+        lg = (hidden @ p["wo"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, labels[..., None], -1).mean()
+
+    ids = jnp.asarray(rng.randint(0, V, size=(8, 8)).astype(np.int32))
+    fwd, (stk, ep, hp, _s) = build_pp_forward_step(
+        block_fn, embed_fn, head_loss_fn, blocks, embed, head, mesh,
+        num_micro=4)
+    losses = jax.jit(fwd)(stk, ep, hp, ids, ids)
+
+    def ref_loss(ids_mb):
+        x = embed["table"][ids_mb]
+        for bp in blocks:
+            x = jnp.tanh(x @ bp["w"])
+        lg = (x @ head["wo"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, ids_mb[..., None], -1).mean()
+
+    refs = [float(ref_loss(ids[i * 2:(i + 1) * 2])) for i in range(4)]
+    np.testing.assert_allclose(np.asarray(losses), refs, rtol=2e-5)
+
+
+def test_pp_forward_predict_logits_parity():
+    """head_out_fn path (Engine.predict under pp): stacked [M, mb, s, V]
+    logits reassemble to the sequential model's full-batch logits."""
+    from paddle_tpu.parallel.pp_1f1b import build_pp_forward_step
+    mesh = dist.init_mesh(dp=2, pp=4)
+    rng = np.random.RandomState(4)
+    L, H, V = 8, 16, 32
+    blocks = [{"w": jnp.asarray(rng.randn(H, H).astype(np.float32) * .3)}
+              for _ in range(L)]
+    embed = {"table": jnp.asarray(rng.randn(V, H).astype(np.float32) * .3)}
+    head = {"wo": jnp.asarray(rng.randn(H, V).astype(np.float32) * .3)}
+
+    def block_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def embed_fn(p, ids):
+        return p["table"][ids]
+
+    def head_out_fn(p, hidden, labels):
+        return (hidden @ p["wo"]).astype(jnp.float32)
+
+    ids = jnp.asarray(rng.randint(0, V, size=(8, 8)).astype(np.int32))
+    fwd, (stk, ep, hp, _s) = build_pp_forward_step(
+        block_fn, embed_fn, head_out_fn, blocks, embed, head, mesh,
+        num_micro=4, out_batch_dims=(0, 1))
+    lg = jax.jit(fwd)(stk, ep, hp, ids, ids)
+    assert lg.shape == (4, 2, 8, V)
+
+    def ref_logits(ids_mb):
+        x = embed["table"][ids_mb]
+        for bp in blocks:
+            x = jnp.tanh(x @ bp["w"])
+        return (x @ head["wo"]).astype(jnp.float32)
+
+    want = jnp.stack([ref_logits(ids[i * 2:(i + 1) * 2])
+                      for i in range(4)])
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(want),
+                               rtol=2e-5, atol=1e-5)
+
+
+def test_pp_forward_tied_interleaved():
+    """Forward-only pass through tied-embedding + interleaved virtual
+    stages: the same tie/gather layout as the train builder."""
+    from paddle_tpu.parallel.pp_1f1b import (build_pp_forward_step,
+                                             make_tied_lm_fns)
+    mesh = dist.init_mesh(dp=2, pp=2)
+    rng = np.random.RandomState(5)
+    L, H, V = 8, 16, 32
+    blocks = [{"w": jnp.asarray(rng.randn(H, H).astype(np.float32) * .3)}
+              for _ in range(L)]
+    embed = {"table": jnp.asarray(rng.randn(V, H).astype(np.float32) * .3)}
+
+    def block_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    efn, hfn = make_tied_lm_fns()
+    ids = jnp.asarray(rng.randint(0, V, size=(8, 8)).astype(np.int32))
+    fwd, (stk, ep, hp, _s) = build_pp_forward_step(
+        block_fn, efn, hfn, blocks, embed, {}, mesh, num_micro=4,
+        interleave=2, tie_embed_head=True)
+    losses = jax.jit(fwd)(stk, ep, hp, ids, ids)
+
+    def ref_tied(ids_mb):
+        x = embed["table"][ids_mb]
+        for bp in blocks:
+            x = jnp.tanh(x @ bp["w"])
+        lg = (x @ embed["table"].T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, ids_mb[..., None], -1).mean()
+
+    refs = [float(ref_tied(ids[i * 2:(i + 1) * 2])) for i in range(4)]
+    np.testing.assert_allclose(np.asarray(losses), refs, rtol=2e-5)
