@@ -113,6 +113,66 @@ class ElasticManager:
         latest checkpoint with the surviving membership."""
         return len(self.healthy_nodes()) < len(self.nodes())
 
+    # ------------------------------------------- scale in/out (ELASTIC)
+    # Reference manager.py:469-604: on membership change the manager
+    # rewrites PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS and relaunches
+    # at the new np. TPU-native: endpoints live in the TCPStore beside
+    # the heartbeats; the surviving/new membership derives a new env and
+    # the trainer restarts from checkpoint onto a re-built mesh.
+
+    def publish_endpoint(self, endpoint):
+        """Advertise this node's trainer endpoint (reference
+        host registry `/{job}/nodes/` values)."""
+        self.store.set(f"{self.job_id}/ep/{self.node_id}",
+                       endpoint.encode() if isinstance(endpoint, str)
+                       else endpoint)
+
+    def endpoints(self, healthy_only=True):
+        """Endpoints of (healthy) members in node-id order."""
+        ids = sorted((self.healthy_nodes() if healthy_only
+                      else self.nodes()), key=int)
+        out = []
+        for i in ids:
+            key = f"{self.job_id}/ep/{i}"
+            if self.store.check(key):
+                out.append(self.store.get(key).decode())
+        return out
+
+    def wait_for_np(self, np_target, timeout=60.0):
+        """Block until the healthy membership reaches ``np_target``
+        (reference ElasticManager.wait: hold until the cluster settles
+        at the desired np)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            n = len(self.healthy_nodes())
+            if n == np_target:
+                return True
+            time.sleep(self.interval / 2)
+        return False
+
+    def scale_plan(self):
+        """(new_np, endpoints) from the CURRENT healthy membership —
+        what the relaunched job should run with (reference
+        _update_endpoint + np adjustment)."""
+        eps = self.endpoints(healthy_only=True)
+        return len(self.healthy_nodes()), eps
+
+    def rewrite_env(self, endpoints, env=None):
+        """Rewrite the trainer env for the new membership (reference
+        manager.py _update_hosts: PADDLE_TRAINER_ENDPOINTS /
+        PADDLE_TRAINERS_NUM / rank remap). Mutates (and returns) ``env``
+        — ``os.environ`` by default. A node no longer in ``endpoints``
+        gets rank -1 (it must exit)."""
+        env = os.environ if env is None else env
+        env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+        env["PADDLE_TRAINERS_NUM"] = str(len(endpoints))
+        own_key = f"{self.job_id}/ep/{self.node_id}"
+        own = (self.store.get(own_key).decode()
+               if self.store.check(own_key) else None)
+        rank = endpoints.index(own) if own in endpoints else -1
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        return env
+
     def exit(self, completed=True):
         self._stop.set()
         if self._hb_thread:

@@ -1,0 +1,191 @@
+"""Worker for the elastic SCALE-IN e2e (VERDICT r4 #6): the full
+reference elastic story composed with TPU-native re-mesh restart.
+
+Phase "train": 2 nodes under ElasticManager (TCPStore heartbeats +
+endpoint registry). Node 0 trains the HYBRID pipeline (tp2 x pp2 x
+sharding2 on the 8-device virtual mesh) and checkpoints the canonical
+per-layer layout (params + Adam moments) every step; node 1 crashes.
+Node 0's manager detects the lost heartbeat, records the scale plan
+(surviving endpoints), and exits asking for a restart.
+
+Phase "resume": the relaunched single node rewrites its env from the
+plan (reference manager.py:469-604 endpoint rewrite), restores the
+checkpoint ONTO A DIFFERENT PIPELINE LAYOUT (pp4 x mp2) via the
+converter's restack helpers, and finishes training.
+"""
+import json
+import os
+import pickle
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as pt
+import paddle_tpu.parallel as dist
+from paddle_tpu.parallel.elastic import ElasticManager
+from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
+                                        init_llama_tp_params,
+                                        make_llama_tp_fns, restack_blocks,
+                                        unstack_blocks)
+
+RANK = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+WORLD = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+CKDIR = os.environ["CKPT_DIR"]
+PHASE = os.environ.get("PHASE", "train")
+CRASH_RANK = int(os.environ.get("CRASH_RANK", "-1"))
+CRASH_STEP = int(os.environ.get("CRASH_STEP", "2"))
+TOTAL = int(os.environ.get("TOTAL_STEPS", "5"))
+MASTER = os.environ.get("ELASTIC_MASTER", "127.0.0.1:29741")
+
+NH, L, H, F, V = 4, 4, 16, 32, 64
+RESTART_RC = 31
+
+
+def step_ids(i):
+    return jnp.asarray(np.random.RandomState(1000 + i)
+                       .randint(0, V, size=(8, 8)).astype(np.int32))
+
+
+def build(mesh, blocks):
+    fns, specs = make_llama_tp_fns(NH, 2)
+    opt = pt.optimizer.AdamW(learning_rate=1e-2)
+    embed, head = build.embed, build.head
+    return build_hybrid_train_step(
+        *fns, blocks, embed, head, mesh, opt, num_micro=2,
+        block_param_specs=specs[0], embed_param_specs=specs[1],
+        head_param_specs=specs[2], zero_stage=1, donate=False)
+
+
+def save_canonical(params, opt_state, step, pp_degree):
+    canon = {
+        "blocks": unstack_blocks(params["blocks"], L, pp_degree=pp_degree),
+        "embed": {k: np.asarray(v) for k, v in params["embed"].items()},
+        "head": {k: np.asarray(v) for k, v in params["head"].items()},
+        "m_blocks": unstack_blocks(opt_state["m"]["blocks"], L,
+                                   pp_degree=pp_degree),
+        "v_blocks": unstack_blocks(opt_state["v"]["blocks"], L,
+                                   pp_degree=pp_degree),
+        "m_embed": {k: np.asarray(v)
+                    for k, v in opt_state["m"]["embed"].items()},
+        "v_embed": {k: np.asarray(v)
+                    for k, v in opt_state["v"]["embed"].items()},
+        "m_head": {k: np.asarray(v)
+                   for k, v in opt_state["m"]["head"].items()},
+        "v_head": {k: np.asarray(v)
+                   for k, v in opt_state["v"]["head"].items()},
+        "step": step,
+    }
+    with open(os.path.join(CKDIR, f"hybrid_{step}.pkl"), "wb") as f:
+        pickle.dump(canon, f)
+    with open(os.path.join(CKDIR, "LATEST"), "w") as f:
+        f.write(str(step))
+
+
+def main_train():
+    from paddle_tpu.runtime import TCPStore
+    host, port = MASTER.rsplit(":", 1)
+    store = TCPStore(host=host, port=int(port), is_master=(RANK == 0),
+                     world_size=WORLD)
+    mgr = ElasticManager(store=store, node_id=str(RANK), np=WORLD,
+                         heartbeat_interval=0.3, heartbeat_timeout=1.5,
+                         job_id="scale-e2e")
+    mgr.register()
+    mgr.publish_endpoint(f"127.0.0.1:{9400 + RANK}")
+    mgr.wait_for_np(WORLD, timeout=30)
+
+    if RANK == 0:
+        blocks, embed, head = init_llama_tp_params(
+            L, H, F, V, rng=np.random.RandomState(77))
+        build.embed, build.head = embed, head
+        mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+        step_fn, params, opt_state, _sh = build(mesh, blocks)
+    losses = []
+    for i in range(1, TOTAL + 1):
+        # lockstep barrier WITH failure detection: a missing peer stops
+        # heartbeating and the manager asks for a restart
+        store.add(f"sbar/{i}", 1)
+        deadline = time.time() + 60
+        while store.add(f"sbar/{i}", 0) < WORLD:
+            if mgr.should_restart():
+                if RANK == 0:
+                    plan_np, plan_eps = mgr.scale_plan()
+                    with open(os.path.join(CKDIR, "PLAN.json"), "w") as f:
+                        json.dump({"np": plan_np, "endpoints": plan_eps,
+                                   "losses": losses}, f)
+                mgr.exit(completed=False)
+                return RESTART_RC
+            if time.time() > deadline:
+                raise RuntimeError(f"barrier timeout at step {i}")
+            time.sleep(0.02)
+        if RANK == CRASH_RANK and i == CRASH_STEP:
+            os._exit(17)                             # simulated node loss
+        if RANK == 0:
+            loss, params, opt_state = step_fn(params, opt_state,
+                                              step_ids(i), step_ids(i), i)
+            losses.append(float(loss))
+            save_canonical(params, opt_state, i, pp_degree=2)
+    mgr.exit(completed=True)
+    return 0
+
+
+def main_resume():
+    from paddle_tpu.runtime import TCPStore
+    host, port = os.environ["RESUME_MASTER"].rsplit(":", 1)
+    store = TCPStore(host=host, port=int(port), is_master=True,
+                     world_size=1)
+    mgr = ElasticManager(store=store, node_id="0", np=1,
+                         heartbeat_interval=0.3, heartbeat_timeout=1.5,
+                         job_id="scale-e2e")
+    mgr.register()
+    mgr.publish_endpoint("127.0.0.1:9400")
+    # endpoint/np rewrite for the shrunk membership (reference
+    # manager.py:469-604) — the new env drives the rebuilt mesh
+    plan = json.load(open(os.path.join(CKDIR, "PLAN.json")))
+    env = mgr.rewrite_env(mgr.endpoints())
+    assert env["PADDLE_TRAINERS_NUM"] == str(plan["np"]) == "1", env
+    assert env["PADDLE_TRAINER_ID"] == "0", env
+
+    last = int(open(os.path.join(CKDIR, "LATEST")).read())
+    with open(os.path.join(CKDIR, f"hybrid_{last}.pkl"), "rb") as f:
+        canon = pickle.load(f)
+    build.embed = {k: jnp.asarray(v) for k, v in canon["embed"].items()}
+    build.head = {k: jnp.asarray(v) for k, v in canon["head"].items()}
+    # DIFFERENT pipeline layout than the checkpoint was trained on
+    mesh4 = dist.init_mesh(dp=1, pp=4, sharding=1, mp=2)
+    step_fn, params, opt_state, _sh = build(mesh4, canon["blocks"])
+    # Adam moments restack onto the new pp exactly like the params
+    for key, mk, ek, hk in (("m", "m_blocks", "m_embed", "m_head"),
+                            ("v", "v_blocks", "v_embed", "v_head")):
+        stacked = restack_blocks(canon[mk], mesh4)
+        new = {"blocks": stacked,
+               "embed": {k: jnp.asarray(v) for k, v in canon[ek].items()},
+               "head": {k: jnp.asarray(v) for k, v in canon[hk].items()}}
+        opt_state[key] = jax.tree_util.tree_map(
+            lambda cur, val: jax.device_put(jnp.asarray(val),
+                                            cur.sharding),
+            opt_state[key], new)
+    losses = []
+    for i in range(last + 1, TOTAL + 1):
+        loss, params, opt_state = step_fn(params, opt_state,
+                                          step_ids(i), step_ids(i), i)
+        losses.append(float(loss))
+    with open(os.path.join(CKDIR, "result.json"), "w") as f:
+        json.dump({"resumed_from": last, "losses": losses,
+                   "train_losses": plan["losses"]}, f)
+    mgr.exit(completed=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main_train() if PHASE == "train" else main_resume())

@@ -1,0 +1,97 @@
+"""Elastic scale-in + hybrid mesh-change restore, end to end
+(VERDICT r4 #6). Reference: fleet/elastic/manager.py:469-604 (endpoint
+rewrite + np adjustment + relaunch) composed with
+auto_parallel/converter.py (mesh-change restore) — here the TCPStore
+heartbeat manager, the endpoint registry, and the hybrid restack
+helpers drive the same story on the virtual TPU mesh.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.parallel as dist
+
+RESTART_RC = 31
+
+
+@pytest.mark.slow
+def test_elastic_scale_in_hybrid_restore(tmp_path):
+    """2 nodes -> node 1 dies -> manager records the scale plan ->
+    relaunch at np=1 -> hybrid ckpt (pp2) restores onto pp4 with Adam
+    moments -> losses continue the uninterrupted trajectory exactly."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "elastic_scale_worker.py")
+    ckdir = str(tmp_path / "ckpts")
+    os.makedirs(ckdir)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+    env.update({"CKPT_DIR": ckdir, "TOTAL_STEPS": "5",
+                "CRASH_RANK": "1", "CRASH_STEP": "2",
+                "ELASTIC_MASTER": "127.0.0.1:29743",
+                "RESUME_MASTER": "127.0.0.1:29744",
+                "PYTHONUNBUFFERED": "1"})
+
+    def launch(nproc, phase):
+        e = dict(env)
+        e["PHASE"] = phase
+        cmd = [sys.executable, "-m", "paddle_tpu.parallel.launch.main",
+               "--nproc_per_node", str(nproc),
+               "--log_dir", str(tmp_path / f"log_{phase}"),
+               "--max_restart", "0",
+               worker]
+        return subprocess.run(cmd, env=e, cwd=repo, capture_output=True,
+                              text=True, timeout=420)
+
+    r1 = launch(2, "train")
+    assert r1.returncode != 0, (r1.stdout[-1500:], r1.stderr[-1500:])
+    # the manager detected the loss and recorded the scale plan
+    plan = json.load(open(os.path.join(ckdir, "PLAN.json")))
+    assert plan["np"] == 1 and plan["endpoints"] == ["127.0.0.1:9400"]
+    saved = int(open(os.path.join(ckdir, "LATEST")).read())
+    assert saved >= 1
+
+    r2 = launch(1, "resume")
+    assert r2.returncode == 0, (
+        r2.stdout[-1500:], r2.stderr[-1500:],
+        open(os.path.join(str(tmp_path / "log_resume"),
+                          "workerlog.0")).read()[-2000:])
+    res = json.load(open(os.path.join(ckdir, "result.json")))
+    assert res["resumed_from"] == saved
+
+    # ---- uninterrupted single-process reference trajectory ----------
+    from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
+                                            init_llama_tp_params,
+                                            make_llama_tp_fns)
+    NH, L, H, F, V = 4, 4, 16, 32, 64
+    blocks, embed, head = init_llama_tp_params(
+        L, H, F, V, rng=np.random.RandomState(77))
+    fns, specs = make_llama_tp_fns(NH, 2)
+    mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+    step_fn, params, opt_state, _sh = build_hybrid_train_step(
+        *fns, blocks, embed, head, mesh,
+        pt.optimizer.AdamW(learning_rate=1e-2), num_micro=2,
+        block_param_specs=specs[0], embed_param_specs=specs[1],
+        head_param_specs=specs[2], zero_stage=1, donate=False)
+
+    def ids(i):
+        return jnp.asarray(np.random.RandomState(1000 + i)
+                           .randint(0, V, size=(8, 8)).astype(np.int32))
+
+    ref = []
+    for i in range(1, 6):
+        loss, params, opt_state = step_fn(params, opt_state, ids(i),
+                                          ids(i), i)
+        ref.append(float(loss))
+
+    got = res["train_losses"] + res["losses"]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, err_msg=(
+        "resumed trajectory diverged from the uninterrupted run"))
+    assert ref[-1] < ref[0]
