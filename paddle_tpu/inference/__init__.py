@@ -159,7 +159,8 @@ class Predictor:
             if getattr(self.config, "_enable_profile", False) else None
         if inputs is not None:
             outs = self._prog(*inputs)
-            res = [np.asarray(o) for o in outs]
+            flat = outs if isinstance(outs, (list, tuple)) else [outs]
+            res = [np.asarray(o) for o in flat]
         else:
             vals = [self._inputs[n]._value for n in self._inputs]
             outs = self._prog(*vals)
@@ -241,3 +242,6 @@ def convert_to_mixed_precision(src_prefix, dst_prefix, mixed_precision="bf16",
              for n, a in zip(feed_names, in_avals)]
     _write(dst_prefix, exported, feed_names, fetch_names, specs)
     return dst_prefix
+
+
+from .serving import BatchScheduler  # noqa: E402  (reference serving surface)

@@ -1,0 +1,128 @@
+"""Minimal batched-request scheduler over a Predictor.
+
+Reference: the AnalysisPredictor serving surface
+(paddle/fluid/inference/api/analysis_predictor.h:95 — zero-copy IO,
+multi-stream request execution). TPU-native collapse: one compiled XLA
+program serves every request; the scheduler's job is to GROUP pending
+requests into a single batched call (the MXU wants batch, and a fixed
+batch shape avoids recompiles), then split the outputs back per request.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+__all__ = ["BatchScheduler"]
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "n")
+
+    def __init__(self, inputs):
+        self.inputs = inputs
+        self.future = Future()
+        self.n = int(inputs[0].shape[0])    # rows this request contributes
+
+
+class BatchScheduler:
+    """Group submitted requests into batched runner calls.
+
+    ``runner``: a ``Predictor`` (its positional ``run(list)`` is used) or
+    any callable ``f(list_of_stacked_arrays) -> list_of_arrays`` where
+    every output keeps the stacked batch on axis 0.
+
+    ``submit(*arrays)`` returns a ``concurrent.futures.Future`` whose
+    result is the list of this request's output slices. Requests are
+    batched up to ``max_batch_size`` rows; a partially filled batch
+    launches after ``max_delay_ms``. Requests whose trailing shapes
+    differ batch separately (a shape change would recompile — the
+    scheduler never mixes them).
+    """
+
+    def __init__(self, runner, max_batch_size=8, max_delay_ms=5.0):
+        self._run = (runner.run if hasattr(runner, "run") else runner)
+        self.max_batch = int(max_batch_size)
+        self.max_delay = float(max_delay_ms) / 1e3
+        self._lock = threading.Condition()
+        self._queue = []                    # pending _Request, FIFO
+        self._closed = False
+        self.batches_run = 0                # introspection for tests
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------ client
+    def submit(self, *arrays):
+        arrays = [np.asarray(a) for a in arrays]
+        if not arrays:
+            raise ValueError("submit() needs at least one input array")
+        req = _Request(arrays)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._queue.append(req)
+            self._lock.notify()
+        return req.future
+
+    def close(self, timeout=10.0):
+        with self._lock:
+            self._closed = True
+            self._lock.notify()
+        self._worker.join(timeout=timeout)
+
+    # ------------------------------------------------------------ worker
+    @staticmethod
+    def _shape_key(req):
+        return tuple((a.shape[1:], str(a.dtype)) for a in req.inputs)
+
+    def _take_group(self):
+        """Pop a shape-compatible group (<= max_batch rows) or None."""
+        if not self._queue:
+            return None
+        key = self._shape_key(self._queue[0])
+        group, rows, rest = [], 0, []
+        for req in self._queue:
+            fits = rows + req.n <= self.max_batch or not group
+            # `not group`: a single request larger than max_batch still
+            # runs (alone) — it must never starve in the queue
+            if self._shape_key(req) == key and fits:
+                group.append(req)
+                rows += req.n
+            else:
+                rest.append(req)
+        self._queue = rest
+        return group
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._lock.wait()
+                if not self._queue and self._closed:
+                    return
+                first_seen = time.monotonic()
+                # linger for more requests while the batch is open
+                while (len(self._queue) > 0
+                       and sum(r.n for r in self._queue) < self.max_batch
+                       and not self._closed
+                       and time.monotonic() - first_seen < self.max_delay):
+                    self._lock.wait(timeout=self.max_delay / 4)
+                group = self._take_group()
+            if not group:
+                continue
+            try:
+                stacked = [np.concatenate([r.inputs[i] for r in group], 0)
+                           for i in range(len(group[0].inputs))]
+                outs = self._run(stacked)
+                self.batches_run += 1
+                off = 0
+                for r in group:
+                    r.future.set_result(
+                        [np.asarray(o)[off:off + r.n] for o in outs])
+                    off += r.n
+            except Exception as e:              # propagate to every waiter
+                for r in group:
+                    if not r.future.done():
+                        r.future.set_exception(e)

@@ -297,3 +297,81 @@ def test_predictor_config_knobs_functional(tmp_path):
     with pytest.raises(NotImplementedError):
         paddle_infer.Config(prefix).switch_ir_optim(False)
     paddle_infer.Config(prefix).switch_ir_optim(True)   # default: fine
+
+
+# --------------------------------------------------- batched scheduler
+
+class TestBatchScheduler:
+    def test_groups_requests_into_one_run(self):
+        """10 single-row requests within the linger window -> far fewer
+        runner calls than requests; every future gets ITS slice."""
+        from paddle_tpu.inference import BatchScheduler
+        calls = []
+
+        def runner(stacked):
+            calls.append(stacked[0].shape[0])
+            return [stacked[0] * 2.0, stacked[0].sum(-1, keepdims=True)]
+
+        sched = BatchScheduler(runner, max_batch_size=8, max_delay_ms=60)
+        xs = [np.full((1, 4), float(i), np.float32) for i in range(10)]
+        futs = [sched.submit(x) for x in xs]
+        outs = [f.result(timeout=20) for f in futs]
+        sched.close()
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(o[0], xs[i] * 2.0)
+            np.testing.assert_allclose(o[1], xs[i].sum(-1, keepdims=True))
+        assert sched.batches_run < 10, calls
+        assert sum(calls) == 10     # every row served exactly once
+
+    def test_mismatched_shapes_batch_separately(self):
+        from paddle_tpu.inference import BatchScheduler
+        shapes = []
+
+        def runner(stacked):
+            shapes.append(stacked[0].shape)
+            return [stacked[0] + 1.0]
+
+        sched = BatchScheduler(runner, max_batch_size=8, max_delay_ms=30)
+        f1 = sched.submit(np.zeros((1, 3), np.float32))
+        f2 = sched.submit(np.zeros((1, 5), np.float32))
+        r1 = f1.result(timeout=20)[0]
+        r2 = f2.result(timeout=20)[0]
+        sched.close()
+        assert r1.shape == (1, 3) and r2.shape == (1, 5)
+        assert all(s[1:] in ((3,), (5,)) for s in shapes)
+        assert len(shapes) == 2, "different shapes must not mix"
+
+    def test_runner_error_propagates(self):
+        from paddle_tpu.inference import BatchScheduler
+
+        def runner(stacked):
+            raise RuntimeError("boom")
+
+        sched = BatchScheduler(runner, max_batch_size=4, max_delay_ms=5)
+        f = sched.submit(np.zeros((1, 2), np.float32))
+        with pytest.raises(RuntimeError, match="boom"):
+            f.result(timeout=20)
+        sched.close()
+
+    def test_scheduler_over_real_predictor(self, tmp_path):
+        """End-to-end: jit.save a layer, create_predictor, serve
+        batched requests through the scheduler — one compiled program,
+        many requests."""
+        from paddle_tpu import inference
+
+        layer = pt.nn.Linear(4, 3)
+        prefix = str(tmp_path / "m")
+        pt.jit.save(layer, prefix,
+                    input_spec=[st.InputSpec([-1, 4], "float32", "x")])
+        cfg = inference.Config(prefix)
+        pred = inference.create_predictor(cfg)
+        sched = inference.BatchScheduler(pred, max_batch_size=4,
+                                         max_delay_ms=40)
+        xs = [np.full((1, 4), float(i), np.float32) for i in range(6)]
+        futs = [sched.submit(x) for x in xs]
+        outs = [f.result(timeout=60) for f in futs]
+        sched.close()
+        for x, o in zip(xs, outs):
+            want = layer(pt.to_tensor(x)).numpy()
+            np.testing.assert_allclose(o[0], want, rtol=1e-5,
+                                       atol=1e-6)
