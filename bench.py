@@ -67,10 +67,26 @@ def peak_flops_bf16():
     return 197e12  # conservative default
 
 
+def peak_hbm_bw():
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
+        return 819e9
+    if "v5p" in kind or "v5" in kind:
+        return 2765e9
+    if "v4" in kind:
+        return 1228e9
+    if "v6" in kind or "trillium" in kind:
+        return 1640e9
+    return 819e9
+
+
 METRICS = {
     "gpt2": "gpt2_345m_train_tokens_per_sec_per_chip",
     "llama350m": "llama_350m_train_tokens_per_sec_per_chip",
     "moe": "mixtral_8e_top2_train_tokens_per_sec_per_chip",
+    "llama1b3": "llama_1b3_train_tokens_per_sec_per_chip",
+    "decode": "gpt2_345m_decode_tokens_per_sec",
 }
 
 
@@ -136,6 +152,273 @@ def _probe_device_responsive(timeout_s=75):
     return False
 
 
+def main_llama1b3():
+    """Largest-fits single-chip run (VERDICT r5 #2): a 1.26B llama
+    (TinyLlama-class: L=22, H=2048, F=5632, 16 heads x 128) trained
+    bf16 with per-block rematerialization, Pallas flash attention, and
+    chunked fused linear+CE — the measured point closest to the
+    BASELINE.md "Llama-2 7B" row that one v5e chip can hold.
+
+    HBM budget (16 GB): params 2.5 GB + grads 2.5 GB + bf16 Adam
+    moments 5 GB + remat'd activations ~0.8 GB. The step builds from
+    raw stacked arrays (no Layer objects) so device init is ONE jitted
+    program instead of per-param transfers through the relay.
+    """
+    import os
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.parallel.hybrid import _rope_tables_np
+
+    L_, H_, F_, V_ = 22, 2048, 5632, 32000
+    NH = 16
+    dims = os.environ.get("PT_BENCH_2B_DIMS")    # "L,H,F,V,NH" (smoke)
+    if dims:
+        L_, H_, F_, V_, NH = (int(x) for x in dims.split(","))
+    HD = H_ // NH
+    B = int(os.environ.get("PT_BENCH_2B_BATCH", "4"))
+    S = int(os.environ.get("PT_BENCH_2B_SEQ", "2048"))
+    fused = os.environ.get("PT_BENCH_2B_FUSED", "1") != "0"
+    eps = 1e-5
+
+    devices = _devices_with_retry()
+    dev = devices[0]
+
+    def init(key):
+        ks = jax.random.split(key, 10)
+        sd = 0.02
+
+        def nrm(k, *shape):
+            return (jax.random.normal(k, shape, jnp.float32) * sd
+                    ).astype(jnp.bfloat16)
+
+        return {
+            "table": nrm(ks[0], V_, H_),
+            "blocks": {
+                "ln1": jnp.ones((L_, H_), jnp.bfloat16),
+                "ln2": jnp.ones((L_, H_), jnp.bfloat16),
+                "wq": nrm(ks[1], L_, H_, H_), "wk": nrm(ks[2], L_, H_, H_),
+                "wv": nrm(ks[3], L_, H_, H_), "wo": nrm(ks[4], L_, H_, H_),
+                "wg": nrm(ks[5], L_, H_, F_), "wu": nrm(ks[6], L_, H_, F_),
+                "wd": nrm(ks[7], L_, F_, H_),
+            },
+            "norm": jnp.ones((H_,), jnp.bfloat16),
+            "head": nrm(ks[8], H_, V_),
+        }
+
+    with jax.default_device(dev):
+        params = jax.jit(init)(jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(
+            lambda a: a.block_until_ready(), params)
+        # bf16 moments: the 20-step bench measures throughput; fp32
+        # moments (+5 GB) would not fit beside grads at this size
+        state = {"m": jax.tree_util.tree_map(jnp.zeros_like, params),
+                 "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+    n_params = sum(int(np.prod(v.shape))
+                   for v in jax.tree_util.tree_leaves(params))
+
+    cos_np, sin_np = _rope_tables_np(HD, S, 10000.0)
+    cos = jnp.asarray(cos_np, jnp.bfloat16)
+    sin = jnp.asarray(sin_np, jnp.bfloat16)
+
+    def rms(x, w):
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1,
+                       keepdims=True)
+        return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+                ).astype(x.dtype) * w
+
+    def rope(t):
+        # t [B, S, NH, HD]; tables [S, HD/2]
+        t1, t2 = jnp.split(t, 2, axis=-1)
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
+        return jnp.concatenate([t1 * c - t2 * s, t1 * s + t2 * c], -1)
+
+    use_flash = fa.available()
+
+    def attn(q, k, v):
+        if use_flash:
+            return fa._flash(q.transpose(0, 2, 1, 3),
+                             k.transpose(0, 2, 1, 3),
+                             v.transpose(0, 2, 1, 3), 1.0 / np.sqrt(HD),
+                             True).transpose(0, 2, 1, 3)
+        # CPU smoke-test fallback (the real bench always runs on TPU)
+        lg = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(HD)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        lg = jnp.where(mask, lg, jnp.finfo(lg.dtype).min)
+        p_ = jax.nn.softmax(lg.astype(jnp.float32), -1).astype(q.dtype)
+        return jnp.einsum("bnqk,bknd->bqnd", p_, v)
+
+    def block(p, x):
+        hn = rms(x, p["ln1"])
+        q = rope((hn @ p["wq"]).reshape(B, S, NH, HD))
+        k = rope((hn @ p["wk"]).reshape(B, S, NH, HD))
+        v = (hn @ p["wv"]).reshape(B, S, NH, HD)
+        x = x + attn(q, k, v).reshape(B, S, H_) @ p["wo"]
+        hn = rms(x, p["ln2"])
+        return x + (jax.nn.silu(hn @ p["wg"]) * (hn @ p["wu"])) @ p["wd"]
+
+    def fwd(ps, ids):
+        x = ps["table"][ids]
+
+        def body(xx, blk):
+            return block(blk, xx), None
+
+        x, _ = jax.lax.scan(jax.checkpoint(body), x, ps["blocks"])
+        h = rms(x, ps["norm"])
+        if fused:
+            return fused_linear_cross_entropy(
+                h[:, :-1], ps["head"], ids[:, 1:], chunk_size=2046)
+        lg = (h[:, :-1] @ ps["head"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, -1)
+        return -jnp.take_along_axis(logp, ids[:, 1:, None], -1).mean()
+
+    b1, b2, lr, adam_eps = 0.9, 0.999, 1e-4, 1e-8
+
+    def step(params, state, ids, i):
+        loss, grads = jax.value_and_grad(fwd)(params, ids)
+
+        def upd(p, g, m, v):
+            g32 = g.astype(jnp.float32)
+            m2 = b1 * m.astype(jnp.float32) + (1 - b1) * g32
+            v2 = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+            mhat = m2 / (1 - jnp.power(b1, i))
+            vhat = v2 / (1 - jnp.power(b2, i))
+            p2 = p.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat)
+                                                      + adam_eps)
+            return (p2.astype(p.dtype), m2.astype(m.dtype),
+                    v2.astype(v.dtype))
+
+        out = jax.tree_util.tree_map(upd, params, grads, state["m"],
+                                     state["v"])
+        new_p = jax.tree_util.tree_map(lambda t: t[0], out,
+                                       is_leaf=lambda t: isinstance(
+                                           t, tuple))
+        new_m = jax.tree_util.tree_map(lambda t: t[1], out,
+                                       is_leaf=lambda t: isinstance(
+                                           t, tuple))
+        new_v = jax.tree_util.tree_map(lambda t: t[2], out,
+                                       is_leaf=lambda t: isinstance(
+                                           t, tuple))
+        return loss, new_p, {"m": new_m, "v": new_v}
+
+    step = jax.jit(step, donate_argnums=(0, 1))
+
+    ids = jax.device_put(np.random.randint(
+        0, V_, size=(B, S)).astype(np.int32), dev)
+
+    def fi(i):
+        return jnp.asarray(i, jnp.float32)
+
+    loss, params, state = step(params, state, ids, fi(1))
+    float(loss)
+    loss, params, state = step(params, state, ids, fi(2))
+    float(loss)
+
+    iters = 8
+    t0 = time.perf_counter()
+    for i in range(iters):
+        loss, params, state = step(params, state, ids, fi(i + 3))
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+    flops_per_token = 6 * n_params
+    attn_flops = 12 * L_ * H_ * S      # causal-pair accounting per token
+    mfu = tokens_per_sec * (flops_per_token + attn_flops) / peak_flops_bf16()
+    print(json.dumps({
+        "metric": METRICS["llama1b3"],
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+    print(f"  loss={final_loss:.4f} mfu={mfu:.3f} "
+          f"params={n_params/1e6:.1f}M step_time={dt/iters*1000:.1f}ms "
+          f"B={B} S={S} fused_ce={fused}", file=sys.stderr)
+
+
+def main_decode():
+    """Serving decode metric (VERDICT r5 #7): static-KV-cache
+    autoregressive decode through incubate fused_multi_transformer at
+    GPT-2 345M shapes — prefill 512 then 127 decode steps, batch 8 and
+    batch 1. The JSON value is batch-8 decode tokens/s; vs_baseline is
+    the HBM-bandwidth utilization (decode is memory-bound: each token
+    streams the 2-byte weights once), the roofline the reference's
+    fused_multi_transformer_op.cu serving path also chases.
+    """
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.incubate.nn.functional as IF
+
+    L, D, H, FF = 24, 1024, 16, 4096
+    T_PRE, T_MAX, steps = 512, 1024, 128
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16
+
+    def mk(*s):
+        return jnp.asarray(
+            rng.standard_normal(s).astype("float32") * 0.02, dt)
+
+    weights = dict(
+        ln_scales=[jnp.ones((D,), dt) for _ in range(L)],
+        ln_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+        qkv_weights=[mk(D, 3 * D) for _ in range(L)],
+        qkv_biases=[jnp.zeros((3 * D,), dt) for _ in range(L)],
+        linear_weights=[mk(D, D) for _ in range(L)],
+        linear_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+        ffn_ln_scales=[jnp.ones((D,), dt) for _ in range(L)],
+        ffn_ln_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+        ffn1_weights=[mk(D, FF) for _ in range(L)],
+        ffn1_biases=[jnp.zeros((FF,), dt) for _ in range(L)],
+        ffn2_weights=[mk(FF, D) for _ in range(L)],
+        ffn2_biases=[jnp.zeros((D,), dt) for _ in range(L)],
+    )
+    n_params = sum(int(np.prod(w.shape)) for ws in weights.values()
+                   for w in ws)
+
+    def step_fn(x, caches, t, ws):
+        out, new_caches = IF.fused_multi_transformer(
+            x, num_heads=H, trans_qkvw=False, cache_kvs=caches,
+            time_step=t, **ws)
+        return out, new_caches
+
+    jit_step = jax.jit(step_fn, donate_argnums=(1,))
+    results = {}
+    for B in (8, 1):
+        caches = [jnp.zeros((2, B, H, T_MAX, D // H), dt)
+                  for _ in range(L)]
+        x_pre = mk(B, T_PRE, D)
+        x_dec = mk(B, 1, D)
+        t0 = time.perf_counter()
+        out, caches = jit_step(x_pre, caches, jnp.int32(0), weights)
+        float(out.sum())
+        prefill_s = time.perf_counter() - t0
+        out, caches = jit_step(x_dec, caches, jnp.int32(T_PRE), weights)
+        float(out.sum())
+        t0 = time.perf_counter()
+        for i in range(1, steps):
+            out, caches = jit_step(x_dec, caches,
+                                   jnp.int32(T_PRE + i), weights)
+        float(out.sum())
+        dt_dec = time.perf_counter() - t0
+        results[B] = (B * (steps - 1) / dt_dec, prefill_s)
+
+    toks8 = results[8][0]
+    # weights stream once per STEP (B tokens): steps/s x bytes / BW
+    bw_util = (toks8 / 8) * 2.0 * n_params / peak_hbm_bw()
+    print(json.dumps({
+        "metric": METRICS["decode"],
+        "value": round(toks8, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(bw_util, 4),
+    }))
+    print(f"  decode B=8: {toks8:,.0f} tok/s (prefill {results[8][1]:.2f}s)"
+          f" | B=1: {results[1][0]:,.0f} tok/s "
+          f"(prefill {results[1][1]:.2f}s) | params {n_params/1e6:.0f}M "
+          f"| HBM util {bw_util:.2f}", file=sys.stderr)
+
+
 def main(config_name="gpt2"):
     # probe FIRST, in a subprocess: when the relay wedges, even
     # jax.devices() in this process can hang with no exception to catch
@@ -152,6 +435,11 @@ def main(config_name="gpt2"):
         print("DEVICE UNRESPONSIVE: accelerator ops hang (relay outage) "
               "— no measurement possible this run", file=sys.stderr)
         return
+
+    if config_name == "llama1b3":
+        return main_llama1b3()
+    if config_name == "decode":
+        return main_decode()
 
     import jax
     import jax.numpy as jnp
@@ -266,7 +554,7 @@ def main(config_name="gpt2"):
 if __name__ == "__main__":
     _argv = sys.argv[1:]
     _cfg = "gpt2"
-    for _name in ("llama350m", "moe"):
+    for _name in ("llama350m", "moe", "llama1b3", "decode"):
         if f"--config={_name}" in _argv or _name in _argv:
             _cfg = _name
     main(_cfg)

@@ -110,6 +110,12 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=True, sm_scale=None,
     rank holds ALL tokens for H/n heads, runs full (flash) attention
     locally, then all_to_alls back. Needs heads % axis_size == 0."""
     n = jax.lax.axis_size(axis_name)
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses_attention: local heads {q.shape[1]} not divisible "
+            f"by {axis_name!r} size {n} — the heads<->sequence "
+            f"all_to_all needs heads % sp == 0 (use ring attention or "
+            f"reduce the sp degree)")
     # [B, H, S_loc, D] -> gather seq, split heads
     q_ = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
                             tiled=True)

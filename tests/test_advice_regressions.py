@@ -233,3 +233,183 @@ class TestGradientsSiblingOutputs:
         # (a, b) was dropped entirely, so sibling b was missing and the
         # replay crashed (or produced wrong grads)
         np.testing.assert_allclose(out[0], np.ones(3, np.float32))
+
+
+# ---------------------------------------------------------------- (f) r4
+
+class TestTiedHeadMpGuard:
+    def test_full_table_fns_refused_on_mp2_mesh(self):
+        """tie_embed_head + mp>1 must refuse any embed/head pair not
+        marked _mp_aware: a full-table lookup fn (e.g. a model
+        pipeline_decompose) would silently read the [V/mp, h] slice and
+        train to NaN."""
+        import paddle_tpu.parallel as dist
+        from paddle_tpu.parallel.pp_1f1b import (build_1f1b_train_step,
+                                                 make_tied_lm_fns)
+        mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+        rng = np.random.RandomState(0)
+        blocks = [{"w": jnp.asarray(rng.randn(16, 16).astype(np.float32))}
+                  for _ in range(4)]
+        embed = {"table": jnp.asarray(
+            rng.randn(64, 16).astype(np.float32))}
+        embed_fn, head_loss_fn = make_tied_lm_fns()
+        with pytest.raises(ValueError, match="_mp_aware"):
+            build_1f1b_train_step(
+                lambda p, x: jnp.tanh(x @ p["w"]), embed_fn, head_loss_fn,
+                blocks, embed, {}, mesh, num_micro=2, tie_embed_head=True)
+
+    def test_mp_aware_factories_carry_marker(self):
+        from paddle_tpu.parallel.hybrid import (make_llama_tp_fns,
+                                                make_tied_tp_lm_fns)
+        (_b, e1, h1), _ = make_llama_tp_fns(4, 2)
+        assert e1._mp_aware and h1._mp_aware
+        (_b2, e2, h2), _ = make_tied_tp_lm_fns(4, 2)
+        assert e2._mp_aware and h2._mp_aware
+
+
+class TestPartialOpsDivisibility:
+    def test_partial_allgather_rejects_indivisible(self):
+        import paddle_tpu.parallel as dist
+        from paddle_tpu.parallel.mesh import P as Pspec
+        mesh = dist.init_mesh(dp=4)
+
+        def body(x):
+            return dist.collective.partial_allgather(x, group="dp")
+
+        bad = jnp.zeros((7, 2), jnp.float32)   # 7 % 4 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            jax.shard_map(body, mesh=mesh.mesh, in_specs=Pspec(),
+                          out_specs=Pspec("dp"), check_vma=False)(bad)
+
+    def test_partial_ppermute_rejects_indivisible(self):
+        import paddle_tpu.parallel as dist
+        from paddle_tpu.parallel.mesh import P as Pspec
+        mesh = dist.init_mesh(dp=4)
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def body(x):
+            return dist.collective.partial_ppermute(x, perm, group="dp")
+
+        bad = jnp.zeros((6, 2), jnp.float32)   # 6 % 4 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            jax.shard_map(body, mesh=mesh.mesh, in_specs=Pspec(),
+                          out_specs=Pspec(), check_vma=False)(bad)
+
+
+class TestGradientMergeFp32Feed:
+    def test_fp16_k_step_sum_does_not_overflow(self):
+        """The fp32 accumulator must reach the optimizer WITHOUT a cast
+        back to the grad dtype: an fp16 re-cast of a k-step sum can
+        overflow to inf (and bf16 re-cast re-rounds the precision the
+        buffer existed to keep)."""
+        import paddle_tpu.parallel as dist
+        from paddle_tpu.core.tensor import unwrap
+        from paddle_tpu.parallel.api import parallel_train_step
+        mesh = dist.init_mesh(dp=1)
+        net = pt.nn.Linear(4, 4)
+        for _n, p in net.named_parameters():
+            p._replace_value(unwrap(p).astype(jnp.float16))
+        opt = pt.optimizer.Momentum(learning_rate=1e-9, momentum=0.9,
+                                    parameters=net.parameters())
+        # per-step grad wrt bias = 2 rows * 30000 = 60000 (< fp16 max);
+        # the k=2 SUM = 120000 overflows fp16
+        step_fn, params, opt_state, _ = parallel_train_step(
+            net, lambda out, *a: out.sum() * 30000.0, opt, mesh,
+            grad_accum_steps=2, accum_avg=False, donate=False)
+        x = np.ones((2, 4), np.float32)
+        batch = {"inputs": (x,), "labels": ()}
+        for i in (1, 2):
+            loss, params, opt_state = step_fn(params, opt_state, batch,
+                                              i, None)
+        flat = jax.tree_util.tree_leaves(params)
+        assert all(bool(jnp.all(jnp.isfinite(p))) for p in flat), \
+            "fp16 re-cast of the k-step sum overflowed to inf"
+        # params keep their storage dtype; the optimizer inner state is
+        # fp32 BY DESIGN for fp16 params (fp16 moments flush tiny v to
+        # zero) and must stay dtype-stable through the k-step select
+        assert {str(p.dtype) for p in flat} == {"float16"}
+        vel = jax.tree_util.tree_leaves(opt_state["_opt"])
+        assert {str(x.dtype) for x in vel} == {"float32"}, \
+            "fp16-param optimizer state must hold fp32 moments, stably"
+        bias = params["bias"] if "bias" in params else flat[0]
+        assert float(jnp.asarray(bias).sum()) < 0   # update applied
+
+
+class TestRoiAlignStaticReplay:
+    def test_recorded_program_does_not_bake_record_time_grids(self):
+        """Under the static recorder the adaptive grid must NOT be
+        derived from record-time box values: the Program replays with
+        fresh feeds. The recorder falls back to the fixed 2x2 grid —
+        same as the jit-tracing path — so replay(feed) == jit(feed)."""
+        import paddle_tpu.static as static
+        import paddle_tpu.vision.ops as V
+        feat = np.random.RandomState(3).rand(1, 2, 16, 16).astype(
+            np.float32)
+        # record with TINY boxes (adaptive grid would be 1x1)...
+        small = np.array([[1.0, 1.0, 3.0, 3.0]], np.float32)
+        # ...replay with BIG boxes (adaptive grid would be 8x8)
+        big = np.array([[0.0, 0.0, 15.0, 15.0]], np.float32)
+        bn = np.array([1], np.int32)
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            xv = static.data("x", shape=[1, 2, 16, 16], dtype="float32")
+            bv = static.data("boxes", shape=[1, 4], dtype="float32")
+            _ = small  # record-time values never enter the graph
+            out = V.roi_align(xv, bv, bn, output_size=2)
+        exe = static.Executor()
+        got = exe.run(prog, feed={"x": feat, "boxes": big},
+                      fetch_list=[out])[0]
+
+        want = jax.jit(lambda f, b: V.roi_align(f, b, bn, output_size=2)
+                       )(feat, big)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------------- (g) review r5
+
+class TestLowPrecisionOptimizerDtypes:
+    @pytest.mark.parametrize("opt_name", ["Momentum", "RMSProp",
+                                          "Adagrad", "Adamax"])
+    def test_fp16_params_stay_fp16_one_eager_step(self, opt_name):
+        """fp32 moments must not promote fp16 params through
+        `p - lr * upd` in ANY optimizer (only Adam/Lamb cast back
+        internally)."""
+        from paddle_tpu.core.tensor import unwrap
+        net = pt.nn.Linear(4, 4)
+        for _n, p in net.named_parameters():
+            p._replace_value(unwrap(p).astype(jnp.float16))
+        opt = getattr(pt.optimizer, opt_name)(
+            learning_rate=1e-3, parameters=net.parameters())
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        dts = {str(unwrap(p).dtype) for p in net.parameters()}
+        assert dts == {"float16"}, (opt_name, dts)
+
+    def test_adagrad_fp16_accumulator_is_fp32(self):
+        """Adagrad's moment must not flush g^2 < 6e-8 to zero."""
+        from paddle_tpu.core.tensor import unwrap
+        net = pt.nn.Linear(2, 2)
+        for _n, p in net.named_parameters():
+            p._replace_value(unwrap(p).astype(jnp.float16))
+        opt = pt.optimizer.Adagrad(learning_rate=1e-3,
+                                   parameters=net.parameters())
+        st = opt.init_state({n: unwrap(p)
+                             for n, p in net.named_parameters()})
+        dts = {str(a.dtype)
+               for a in jax.tree_util.tree_leaves(st["moment"])}
+        assert dts == {"float32"}, dts
+
+
+class TestSchedulerOversizedRequest:
+    def test_request_bigger_than_max_batch_runs_alone(self):
+        from paddle_tpu.inference import BatchScheduler
+        sched = BatchScheduler(lambda s: [s[0] * 2.0],
+                               max_batch_size=4, max_delay_ms=5)
+        big = np.ones((9, 3), np.float32)
+        out = sched.submit(big).result(timeout=20)
+        sched.close()
+        np.testing.assert_allclose(out[0], big * 2.0)
