@@ -1,27 +1,21 @@
 """paddle.quantization parity: observers, fake quanters, QuantConfig,
-QAT/PTQ pipelines.
-
-Reference: python/paddle/quantization/ (base_quanter.py, base_observer.py,
-config.py, qat.py, ptq.py, quantize.py, observers/abs_max.py,
-quanters/abs_max.py) and python/paddle/nn/quant/quant_layers.py.
-
-TPU-native design: fake-quant is a pure function with a straight-through
-estimator (`x + stop_gradient(q(x) - x)`), so QAT graphs stay fully
-jittable — no per-op Python hooks in the hot path. Scales live as layer
-buffers; `convert` bakes them for inference (int8 simulation in bf16/fp32
-compute, which is what the MXU wants).
+QAT/PTQ pipelines — package layout mirroring the reference
+python/paddle/quantization/ (observers/, config.py, qat.py).
+See each submodule's docstring for the TPU-native design notes.
 """
-from __future__ import annotations
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-from ..core.tensor import Tensor, dispatch, unwrap, wrap
-from ..nn.layer import Layer
-from ..nn import functional as F
+from .observers import (fake_quant, quant_dequant, BaseQuanter,
+                        BaseObserver, QuanterFactory, quanter,
+                        AbsmaxObserver, AbsmaxObserverLayer, EMAObserver,
+                        EMAObserverLayer, AVGObserver, AVGObserverLayer,
+                        HistObserver, HistObserverLayer, KLObserver,
+                        KLObserverLayer, MSEObserver, MSEObserverLayer,
+                        FakeQuanterWithAbsMaxObserver,
+                        FakeQuanterWithAbsMaxObserverLayer,
+                        FakeQuanterChannelWiseAbsMax,
+                        FakeQuanterChannelWiseAbsMaxLayer)
+from .config import SingleLayerConfig, QuantConfig
+from .qat import (QuantedLinear, QuantedConv2D, Quantization, QAT, PTQ,
+                  Int8InferLinear, to_int8_inference)
 
 __all__ = [
     "fake_quant", "quant_dequant", "BaseQuanter", "BaseObserver",
@@ -29,620 +23,5 @@ __all__ = [
     "AVGObserver", "HistObserver", "KLObserver", "MSEObserver",
     "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMax",
     "QuantConfig", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
+    "to_int8_inference",
 ]
-
-
-def _v(x):
-    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
-
-
-def fake_quant(x, scale, bit_length=8):
-    """Symmetric round-to-nearest: q = round(x/scale * qmax) clamped, then
-    dequantized. Scale broadcasts (per-tensor scalar or per-channel)."""
-    qmax = float(2 ** (bit_length - 1) - 1)
-    s = jnp.maximum(scale, 1e-9)
-    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
-    return q * s / qmax
-
-
-def quant_dequant(x, scale, bit_length=8):
-    """fake_quant with a straight-through gradient (QAT trainable)."""
-    return x + lax.stop_gradient(fake_quant(x, scale, bit_length) - x)
-
-
-class BaseQuanter(Layer):
-    """Layer that simulates quantization in forward (reference
-    base_quanter.py). Subclasses implement forward + scales()."""
-
-    def scales(self):
-        raise NotImplementedError
-
-    def quant_axis(self):
-        return None
-
-    def bit_length(self):
-        return 8
-
-
-class BaseObserver(BaseQuanter):
-    """Calibration-only quanter: observes ranges, passes data through
-    (reference base_observer.py). convert() freezes observation so serving
-    traffic can no longer move the calibrated scales."""
-
-    def __init__(self):
-        super().__init__()
-        self._frozen = False
-
-    def observe(self, x):
-        raise NotImplementedError
-
-    def forward(self, x):
-        if not self._frozen:
-            self.observe(x)
-        return x
-
-
-class _WithArgs:
-    def __init__(self, *args, **kwargs):
-        self.args = args
-        self.kwargs = kwargs
-
-
-class QuanterFactory(_WithArgs):
-    """Partial-application handle: holds ctor args, instantiated per layer
-    (reference factory.py QuanterFactory)."""
-    _layer_cls = None
-
-    def _instance(self, layer):
-        return self._layer_cls(layer, *self.args, **self.kwargs)
-
-
-def quanter(name):
-    """Decorator registering a quanter layer class under a factory with
-    the given name (reference factory.py quanter)."""
-    def deco(layer_cls):
-        factory = type(name, (QuanterFactory,), {"_layer_cls": layer_cls})
-        globals()[name] = factory
-        return layer_cls
-    return deco
-
-
-class AbsmaxObserverLayer(BaseObserver):
-    """Running abs-max calibration observer (reference
-    observers/abs_max.py)."""
-
-    def __init__(self, layer=None, quant_bits=8):
-        super().__init__()
-        self._quant_bits = quant_bits
-        self._max = 0.0
-        del layer  # factory protocol passes the wrapped layer; unused here
-
-    def observe(self, x):
-        v = float(jnp.max(jnp.abs(_v(x))))
-        self._max = max(self._max, v)
-
-    def scales(self):
-        return wrap(jnp.asarray(self._max, jnp.float32))
-
-    def bit_length(self):
-        return self._quant_bits
-
-    def cal_thresholds(self):
-        pass
-
-
-class AbsmaxObserver(QuanterFactory):
-    _layer_cls = AbsmaxObserverLayer
-
-
-class EMAObserverLayer(BaseObserver):
-    """Exponential-moving-average absmax (reference observers/ema.py)."""
-
-    def __init__(self, layer=None, quant_bits=8, moving_rate=0.9):
-        super().__init__()
-        self._quant_bits = quant_bits
-        self._rate = moving_rate
-        self._ema = None
-        del layer
-
-    def observe(self, x):
-        v = float(jnp.max(jnp.abs(_v(x))))
-        self._ema = v if self._ema is None else \
-            self._rate * self._ema + (1.0 - self._rate) * v
-
-    def scales(self):
-        return wrap(jnp.asarray(self._ema or 0.0, jnp.float32))
-
-    def bit_length(self):
-        return self._quant_bits
-
-    def cal_thresholds(self):
-        pass
-
-
-class EMAObserver(QuanterFactory):
-    _layer_cls = EMAObserverLayer
-
-
-class AVGObserverLayer(BaseObserver):
-    """Mean of per-batch absmax (reference observers/avg.py)."""
-
-    def __init__(self, layer=None, quant_bits=8):
-        super().__init__()
-        self._quant_bits = quant_bits
-        self._sum = 0.0
-        self._n = 0
-        del layer
-
-    def observe(self, x):
-        self._sum += float(jnp.max(jnp.abs(_v(x))))
-        self._n += 1
-
-    def scales(self):
-        return wrap(jnp.asarray(self._sum / max(self._n, 1), jnp.float32))
-
-    def bit_length(self):
-        return self._quant_bits
-
-    def cal_thresholds(self):
-        pass
-
-
-class AVGObserver(QuanterFactory):
-    _layer_cls = AVGObserverLayer
-
-
-class _HistogramObserverBase(BaseObserver):
-    """Shared |x| histogram accumulation (reference observers/
-    base_hist.py): a fixed-bin histogram over [0, running_max], rescaled
-    when the range grows."""
-
-    def __init__(self, layer=None, quant_bits=8, bins_count=2048):
-        super().__init__()
-        self._quant_bits = quant_bits
-        self._bins = bins_count
-        self._hist = np.zeros(bins_count, np.float64)
-        self._max = 0.0
-        self._scale = None
-        del layer
-
-    def observe(self, x):
-        self._scale = None   # new data invalidates the cached threshold
-        v = np.abs(np.asarray(_v(x), np.float64)).reshape(-1)
-        vmax = float(v.max()) if v.size else 0.0
-        if vmax > self._max:
-            if self._max > 0.0:
-                # re-bin the old histogram onto the wider range
-                old_edges = np.linspace(0, self._max, self._bins + 1)
-                centers = (old_edges[:-1] + old_edges[1:]) / 2
-                self._hist = np.histogram(
-                    centers, bins=self._bins, range=(0, vmax),
-                    weights=self._hist)[0]
-            self._max = vmax
-        if self._max > 0.0:
-            self._hist += np.histogram(v, bins=self._bins,
-                                       range=(0, self._max))[0]
-
-    def bit_length(self):
-        return self._quant_bits
-
-    def scales(self):
-        if self._scale is None:
-            self.cal_thresholds()
-        return wrap(jnp.asarray(self._scale or self._max, jnp.float32))
-
-
-class HistObserverLayer(_HistogramObserverBase):
-    """Percentile threshold (reference observers/hist.py)."""
-
-    def __init__(self, layer=None, quant_bits=8, bins_count=2048,
-                 percent=0.999):
-        super().__init__(layer, quant_bits, bins_count)
-        self._percent = percent
-
-    def cal_thresholds(self):
-        total = self._hist.sum()
-        if total <= 0:
-            self._scale = self._max
-            return
-        cum = np.cumsum(self._hist) / total
-        idx = int(np.searchsorted(cum, self._percent))
-        edges = np.linspace(0, self._max, self._bins + 1)
-        self._scale = float(edges[min(idx + 1, self._bins)])
-
-
-class HistObserver(QuanterFactory):
-    _layer_cls = HistObserverLayer
-
-
-class KLObserverLayer(_HistogramObserverBase):
-    """KL-divergence threshold search (reference observers/kl.py — the
-    TensorRT-style calibration: pick the clip threshold whose quantized
-    distribution has minimal KL divergence from the observed one)."""
-
-    def cal_thresholds(self):
-        total = self._hist.sum()
-        if total <= 0:
-            self._scale = self._max
-            return
-        levels = 2 ** (self._quant_bits - 1)
-        eps = 1e-10
-        p_full = self._hist / total + eps
-        p_full /= p_full.sum()
-        best_kl, best_i = np.inf, self._bins
-        start = max(levels, self._bins // 16)
-        for i in range(start, self._bins + 1, max(1, self._bins // 128)):
-            # quantize the kept range into `levels` buckets; bins past the
-            # clip threshold get (near-)zero mass, so clipping away real
-            # probability carries an explicit KL cost — without the
-            # full-support comparison, i == levels represents p exactly
-            # and the search degenerates to the smallest threshold
-            chunks = np.array_split(self._hist[:i], levels)
-            q = np.concatenate([
-                np.full(len(c), c.sum() / max((c > 0).sum(), 1))
-                * (c > 0) for c in chunks])
-            q_full = np.concatenate(
-                [q, np.zeros(self._bins - i)]) + eps
-            q_full /= q_full.sum()
-            kl = float(np.sum(p_full * np.log(p_full / q_full)))
-            if kl < best_kl:
-                best_kl, best_i = kl, i
-        edges = np.linspace(0, self._max, self._bins + 1)
-        self._scale = float(edges[best_i])
-
-
-class KLObserver(QuanterFactory):
-    _layer_cls = KLObserverLayer
-
-
-class MSEObserverLayer(_HistogramObserverBase):
-    """Scale minimizing quantization MSE over the observed histogram
-    (reference observers/mse.py)."""
-
-    def cal_thresholds(self):
-        total = self._hist.sum()
-        if total <= 0:
-            self._scale = self._max
-            return
-        qmax = float(2 ** (self._quant_bits - 1) - 1)
-        edges = np.linspace(0, self._max, self._bins + 1)
-        centers = (edges[:-1] + edges[1:]) / 2
-        w = self._hist / total
-        best_mse, best_s = np.inf, self._max
-        for frac in np.linspace(0.3, 1.0, 36):
-            s = self._max * frac
-            q = np.clip(np.round(centers / s * qmax), -qmax, qmax) \
-                * s / qmax
-            mse = float(np.sum(w * (centers - q) ** 2))
-            if mse < best_mse:
-                best_mse, best_s = mse, s
-        self._scale = float(best_s)
-
-
-class MSEObserver(QuanterFactory):
-    _layer_cls = MSEObserverLayer
-
-
-class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
-    """Moving-average abs-max fake quanter (reference quanters/abs_max.py,
-    nn/quant FakeQuantMovingAverageAbsMax)."""
-
-    def __init__(self, layer=None, moving_rate=0.9, bit_length=8):
-        super().__init__()
-        self._moving_rate = moving_rate
-        self._bit_length = bit_length
-        self.register_buffer("_scale", wrap(jnp.asarray(1.0, jnp.float32)))
-
-    def forward(self, x):
-        if self.training:
-            cur = jnp.max(jnp.abs(_v(x))).astype(jnp.float32)
-            r = self._moving_rate
-            new_scale = r * unwrap(self._scale) + (1 - r) * cur
-            # under jit tracing the buffer update is a Python side effect on
-            # a tracer; skip it there (the traced graph still uses the
-            # updated scale) — eager QAT steps persist it
-            if not isinstance(new_scale, jax.core.Tracer):
-                self._scale.set_value(new_scale)
-            scale = new_scale
-        else:
-            scale = unwrap(self._scale)
-        bits = self._bit_length
-        # dispatch records the STE vjp on the eager tape
-        return dispatch(
-            lambda v: quant_dequant(v, lax.stop_gradient(scale), bits),
-            x, name="fake_quant_moving_absmax")
-
-    def scales(self):
-        return self._scale
-
-    def bit_length(self):
-        return self._bit_length
-
-
-class FakeQuanterWithAbsMaxObserver(QuanterFactory):
-    _layer_cls = FakeQuanterWithAbsMaxObserverLayer
-
-
-class FakeQuanterChannelWiseAbsMaxLayer(BaseQuanter):
-    """Per-output-channel abs-max weight quanter (reference
-    FakeQuantChannelWiseAbsMax)."""
-
-    def __init__(self, layer=None, quant_axis=None, bit_length=8):
-        super().__init__()
-        if quant_axis is None:
-            # per-output-channel: conv OIHW → axis 0, transpose conv
-            # [in, out//g, kh, kw] → axis 1, Linear [in, out] → axis 1
-            from ..nn.layers_basic import _ConvND
-            if isinstance(layer, _ConvND):
-                quant_axis = 1 if getattr(layer, "_transpose", False) else 0
-            else:
-                quant_axis = 1
-        self._quant_axis = quant_axis
-        self._bit_length = bit_length
-        self._scale_val = None
-
-    def forward(self, w):
-        bits = self._bit_length
-        wv = _v(w)
-        axes = tuple(i for i in range(wv.ndim) if i != self._quant_axis)
-        scale = jnp.max(jnp.abs(wv), axis=axes, keepdims=True)
-        self._scale_val = scale
-        # scale enters fn as a closure constant: STE treats it as constant
-        # anyway, and this avoids recomputing the reduction in the trace
-        return dispatch(
-            lambda v: quant_dequant(v, scale, bits),
-            w, name="fake_quant_channelwise_absmax")
-
-    def scales(self):
-        return wrap(self._scale_val)
-
-    def quant_axis(self):
-        return self._quant_axis
-
-    def bit_length(self):
-        return self._bit_length
-
-
-class FakeQuanterChannelWiseAbsMax(QuanterFactory):
-    _layer_cls = FakeQuanterChannelWiseAbsMaxLayer
-
-
-# ---------------------------------------------------------------- config
-
-class SingleLayerConfig:
-    def __init__(self, activation=None, weight=None):
-        self.activation = activation
-        self.weight = weight
-
-
-class QuantConfig:
-    """Maps layers → quanter factories (reference config.py QuantConfig:
-    add_layer_config / add_name_config / add_type_config / default)."""
-
-    def __init__(self, activation=None, weight=None):
-        self._default = SingleLayerConfig(activation, weight)
-        self._by_layer = {}     # layer.full_name() -> cfg
-        self._by_name = {}      # dotted attribute path -> cfg
-        self._by_type = {}      # type -> cfg
-        self._qat_mapping = dict(_DEFAULT_QAT_MAPPING)
-
-    def add_layer_config(self, layer, activation=None, weight=None):
-        # keyed by full_name(), not id(): quantize() deepcopies the model
-        # before transforming, and the copy keeps full_name while id
-        # changes (reference python/paddle/quantization/config.py keys
-        # by layer.full_name() for the same reason)
-        layers = layer if isinstance(layer, (list, tuple)) else [layer]
-        for l in layers:
-            self._by_layer[l.full_name()] = SingleLayerConfig(
-                activation, weight)
-
-    def add_name_config(self, name, activation=None, weight=None):
-        names = name if isinstance(name, (list, tuple)) else [name]
-        for n in names:
-            self._by_name[n] = SingleLayerConfig(activation, weight)
-
-    def add_type_config(self, layer_type, activation=None, weight=None):
-        types = layer_type if isinstance(layer_type, (list, tuple)) \
-            else [layer_type]
-        for t in types:
-            self._by_type[t] = SingleLayerConfig(activation, weight)
-
-    def add_qat_layer_mapping(self, source, target):
-        self._qat_mapping[source] = target
-
-    def _config_for(self, layer, name):
-        key = layer.full_name() if hasattr(layer, "full_name") else None
-        if key in self._by_layer:
-            return self._by_layer[key]
-        if name in self._by_name:
-            return self._by_name[name]
-        for t, cfg in self._by_type.items():
-            if isinstance(layer, t):
-                return cfg
-        if self._default.activation or self._default.weight:
-            return self._default
-        return None
-
-
-# ------------------------------------------------------- quantized layers
-
-class QuantedLinear(Layer):
-    """Linear with weight+activation fake quant (reference
-    nn/quant/qat/linear.py QuantedLinear)."""
-
-    def __init__(self, layer, q_config: SingleLayerConfig):
-        super().__init__()
-        self.weight = layer.weight
-        self.bias = layer.bias
-        self.activation_quanter = (
-            q_config.activation._instance(layer)
-            if q_config.activation else None)
-        self.weight_quanter = (
-            q_config.weight._instance(layer) if q_config.weight else None)
-
-    def forward(self, x):
-        w = self.weight
-        if self.weight_quanter is not None:
-            w = self.weight_quanter(w)
-        if self.activation_quanter is not None:
-            x = self.activation_quanter(x)
-        return F.linear(x, w, self.bias)
-
-
-class QuantedConv2D(Layer):
-    def __init__(self, layer, q_config: SingleLayerConfig):
-        super().__init__()
-        self.weight = layer.weight
-        self.bias = layer.bias
-        # copy conv config as plain attrs: keeping `layer` as a sublayer
-        # would leave the raw Conv2D visible to named_sublayers and let a
-        # second quantize() pass double-wrap it
-        self._stride = layer.stride
-        self._padding = layer.padding
-        self._dilation = layer.dilation
-        self._groups = layer.groups
-        self._data_format = layer.data_format
-        self.activation_quanter = (
-            q_config.activation._instance(layer)
-            if q_config.activation else None)
-        self.weight_quanter = (
-            q_config.weight._instance(layer) if q_config.weight else None)
-
-    def forward(self, x):
-        w = self.weight
-        if self.weight_quanter is not None:
-            w = self.weight_quanter(w)
-        if self.activation_quanter is not None:
-            x = self.activation_quanter(x)
-        return F.conv2d(x, w, self.bias, stride=self._stride,
-                        padding=self._padding, dilation=self._dilation,
-                        groups=self._groups, data_format=self._data_format)
-
-
-def _default_qat_mapping():
-    from ..nn.layers_basic import Linear
-    mapping = {Linear: QuantedLinear}
-    try:
-        from ..nn.layers_basic import Conv2D
-        mapping[Conv2D] = QuantedConv2D
-    except ImportError:
-        pass
-    return mapping
-
-
-_DEFAULT_QAT_MAPPING = _default_qat_mapping()
-
-
-# ---------------------------------------------------------------- engines
-
-class Quantization:
-    def __init__(self, config: QuantConfig):
-        self._config = config
-
-    def _transform(self, model, wrap_fn, inplace=False):
-        if not inplace:
-            import copy
-            model = copy.deepcopy(model)  # keep the fp original intact
-        for name, sub in list(model.named_sublayers()):
-            cfg = self._config._config_for(sub, name)
-            target = self._config._qat_mapping.get(type(sub))
-            if cfg is not None and target is not None:
-                replacement = wrap_fn(sub, cfg, target)
-                _set_sublayer(model, name, replacement)
-        return model
-
-    def quantize(self, model, inplace=False):
-        return self._transform(model,
-                               lambda sub, cfg, tgt: tgt(sub, cfg),
-                               inplace=inplace)
-
-    def convert(self, model, inplace=False):
-        """Freeze: eval-mode scales baked; observers stop updating. With
-        inplace=False (default) the QAT/calibration model stays live and a
-        frozen copy is returned."""
-        if not inplace:
-            import copy
-            model = copy.deepcopy(model)
-        model.eval()
-        for _, sub in model.named_sublayers(include_self=True):
-            if isinstance(sub, BaseObserver):
-                sub._frozen = True
-        return model
-
-
-class QAT(Quantization):
-    """Quantization-aware training (reference qat.py). quantize() swaps
-    matched layers for Quanted* wrappers with trainable-through STE."""
-
-
-class PTQ(Quantization):
-    """Post-training quantization (reference ptq.py): wrap with observers,
-    run calibration batches, then convert()."""
-
-
-def _set_sublayer(root, dotted, new):
-    parts = dotted.split(".")
-    obj = root
-    for p in parts[:-1]:
-        obj = getattr(obj, p)
-    setattr(obj, parts[-1], new)
-
-
-class Int8InferLinear(Layer):
-    """True-int8 inference Linear (reference capability: the cutlass int8
-    deploy kernels behind PTQ convert). Weights pre-quantized to int8 with
-    per-output-channel scales; forward runs the Pallas int8 MXU matmul
-    (ops/pallas/quant_matmul.py) with activation quantization per batch
-    and fused dequantize."""
-
-    def __init__(self, layer):
-        super().__init__()
-        import jax.numpy as jnp
-
-        from ..core.tensor import unwrap, wrap
-        from ..ops.pallas.quant_matmul import quantize_tensor
-        w = unwrap(layer.weight)
-        qw, sw = quantize_tensor(w, per_channel_axis=1)
-        self.register_buffer("qweight", wrap(qw))
-        self.register_buffer("w_scale", wrap(jnp.asarray(sw)))
-        self.bias = getattr(layer, "bias", None)
-
-    def forward(self, x):
-        from ..core.tensor import dispatch
-        from ..ops.pallas import quant_matmul as qm
-
-        def fn(xv, qw, sw):
-            import jax
-            # deploy-only path: int8 rounding is non-differentiable and the
-            # Pallas kernel has no JVP rule — cut the tangent explicitly
-            xv = jax.lax.stop_gradient(xv)
-            shape = xv.shape
-            x2 = xv.reshape(-1, shape[-1])
-            qx, sx = qm.quantize_tensor(x2)
-            out = qm.quantized_matmul(
-                qx, qw, sx, sw, interpret=not qm.available())
-            return out.reshape(shape[:-1] + (out.shape[-1],)).astype(
-                xv.dtype)
-
-        out = dispatch(fn, x, self.qweight, self.w_scale,
-                       nondiff_args=(1, 2), name="int8_linear")
-        if self.bias is not None:
-            out = out + self.bias
-        return out
-
-
-def to_int8_inference(model, inplace=False):
-    """Replace (Quanted)Linear layers with true-int8 Int8InferLinear for
-    deployment (the step after convert(); reference: save_quantized_model
-    emitting int8 ops)."""
-    if not inplace:
-        import copy
-        model = copy.deepcopy(model)
-    for name, sub in list(model.named_sublayers()):
-        from ..nn.layers_basic import Linear
-        if isinstance(sub, (Linear, QuantedLinear)):
-            _set_sublayer(model, name, Int8InferLinear(sub))
-    return model
-
-
-__all__ += ["Int8InferLinear", "to_int8_inference"]

@@ -1,0 +1,67 @@
+"""QuantConfig (reference python/paddle/quantization/config.py): per-layer
+/ per-type / global quanter assignment, keyed by stable layer full_name
+so configs survive the deepcopy inside Quantization.quantize."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from .observers import QuanterFactory
+
+
+# ---------------------------------------------------------------- config
+
+class SingleLayerConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Maps layers → quanter factories (reference config.py QuantConfig:
+    add_layer_config / add_name_config / add_type_config / default)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._default = SingleLayerConfig(activation, weight)
+        self._by_layer = {}     # layer.full_name() -> cfg
+        self._by_name = {}      # dotted attribute path -> cfg
+        self._by_type = {}      # type -> cfg
+        from .qat import _DEFAULT_QAT_MAPPING   # lazy: qat imports config
+        self._qat_mapping = dict(_DEFAULT_QAT_MAPPING)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        # keyed by full_name(), not id(): quantize() deepcopies the model
+        # before transforming, and the copy keeps full_name while id
+        # changes (reference python/paddle/quantization/config.py keys
+        # by layer.full_name() for the same reason)
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._by_layer[l.full_name()] = SingleLayerConfig(
+                activation, weight)
+
+    def add_name_config(self, name, activation=None, weight=None):
+        names = name if isinstance(name, (list, tuple)) else [name]
+        for n in names:
+            self._by_name[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._by_type[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        self._qat_mapping[source] = target
+
+    def _config_for(self, layer, name):
+        key = layer.full_name() if hasattr(layer, "full_name") else None
+        if key in self._by_layer:
+            return self._by_layer[key]
+        if name in self._by_name:
+            return self._by_name[name]
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._default.activation or self._default.weight:
+            return self._default
+        return None
+
+

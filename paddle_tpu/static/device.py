@@ -1,0 +1,82 @@
+"""Place/device helpers and IPU shells (reference paddle.static places
+API; TPU-native: places are informational — XLA owns placement)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype as to_jax_dtype
+from ..utils import unique_name
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
+                    default_startup_program, in_static_build, program_guard)
+
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [f"cpu:{i}" for i in range(n)]
+
+
+def xpu_places(device_count=None):
+    return cpu_places(device_count)
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@_ctx.contextmanager
+def name_scope(prefix=None):
+    # Prefix names but keep the *global* uniqueness counters (reference
+    # fluid name_scope semantics): two models built under the same scope
+    # prefix must not collide in the process-global scope.
+    outer = unique_name._generator
+
+    class _Prefixed(unique_name.UniqueNameGenerator):
+        def __call__(self, key):
+            return outer(f"{prefix or ''}{key}")
+
+    with unique_name.guard(_Prefixed()):
+        yield
+
+
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def npu_places(device_ids=None):
+    return []
+
+
+def mlu_places(device_ids=None):
+    return []
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class IpuStrategy:
+    def __init__(self):
+        self.enable_fp16 = False
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise NotImplementedError(
+            "IPU backend is not part of the TPU build; use the default "
+            "Executor (XLA) path")
+
+
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func  # IPU sharding has no TPU meaning; identity

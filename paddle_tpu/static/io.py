@@ -1,0 +1,135 @@
+"""Model save/load + serialization (reference python/paddle/static/io.py):
+save/load_inference_model over the StableHLO exporter, program state
+save/load, serialize/deserialize surfaces."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype as to_jax_dtype
+from ..utils import unique_name
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
+                    default_startup_program, in_static_build, program_guard)
+from .program import _program_infer_fn, _prune_ops  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize an inference function as StableHLO + params
+    (reference: paddle.static.save_inference_model → __model__ ProgramDesc;
+    here the artifact is a jax.export archive consumed by
+    paddle_tpu.inference.create_predictor)."""
+    from ..inference.export import export_program
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    if program is None:
+        owner = getattr(feed_vars[0], "block", None)
+        program = owner.program if owner is not None else default_main_program()
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    export_program(path_prefix, program, [v.name for v in feed_vars],
+                   [v.name for v in fetch_vars], global_scope())
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program_like, feed_names, fetch_names); the returned object
+    is directly callable via Executor.run-compatible predictor."""
+    from ..inference.export import load_exported
+    return load_exported(path_prefix)
+
+
+def save(program, path_prefix):
+    """Persist all persistable vars of ``program`` (paddle.static.save)."""
+    from ..io.save_load import save as _save
+    scope = global_scope()
+    names = [n for n, v in program.global_block.vars.items()
+             if v.persistable and n in scope._vars]
+    _save({n: np.asarray(scope._vars[n]) for n in names},
+          path_prefix + ".pdparams")
+
+
+def load(program, path_prefix, executor=None, var_list=None):
+    from ..io.save_load import load as _load
+    state = _load(path_prefix + ".pdparams")
+    scope = global_scope()
+    for n, v in state.items():
+        scope._vars[n] = jnp.asarray(np.asarray(v))
+
+
+
+
+def set_program_state(program, state_dict):
+    scope = global_scope()
+    for n, v in state_dict.items():
+        scope._vars[n] = jnp.asarray(np.asarray(v))
+
+
+
+
+# --- program serialization (reference static/io.py) -------------------
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+    program = program or default_main_program()
+    return pickle.dumps({
+        "version": 1,
+        "feeds": [v.name for v in feed_vars],
+        "fetches": [v.name for v in fetch_vars],
+        "desc": [(op.op_type, [getattr(i, "name", None) for i in op.inputs],
+                  list(op.outputs))
+                 for op in program.global_block.ops],
+    })
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    import pickle
+
+    import numpy as _np
+    scope = global_scope()
+    state = {n: _np.asarray(scope._vars[n])
+             for n in scope.local_var_names()}
+    return pickle.dumps(state)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    scope = global_scope()
+    for name, val in state.items():
+        scope.var(name).set(val)
+    return state
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference normalize_program prunes to the feed->fetch subgraph; our
+    executor prunes at run time, so normalization is the identity plus
+    recording the endpoints."""
+    program._normalized_feeds = [v.name for v in feed_vars]
+    program._normalized_fetches = [v.name for v in fetch_vars]
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    from ..io.save_load import load as _load
+    state = _load(model_path if model_path.endswith(".pdparams")
+                  else model_path + ".pdparams")
+    return state
+
+

@@ -1,0 +1,70 @@
+"""Static metric ops (reference python/paddle/static/nn/metric.py):
+accuracy/auc/ctr bundle + fluid-era lr decay helper."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype as to_jax_dtype
+from ..utils import unique_name
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
+                    default_startup_program, in_static_build, program_guard)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """static.accuracy op parity: top-k accuracy over a batch."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import dispatch
+
+    def fn(logits, lb):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = (topk == lb.reshape(-1, 1)).any(-1)
+        return hit.mean(dtype=jnp.float32)
+
+    return dispatch(fn, input, label, nondiff_args=(1,), name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,  # noqa: A002
+        slide_steps=1, ins_tag_weight=None):
+    """static.auc op parity: returns (auc_value, batch_auc, states...)
+    simplified to the AUC value via the rank statistic."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    probs = np.asarray(input.numpy() if isinstance(input, Tensor)
+                       else input)
+    lb = np.asarray(label.numpy() if isinstance(label, Tensor)
+                    else label).reshape(-1)
+    pos_scores = probs[:, 1] if probs.ndim == 2 and probs.shape[1] > 1 \
+        else probs.reshape(-1)
+    order = np.argsort(pos_scores)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(order) + 1)
+    n_pos = (lb == 1).sum()
+    n_neg = (lb == 0).sum()
+    if n_pos == 0 or n_neg == 0:
+        value = 0.0
+    else:
+        value = (ranks[lb == 1].sum() - n_pos * (n_pos + 1) / 2) \
+            / (n_pos * n_neg)
+    import paddle_tpu as pt
+    v = pt.to_tensor(np.float32(value))
+    return v, v, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):  # noqa: A002
+    """CTR metrics (reference static.ctr_metric_bundle): returns
+    (auc, batch_auc, [stat states])."""
+    return auc(input, label)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """Legacy LR schedule fn -> ExponentialDecay scheduler handle."""
+    from ..optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+

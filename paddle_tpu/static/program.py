@@ -1,0 +1,346 @@
+"""Program-building API: data/InputSpec/parameters, append_backward,
+gradients, compiled-program & strategy shells, EMA (reference
+python/paddle/static/__init__.py + framework.py surfaces)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype as to_jax_dtype
+from ..utils import unique_name
+from .executor import Executor, Scope, global_scope, scope_guard  # noqa: F401
+from .graph import (Program, Variable, VarRef, default_main_program,  # noqa: F401
+                    default_startup_program, in_static_build, program_guard)
+
+
+class InputSpec:
+    """Shape/dtype/name spec (python/paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(list(tensor.shape), str(tensor.dtype), name)
+
+    def to_aval(self):
+        shape = [1 if (d is None or d == -1) else int(d) for d in self.shape]
+        return jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(self.dtype))
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed Variable in the default main program."""
+    prog = default_main_program()
+    spec = InputSpec(shape, dtype, name)
+    v = prog.global_block.create_var(spec.to_aval(), name=name, is_data=True)
+    v._input_spec = spec  # original (possibly dynamic) dims, for export
+    if name not in prog._feed_names:
+        prog._feed_names.append(name)
+    return v
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Persistable trainable var; its init op is recorded into the startup
+    program (paddle.static.create_parameter)."""
+    from ..nn import initializer as I
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierUniform())
+    name = name or unique_name.generate("param")
+    value = init(list(shape), dtype)
+    from ..core.tensor import unwrap
+    raw = unwrap(value)
+
+    main, startup = default_main_program(), default_startup_program()
+    v = main.global_block.create_var(
+        jax.ShapeDtypeStruct(raw.shape, raw.dtype), name=name,
+        persistable=True, trainable=True)
+    if name not in main._param_names:
+        main._param_names.append(name)
+    from .graph import OpDesc
+    startup.global_block.append_op(OpDesc(
+        "fill_parameter", lambda _v=raw: _v, [], {}, [name],
+        jax.tree_util.tree_structure(raw)))
+    sv = startup.global_block.create_var(
+        jax.ShapeDtypeStruct(raw.shape, raw.dtype), name=name,
+        persistable=True)
+    startup.global_block.vars[name] = sv
+    startup._version += 1
+    return v
+
+
+def create_global_var(shape, value, dtype="float32", persistable=True,
+                      name=None):
+    name = name or unique_name.generate("global_var")
+    raw = jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+    main = default_main_program()
+    v = main.global_block.create_var(
+        jax.ShapeDtypeStruct(raw.shape, raw.dtype), name=name,
+        persistable=persistable)
+    global_scope()._vars[name] = raw
+    return v
+
+
+def run_startup(exe=None, startup_program=None):
+    """Materialize startup-program vars into the scope (Executor.run(startup))."""
+    prog = startup_program or default_startup_program()
+    from .executor import _replay
+    env = _replay(list(prog.global_block.ops), {})
+    scope = global_scope()
+    for n, v in env.items():
+        var = prog.global_block.vars.get(n)
+        if var is None or var.persistable:
+            scope._vars[n] = jnp.asarray(v)
+
+
+# Executor.run(startup_program) path: startup programs have no feeds/fetches,
+# so Executor.run special-cases them via this hook.
+_orig_exe_run = Executor.run
+
+
+def _exe_run(self, program=None, feed=None, fetch_list=None, **kwargs):
+    prog = program or default_main_program()
+    if (not fetch_list and not feed and prog._train_spec is None
+            and any(op.op_type == "fill_parameter"
+                    for op in prog.global_block.ops)):
+        run_startup(self, prog)
+        return []
+    return _orig_exe_run(self, program=program, feed=feed,
+                         fetch_list=fetch_list, **kwargs)
+
+
+Executor.run = _exe_run
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Register grad computation for trainable params; returns
+    [(param_var, grad_var)] (paddle.static.append_backward). The actual
+    jax.grad happens at Executor compile time."""
+    prog = loss.block.program if getattr(loss, "block", None) is not None \
+        else default_main_program()
+    block = prog.global_block
+    if parameter_list:
+        wrt = [p if isinstance(p, str) else p.name for p in parameter_list]
+    else:
+        wrt = list(prog._param_names)
+    if no_grad_set:
+        drop = {p if isinstance(p, str) else p.name for p in no_grad_set}
+        wrt = [n for n in wrt if n not in drop]
+    gnames = [f"{n}@GRAD" for n in wrt]
+    for n, g in zip(wrt, gnames):
+        src = block.vars[n]
+        block.vars[g] = Variable(src._value, name=g, block=block)
+    prog._grad_requests.append((loss.name, wrt, gnames))
+    prog._version += 1
+    return [(block.vars[n], block.vars[g]) for n, g in zip(wrt, gnames)]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients: d(sum(targets))/d(inputs) as new vars."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    t0 = targets[0]
+    prog = t0.block.program if getattr(t0, "block", None) is not None \
+        else default_main_program()
+    block = prog.global_block
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = []
+    for t in targets:
+        wrt = [v.name for v in inputs]
+        gnames = [unique_name.generate(f"{n}@GRAD") for n in wrt]
+        for v, g in zip(inputs, gnames):
+            block.vars[g] = Variable(v._value, name=g, block=block)
+        prog._grad_requests.append((t.name, wrt, gnames))
+        outs.extend(block.vars[g] for g in gnames)
+    prog._version += 1
+    return outs
+
+
+def _prune_ops(ops, fetch_names):
+    """Backward slice: keep only ops that contribute to the fetch targets
+    (reference: Program.prune on save_inference_model)."""
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(ops):
+        if any(o in needed for o in op.outputs):
+            kept.append(op)
+            needed.update(i.name for i in op.inputs if isinstance(i, VarRef))
+    return list(reversed(kept))
+
+
+def _program_infer_fn(program, feed_names, fetch_names, scope):
+    """Pure (feed…) -> fetches closure over scope values, for export.
+
+    Stateful ops (dropout, …) are snapshotted at export: the traced
+    function bakes one sample. Export inference programs (is_test /
+    training=False) — the reference's save_inference_model likewise
+    expects test-mode graphs."""
+    from .executor import _replay
+    ops = _prune_ops(program.global_block.ops, fetch_names)
+    scope_vals = {n: scope._vars[n]
+                  for op in ops for n in
+                  [i.name for i in op.inputs if isinstance(i, VarRef)]
+                  if n in scope._vars}
+
+    def fn(*feed_vals):
+        env = dict(scope_vals)
+        env.update(zip(feed_names, feed_vals))
+        _replay(ops, env)
+        return [env[n] for n in fetch_names]
+
+    return fn
+
+
+
+
+class CompiledProgram:
+    """Parity shim: compilation happens in Executor's cache already."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+
+
+# ------------------------------------------------- round-3 static tail
+# (reference python/paddle/static/__init__.py __all__)
+
+
+class BuildStrategy:
+    """Accepted-and-recorded build options (reference BuildStrategy pybind).
+    XLA owns fusion/memory decisions on TPU; the knobs exist for parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+        self.enable_addto = False
+        self.enable_sequential_execution = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.num_iteration_per_run = 1
+        self.use_thread_barrier = False
+
+
+class ParallelExecutor:
+    """Legacy ParallelExecutor facade (reference fluid ParallelExecutor):
+    delegates to the single Executor — XLA SPMD replaces graph replication."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """static.Print parity: prints at execution via the recorded op."""
+    from ..jit.dy2static import convert_print
+    convert_print(message or "", input)
+    return input
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from .nn import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+def WeightNormParamAttr(dim=None, name=None, initializer=None,
+                        learning_rate=1.0, regularizer=None,
+                        trainable=True, do_model_average=False,
+                        need_clip=True):
+    """Weight-normalized ParamAttr (reference WeightNormParamAttr); the
+    norm reparameterization applies via nn.utils.weight_norm at layer
+    level — here the attr carries the config."""
+    from ..nn.param_attr import ParamAttr
+    attr = ParamAttr(name=name, initializer=initializer,
+                     learning_rate=learning_rate, regularizer=regularizer,
+                     trainable=trainable, do_model_average=do_model_average,
+                     need_clip=need_clip)
+    attr.weight_norm_dim = dim
+    return attr
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static ExponentialMovingAverage):
+    update() accumulates; apply()/restore() swap shadow weights."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self, parameters=None):
+        from ..core.tensor import unwrap
+        params = parameters or _collect_scope_params()
+        for p in params:
+            key = id(p)
+            v = unwrap(p)
+            if key not in self._shadow:
+                self._shadow[key] = (p, v)
+            else:
+                _, s = self._shadow[key]
+                self._shadow[key] = (p, self._decay * s
+                                     + (1 - self._decay) * v)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        from ..core.tensor import unwrap
+
+        @contextlib.contextmanager
+        def guard():
+            self._backup = {k: unwrap(p) for k, (p, _s)
+                            in self._shadow.items()}
+            for k, (p, s) in self._shadow.items():
+                p._replace_value(s)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return guard()
+
+    def restore(self, executor=None):
+        for k, (p, _s) in self._shadow.items():
+            if k in self._backup:
+                p._replace_value(self._backup[k])
+        self._backup = {}
+
+
+def _collect_scope_params():
+    scope = global_scope()
+    return [p for p in scope._params.values() if p is not None]
+
+
