@@ -520,12 +520,23 @@ def main(config_name="gpt2"):
     loss, params, state = step(params, state, ids, 2)
     float(loss)
 
+    # PT_BENCH_TRACE=<dir>: capture a jax.profiler trace of the steady
+    # state (VERDICT r5 #8 — profiler-verified step: inspect for host
+    # syncs / gaps between device kernels in the timed window)
+    import contextlib
+    trace_dir = _os.environ.get("PT_BENCH_TRACE")
+    trace_cm = (jax.profiler.trace(trace_dir) if trace_dir
+                else contextlib.nullcontext())
+
     iters = 10
-    t0 = time.perf_counter()
-    for i in range(iters):
-        loss, params, state = step(params, state, ids, i + 3)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
+    with trace_cm:
+        t0 = time.perf_counter()
+        for i in range(iters):
+            loss, params, state = step(params, state, ids, i + 3)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+    if trace_dir:
+        print(f"  profiler trace written to {trace_dir}", file=sys.stderr)
 
     tokens_per_sec = batch * seq * iters / dt
     n_active = n_params

@@ -15,34 +15,49 @@ def main():
     from paddle_tpu.ops.pallas import flash_attention as fa
 
     _devices_with_retry()
-    b, h, d, s = 4, 16, 128, 4096
     rng = np.random.RandomState(0)
-    mk = lambda: jnp.asarray(rng.randn(b * h, s, d).astype(np.float32) * 0.3,
-                             dtype=jnp.bfloat16)
-    q, k, v = mk(), mk(), mk()
-    sm = 1.0 / np.sqrt(d)
-    f_fwd = 2.0 * b * h * s * s * d
-    f_bwd = 5.0 * b * h * s * s * d
+    d = 128
+    # (label, batch*heads, seq): MHA 345M-ish shapes; the 70B TP8 local
+    # slice (GQA kv pre-repeated to 8 local q heads, small batch); the
+    # 32k long-context shard (VERDICT r4 weak#1 — GQA/longctx shapes
+    # were never swept on chip)
+    shapes = [("mha-4k  (bh=64)", 64, 4096),
+              ("gqa70b-4k (bh=8)", 8, 4096),
+              ("longctx-32k (bh=8)", 8, 32768)]
+    for label, bh, s in shapes:
+        mk = lambda: jnp.asarray(
+            rng.randn(bh, s, d).astype(np.float32) * 0.3,
+            dtype=jnp.bfloat16)
+        q, k, v = mk(), mk(), mk()
+        sm = 1.0 / np.sqrt(d)
+        f_fwd = 2.0 * bh * s * s * d
+        f_bwd = 5.0 * bh * s * s * d
+        print(f"== {label} ==")
+        for bq, bk in [(512, 512), (1024, 1024), (512, 2048),
+                       (1024, 2048), (2048, 1024), (2048, 2048),
+                       (1024, 4096)]:
+            if bq > s or bk > s:
+                continue
+            try:
+                fwd = jax.jit(
+                    lambda q, k, v, bq=bq, bk=bk: fa._flash_fwd_pallas(
+                        q, k, v, sm, True, block_q=bq, block_k=bk)[0])
+                t_f = bench(fwd, q, k, v, iters=10)
 
-    for bq, bk in [(1024, 1024), (512, 2048), (1024, 2048),
-                   (2048, 1024), (2048, 2048), (1024, 4096)]:
-        try:
-            fwd = jax.jit(lambda q, k, v, bq=bq, bk=bk: fa._flash_fwd_pallas(
-                q, k, v, sm, True, block_q=bq, block_k=bk)[0])
-            t_f = bench(fwd, q, k, v, iters=10)
+                def bwd(q, k, v, bq=bq, bk=bk):
+                    o, lse = fa._flash_fwd_pallas(q, k, v, sm, True,
+                                                  block_q=bq, block_k=bk)
+                    return fa._flash_bwd_pallas(q, k, v, o, lse, q, sm,
+                                                True, block_q=bq,
+                                                block_k=bk)
 
-            def bwd(q, k, v, bq=bq, bk=bk):
-                o, lse = fa._flash_fwd_pallas(q, k, v, sm, True,
-                                              block_q=bq, block_k=bk)
-                return fa._flash_bwd_pallas(q, k, v, o, lse, q, sm, True,
-                                            block_q=bq, block_k=bk)
-
-            t_b = bench(jax.jit(bwd), q, k, v, iters=10)
-            print(f"bq={bq:4d} bk={bk:4d}  fwd {t_f*1e3:7.2f}ms "
-                  f"({f_fwd/t_f/1e12:5.1f} TF/s)   fwd+bwd {t_b*1e3:7.2f}ms "
-                  f"({(f_fwd+f_bwd)/t_b/1e12:5.1f} TF/s)")
-        except Exception as e:
-            print(f"bq={bq} bk={bk}  FAILED: {str(e)[:120]}")
+                t_b = bench(jax.jit(bwd), q, k, v, iters=10)
+                print(f"bq={bq:4d} bk={bk:4d}  fwd {t_f*1e3:7.2f}ms "
+                      f"({f_fwd/t_f/1e12:5.1f} TF/s)   fwd+bwd "
+                      f"{t_b*1e3:7.2f}ms "
+                      f"({(f_fwd+f_bwd)/t_b/1e12:5.1f} TF/s)")
+            except Exception as e:
+                print(f"bq={bq} bk={bk}  FAILED: {str(e)[:120]}")
 
 
 if __name__ == "__main__":

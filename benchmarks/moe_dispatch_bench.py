@@ -1,0 +1,120 @@
+"""MoE dispatch A/B on chip (VERDICT r5 #5).
+
+Reference ships CUDA global_scatter/global_gather
+(paddle/fluid/operators/collective/global_scatter_op.cu.cc) — a
+sort-based sparse dispatch. Our hybrid MoE block uses DENSE GShard-style
+dispatch (every expert computes every token on the MXU; combine selects)
+which burns E/k extra FLOPs but has zero gather/scatter. This bench
+measures both at Mixtral-8x7B per-chip shapes to pick the default by
+measurement:
+
+  dense:  einsum over the full [E, T, F] — E x T x H x F FLOPs
+  sorted: top-k gather to [E, C, H] capacity bins, expert matmuls,
+          weighted scatter-add back — k x T x H x F FLOPs + data movement
+
+Prints ms/step and us/token for each; the winner should drive
+make_moe_tp_fns' dispatch choice.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def bench(fn, *args, iters=10):
+    out = fn(*args)
+    jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    # Mixtral-8x7B per-chip: H=4096, expert FFN 14336, E=8, top-2.
+    # T tokens on this chip (batch x seq shard).
+    T, H, F, E, K = 4096, 4096, 14336, 8, 2
+    cap_factor = 1.25
+    C = int(T * K / E * cap_factor)
+    rng = np.random.RandomState(0)
+    dt = jnp.bfloat16
+
+    x = jnp.asarray(rng.randn(T, H).astype(np.float32) * 0.3, dt)
+    wg = jnp.asarray(rng.randn(H, E).astype(np.float32) * 0.1, dt)
+    we_g = jnp.asarray(rng.randn(E, H, F).astype(np.float32) * 0.02, dt)
+    we_u = jnp.asarray(rng.randn(E, H, F).astype(np.float32) * 0.02, dt)
+    we_d = jnp.asarray(rng.randn(E, F, H).astype(np.float32) * 0.02, dt)
+
+    def gate(xv):
+        logits = xv @ wg
+        topv, topi = jax.lax.top_k(logits.astype(jnp.float32), K)
+        probs = jax.nn.softmax(topv, -1)
+        return probs, topi
+
+    # ---- dense GShard-style (the hybrid block's current dispatch) ----
+    @jax.jit
+    def dense(xv):
+        probs, topi = gate(xv)
+        oh = jax.nn.one_hot(topi, E, dtype=jnp.float32)
+        comb = (oh * probs[..., None]).sum(-2)           # [T, E]
+        up = jnp.einsum("th,ehf->etf", xv, we_g)
+        up = jax.nn.silu(up) * jnp.einsum("th,ehf->etf", xv, we_u)
+        down = jnp.einsum("etf,efh->eth", up, we_d)
+        return jnp.einsum("eth,te->th", down.astype(jnp.float32),
+                          comb).astype(xv.dtype)
+
+    # ---- sort/capacity dispatch (reference global_scatter shape) -----
+    @jax.jit
+    def sorted_dispatch(xv):
+        probs, topi = gate(xv)                            # [T, K]
+        flat_e = topi.reshape(-1)                         # [T*K]
+        flat_w = probs.reshape(-1)                        # [T*K]
+        flat_t = jnp.repeat(jnp.arange(T), K)
+        # sort pairs by expert; rank within each expert's run = bin slot
+        order = jnp.argsort(flat_e, stable=True)
+        sorted_e = flat_e[order]
+        run_start = jnp.cumsum(
+            jnp.concatenate([jnp.zeros(1, jnp.int32),
+                             jnp.bincount(sorted_e, length=E)[:-1]
+                             .astype(jnp.int32)]))
+        rank = jnp.arange(T * K) - run_start[sorted_e]
+        keep = rank < C                                   # capacity drop
+        dst = sorted_e * C + jnp.minimum(rank, C - 1)
+        src_tok = flat_t[order]
+        bins = jnp.zeros((E * C, H), xv.dtype)
+        bins = bins.at[dst].set(jnp.where(keep[:, None], xv[src_tok], 0))
+        bins = bins.reshape(E, C, H)
+        up = jnp.einsum("ech,ehf->ecf", bins, we_g)
+        up = jax.nn.silu(up) * jnp.einsum("ech,ehf->ecf", bins, we_u)
+        down = jnp.einsum("ecf,efh->ech", up, we_d).reshape(E * C, H)
+        out = jnp.zeros((T, H), jnp.float32)
+        w_sorted = flat_w[order]
+        out = out.at[src_tok].add(
+            jnp.where(keep[:, None],
+                      down[dst].astype(jnp.float32) * w_sorted[:, None],
+                      0.0))
+        return out.astype(xv.dtype)
+
+    t_dense = bench(dense, x)
+    t_sorted = bench(sorted_dispatch, x)
+    fl_dense = 3 * 2 * T * H * F * E       # 3 matmuls, all experts
+    fl_sorted = 3 * 2 * T * H * F * K      # only routed pairs (capacity)
+    print(f"tokens={T} H={H} F={F} E={E} top{K} capacity={C}")
+    print(f"dense  GShard : {t_dense*1e3:8.2f} ms/step  "
+          f"{t_dense/T*1e6:6.2f} us/token  "
+          f"({fl_dense/t_dense/1e12:5.1f} TF/s effective)")
+    print(f"sorted capac. : {t_sorted*1e3:8.2f} ms/step  "
+          f"{t_sorted/T*1e6:6.2f} us/token  "
+          f"({fl_sorted/t_sorted/1e12:5.1f} TF/s effective)")
+    win = "dense" if t_dense <= t_sorted else "sorted"
+    print(f"winner: {win} ({max(t_dense, t_sorted)/min(t_dense, t_sorted):.2f}x)")
+
+
+if __name__ == "__main__":
+    main()
