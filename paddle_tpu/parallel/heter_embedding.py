@@ -1,0 +1,131 @@
+"""Heterogeneous embedding: giant tables on host/SSD, hot rows on chip.
+
+Reference: paddle/fluid/framework/fleet/heter_ps/ — the GPU-PS design
+(heter_comm.h, ps_gpu_wrapper.cc) keeps terabyte embedding tables in
+CPU memory/SSD and pulls each batch's touched rows into GPU HBM, pushes
+sparse grads back, and applies per-row optimizer updates host-side.
+
+TPU-native collapse: the table is a lazy host hash table (SparseTable)
+or its SSD-spilling subclass (SSDSparseTable) from ``parallel.ps``; per
+batch we deduplicate the ids host-side, stream ONLY the unique rows to
+the chip as a regular jit argument, gather inside the jitted step (MXU
+sees a dense [U, D] leaf), and scatter the [U, D] row grads back into a
+host-side Adagrad/SGD update. HBM never holds the table — only the
+batch's working set — which is the heter-PS capability without the CUDA
+cache hierarchy (XLA owns the device side; the host side IS the PS).
+
+Usage (the fetch/step/apply triangle — fetch and apply are host work
+outside jit, the step is pure and jittable):
+
+    emb = HeterEmbedding(1 << 40, 64, optimizer="adagrad")
+
+    @jax.jit
+    def step(w, rows, inv, labels):
+        def loss_fn(w, rows):
+            x = HeterEmbedding.embed(rows, inv, labels.shape)  # [B,S,D]
+            ...
+        (loss, gw), g_rows = ...jax.grad wrt (w, rows)...
+        return loss, new_w, g_rows
+
+    rows, inv, ids_u = emb.fetch(ids)
+    loss, w, g_rows = step(w, rows, inv, labels)
+    emb.apply_grad_rows(ids_u, g_rows)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .ps import SparseTable, SSDSparseTable
+
+__all__ = ["HeterEmbedding"]
+
+
+class HeterEmbedding:
+    def __init__(self, num_embeddings, dim, lr=0.1, optimizer="sgd",
+                 initializer="uniform", seed=0, ssd_path=None,
+                 cache_rows=100_000, epsilon=1e-6):
+        self.num_embeddings = int(num_embeddings)
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        self._eps = float(epsilon)
+        if ssd_path is not None:
+            self.table = SSDSparseTable("heter", dim, path=ssd_path,
+                                        cache_rows=cache_rows,
+                                        initializer=initializer,
+                                        seed=seed, lr=lr)
+        else:
+            self.table = SparseTable("heter", dim,
+                                     initializer=initializer,
+                                     seed=seed, lr=lr)
+        if optimizer == "adagrad":
+            self._acc = {}          # id -> per-row G accumulator [D]
+
+    # ------------------------------------------------------------ fetch
+    def fetch(self, ids):
+        """Host-side: dedupe ids, pull their rows (lazy-init/SSD-load),
+        return (rows [U, D] device-ready, inv [ids.size] int32 mapping
+        each position to its row, ids_u [U] the unique ids to pass back
+        to apply_grad_rows)."""
+        ids = np.asarray(ids).reshape(-1)
+        ids_u, inv = np.unique(ids, return_inverse=True)
+        rows = self.table.pull(ids_u)
+        return (jnp.asarray(rows), jnp.asarray(inv.astype(np.int32)),
+                ids_u)
+
+    @staticmethod
+    def embed(rows, inv, ids_shape):
+        """Pure/jittable: gather the streamed rows back into the ids'
+        layout — rows [U, D], inv [prod(ids_shape)] -> [*ids_shape, D].
+        Differentiable: grads wrt ``rows`` come out [U, D] with the
+        duplicate-id contributions summed (exactly the sparse grad the
+        push expects)."""
+        out = rows[inv]
+        return out.reshape(tuple(ids_shape) + (rows.shape[-1],))
+
+    # ------------------------------------------------------------ apply
+    def apply_grad_rows(self, ids_u, grad_rows):
+        """Host-side sparse update of the touched rows (reference
+        ps_gpu_wrapper push_sparse + per-row optimizer)."""
+        g = np.asarray(grad_rows, np.float32)
+        if self.optimizer == "adagrad":
+            # rescale to an effective grad and reuse the table's SGD
+            # apply (works for both the in-memory and SSD backings
+            # without touching their cache/dirty internals)
+            eff = np.empty_like(g)
+            for i, _id in enumerate(ids_u):
+                _id = int(_id)
+                acc = self._acc.get(_id)
+                if acc is None:
+                    acc = np.zeros(self.dim, np.float32)
+                acc = acc + g[i] * g[i]
+                self._acc[_id] = acc
+                eff[i] = g[i] / (np.sqrt(acc) + self._eps)
+            self.table.push_grad(ids_u, eff)
+            return
+        self.table.push_grad(ids_u, g)      # table-native SGD
+
+    # ------------------------------------------------------------ state
+    def state(self):
+        st = {"table": self.table.state()}
+        if self.optimizer == "adagrad":
+            ids = np.asarray(sorted(self._acc), np.int64)
+            st["acc_ids"] = ids
+            st["acc"] = (np.stack([self._acc[int(i)] for i in ids])
+                         if len(ids) else
+                         np.zeros((0, self.dim), np.float32))
+        return st
+
+    def load_state(self, st):
+        self.table.load_state(st["table"])
+        if self.optimizer == "adagrad" and "acc_ids" in st:
+            self._acc = {int(i): np.asarray(v, np.float32)
+                         for i, v in zip(st["acc_ids"], st["acc"])}
+
+    @property
+    def num_touched_rows(self):
+        return (self.table.num_rows()
+                if hasattr(self.table, "num_rows")
+                else len(self.table.rows))

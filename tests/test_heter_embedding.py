@@ -1,0 +1,136 @@
+"""Heter-PS analog: giant host/SSD embedding tables with per-batch row
+streaming through a jitted TPU step (VERDICT r4 missing #6; reference
+paddle/fluid/framework/fleet/heter_ps/ GPU-PS design).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel.heter_embedding import HeterEmbedding
+
+
+def _step_fn(dim):
+    @jax.jit
+    def step(w, rows, inv, labels):
+        def loss_fn(w, rows):
+            x = HeterEmbedding.embed(rows, inv, labels.shape)  # [B,S,D]
+            pred = x @ w                                       # [B,S]
+            return jnp.mean((pred.squeeze(-1) - labels) ** 2)
+
+        (loss, (gw, g_rows)) = jax.value_and_grad(
+            lambda w, r: loss_fn(w, r), argnums=(0, 1))(w, rows)
+        return loss, w - 0.1 * gw, g_rows
+
+    return step
+
+
+def test_streamed_rows_match_dense_table_training():
+    """3 steps of SGD through the fetch/step/apply triangle == the same
+    training on a DENSE jnp table (the oracle), with a vocab far larger
+    than anything materialized."""
+    V, D, B, S = 1 << 30, 8, 4, 6       # 2^30 vocab: only touched rows exist
+    emb = HeterEmbedding(V, D, lr=0.05, optimizer="sgd",
+                         initializer="uniform", seed=3)
+    rng = np.random.RandomState(0)
+    # oracle: dense table over a REMAPPED small id space
+    all_ids = rng.choice(1 << 20, size=32, replace=False).astype(np.int64)
+    id2small = {int(i): k for k, i in enumerate(all_ids)}
+    dense = jnp.asarray(np.stack(
+        [np.asarray(emb.table.pull([i])[0]) for i in all_ids]))
+    w = jnp.asarray(rng.randn(D, 1).astype(np.float32))
+    w2 = w
+    step = _step_fn(D)
+
+    @jax.jit
+    def dense_step(tab, w, ids_small, labels):
+        def loss_fn(tab, w):
+            x = tab[ids_small]
+            pred = (x @ w).squeeze(-1)
+            return jnp.mean((pred - labels) ** 2)
+
+        loss, (gt, gw) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            tab, w)
+        return loss, tab - 0.05 * gt, w - 0.1 * gw
+
+    for it in range(3):
+        ids = rng.choice(all_ids, size=(B, S))          # duplicates likely
+        labels = rng.randn(B, S).astype(np.float32)
+        rows, inv, ids_u = emb.fetch(ids)
+        loss, w, g_rows = step(w, rows, jnp.asarray(inv),
+                               jnp.asarray(labels))
+        emb.apply_grad_rows(ids_u, g_rows)
+
+        ids_small = jnp.asarray(
+            np.vectorize(id2small.get)(ids).astype(np.int32))
+        loss2, dense, w2 = dense_step(dense, w2, ids_small,
+                                      jnp.asarray(labels))
+        np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-5)
+
+    # every touched row matches the dense oracle after training
+    got = emb.table.pull(all_ids)
+    np.testing.assert_allclose(got, np.asarray(dense), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(w), np.asarray(w2), rtol=1e-5)
+    # the table only ever materialized the touched rows, not 2^30
+    assert emb.num_touched_rows == len(all_ids)
+
+
+def test_duplicate_ids_sum_their_grads():
+    """embed()'s gather makes duplicate-id grads SUM into one row — the
+    sparse-grad contract of the reference push_sparse."""
+    emb = HeterEmbedding(1000, 4, lr=1.0, optimizer="sgd",
+                         initializer="zeros")
+    ids = np.array([[7, 7, 7, 9]])
+    rows, inv, ids_u = emb.fetch(ids)
+
+    def f(r):
+        x = HeterEmbedding.embed(r, inv, (1, 4))
+        return x.sum()
+
+    g = jax.grad(f)(rows)
+    # id 7 appears 3x -> grad 3.0 per component; id 9 once -> 1.0
+    np.testing.assert_allclose(np.asarray(g[list(ids_u).index(7)]), 3.0)
+    np.testing.assert_allclose(np.asarray(g[list(ids_u).index(9)]), 1.0)
+
+
+def test_adagrad_rows_and_state_roundtrip(tmp_path):
+    emb = HeterEmbedding(10_000, 4, lr=0.5, optimizer="adagrad",
+                         initializer="zeros")
+    ids = np.array([1, 2, 2, 3])
+    rows, inv, ids_u = emb.fetch(ids)
+    g = np.ones((len(ids_u), 4), np.float32)
+    emb.apply_grad_rows(ids_u, g)
+    emb.apply_grad_rows(ids_u, g)
+    # adagrad: second step smaller than first (acc grows)
+    r = emb.table.pull(ids_u)
+    first = 0.5 * 1.0 / (1.0 + 1e-6)
+    second = 0.5 * 1.0 / (np.sqrt(2.0) + 1e-6)
+    np.testing.assert_allclose(r, -(first + second), rtol=1e-5)
+    # state roundtrip restores rows AND accumulators
+    st = emb.state()
+    emb2 = HeterEmbedding(10_000, 4, lr=0.5, optimizer="adagrad",
+                          initializer="zeros")
+    emb2.load_state(st)
+    emb2.apply_grad_rows(ids_u, g)
+    third = 0.5 * 1.0 / (np.sqrt(3.0) + 1e-6)
+    np.testing.assert_allclose(emb2.table.pull(ids_u),
+                               -(first + second + third), rtol=1e-5)
+
+
+def test_ssd_spill_backing(tmp_path):
+    """The SSD table composes: rows spill to disk past cache_rows and
+    stream back on fetch (reference heter_ps SSD cache level)."""
+    emb = HeterEmbedding(1 << 24, 4, lr=0.1, optimizer="sgd",
+                         ssd_path=str(tmp_path / "ssd"), cache_rows=8,
+                         initializer="uniform", seed=1)
+    ids = np.arange(64)
+    rows, inv, ids_u = emb.fetch(ids)
+    emb.apply_grad_rows(ids_u, np.ones((64, 4), np.float32))
+    before = emb.table.pull(np.arange(8))
+    # touch 64 rows with an 8-row cache: most spilled to disk; re-fetch
+    # round-trips through the spill
+    rows2, _inv2, _ = emb.fetch(np.arange(8))
+    np.testing.assert_allclose(np.asarray(rows2), before, rtol=1e-6)
+    emb.table.close()
