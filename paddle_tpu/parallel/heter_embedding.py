@@ -46,6 +46,10 @@ class HeterEmbedding:
     def __init__(self, num_embeddings, dim, lr=0.1, optimizer="sgd",
                  initializer="uniform", seed=0, ssd_path=None,
                  cache_rows=100_000, epsilon=1e-6):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(
+                f"HeterEmbedding optimizer {optimizer!r}: supported row "
+                "optimizers are 'sgd' and 'adagrad'")
         self.num_embeddings = int(num_embeddings)
         self.dim = int(dim)
         self.lr = float(lr)
@@ -61,7 +65,17 @@ class HeterEmbedding:
                                      initializer=initializer,
                                      seed=seed, lr=lr)
         if optimizer == "adagrad":
-            self._acc = {}          # id -> per-row G accumulator [D]
+            # the accumulator is ITSELF a spillable table: a host dict
+            # would re-grow the unbounded footprint the SSD backing
+            # exists to avoid
+            if ssd_path is not None:
+                self._acc = SSDSparseTable("heter_acc", dim,
+                                           path=ssd_path + "_acc",
+                                           cache_rows=cache_rows,
+                                           initializer="zeros", lr=lr)
+            else:
+                self._acc = SparseTable("heter_acc", dim,
+                                        initializer="zeros", lr=lr)
 
     # ------------------------------------------------------------ fetch
     def fetch(self, ids):
@@ -91,19 +105,12 @@ class HeterEmbedding:
         ps_gpu_wrapper push_sparse + per-row optimizer)."""
         g = np.asarray(grad_rows, np.float32)
         if self.optimizer == "adagrad":
-            # rescale to an effective grad and reuse the table's SGD
-            # apply (works for both the in-memory and SSD backings
-            # without touching their cache/dirty internals)
-            eff = np.empty_like(g)
-            for i, _id in enumerate(ids_u):
-                _id = int(_id)
-                acc = self._acc.get(_id)
-                if acc is None:
-                    acc = np.zeros(self.dim, np.float32)
-                acc = acc + g[i] * g[i]
-                self._acc[_id] = acc
-                eff[i] = g[i] / (np.sqrt(acc) + self._eps)
-            self.table.push_grad(ids_u, eff)
+            # vectorized over the pulled block; rescale to an effective
+            # grad and reuse the table's SGD apply (works for both the
+            # in-memory and SSD backings)
+            acc = self._acc.pull(ids_u) + g * g
+            self._acc.set_rows(ids_u, acc)
+            self.table.push_grad(ids_u, g / (np.sqrt(acc) + self._eps))
             return
         self.table.push_grad(ids_u, g)      # table-native SGD
 
@@ -111,18 +118,20 @@ class HeterEmbedding:
     def state(self):
         st = {"table": self.table.state()}
         if self.optimizer == "adagrad":
-            ids = np.asarray(sorted(self._acc), np.int64)
-            st["acc_ids"] = ids
-            st["acc"] = (np.stack([self._acc[int(i)] for i in ids])
-                         if len(ids) else
-                         np.zeros((0, self.dim), np.float32))
+            st["acc"] = self._acc.state()
         return st
 
     def load_state(self, st):
         self.table.load_state(st["table"])
-        if self.optimizer == "adagrad" and "acc_ids" in st:
-            self._acc = {int(i): np.asarray(v, np.float32)
-                         for i, v in zip(st["acc_ids"], st["acc"])}
+        if self.optimizer == "adagrad" and "acc" in st:
+            self._acc.load_state(st["acc"])
+
+    def close(self):
+        if hasattr(self.table, "close"):
+            self.table.close()
+        acc = getattr(self, "_acc", None)
+        if acc is not None and hasattr(acc, "close"):
+            acc.close()
 
     @property
     def num_touched_rows(self):

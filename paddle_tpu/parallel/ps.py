@@ -58,6 +58,12 @@ class SparseTable:
                 self.rows[_id] = self._new_row()
             self.rows[_id] -= self.lr * g
 
+    def set_rows(self, ids, values):
+        """Overwrite rows directly (optimizer-state tables)."""
+        values = np.asarray(values, np.float32)
+        for _id, v in zip(ids, values):
+            self.rows[int(_id)] = v.copy()
+
     def state(self):
         return {"ids": np.asarray(sorted(self.rows), np.int64),
                 "values": np.stack([self.rows[i] for i in sorted(self.rows)])
@@ -149,6 +155,17 @@ class SSDSparseTable(SparseTable):
                 row = self._get_row(_id)
                 row -= self.lr * g
                 self.rows[_id] = row
+                self._dirty.add(_id)
+            self._evict_if_needed()
+
+    def set_rows(self, ids, values):
+        """Overwrite rows directly (optimizer-state tables); spills and
+        dirty-tracks like push_grad."""
+        with self._lock:
+            values = np.asarray(values, np.float32)
+            for _id, v in zip(ids, values):
+                _id = int(_id)
+                self.rows[_id] = v.copy()
                 self._dirty.add(_id)
             self._evict_if_needed()
 

@@ -80,3 +80,107 @@ def test_fleet_executor_detects_deadlock():
     b.add_downstream_task(0)
     with pytest.raises(RuntimeError, match="deadlock"):
         FleetExecutor([a, b], max_run_times=1).run()
+
+
+# ---------------------------------------------------------- actor runtime
+
+def test_message_bus_protocol_flows():
+    """The reference protocol is visible on the bus: DATA_IS_READY flows
+    downstream, DATA_IS_USELESS releases upstream, START seeds sources
+    (interceptor_message.proto types over carrier.h routing)."""
+    from paddle_tpu.parallel.fleet_executor import (
+        Carrier, DATA_IS_READY, DATA_IS_USELESS, START)
+    a = TaskNode(task_id=0)
+    b = TaskNode(task_id=1)
+    a.add_downstream_task(1, 2)
+    b.add_upstream_task(0, 2)
+    car = Carrier([a, b], max_run_times=3)
+    trace = car.start()
+    # causality: b's microbatch k only after a's microbatch k; a never
+    # more than buffer=2 ahead of b
+    pos = {(t, m): i for i, (t, m) in enumerate(trace)}
+    for k in range(3):
+        assert pos[(0, k)] < pos[(1, k)]
+    for i, (t, m) in enumerate(trace):
+        if t == 0:
+            done_b = sum(1 for (t2, _m2) in trace[:i] if t2 == 1)
+            assert m - done_b < 2, trace
+    kinds = [m.message_type for m in car.bus.log]
+    assert kinds.count(START) == 3
+    assert kinds.count(DATA_IS_READY) == 3          # a -> b per mb
+    assert kinds.count(DATA_IS_USELESS) == 3        # b releases a per mb
+    ready = [m for m in car.bus.log if m.message_type == DATA_IS_READY]
+    assert all(m.src_id == 0 and m.dst_id == 1 for m in ready)
+
+
+def test_buffer_size_throttles_producer():
+    """A buffer of 1 on a->b forces strict alternation: `a` can never
+    run 2 ahead (ComputeInterceptor CanWriteOutput)."""
+    from paddle_tpu.parallel.fleet_executor import Carrier
+    a, b = TaskNode(task_id=0), TaskNode(task_id=1)
+    a.add_downstream_task(1, 1)
+    b.add_upstream_task(0, 1)
+    car = Carrier([a, b], max_run_times=4)
+    trace = car.start()
+    for i in range(len(trace) - 1):
+        (t1, m1), (t2, m2) = trace[i], trace[i + 1]
+        if t1 == 0:
+            assert (t2, m2) == (1, m1), trace       # strict a,b,a,b
+
+def test_amplifier_runs_once_per_round():
+    """Amplifier nodes (lr/opt in the reference) execute every
+    run_per_steps messages at their offset while the dataflow still
+    ticks every microbatch (amplifier_interceptor.h)."""
+    from paddle_tpu.parallel.fleet_executor import Carrier
+    M = 6
+    ran = []
+    fwd = TaskNode(task_id=0, program=lambda mb: ran.append(("fwd", mb)))
+    opt = TaskNode(task_id=1, node_type="Amplifier",
+                   program=lambda k: ran.append(("opt", k)))
+    opt.set_run_pre_steps(3)       # once per 3 microbatches
+    opt.set_run_at_offset(2)       # at the round's last microbatch
+    fwd.add_downstream_task(1, 3)
+    opt.add_upstream_task(0, 3)
+    car = Carrier([fwd, opt], max_run_times=M)
+    car.start()
+    assert [x for x in ran if x[0] == "opt"] == [("opt", 0), ("opt", 1)]
+    assert len([x for x in ran if x[0] == "fwd"]) == M
+
+
+def test_deadlocked_graph_raises():
+    from paddle_tpu.parallel.fleet_executor import Carrier
+    a, b = TaskNode(task_id=0), TaskNode(task_id=1)
+    # b depends on a AND a depends on b with zero seed -> no source
+    a.add_upstream_task(1, 2)
+    a.add_downstream_task(1, 2)
+    b.add_upstream_task(0, 2)
+    b.add_downstream_task(0, 2)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="deadlock"):
+        Carrier([a, b], max_run_times=2).start()
+
+
+def test_one_sided_edge_declarations_mirror():
+    """A downstream declared without the matching upstream (or vice
+    versa) still gates correctly — the Carrier mirrors one-sided edges
+    instead of crashing on undeclared peers."""
+    from paddle_tpu.parallel.fleet_executor import Carrier
+    a, b = TaskNode(task_id=0), TaskNode(task_id=1)
+    a.add_downstream_task(1, 2)       # b never declares the upstream
+    trace = Carrier([a, b], max_run_times=2).start()
+    pos = {(t, m): i for i, (t, m) in enumerate(trace)}
+    assert pos[(0, 0)] < pos[(1, 0)] and pos[(0, 1)] < pos[(1, 1)]
+
+
+def test_executor_count_overrides_node_count():
+    """The executor-level max_run_times drives the run (old-contract
+    parity): a node constructed with a larger count neither over-runs
+    nor deadlocks, and the caller's TaskNode is not mutated."""
+    a = TaskNode(task_id=0, max_run_times=5)
+    b = TaskNode(task_id=1)
+    a.add_downstream_task(1, 2)
+    b.add_upstream_task(0, 2)
+    fe = FleetExecutor([a, b], max_run_times=2)
+    trace = fe.run()
+    assert sorted(trace) == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    assert a.max_run_times == 5       # caller's object untouched
