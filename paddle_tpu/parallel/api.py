@@ -108,6 +108,90 @@ def scale_and_shard_batch(batch, mesh: HybridMesh, spec=None):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), batch)
 
 
+def scaled_merge_update(grads, params, opt_state, update_fn, clip_fn,
+                        k_accum, accum_avg, dynamic_scale, sc, step_i,
+                        lr=None, scale_window=1000):
+    """The DynamicLossScaler + GradientMerge state machine shared by
+    ``parallel_train_step`` and ``build_hybrid_train_step`` (reference
+    amp/grad_scaler.py + GradientMerge meta optimizer).
+
+    ``grads`` are UNSCALED fp-any gradients; ``opt_state`` is the
+    wrapped state ({"_opt": inner[, "_accum"][, "_scale", "_growth"]})
+    when k_accum>1 or dynamic_scale, else the bare inner state.
+    Returns (new_params, new_opt_state) with the same wrapping.
+    """
+    wrapped = k_accum > 1 or dynamic_scale
+    inner = opt_state["_opt"] if wrapped else opt_state
+    finite = None
+    if dynamic_scale:
+        # reference DynamicLossScaler: inf/nan grads -> zero this
+        # step's contribution, halve the scale, skip the update
+        import functools as _ft
+        finite = _ft.reduce(
+            jnp.logical_and,
+            [jnp.all(jnp.isfinite(g))
+             for g in jax.tree_util.tree_leaves(grads)])
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+
+    def _pin_dtypes(upd_p, upd_s):
+        # fp32 eff grads must not promote the stored param or
+        # optimizer-state dtypes (Adam casts params back itself;
+        # SGD/Momentum would leak fp32 params, and a promoted inner
+        # state would double its memory and break checkpoint dtypes)
+        upd_p = jax.tree_util.tree_map(
+            lambda a, b: a.astype(b.dtype), upd_p, params)
+        upd_s = jax.tree_util.tree_map(
+            lambda a, b: a.astype(b.dtype), upd_s, inner)
+        return upd_p, upd_s
+
+    if k_accum > 1:
+        # GradientMerge: accumulate fp32; update only every k-th step.
+        # The fp32 accumulator feeds the optimizer DIRECTLY: a cast
+        # back to bf16/fp16 would re-round away the precision the
+        # buffer held (and fp16 can overflow k-step sums).
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32),
+            opt_state["_accum"], grads)
+        apply = (step_i % k_accum == 0)
+        eff = clip_fn(jax.tree_util.tree_map(
+            lambda a: (a / k_accum) if accum_avg else a, acc))
+        upd_i = jnp.maximum(step_i // k_accum, 1)
+        upd_p, upd_s = update_fn(eff, params, inner, lr=lr, step=upd_i)
+        upd_p, upd_s = _pin_dtypes(upd_p, upd_s)
+        new_p = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(apply, a, b), upd_p, params)
+        new_inner = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(apply, a, b), upd_s, inner)
+        new_acc = jax.tree_util.tree_map(
+            lambda a: jnp.where(apply, jnp.zeros_like(a), a), acc)
+        out_state = {"_opt": new_inner, "_accum": new_acc}
+    else:
+        grads = clip_fn(grads)
+        upd_p, upd_s = update_fn(grads, params, inner, lr=lr,
+                                 step=step_i)
+        if dynamic_scale:
+            upd_p, upd_s = _pin_dtypes(upd_p, upd_s)
+            new_p = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(finite, a, b), upd_p, params)
+            new_inner = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(finite, a, b), upd_s, inner)
+            out_state = {"_opt": new_inner}
+        else:
+            return upd_p, upd_s
+    if dynamic_scale:
+        # scale_window = reference incr_every_n_steps
+        growth = jnp.where(finite, opt_state["_growth"] + 1, 0)
+        grow_now = growth >= scale_window
+        new_scale = jnp.where(
+            finite, jnp.where(grow_now, sc * 2.0, sc),
+            jnp.maximum(sc * 0.5, 1.0))
+        out_state["_scale"] = jnp.minimum(new_scale,
+                                          jnp.float32(2.0 ** 24))
+        out_state["_growth"] = jnp.where(grow_now, 0, growth)
+    return new_p, out_state
+
+
 def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
                         zero_stage=0, remat=False, batch_spec=None,
                         donate=True, grad_clip_norm=None, offload=False,
@@ -196,8 +280,6 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
         batch = jax.tree_util.tree_map(
             lambda a: jax.lax.with_sharding_constraint(
                 a, NamedSharding(mesh.mesh, bspec)), batch)
-        wrapped = k_accum > 1 or dynamic_scale
-        inner = opt_state["_opt"] if wrapped else opt_state
         if dynamic_scale:
             sc = opt_state["_scale"]
         elif loss_scale:
@@ -210,69 +292,10 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
             grads = jax.tree_util.tree_map(
                 lambda g: (g.astype(jnp.float32) / sc).astype(g.dtype),
                 grads)
-        finite = None
-        if dynamic_scale:
-            # reference DynamicLossScaler (amp/grad_scaler.py): inf/nan
-            # grads -> zero them, halve the scale, skip the update
-            import functools as _ft
-            finite = _ft.reduce(
-                jnp.logical_and,
-                [jnp.all(jnp.isfinite(g))
-                 for g in jax.tree_util.tree_leaves(grads)])
-            grads = jax.tree_util.tree_map(
-                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
-        if k_accum > 1:
-            # GradientMerge: accumulate fp32; update only every k-th step
-            acc = jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32),
-                opt_state["_accum"], grads)
-            apply = (step_i % k_accum == 0)
-            # feed the fp32 accumulator straight to the optimizer: a
-            # cast back to bf16/fp16 would re-round away the precision
-            # the fp32 buffer held (and fp16 can overflow k-step sums)
-            eff = _clip(jax.tree_util.tree_map(
-                lambda a: (a / k_accum) if accum_avg else a, acc))
-            upd_i = jnp.maximum(step_i // k_accum, 1)
-            upd_p, upd_s = update_fn(eff, params, inner, step=upd_i)
-            # fp32 eff grads must not promote the stored param or
-            # optimizer-state dtypes (Adam casts params back itself;
-            # SGD/Momentum would leak fp32 params, and a promoted inner
-            # state would double its memory and break checkpoint dtypes)
-            upd_p = jax.tree_util.tree_map(
-                lambda a, b: a.astype(b.dtype), upd_p, params)
-            upd_s = jax.tree_util.tree_map(
-                lambda a, b: a.astype(b.dtype), upd_s, inner)
-            new_params = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(apply, a, b), upd_p, params)
-            new_inner = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(apply, a, b), upd_s, inner)
-            new_acc = jax.tree_util.tree_map(
-                lambda a: jnp.where(apply, jnp.zeros_like(a), a), acc)
-            out_state = {"_opt": new_inner, "_accum": new_acc}
-        else:
-            grads = _clip(grads)
-            upd_p, upd_s = update_fn(grads, params, inner, step=step_i)
-            if dynamic_scale:
-                upd_p = jax.tree_util.tree_map(
-                    lambda a, b: a.astype(b.dtype), upd_p, params)
-                upd_s = jax.tree_util.tree_map(
-                    lambda a, b: a.astype(b.dtype), upd_s, inner)
-                new_params = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(finite, a, b), upd_p, params)
-                new_inner = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(finite, a, b), upd_s, inner)
-                out_state = {"_opt": new_inner}
-            else:
-                return loss, upd_p, upd_s
-        if dynamic_scale:
-            growth = jnp.where(finite, opt_state["_growth"] + 1, 0)
-            grow_now = growth >= scale_window
-            new_scale = jnp.where(
-                finite, jnp.where(grow_now, sc * 2.0, sc),
-                jnp.maximum(sc * 0.5, 1.0))
-            out_state["_scale"] = jnp.minimum(new_scale,
-                                              jnp.float32(2.0 ** 24))
-            out_state["_growth"] = jnp.where(grow_now, 0, growth)
+        new_params, out_state = scaled_merge_update(
+            grads, params, opt_state, update_fn, _clip, k_accum,
+            accum_avg, dynamic_scale, sc, step_i,
+            scale_window=scale_window)
         return loss, new_params, out_state
 
     out_shardings = (NamedSharding(mesh.mesh, P()),

@@ -580,8 +580,6 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         return grads
 
     def step(params, opt_state, ids, labels, step_i, lr):
-        wrapped = k_accum > 1 or dynamic_scale
-        inner = opt_state["_opt"] if wrapped else opt_state
         if dynamic_scale:
             sc = opt_state["_scale"]
         elif loss_scale:
@@ -595,68 +593,11 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         if sc is not None:
             grads = jax.tree_util.tree_map(
                 lambda g_: g_ / sc, grads)           # builder grads: fp32
-        finite = None
-        if dynamic_scale:
-            # reference DynamicLossScaler: inf/nan grads -> zero this
-            # step's contribution, halve the scale, skip the update
-            import functools as _ft
-            finite = _ft.reduce(
-                jnp.logical_and,
-                [jnp.all(jnp.isfinite(g_))
-                 for g_ in jax.tree_util.tree_leaves(grads)])
-            grads = jax.tree_util.tree_map(
-                lambda g_: jnp.where(finite, g_, jnp.zeros_like(g_)),
-                grads)
-
-        if k_accum > 1:
-            acc = jax.tree_util.tree_map(
-                lambda a, g_: a + g_.astype(jnp.float32),
-                opt_state["_accum"], grads)
-            apply = (step_i % k_accum == 0)
-            eff = _clip(jax.tree_util.tree_map(
-                lambda a: (a / k_accum) if accum_avg else a, acc))
-            upd_i = jnp.maximum(step_i // k_accum, 1)
-            upd_p, upd_s = update_fn(eff, params, inner, lr=lr,
-                                     step=upd_i)
-            # fp32 eff grads must not promote stored param/state dtypes
-            upd_p = jax.tree_util.tree_map(
-                lambda a, b: a.astype(b.dtype), upd_p, params)
-            upd_s = jax.tree_util.tree_map(
-                lambda a, b: a.astype(b.dtype), upd_s, inner)
-            new_p = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(apply, a, b), upd_p, params)
-            new_inner = jax.tree_util.tree_map(
-                lambda a, b: jnp.where(apply, a, b), upd_s, inner)
-            new_acc = jax.tree_util.tree_map(
-                lambda a: jnp.where(apply, jnp.zeros_like(a), a), acc)
-            out_state = {"_opt": new_inner, "_accum": new_acc}
-        else:
-            grads = _clip(grads)
-            upd_p, upd_s = update_fn(grads, params, inner, lr=lr,
-                                     step=step_i)
-            if dynamic_scale:
-                upd_p = jax.tree_util.tree_map(
-                    lambda a, b: a.astype(b.dtype), upd_p, params)
-                upd_s = jax.tree_util.tree_map(
-                    lambda a, b: a.astype(b.dtype), upd_s, inner)
-                new_p = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(finite, a, b), upd_p, params)
-                new_inner = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(finite, a, b), upd_s, inner)
-            else:
-                new_p, new_inner = upd_p, upd_s
-            out_state = {"_opt": new_inner} if wrapped else new_inner
-
-        if dynamic_scale:
-            growth = jnp.where(finite, opt_state["_growth"] + 1, 0)
-            grow_now = growth >= dynamic_scale_window
-            new_scale = jnp.where(
-                finite,
-                jnp.where(grow_now, sc * 2.0, sc),
-                jnp.maximum(sc * 0.5, 1.0))
-            out_state["_scale"] = jnp.minimum(new_scale,
-                                              jnp.float32(2.0 ** 24))
-            out_state["_growth"] = jnp.where(grow_now, 0, growth)
+        from .api import scaled_merge_update
+        new_p, out_state = scaled_merge_update(
+            grads, params, opt_state, update_fn, _clip, k_accum,
+            accum_avg, dynamic_scale, sc, step_i, lr=lr,
+            scale_window=dynamic_scale_window)
         return loss, new_p, out_state
 
     jit_step = jax.jit(
