@@ -313,14 +313,90 @@ def _tree_from_shm(obj):
     return obj
 
 
+class _RingResultQueue:
+    """Queue-interface adapter over per-worker native SPSC rings
+    (runtime.ShmRing, csrc/shm_ring.cc — the reference's C++
+    buffered_reader transport). The parent pops round-robin; each
+    worker attaches its own ring by name and pushes pickled results
+    (large batches go inline through the ring's slot — one memcpy into
+    shared memory, no pipe, no feeder thread)."""
+
+    def __init__(self, names, slot_size, n_slots=8):
+        from ..runtime import ShmRing
+        self._rings = [ShmRing(n, slot_size=slot_size, n_slots=n_slots,
+                               create=True) for n in names]
+        self._slot = slot_size
+
+    def _sweep(self):
+        import pickle
+        for r in self._rings:
+            data = r.pop(timeout_ms=0)
+            if data is not None:
+                return pickle.loads(data)
+        return None
+
+    def get(self, timeout=5.0):
+        import queue as queue_mod
+        import time as time_mod
+        deadline = time_mod.monotonic() + timeout
+        while True:
+            msg = self._sweep()
+            if msg is not None:
+                return msg
+            if time_mod.monotonic() > deadline:
+                raise queue_mod.Empty
+            time_mod.sleep(0.001)
+
+    def get_nowait(self):
+        import queue as queue_mod
+        msg = self._sweep()
+        if msg is None:
+            raise queue_mod.Empty
+        return msg
+
+    def close(self):
+        for r in self._rings:
+            r.close()
+        self._rings = []
+
+
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
-                 num_workers, worker_init_fn, use_shared_memory, seed):
+                 num_workers, worker_init_fn, use_shared_memory, seed,
+                 ring_name=None, ring_slot=0):
     """Worker process body (reference _worker_loop, dataloader/worker.py)."""
     global _worker_info
     _worker_info = _WorkerInfo(wid, num_workers, dataset)
     np.random.seed((seed + wid) % (2 ** 31))
     if worker_init_fn is not None:
         worker_init_fn(wid)
+    if ring_name is not None:
+        import pickle
+        from ..runtime import ShmRing
+        ring = ShmRing(ring_name, create=False)
+
+        def _send(msg):
+            ep_, bi_, ok_, payload_ = msg
+            data = pickle.dumps(msg)
+            if len(data) + 8 > ring_slot and ok_:
+                # batch bigger than a slot: park arrays in their own
+                # shm segments and send the light refs through the ring
+                data = pickle.dumps((ep_, bi_, ok_,
+                                     _tree_to_shm(payload_)))
+            if len(data) + 8 > ring_slot:
+                # still oversized (object-heavy batch or a huge error
+                # traceback): report the failure instead of dying on
+                # the push — the worker must stay alive
+                note = (f"batch {bi_} payload exceeds the native ring "
+                        f"slot ({len(data)} > {ring_slot - 8} bytes); "
+                        "raise ring_slot_mb or disable use_native_ring"
+                        if ok_ else
+                        "worker error traceback exceeded the ring "
+                        "slot:\n" + str(payload_)[:4096])
+                data = pickle.dumps((ep_, bi_, False, note))
+            ring.push(data)
+    else:
+        def _send(msg):
+            result_queue.put(msg)
     while True:
         item = index_queue.get()
         if item is None:
@@ -328,12 +404,12 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, wid,
         epoch, bidx, indices = item
         try:
             batch = collate_fn([dataset[i] for i in indices])
-            if use_shared_memory:
+            if use_shared_memory and ring_name is None:
                 batch = _tree_to_shm(batch)
-            result_queue.put((epoch, bidx, True, batch))
+            _send((epoch, bidx, True, batch))
         except Exception:
             import traceback
-            result_queue.put((epoch, bidx, False, traceback.format_exc()))
+            _send((epoch, bidx, False, traceback.format_exc()))
 
 
 class DataLoader:
@@ -348,11 +424,14 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_native_ring=False,
+                 ring_slot_mb=8):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_shared_memory = use_shared_memory
+        self.use_native_ring = use_native_ring
+        self.ring_slot = int(ring_slot_mb) << 20
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
@@ -376,16 +455,31 @@ class DataLoader:
 
     # ---------------------------------------------------- worker control
     def _start_workers(self):
+        import os as os_mod
         ctx = multiprocessing.get_context("fork")
-        self._result_queue = ctx.Queue()
+        ring_names = None
+        if self.use_native_ring:
+            ring_names = [f"/pt_dl_{os_mod.getpid()}_{id(self)}_{w}"
+                          for w in range(self.num_workers)]
+            # slots must cover this worker's share of the dispatch
+            # window or producers block at epoch boundaries
+            n_slots = max(8, 2 * max(2, self.prefetch_factor) + 2)
+            self._result_queue = _RingResultQueue(ring_names,
+                                                  self.ring_slot,
+                                                  n_slots=n_slots)
+        else:
+            self._result_queue = ctx.Queue()
         for wid in range(self.num_workers):
             iq = ctx.Queue()
             p = ctx.Process(
                 target=_worker_loop,
-                args=(self.dataset, iq, self._result_queue,
+                args=(self.dataset, iq,
+                      None if ring_names else self._result_queue,
                       self.collate_fn, wid, self.num_workers,
                       self.worker_init_fn, self.use_shared_memory,
-                      np.random.randint(0, 2 ** 31)),
+                      np.random.randint(0, 2 ** 31),
+                      ring_names[wid] if ring_names else None,
+                      self.ring_slot),
                 daemon=True)
             p.start()
             self._workers.append(p)
@@ -421,6 +515,8 @@ class DataLoader:
             if p.is_alive():
                 p.terminate()
         self._drain_result_queue()
+        if isinstance(self._result_queue, _RingResultQueue):
+            self._result_queue.close()
         self._workers, self._index_queues = [], []
         self._result_queue = None
 
@@ -492,7 +588,10 @@ class DataLoader:
                         self._shutdown_workers()
                         raise RuntimeError(
                             f"DataLoader worker failed:\n{payload}")
-                    if self.use_shared_memory:
+                    if self.use_shared_memory or self.use_native_ring:
+                        # ring payloads are inline unless a batch
+                        # overflowed its slot into shm refs; the
+                        # converter passes plain arrays through
                         payload = _tree_from_shm(payload)
                     if ep != epoch:
                         continue  # stale result from an abandoned epoch

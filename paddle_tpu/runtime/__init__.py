@@ -20,9 +20,18 @@ _lib = None
 
 
 def build_library(force=False):
-    """CMake+ninja build of the runtime library (g++ direct fallback)."""
+    """CMake+ninja build of the runtime library (g++ direct fallback).
+    Rebuilds when any csrc source is newer than the built .so (a stale
+    library missing newly added symbols would break EVERY runtime user
+    at ctypes bind time)."""
     if os.path.exists(_LIB_PATH) and not force:
-        return _LIB_PATH
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        srcdir = os.path.join(_HERE, "csrc")
+        fresh = all(os.path.getmtime(os.path.join(srcdir, f)) <= lib_mtime
+                    for f in os.listdir(srcdir) if f.endswith(".cc"))
+        if fresh:
+            return _LIB_PATH
+        force = True
     build_dir = os.path.join(_HERE, "build")
     os.makedirs(build_dir, exist_ok=True)
     try:
@@ -32,10 +41,10 @@ def build_library(force=False):
                        capture_output=True)
     except (subprocess.CalledProcessError, FileNotFoundError):
         srcs = [os.path.join(_HERE, "csrc", f)
-                for f in ("tcp_store.cc", "flags.cc")]
+                for f in ("tcp_store.cc", "flags.cc", "shm_ring.cc")]
         subprocess.run(
             ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-o", _LIB_PATH,
-             *srcs, "-lpthread"], check=True)
+             *srcs, "-lpthread", "-lrt"], check=True)
     return _LIB_PATH
 
 
@@ -73,8 +82,84 @@ def lib():
             L.pt_flags_has.restype = ctypes.c_int
             L.pt_flags_has.argtypes = [ctypes.c_char_p]
             L.pt_flags_list.restype = ctypes.c_char_p
+            L.shm_ring_create.restype = ctypes.c_void_p
+            L.shm_ring_create.argtypes = [ctypes.c_char_p,
+                                          ctypes.c_uint64,
+                                          ctypes.c_uint32]
+            L.shm_ring_attach.restype = ctypes.c_void_p
+            L.shm_ring_attach.argtypes = [ctypes.c_char_p]
+            L.shm_ring_push.restype = ctypes.c_int
+            L.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64, ctypes.c_int]
+            L.shm_ring_pop.restype = ctypes.c_int64
+            L.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_int]
+            L.shm_ring_size.restype = ctypes.c_uint64
+            L.shm_ring_size.argtypes = [ctypes.c_void_p]
+            L.shm_ring_slot_size.restype = ctypes.c_uint64
+            L.shm_ring_slot_size.argtypes = [ctypes.c_void_p]
+            L.shm_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
             _lib = L
     return _lib
+
+
+class ShmRing:
+    """Lock-free SPSC shared-memory ring (native csrc/shm_ring.cc) —
+    the DataLoader's worker->main batch transport (reference C++
+    buffered_reader over shared memory). ``create=True`` owns the
+    segment (and unlinks it on close); workers ``attach``."""
+
+    def __init__(self, name, slot_size=1 << 23, n_slots=8, create=True):
+        self.name = name
+        self._own = create
+        self.slot_size = slot_size
+        self._buf = None                 # lazy persistent pop buffer
+        if create:
+            self._h = lib().shm_ring_create(name.encode(), slot_size,
+                                            n_slots)
+        else:
+            self._h = lib().shm_ring_attach(name.encode())
+        if not self._h:
+            raise RuntimeError(f"shm_ring {'create' if create else 'attach'}"
+                               f" failed for {name!r}")
+        if not create:
+            # the creator owns the true slot size; read it back
+            self.slot_size = int(lib().shm_ring_slot_size(self._h))
+
+    def push(self, data, timeout_ms=-1):
+        rc = lib().shm_ring_push(self._h, bytes(data), len(data),
+                                 timeout_ms)
+        if rc == -2:
+            raise ValueError(f"payload {len(data)} bytes exceeds the "
+                             "ring slot size")
+        return rc == 0
+
+    def pop(self, max_len=None, timeout_ms=-1):
+        if timeout_ms == 0 and len(self) == 0:
+            return None                  # cheap empty probe: no buffer
+        cap = max_len or self.slot_size
+        if self._buf is None or len(self._buf) < cap:
+            self._buf = ctypes.create_string_buffer(cap)
+        n = lib().shm_ring_pop(self._h, self._buf, cap, timeout_ms)
+        if n == -1:
+            return None
+        if n == -2:
+            raise ValueError("ring payload larger than max_len")
+        return self._buf.raw[:n]
+
+    def __len__(self):
+        return int(lib().shm_ring_size(self._h))
+
+    def close(self):
+        if self._h:
+            lib().shm_ring_close(self._h, 1 if self._own else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class TCPStoreServer:

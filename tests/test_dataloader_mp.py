@@ -154,3 +154,76 @@ def test_workers_scale_slow_transform():
     # pipelining, not real parallelism, and background load adds noise —
     # require a clear win, not an exact ratio.
     assert t_mp < t_serial * 0.85, (t_serial, t_mp)
+
+
+# ---------------------------------------------------- native ring transport
+
+def test_native_ring_transport_round_trips():
+    """use_native_ring=True routes worker results through the C
+    shared-memory SPSC ring (runtime csrc/shm_ring.cc) — same batches,
+    same order as the queue transport."""
+    from paddle_tpu.io.dataloader import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return np.full((4,), float(i), np.float32)
+
+    dl = DataLoader(DS(), batch_size=4, num_workers=2, shuffle=False,
+                    use_native_ring=True)
+    got = [b for b in dl]
+    dl._shutdown_workers()
+    assert len(got) == 6
+    for k, b in enumerate(got):
+        want = np.stack([np.full((4,), float(4 * k + j), np.float32)
+                         for j in range(4)])
+        np.testing.assert_allclose(np.asarray(b), want)
+
+
+def test_native_ring_oversized_batch_falls_back_to_shm_refs():
+    """A batch bigger than the ring slot parks its arrays in their own
+    shm segments and sends light refs through the ring."""
+    from paddle_tpu.io.dataloader import DataLoader
+
+    class BigDS:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.full((1 << 18,), float(i), np.float32)  # 1 MB each
+
+    # 1 MB slots; batch of 2 = 2 MB payload -> overflow path
+    dl = DataLoader(BigDS(), batch_size=2, num_workers=1, shuffle=False,
+                    use_native_ring=True, ring_slot_mb=1)
+    got = [np.asarray(b) for b in dl]
+    dl._shutdown_workers()
+    assert len(got) == 2 and got[0].shape == (2, 1 << 18)
+    np.testing.assert_allclose(got[0][0], 0.0)
+    np.testing.assert_allclose(got[1][1], 3.0)
+
+
+def test_native_ring_object_heavy_batch_reports_instead_of_dying():
+    """A batch that cannot shrink below the slot (no big ndarrays)
+    surfaces a clear error; the worker survives."""
+    import pytest
+    from paddle_tpu.io.dataloader import DataLoader
+
+    class ObjDS:
+        def __len__(self):
+            return 2
+
+        def __getitem__(self, i):
+            return ["x" * 500_000]          # strings: _tree_to_shm no-op
+
+    def collate(items):
+        return sum(items, [])
+
+    # tiny slots: the pickled strings can never fit
+    dl = DataLoader(ObjDS(), batch_size=2, num_workers=1, shuffle=False,
+                    use_native_ring=True, ring_slot_mb=0)
+    dl.ring_slot = 4096
+    with pytest.raises(RuntimeError, match="ring slot"):
+        list(dl)
+    dl._shutdown_workers()
