@@ -375,3 +375,28 @@ class TestBatchScheduler:
             want = layer(pt.to_tensor(x)).numpy()
             np.testing.assert_allclose(o[0], want, rtol=1e-5,
                                        atol=1e-6)
+
+
+def test_predictor_concurrent_runs_are_isolated():
+    """The reference AnalysisPredictor advertises multi-stream serving
+    (analysis_predictor.h:95); the TPU-native analog is one compiled
+    XLA program safely shared across caller threads."""
+    from concurrent.futures import ThreadPoolExecutor
+    from paddle_tpu import inference
+
+    layer = pt.nn.Linear(4, 3)
+    prefix = str(__import__("tempfile").mkdtemp()) + "/m"
+    pt.jit.save(layer, prefix,
+                input_spec=[st.InputSpec([-1, 4], "float32", "x")])
+    pred = inference.create_predictor(inference.Config(prefix))
+
+    def call(i):
+        x = np.full((2, 4), float(i), np.float32)
+        return i, pred.run([x])[0]
+
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        results = list(ex.map(call, range(32)))
+    for i, out in results:
+        want = layer(pt.to_tensor(
+            np.full((2, 4), float(i), np.float32))).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
