@@ -85,19 +85,22 @@ def main():
                              .astype(jnp.int32)]))
         rank = jnp.arange(T * K) - run_start[sorted_e]
         keep = rank < C                                   # capacity drop
-        dst = sorted_e * C + jnp.minimum(rank, C - 1)
+        # dropped pairs land in a SCRATCH slot — routing them to slot
+        # C-1 would clobber a legitimately binned token
+        dst = jnp.where(keep, sorted_e * C + rank, E * C)
         src_tok = flat_t[order]
-        bins = jnp.zeros((E * C, H), xv.dtype)
+        bins = jnp.zeros((E * C + 1, H), xv.dtype)
         bins = bins.at[dst].set(jnp.where(keep[:, None], xv[src_tok], 0))
-        bins = bins.reshape(E, C, H)
-        up = jnp.einsum("ech,ehf->ecf", bins, we_g)
-        up = jax.nn.silu(up) * jnp.einsum("ech,ehf->ecf", bins, we_u)
+        eb = bins[:E * C].reshape(E, C, H)
+        up = jnp.einsum("ech,ehf->ecf", eb, we_g)
+        up = jax.nn.silu(up) * jnp.einsum("ech,ehf->ecf", eb, we_u)
         down = jnp.einsum("ecf,efh->ech", up, we_d).reshape(E * C, H)
         out = jnp.zeros((T, H), jnp.float32)
         w_sorted = flat_w[order]
+        picked = down[jnp.minimum(dst, E * C - 1)]
         out = out.at[src_tok].add(
             jnp.where(keep[:, None],
-                      down[dst].astype(jnp.float32) * w_sorted[:, None],
+                      picked.astype(jnp.float32) * w_sorted[:, None],
                       0.0))
         return out.astype(xv.dtype)
 

@@ -263,20 +263,29 @@ def make_tied_tp_lm_fns(n_heads, mp_degree, causal=True, eps=1e-5,
 def make_moe_tp_fns(n_heads, mp_degree, num_experts, top_k=2,
                     causal=True, eps=1e-5, mp_axis="mp", n_kv_heads=None,
                     use_flash=False, rope_theta=None, sp_axis=None,
-                    sp_degree=1):
+                    sp_degree=1, dispatch="dense", capacity_factor=1.25):
     """MoE hybrid block: TP attention + EXPERT-PARALLEL SwiGLU MoE FFN
     (reference Mixtral/DeepSeek-MoE under fleet EP, moe/layer.py). The
-    expert banks shard over the mp axis (expert dim): each rank computes
-    its E/mp experts' contributions for every token (dense GShard-style
-    dispatch on the MXU, no capacity drops) and the combine psums over
-    mp — EP rides the same axis/collectives as TP, composing with
+    expert banks shard over the mp axis (expert dim); the combine psums
+    over mp — EP rides the same axis/collectives as TP, composing with
     pp/sharding/sp like the dense block. The gate weight is replicated
     with a c_identity boundary so its grad psums to full.
+
+    ``dispatch``: "dense" (GShard-style — every rank computes its local
+    experts for EVERY token on the MXU, combine selects; E/k extra
+    FLOPs, zero gather/scatter, no drops) or "sorted" (the reference
+    global_scatter shape — per local expert, routed tokens gather into
+    ``capacity_factor``-sized bins, expert matmuls run only on routed
+    tokens, weighted scatter-add combines; k/E of the dense FLOPs plus
+    data movement, tokens beyond capacity drop). Pick by measurement:
+    ``benchmarks/moe_dispatch_bench.py``.
 
     Params per block: llama attention tensors + w_gate [h, E] and expert
     banks we_g/we_u [E, h, f], we_d [E, f, h] (sharded P("mp") on dim 0).
     """
     assert num_experts % mp_degree == 0, (num_experts, mp_degree)
+    if dispatch not in ("dense", "sorted"):
+        raise ValueError(f"dispatch={dispatch!r}: 'dense' or 'sorted'")
     e_local = num_experts // mp_degree
     (dense_block, embed_fn, head_loss_fn), (dense_specs, embed_specs,
                                             head_specs) = \
@@ -286,6 +295,66 @@ def make_moe_tp_fns(n_heads, mp_degree, num_experts, top_k=2,
                           sp_axis=sp_axis, sp_degree=sp_degree)
     attn_part = dense_block._attn_part
     from .mp_ops import c_identity, mp_allreduce
+
+    def _moe_dense(p, hn, w_local):
+        # every local expert computes every token; the weighted combine
+        # selects — three big MXU einsums, zero data movement
+        up = jnp.einsum("bsh,ehf->ebsf", hn, p["we_g"])
+        up = jax.nn.silu(up) * jnp.einsum("bsh,ehf->ebsf", hn, p["we_u"])
+        down = jnp.einsum("ebsf,efh->ebsh", up, p["we_d"])
+        return jnp.einsum("ebsh,bse->bsh", down.astype(jnp.float32),
+                          w_local).astype(hn.dtype)
+
+    def _moe_sorted(p, hn, w_local, topi, probs, i_rank):
+        # ONE stable argsort of the T*k (token, expert) pairs bins the
+        # locally-routed pairs by expert with rank-within-run slots
+        # (reference global_scatter semantics; the exact algorithm
+        # benchmarks/moe_dispatch_bench.py A/Bs against dense). Pairs
+        # past an expert's capacity — and non-local pairs — land in a
+        # scratch slot so they can never clobber a real bin. Fully
+        # differentiable: grads ride the gather/scatter-add transposes.
+        mb, s, h = hn.shape
+        T = mb * s
+        TK = T * top_k
+        C = max(1, min(int(capacity_factor * T * top_k / num_experts),
+                       T))
+        x2 = hn.reshape(T, h)
+        flat_g = topi.reshape(TK)                        # global ids
+        flat_w = probs.reshape(TK).astype(jnp.float32)
+        flat_t = jnp.repeat(jnp.arange(T), top_k,
+                            total_repeat_length=TK)
+        loc = flat_g - i_rank * e_local
+        is_local = (loc >= 0) & (loc < e_local)
+        key = jnp.where(is_local, loc, e_local)          # sentinel bin
+        order = jnp.argsort(key, stable=True)
+        sorted_e = key[order]
+        counts = jnp.bincount(key, length=e_local + 1)
+        run_start = jnp.concatenate(
+            [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]])
+        rank = jnp.arange(TK) - run_start[sorted_e]
+        keep = (sorted_e < e_local) & (rank < C)
+        scratch = e_local * C                            # drop slot
+        dst = jnp.where(keep, sorted_e * C + rank, scratch)
+        src = flat_t[order]
+        bins = jnp.zeros((e_local * C + 1, h), x2.dtype)
+        bins = bins.at[dst].set(
+            jnp.where(keep[:, None], x2[src], 0))
+        eb = bins[:e_local * C].reshape(e_local, C, h)
+        up = jnp.einsum("ech,ehf->ecf", eb, p["we_g"])
+        up = jax.nn.silu(up) * jnp.einsum("ech,ehf->ecf", eb,
+                                          p["we_u"])
+        down = jnp.einsum("ecf,efh->ech", up,
+                          p["we_d"]).reshape(e_local * C, h)
+        w_sorted = flat_w[order]
+        picked = down[jnp.minimum(dst, e_local * C - 1)]
+        out = jnp.zeros((T, h), jnp.float32)
+        out = out.at[src].add(
+            jnp.where(keep[:, None],
+                      picked.astype(jnp.float32)
+                      * w_sorted[:, None], 0.0))
+        return out.reshape(mb, s, h).astype(hn.dtype)
+
+    moe_ffn = _moe_sorted if dispatch == "sorted" else _moe_dense
 
     def block_fn(p, x):
         x = attn_part(p, x)
@@ -304,12 +373,10 @@ def make_moe_tp_fns(n_heads, mp_degree, num_experts, top_k=2,
         i = jax.lax.axis_index(mp_axis)
         w_local = jax.lax.dynamic_slice_in_dim(
             comb, i * e_local, e_local, 2)               # [mb, s, E/mp]
-        up = jnp.einsum("bsh,ehf->ebsf", hn, p["we_g"])
-        up = jax.nn.silu(up) * jnp.einsum("bsh,ehf->ebsf", hn, p["we_u"])
-        down = jnp.einsum("ebsf,efh->ebsh", up, p["we_d"])
-        y_local = jnp.einsum("ebsh,bse->bsh",
-                             down.astype(jnp.float32),
-                             w_local).astype(x.dtype)
+        if dispatch == "sorted":
+            y_local = moe_ffn(p, hn, w_local, topi, probs, i)
+        else:
+            y_local = moe_ffn(p, hn, w_local)
         return x + mp_allreduce(y_local, mp_axis)
 
     block_fn._sp_axis = sp_axis       # builder asserts seq_axis matches

@@ -760,3 +760,125 @@ def test_multi_precision_checkpoint_guard():
     opt = pt.optimizer.AdamW(learning_rate=1e-3, multi_precision=True)
     with pytest.raises(ValueError, match="master"):
         opt.set_state_dict({"step": 5, "state": {"m": {}, "v": {}}})
+
+
+def test_moe_sorted_dispatch_matches_dense():
+    """dispatch="sorted" (reference global_scatter shape: capacity bins,
+    routed-token matmuls, weighted scatter-add) reproduces the dense
+    GShard dispatch exactly when capacity covers every routed token —
+    loss AND expert grads; the on-chip A/B
+    (benchmarks/moe_dispatch_bench.py) picks the default."""
+    from paddle_tpu.parallel.hybrid import (init_moe_tp_params,
+                                            make_moe_tp_fns)
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    E, K = 4, 2
+    rng = np.random.RandomState(95)
+    ids = jnp.asarray(rng.randint(0, V, size=(B, S)).astype(np.int32))
+    outs = {}
+    for mode in ("dense", "sorted"):
+        mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+        fns, specs = make_moe_tp_fns(
+            NH, 2, num_experts=E, top_k=K, dispatch=mode,
+            capacity_factor=float(E))      # C = T: nothing can drop
+        blocks, embed, head = init_moe_tp_params(
+            L, H, F, V, E, rng=np.random.RandomState(91))
+        grad_fn, (stacked, emb_p, head_p, _s) = build_1f1b_train_step(
+            *fns, blocks, embed, head, mesh, num_micro=M,
+            block_param_specs=specs[0], embed_param_specs=specs[1],
+            head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+        loss, (d_blk, _de, _dh) = jax.jit(grad_fn)(
+            stacked, emb_p, head_p, ids, ids)
+        outs[mode] = (float(loss), np.asarray(d_blk["we_d"]),
+                      np.asarray(d_blk["w_gate"]))
+    np.testing.assert_allclose(outs["sorted"][0], outs["dense"][0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs["sorted"][1], outs["dense"][1],
+                               rtol=1e-4, atol=1e-7)
+    np.testing.assert_allclose(outs["sorted"][2], outs["dense"][2],
+                               rtol=1e-4, atol=1e-7)
+
+
+def test_moe_sorted_dispatch_capacity_drops():
+    """With a tight capacity the sorted dispatch DROPS overflow pairs —
+    and only those: the result equals the dense combine with the same
+    pairs' weights zeroed by the deterministic (stable-sort) drop rule."""
+    from paddle_tpu.parallel.hybrid import (init_moe_tp_params,
+                                            make_moe_tp_fns)
+    from paddle_tpu.parallel.mesh import P as Pspec
+    E, K, cap = 4, 2, 0.5
+    mesh = dist.init_mesh(dp=1, pp=1, sharding=1, mp=2)
+    fns, specs = make_moe_tp_fns(NH, 2, num_experts=E, top_k=K,
+                                 dispatch="sorted", capacity_factor=cap)
+    blocks, embed, head = init_moe_tp_params(
+        1, H, F, V, E, rng=np.random.RandomState(97))
+    block_fn = fns[0]
+    rng = np.random.RandomState(98)
+    x = jnp.asarray(rng.randn(2, 8, H).astype(np.float32) * 0.3)
+    bp = blocks[0]
+
+    def body(px, xx):
+        return block_fn(px, xx)
+
+    sharded_params = {
+        n: jax.device_put(v, jax.NamedSharding(mesh.mesh, Pspec(*spec)))
+        for (n, v), spec in zip(bp.items(),
+                                [specs[0][n] for n in bp])}
+    y = jax.shard_map(body, mesh=mesh.mesh,
+                      in_specs=({n: specs[0][n] for n in bp},
+                                Pspec()),
+                      out_specs=Pspec(), check_vma=False)(
+        sharded_params, x)
+
+    # reference: dense combine with weights zeroed by the SAME drop rule
+    T = 2 * 8
+    C = max(1, min(int(cap * T * K / E), T))
+
+    def rms(v, w, eps=1e-5):
+        var = jnp.mean(jnp.square(v), -1, keepdims=True)
+        return v * jax.lax.rsqrt(var + eps) * w
+
+    # replicate attention half
+    def attn_half(p, xx):
+        mb, s, h = xx.shape
+        hn = rms(xx, p["ln1"])
+        q = (hn @ p["wq"]).reshape(mb, s, NH, -1)
+        k = (hn @ p["wk"]).reshape(mb, s, NH, -1)
+        v = (hn @ p["wv"]).reshape(mb, s, NH, -1)
+        dh = q.shape[-1]
+        lg = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(dh)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        lg = jnp.where(mask, lg, jnp.finfo(lg.dtype).min)
+        a = jax.nn.softmax(lg, -1)
+        ctx = jnp.einsum("bnqk,bknd->bqnd", a, v).reshape(mb, s, -1)
+        return xx + ctx @ p["wo"]
+
+    xa = attn_half(bp, x)
+    hn = rms(xa, bp["ln2"])
+    logits = hn @ bp["w_gate"]
+    topv, topi = jax.lax.top_k(logits, K)
+    probs = jax.nn.softmax(topv.astype(jnp.float32), -1)
+    # drop rule: flat (token, expert) pairs in stable order per expert;
+    # pair kept iff its rank within its expert's run < C
+    flat_g = np.asarray(topi.reshape(-1))
+    kept = np.zeros(len(flat_g), bool)
+    counts = {e: 0 for e in range(E)}
+    for j, e in enumerate(flat_g):
+        if counts[e] < C:
+            kept[j] = True
+            counts[e] += 1
+    comb = np.zeros((T, E), np.float32)
+    pf = np.asarray(probs.reshape(-1))
+    tf = np.repeat(np.arange(T), K)
+    for j in range(len(flat_g)):
+        if kept[j]:
+            comb[tf[j], flat_g[j]] += pf[j]
+    comb = jnp.asarray(comb.reshape(2, 8, E))
+    up = jnp.einsum("bsh,ehf->ebsf", hn, bp["we_g"])
+    up = jax.nn.silu(up) * jnp.einsum("bsh,ehf->ebsf", hn, bp["we_u"])
+    down = jnp.einsum("ebsf,efh->ebsh", up, bp["we_d"])
+    want = xa + jnp.einsum("ebsh,bse->bsh", down.astype(jnp.float32),
+                           comb).astype(x.dtype)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    # sanity: drops actually happened at this capacity
+    assert kept.sum() < len(flat_g)
