@@ -187,11 +187,19 @@ class TCPStoreServer:
 
 
 class TCPStore:
-    """Client (reference phi TCPStore API: set/get/add/wait)."""
+    """Client (reference phi TCPStore API: set/get/add/wait).
+
+    Thread-safe: one request/reply cycle at a time per connection — the
+    elastic manager heartbeats from a daemon thread while the main
+    thread polls membership, and interleaved writes on the shared
+    socket would corrupt the length-prefixed protocol (observed as a
+    blocked check() waiting on a reply the other thread consumed).
+    """
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
                  world_size=1, timeout=30):
         self._server = None
+        self._io_lock = threading.Lock()
         if is_master:
             self._server = TCPStoreServer(port)
             port = self._server.port
@@ -204,12 +212,16 @@ class TCPStore:
 
     def set(self, key, value):
         data = value if isinstance(value, bytes) else str(value).encode()
-        if lib().pt_store_set(self._h, key.encode(), data, len(data)) != 0:
+        with self._io_lock:
+            rc = lib().pt_store_set(self._h, key.encode(), data,
+                                    len(data))
+        if rc != 0:
             raise RuntimeError("TCPStore.set failed")
 
     def get(self, key, max_len=1 << 20):
         buf = ctypes.create_string_buffer(max_len)
-        n = lib().pt_store_get(self._h, key.encode(), buf, max_len)
+        with self._io_lock:
+            n = lib().pt_store_get(self._h, key.encode(), buf, max_len)
         if n < 0:
             raise RuntimeError("TCPStore.get failed")
         return buf.raw[:n]
@@ -217,16 +229,19 @@ class TCPStore:
     wait = get
 
     def add(self, key, delta=1):
-        out = lib().pt_store_add(self._h, key.encode(), delta)
+        with self._io_lock:
+            out = lib().pt_store_add(self._h, key.encode(), delta)
         if out == -1:
             raise RuntimeError("TCPStore.add failed")
         return int(out)
 
     def check(self, key):
-        return bool(lib().pt_store_check(self._h, key.encode()))
+        with self._io_lock:
+            return bool(lib().pt_store_check(self._h, key.encode()))
 
     def delete_key(self, key):
-        return lib().pt_store_del(self._h, key.encode()) == 0
+        with self._io_lock:
+            return lib().pt_store_del(self._h, key.encode()) == 0
 
     def barrier(self, name, world_size, timeout=60):
         """Counter barrier over the store (launcher sync primitive)."""

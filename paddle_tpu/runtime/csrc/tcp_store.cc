@@ -44,6 +44,8 @@ struct Server {
   std::atomic<bool> running{false};
   std::thread accept_thread;
   std::vector<std::thread> workers;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;
   Store store;
   ~Server() { stop(); }
   void stop() {
@@ -51,6 +53,14 @@ struct Server {
       shutdown(listen_fd, SHUT_RDWR);
       close(listen_fd);
       if (accept_thread.joinable()) accept_thread.join();
+      {
+        // wake serve_conn threads blocked in recv on live clients —
+        // joining them without this deadlocks process exit whenever a
+        // client (e.g. this process's own rendezvous connection) is
+        // still connected
+        std::lock_guard<std::mutex> g(conn_mu);
+        for (int fd : conn_fds) shutdown(fd, SHUT_RDWR);
+      }
       for (auto& w : workers)
         if (w.joinable()) w.join();
     }
@@ -185,6 +195,10 @@ void* pt_store_server_start(int port) {
       if (fd < 0) {
         if (!srv->running) break;
         continue;
+      }
+      {
+        std::lock_guard<std::mutex> g(srv->conn_mu);
+        srv->conn_fds.push_back(fd);
       }
       srv->workers.emplace_back(serve_conn, srv, fd);
     }

