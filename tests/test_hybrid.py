@@ -8,6 +8,7 @@ Parity oracle: the same model on full weights, sequentially, one device.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 import paddle_tpu.parallel as dist
@@ -882,3 +883,39 @@ def test_moe_sorted_dispatch_capacity_drops():
                                rtol=2e-4, atol=2e-5)
     # sanity: drops actually happened at this capacity
     assert kept.sum() < len(flat_g)
+
+
+@pytest.mark.slow
+def test_hybrid_trace_time_scales_with_stacked_blocks():
+    """Compile-time canary (r4 weak #4): the stacked-scan hybrid block
+    must keep TRACE+LOWER time flat in depth — the tick table scans a
+    [v,S,C,...] stack, so 32 layers lower as fast as 8 (a per-layer
+    unrolled builder would blow up here). Full-size compile walls are
+    tracked on-chip by benchmarks/compile_hybrid.py."""
+    import time
+    from paddle_tpu.parallel.pp_1f1b import build_1f1b_train_step
+    times = {}
+    for Lc in (8, 32):
+        mesh = dist.init_mesh(dp=1, pp=2, sharding=2, mp=2)
+        fns, specs = make_llama_tp_fns(NH, 2)
+        blocks = [jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), bp)
+            for bp in init_llama_tp_params(
+                Lc, H, F, V, rng=np.random.RandomState(5))[0]]
+        _b, embed, head = init_llama_tp_params(
+            2, H, F, V, rng=np.random.RandomState(5))
+        e_avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), embed)
+        h_avals = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), head)
+        grad_fn, (stk, ep, hp, _s) = build_1f1b_train_step(
+            *fns, blocks, e_avals, h_avals, mesh, num_micro=2,
+            block_param_specs=specs[0], embed_param_specs=specs[1],
+            head_param_specs=specs[2], batch_axes=("dp", "sharding"))
+        ids = jax.ShapeDtypeStruct((8, S), jnp.int32)
+        t0 = time.time()
+        jax.jit(grad_fn).lower(stk, ep, hp, ids, ids)
+        times[Lc] = time.time() - t0
+    # depth rides the scan: 4x the layers must not cost anywhere near
+    # 4x the trace+lower time (allow 2x for stack-shape overheads)
+    assert times[32] < max(2.0 * times[8], times[8] + 5.0), times
