@@ -1,0 +1,144 @@
+"""On-device autoregressive decode loops.
+
+The reference serves decode through ``fused_multi_transformer_op.cu``
+(/root/reference/paddle/fluid/operators/fused/fused_multi_transformer_op.cu)
+driven by a host loop: one kernel launch per generated token. On TPU the
+equivalent host loop pays a full dispatch round-trip per token (and over a
+remote-execution relay, several milliseconds), while the chip-side work of
+one decode step is sub-millisecond — decode becomes dispatch-bound.
+
+The TPU-native design runs the WHOLE decode loop on device as one XLA
+program: ``jax.lax.scan`` over positions with the KV caches as loop carry.
+Host dispatch is paid once per sequence instead of once per token, and XLA
+pipelines the per-step weight streaming. Two entry points:
+
+- ``scan_decode``: generic — scans any ``step_fn(x, caches, t)`` whose
+  output feeds the next step (hidden-state loops, benchmark harnesses).
+- ``greedy_generate``: token-level — embed → step → head → argmax fed
+  back as the next token; returns the generated ids. The static-shape
+  analogue of the reference serving loop.
+"""
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import unwrap
+
+__all__ = ["scan_decode", "greedy_generate"]
+
+
+def _pure(fn):
+    """Adapt a framework-level fn (may return Tensor wrappers) to a pure
+    array fn usable as a ``lax.scan`` body."""
+    def run(*args):
+        out = fn(*args)
+        return jax.tree_util.tree_map(unwrap, out)
+    return run
+
+
+# Compiled-program cache. Anchored on the step_fn (or, for bound methods,
+# its instance) via weakref so entries die with their owner; the key tuple
+# holds strong refs to every function identity the compiled program closed
+# over, so an id can never be reused for a stale hit.
+_JIT_CACHE = weakref.WeakKeyDictionary()
+
+
+def _cached_jit(step_fn, key_tail, build):
+    anchor = getattr(step_fn, "__self__", step_fn)
+    func = getattr(step_fn, "__func__", None)
+    try:
+        inner = _JIT_CACHE.setdefault(anchor, {})
+    except TypeError:        # non-weakrefable callable: no caching
+        return build()
+    key = (func, *key_tail)
+    jit_run = inner.get(key)
+    if jit_run is None:
+        jit_run = build()
+        inner[key] = jit_run
+    return jit_run
+
+
+def scan_decode(step_fn, x0, caches, t0, steps, donate=True):
+    """Run ``steps`` decode iterations on device as ONE program.
+
+    ``step_fn(x, caches, t) -> (out, new_caches)`` is one decoder step
+    (e.g. a closure over ``incubate.nn.functional.fused_multi_transformer``
+    with ``time_step=t``); ``x0`` is the step input ``[B, 1, D]``,
+    ``caches`` the static-shape KV buffers, ``t0`` the starting position
+    (int). The output of each step becomes the input of the next.
+
+    Returns ``(out, new_caches)`` after ``steps`` iterations. The jitted
+    program is cached on ``step_fn``; repeated calls with the same shapes
+    recompile nothing.
+    """
+    pure_step = _pure(step_fn)
+
+    def body(carry, _):
+        x, cs, t = carry
+        out, cs2 = pure_step(x, cs, t)
+        return (out, cs2, t + 1), None
+
+    def run(x0, caches, t0):
+        (x, cs, _), _ = jax.lax.scan(
+            body, (x0, caches, jnp.asarray(t0, jnp.int32)), None,
+            length=steps)
+        return x, cs
+
+    jit_run = _cached_jit(
+        step_fn, ("scan_decode", steps, donate),
+        lambda: jax.jit(run, donate_argnums=(1,) if donate else ()))
+    return jit_run(unwrap(x0), jax.tree_util.tree_map(unwrap, caches), t0)
+
+
+def greedy_generate(embed_fn, step_fn, head_fn, caches, first_token, t0,
+                    max_new_tokens, eos_token_id=None):
+    """Greedy autoregressive generation as one on-device program.
+
+    Per step: ``x = embed_fn(tok, t)`` → ``out, caches = step_fn(x,
+    caches, t)`` → ``tok' = argmax(head_fn(out))``; the loop carries
+    ``(tok, caches, t, done)``. Static shapes throughout: exactly
+    ``max_new_tokens`` iterations run; once every row has emitted
+    ``eos_token_id`` the remaining steps write ``eos`` (XLA cannot break
+    early, matching the padded behavior of batched serving).
+
+    ``first_token`` is ``[B]`` int32 (typically the argmax over the last
+    prefill logits); ``t0`` the first decode position. Returns
+    ``(ids [B, max_new_tokens], caches)``.
+
+    The compiled program is cached on the ``(embed_fn, step_fn, head_fn,
+    max_new_tokens, eos_token_id)`` identity — pass STABLE callables (not
+    per-request closures) so repeated requests reuse one compile.
+    """
+    embed_p, step_p, head_p = _pure(embed_fn), _pure(step_fn), _pure(head_fn)
+
+    def body(carry, _):
+        tok, cs, t, done = carry
+        x = embed_p(tok, t)
+        out, cs2 = step_p(x, cs, t)
+        logits = head_p(out)
+        if logits.ndim == 3:            # [B, 1, V] -> [B, V]
+            logits = logits[:, -1]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, cs2, t + 1, done), tok
+
+    def run(first_token, caches, t0):
+        B = first_token.shape[0]
+        carry = (first_token.astype(jnp.int32),
+                 caches,
+                 jnp.asarray(t0, jnp.int32),
+                 jnp.zeros((B,), bool))
+        (_, cs, _, _), toks = jax.lax.scan(body, carry, None,
+                                           length=max_new_tokens)
+        return jnp.transpose(toks, (1, 0)), cs   # [B, T_new]
+
+    jit_run = _cached_jit(
+        step_fn,
+        ("greedy_generate", embed_fn, head_fn, max_new_tokens,
+         eos_token_id),
+        lambda: jax.jit(run))
+    return jit_run(unwrap(first_token),
+                   jax.tree_util.tree_map(unwrap, caches), t0)
