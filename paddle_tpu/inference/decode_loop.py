@@ -25,7 +25,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import unwrap
 
-__all__ = ["scan_decode", "greedy_generate"]
+__all__ = ["scan_decode", "greedy_generate", "sample_generate",
+           "process_logits"]
 
 
 def _pure(fn):
@@ -142,3 +143,85 @@ def greedy_generate(embed_fn, step_fn, head_fn, caches, first_token, t0,
         lambda: jax.jit(run))
     return jit_run(unwrap(first_token),
                    jax.tree_util.tree_map(unwrap, caches), t0)
+
+
+def process_logits(logits, temperature=1.0, top_k=0, top_p=1.0):
+    """Standard sampling filters (reference generation semantics:
+    TopKProcess/TopPProcess in the incubate generation utils): scale by
+    temperature, keep the top-k logits, then nucleus-filter to the
+    smallest set with cumulative probability >= top_p. Filtered entries
+    go to -inf; returns filtered logits ready for categorical sampling."""
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        logits = logits / jnp.float32(max(temperature, 1e-6))
+    neg = jnp.float32(-1e30)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -int(top_k)][..., None]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        sort_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep
+        # the first)
+        keep_sorted = (cum - probs) < jnp.float32(top_p)
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+        logits = jnp.where(keep, logits, neg)
+    return logits
+
+
+def sample_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
+                    max_new_tokens, key, temperature=1.0, top_k=0,
+                    top_p=1.0, eos_token_id=None):
+    """Stochastic generation as one on-device program: every token —
+    including the first, drawn from ``first_logits`` (the last prefill
+    position) — is sampled with ``jax.random.categorical`` after
+    temperature/top-k/top-p filtering (``process_logits``). Same loop
+    shape and caching rules as ``greedy_generate``; ``key`` is a JAX
+    PRNG key carried through the scan. Returns
+    ``(ids [B, max_new_tokens], caches)``.
+    """
+    embed_p, step_p, head_p = _pure(embed_fn), _pure(step_fn), _pure(head_fn)
+    temperature = float(temperature)
+    top_k = int(top_k)
+    top_p = float(top_p)
+
+    def sample(logits, k):
+        if logits.ndim == 3:
+            logits = logits[:, -1]
+        return jax.random.categorical(
+            k, process_logits(logits, temperature, top_k, top_p),
+            axis=-1).astype(jnp.int32)
+
+    def body(carry, _):
+        tok, cs, t, done, key = carry
+        x = embed_p(tok, t)
+        out, cs2 = step_p(x, cs, t)
+        key, sub = jax.random.split(key)
+        nxt = sample(head_p(out), sub)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, cs2, t + 1, done, key), tok
+
+    def run(first_logits, caches, t0, key):
+        B = first_logits.shape[0]
+        key, sub = jax.random.split(key)
+        tok0 = sample(first_logits, sub)
+        done = jnp.zeros((B,), bool)
+        if eos_token_id is not None:
+            done = tok0 == eos_token_id
+        carry = (tok0, caches, jnp.asarray(t0, jnp.int32), done, key)
+        (_, cs, _, _, _), toks = jax.lax.scan(body, carry, None,
+                                              length=max_new_tokens)
+        return jnp.transpose(toks, (1, 0)), cs
+
+    jit_run = _cached_jit(
+        step_fn,
+        ("sample_generate", embed_fn, head_fn, max_new_tokens,
+         temperature, top_k, top_p, eos_token_id),
+        lambda: jax.jit(run))
+    return jit_run(unwrap(first_logits),
+                   jax.tree_util.tree_map(unwrap, caches), t0, key)

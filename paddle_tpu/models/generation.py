@@ -1,0 +1,286 @@
+"""Model-level text generation on the on-device decode loop.
+
+The reference serves autoregressive models through per-token host loops
+around fused ops (fused_multi_transformer_op.cu time_step path); the
+generation filters (top-k/top-p/temperature) live in its incubate
+generation utils. Here the whole pipeline — prefill, KV-cache decode,
+logits filtering, sampling — compiles to two XLA programs (one prefill,
+one `lax.scan` decode; inference/decode_loop.py), so host dispatch is
+paid once per sequence.
+
+Design: instead of threading mutable cache state through every
+``nn.Layer.forward`` (the torch/reference pattern), each CausalLM model
+decomposes into PURE step functions over its raw parameter tree — the
+same approach its ``pipeline_decompose`` uses for pipeline parallelism.
+``GenerationMixin.generate`` is the user API on GPTForCausalLM and
+LlamaForCausalLM.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import unwrap, wrap
+
+__all__ = ["GenerationMixin"]
+
+
+def _stacked(blocks, name):
+    return jnp.stack([unwrap(b[name]) for b in blocks])
+
+
+def _rms(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+            ).astype(x.dtype) * w
+
+
+def _ln(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
+
+
+def _cached_attend(q, k_cache, v_cache, t, s, scale):
+    """q [B,s,nh,hd] at positions [t, t+s); caches [B,T,nh,hd] already
+    updated through t+s. Masks unwritten/future slots: key position p is
+    visible to query row r iff p <= t+r."""
+    T = k_cache.shape[1]
+    logits = jnp.einsum("bsnd,btnd->bnst", q, k_cache) * scale
+    pos = jnp.arange(T)[None, :]
+    row = jnp.arange(s)[:, None]
+    ok = pos <= (t + row)
+    logits = jnp.where(ok[None, None], logits.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", p, v_cache)
+
+
+def _write_cache(cache, kv, t):
+    """cache [B,T,h,hd] <- kv [B,s,h,hd] at positions [t, t+s)."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        cache, kv.astype(cache.dtype), t, axis=1)
+
+
+def _make_llama_decode_fns(model, max_cache_len):
+    """(init_caches, embed_fn, step_fn, head_fn) for LlamaForCausalLM —
+    GQA-aware (kv heads cached unrepeated), rope applied at absolute
+    positions."""
+    from ..ops.pallas import rope as rope_mod
+    cfg = model.cfg
+    nh, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    eps = cfg.rms_eps
+    blocks = [dict(blk.raw_params()) for blk in model.model.layers]
+    p = {
+        "table": unwrap(model.model.embed_tokens.weight),
+        "norm": unwrap(model.model.norm.weight),
+        "head": unwrap(model.lm_head.weight),            # [H, V]
+        "ln1": _stacked(blocks, "input_layernorm.weight"),
+        "ln2": _stacked(blocks, "post_attention_layernorm.weight"),
+        "wq": _stacked(blocks, "self_attn.q_proj.weight"),
+        "wk": _stacked(blocks, "self_attn.k_proj.weight"),
+        "wv": _stacked(blocks, "self_attn.v_proj.weight"),
+        "wo": _stacked(blocks, "self_attn.o_proj.weight"),
+        "wg": _stacked(blocks, "mlp.gate_proj.weight"),
+        "wu": _stacked(blocks, "mlp.up_proj.weight"),
+        "wd": _stacked(blocks, "mlp.down_proj.weight"),
+    }
+    cos, sin = rope_mod.precompute_freqs(hd, max_cache_len, cfg.rope_theta)
+    dtype = p["table"].dtype
+    L = cfg.num_layers
+    scale = 1.0 / np.sqrt(hd)
+
+    def init_caches(batch):
+        shape = (L, batch, max_cache_len, kvh, hd)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def embed_fn(tok, t):
+        return p["table"][tok][:, None, :]
+
+    def step_fn(x, caches, t):
+        x = unwrap(x)
+        b, s = x.shape[0], x.shape[1]
+        pos = (t + jnp.arange(s))[None, :].repeat(b, 0)   # [B, s]
+
+        def layer(xx, xs):
+            blk, kc, vc = xs
+            h = _rms(xx, blk["ln1"], eps)
+            q = (h @ blk["wq"]).reshape(b, s, nh, hd)
+            k = (h @ blk["wk"]).reshape(b, s, kvh, hd)
+            v = (h @ blk["wv"]).reshape(b, s, kvh, hd)
+            q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
+            k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
+            kc = _write_cache(kc, k, t)
+            vc = _write_cache(vc, v, t)
+            rep = nh // kvh
+            kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+            vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+            att = _cached_attend(q, kk, vv, t, s, scale)
+            xx = xx + att.reshape(b, s, nh * hd) @ blk["wo"]
+            h2 = _rms(xx, blk["ln2"], eps)
+            xx = xx + (jax.nn.silu(h2 @ blk["wg"]) * (h2 @ blk["wu"])
+                       ) @ blk["wd"]
+            return xx, (kc, vc)
+
+        blk_tree = {k_: v_ for k_, v_ in p.items()
+                    if k_ not in ("table", "norm", "head")}
+        x, (kcs, vcs) = jax.lax.scan(
+            layer, x, (blk_tree, caches["k"], caches["v"]))
+        return x, {"k": kcs, "v": vcs}
+
+    def head_fn(out):
+        return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
+                ).astype(jnp.float32)
+
+    return init_caches, embed_fn, step_fn, head_fn
+
+
+def _make_gpt_decode_fns(model, max_cache_len):
+    """(init_caches, embed_fn, step_fn, head_fn) for GPTForCausalLM —
+    learned positions, fused qkv, tied lm head."""
+    cfg = model.cfg
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    eps = cfg.layer_norm_eps
+    blocks = [dict(blk.raw_params()) for blk in model.gpt.blocks]
+    p = {
+        "table": unwrap(model.gpt.wte.weight),           # [V, H] (tied)
+        "wpe": unwrap(model.gpt.wpe.weight),
+        "lnf_w": unwrap(model.gpt.ln_f.weight),
+        "lnf_b": unwrap(model.gpt.ln_f.bias),
+    }
+    for name in ("ln1.weight", "ln1.bias", "ln2.weight", "ln2.bias",
+                 "attn.qkv.weight", "attn.qkv.bias",
+                 "attn.proj.weight", "attn.proj.bias",
+                 "mlp.fc1.weight", "mlp.fc1.bias",
+                 "mlp.fc2.weight", "mlp.fc2.bias"):
+        p[name] = _stacked(blocks, name)
+    dtype = p["table"].dtype
+    L = cfg.num_layers
+    scale = 1.0 / np.sqrt(hd)
+
+    def init_caches(batch):
+        shape = (L, batch, max_cache_len, nh, hd)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def embed_fn(tok, t):
+        return (p["table"][tok] + p["wpe"][t][None])[:, None, :]
+
+    def step_fn(x, caches, t):
+        x = unwrap(x)
+        b, s = x.shape[0], x.shape[1]
+
+        def layer(xx, xs):
+            blk, kc, vc = xs
+            h = _ln(xx, blk["ln1.weight"], blk["ln1.bias"], eps)
+            qkv = (h @ blk["attn.qkv.weight"] + blk["attn.qkv.bias"]
+                   ).reshape(b, s, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kc = _write_cache(kc, k, t)
+            vc = _write_cache(vc, v, t)
+            att = _cached_attend(q, kc, vc, t, s, scale)
+            xx = xx + (att.reshape(b, s, nh * hd) @ blk["attn.proj.weight"]
+                       + blk["attn.proj.bias"])
+            h2 = _ln(xx, blk["ln2.weight"], blk["ln2.bias"], eps)
+            ff = jax.nn.gelu(h2 @ blk["mlp.fc1.weight"]
+                             + blk["mlp.fc1.bias"], approximate=True)
+            xx = xx + ff @ blk["mlp.fc2.weight"] + blk["mlp.fc2.bias"]
+            return xx, (kc, vc)
+
+        blk_tree = {k_: v_ for k_, v_ in p.items()
+                    if k_ not in ("table", "wpe", "lnf_w", "lnf_b")}
+        x, (kcs, vcs) = jax.lax.scan(
+            layer, x, (blk_tree, caches["k"], caches["v"]))
+        return x, {"k": kcs, "v": vcs}
+
+    def head_fn(out):
+        h = _ln(unwrap(out), p["lnf_w"], p["lnf_b"], eps)
+        return (h @ p["table"].T).astype(jnp.float32)
+
+    return init_caches, embed_fn, step_fn, head_fn
+
+
+class GenerationMixin:
+    """``generate()`` for causal-LM models (greedy + sampling), running
+    prefill and the whole decode loop as on-device XLA programs."""
+
+    def _decode_bundle(self, max_cache_len):
+        key = ("_pt_decode_bundle", max_cache_len)
+        cached = getattr(self, "_pt_decode_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .gpt import GPTForCausalLM
+        from .llama import LlamaForCausalLM
+        if isinstance(self, LlamaForCausalLM):
+            bundle = _make_llama_decode_fns(self, max_cache_len)
+        elif isinstance(self, GPTForCausalLM):
+            bundle = _make_gpt_decode_fns(self, max_cache_len)
+        else:
+            raise NotImplementedError(
+                f"generate() not wired for {type(self).__name__}")
+        # one prefill program per (bundle, prompt-shape): jit here, not
+        # inside generate(), so repeated calls reuse the compile
+        bundle = bundle + (jax.jit(bundle[2], donate_argnums=(1,)),)
+        self._pt_decode_cache = (key, bundle)
+        return bundle
+
+    def _prefill_embed(self, ids, bundle):
+        """[B, T] ids -> [B, T, H] input embeddings for the prefill call."""
+        from .gpt import GPTForCausalLM
+        if isinstance(self, GPTForCausalLM):
+            table = unwrap(self.gpt.wte.weight)
+            wpe = unwrap(self.gpt.wpe.weight)
+            return table[ids] + wpe[jnp.arange(ids.shape[1])][None]
+        table = unwrap(self.model.embed_tokens.weight)
+        return table[ids]
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 seed=None, max_cache_len=None):
+        """Generate continuations for ``input_ids`` ([B, T] int). Returns
+        the FULL sequence (prompt + ``max_new_tokens``) as a framework
+        tensor; after every row hits ``eos_token_id`` the tail is padded
+        with eos (static shapes — XLA cannot break early).
+
+        Greedy when ``do_sample=False``; otherwise categorical sampling
+        with ``temperature``/``top_k``/``top_p`` filtering and a PRNG
+        seeded by ``seed``. Weight-change caveat: decode functions are
+        built from the CURRENT weights and cached per ``max_cache_len``;
+        call ``model.reset_generate_cache()`` after loading new weights.
+        """
+        from ..inference.decode_loop import greedy_generate, sample_generate
+        ids_np = np.asarray(unwrap(input_ids))
+        if ids_np.ndim == 1:
+            ids_np = ids_np[None]
+        ids_np = ids_np.astype(np.int32)
+        B, T = ids_np.shape
+        if max_cache_len is None:
+            max_cache_len = min(self.cfg.max_seq_len, T + max_new_tokens)
+        if T + max_new_tokens > max_cache_len:
+            raise ValueError(
+                f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_cache_len ({max_cache_len})")
+        bundle = self._decode_bundle(max_cache_len)
+        init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
+
+        caches = init_caches(B)
+        x0 = self._prefill_embed(jnp.asarray(ids_np), bundle)
+        out, caches = prefill_jit(x0, caches, jnp.int32(0))
+        last_logits = head_fn(out[:, -1:])[:, -1]         # [B, V]
+
+        if do_sample:
+            key = jax.random.PRNGKey(0 if seed is None else seed)
+            new_ids, _ = sample_generate(
+                embed_fn, step_fn, head_fn, caches, last_logits, T,
+                max_new_tokens, key, temperature=temperature,
+                top_k=top_k, top_p=top_p, eos_token_id=eos_token_id)
+        else:
+            first = jnp.argmax(last_logits, -1).astype(jnp.int32)
+            new_ids, _ = greedy_generate(
+                embed_fn, step_fn, head_fn, caches, first, T,
+                max_new_tokens, eos_token_id=eos_token_id)
+        full = np.concatenate([ids_np, np.asarray(new_ids)], axis=1)
+        return wrap(jnp.asarray(full))
+
+    def reset_generate_cache(self):
+        """Drop cached decode programs (call after loading new weights)."""
+        self._pt_decode_cache = None

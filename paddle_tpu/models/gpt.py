@@ -9,6 +9,7 @@ from dataclasses import dataclass
 
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
+from paddle_tpu.models.generation import GenerationMixin
 from paddle_tpu.parallel.mp_layers import (
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
     VocabParallelEmbedding,
@@ -123,7 +124,7 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.core.tensor import dispatch
+from paddle_tpu.models.generation import GenerationMixin
 from paddle_tpu.ops.pallas import rope as rope_mod
 from paddle_tpu.parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
@@ -157,7 +158,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
