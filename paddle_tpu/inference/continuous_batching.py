@@ -1,0 +1,200 @@
+"""Continuous-batching decode server (slot-based, static shapes).
+
+The reference's serving depth is AnalysisPredictor + the fused-transformer
+decode op driven per request (analysis_predictor.h:95,
+fused_multi_transformer_op.cu). The TPU-native upgrade is CONTINUOUS
+BATCHING: a fixed pool of decode slots steps as ONE batched XLA program
+every tick; finished slots are refilled from the queue without stopping
+the others. Static shapes throughout (slot count, cache length) — no
+recompiles as requests come and go; per-slot positions ride the vector-t
+decode step fns (models/generation.py).
+
+Host/device split: the device does batched prefill + batched decode
+steps; the host only assigns slots, harvests finished rows, and swaps
+new prompts in — O(requests), not O(tokens), host work.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import unwrap
+
+__all__ = ["ContinuousBatchingServer"]
+
+
+class _Slot:
+    __slots__ = ("rid", "prompt_len", "budget", "emitted")
+
+    def __init__(self, rid, prompt_len, budget):
+        self.rid = rid
+        self.prompt_len = prompt_len
+        self.budget = budget          # max_new_tokens remaining
+        self.emitted = []
+
+
+class ContinuousBatchingServer:
+    """Serve ``model.generate``-compatible requests through a fixed slot
+    pool. Greedy or sampled decoding; results for any request are
+    identical to a solo ``model.generate`` call (slots are row-wise
+    independent).
+
+    >>> srv = ContinuousBatchingServer(model, max_slots=4,
+    ...                                max_cache_len=256)
+    >>> rid = srv.submit(prompt_ids, max_new_tokens=32)
+    >>> outs = srv.run()            # {rid: np.ndarray of new tokens}
+    """
+
+    def __init__(self, model, max_slots=4, max_cache_len=256,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 eos_token_id=None, seed=0):
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_cache_len = int(max_cache_len)
+        self.eos_token_id = eos_token_id
+        self.do_sample = bool(do_sample)
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._top_p = float(top_p)
+        self._key = jax.random.PRNGKey(seed)
+        (self._init_caches, self._embed_fn, self._step_fn,
+         self._head_fn, self._prefill_jit) = \
+            model._decode_bundle(max_cache_len)
+
+        self._caches = self._init_caches(self.max_slots)
+        self._tok = jnp.zeros((self.max_slots,), jnp.int32)
+        self._t = jnp.zeros((self.max_slots,), jnp.int32)
+        self._active = np.zeros((self.max_slots,), bool)   # host-side
+        self._slots = [None] * self.max_slots
+        self._queue = []          # (rid, ids_np, max_new_tokens)
+        self._results = {}
+        self._next_rid = 0
+        self._decode_jit = None
+
+    # ------------------------------------------------------------ queue
+    def submit(self, input_ids, max_new_tokens=32):
+        """Queue a prompt; returns a request id. The FIRST generated
+        token is produced by the prefill (same contract as generate())."""
+        ids = np.asarray(unwrap(input_ids)).astype(np.int32)
+        if ids.ndim == 2:
+            if ids.shape[0] != 1:
+                raise ValueError("submit() takes one request; batch by "
+                                 "calling submit() per row")
+            ids = ids[0]
+        if ids.shape[0] + max_new_tokens > self.max_cache_len:
+            raise ValueError(
+                f"prompt ({ids.shape[0]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_cache_len "
+                f"({self.max_cache_len})")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append((rid, ids, int(max_new_tokens)))
+        return rid
+
+    # ------------------------------------------------------- scheduling
+    def _admit(self):
+        """Fill free slots from the queue (one prefill program each)."""
+        for slot in range(self.max_slots):
+            if self._active[slot] or not self._queue:
+                continue
+            rid, ids, budget = self._queue.pop(0)
+            T = ids.shape[0]
+            # per-request prefill at batch 1, then scatter into the pool
+            caches1 = self._init_caches(1)
+            x0 = self.model._prefill_embed(jnp.asarray(ids[None]), None)
+            out, caches1 = self._prefill_jit(x0, caches1, jnp.int32(0))
+            logits = self._head_fn(out[:, -1:])[:, -1]     # [1, V]
+            first = self._pick(logits)[0]
+            self._caches = jax.tree_util.tree_map(
+                lambda pool, one: pool.at[:, slot].set(one[:, 0]),
+                self._caches, caches1)
+            self._tok = self._tok.at[slot].set(first)
+            self._t = self._t.at[slot].set(T)
+            self._active[slot] = True
+            st = _Slot(rid, T, budget)
+            st.emitted.append(int(first))
+            self._slots[slot] = st
+
+    def _pick(self, logits):
+        """Next-token choice for prefill logits [N, V] -> [N] int32."""
+        if not self.do_sample:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        from .decode_loop import process_logits
+        self._key, sub = jax.random.split(self._key)
+        return jax.random.categorical(
+            sub, process_logits(logits, self._temperature, self._top_k,
+                                self._top_p), axis=-1).astype(jnp.int32)
+
+    # ------------------------------------------------------------ steps
+    def _build_decode_step(self):
+        embed_p, step_p, head_p = (self._embed_fn, self._step_fn,
+                                   self._head_fn)
+        do_sample = self.do_sample
+        temperature, top_k, top_p = (self._temperature, self._top_k,
+                                     self._top_p)
+
+        def step(tok, caches, t, key):
+            x = embed_p(tok, t)
+            out, caches = step_p(x, caches, t)
+            logits = head_p(out)
+            if logits.ndim == 3:
+                logits = logits[:, -1]
+            if do_sample:
+                from .decode_loop import process_logits
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, process_logits(logits, temperature, top_k,
+                                        top_p), axis=-1).astype(jnp.int32)
+            else:
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return nxt, caches, t + 1, key
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    def step(self):
+        """One server tick: admit waiting requests, run ONE batched
+        decode step for every active slot, harvest finished rows.
+        Returns the number of active slots after the tick."""
+        self._admit()
+        if not self._active.any():
+            return 0
+        # harvest BEFORE stepping: a slot whose budget is spent (or that
+        # emitted eos at admission) must not decode further
+        self._harvest()
+        if not self._active.any():
+            return 0
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode_step()
+        self._tok, self._caches, self._t, self._key = self._decode_jit(
+            self._tok, self._caches, self._t, self._key)
+        toks = np.asarray(self._tok)
+        for slot in range(self.max_slots):
+            if self._active[slot]:
+                self._slots[slot].emitted.append(int(toks[slot]))
+        self._harvest()
+        self._admit()
+        return int(self._active.sum())
+
+    def _finished(self, st):
+        if len(st.emitted) >= st.budget:
+            return True
+        return (self.eos_token_id is not None
+                and st.emitted[-1] == self.eos_token_id)
+
+    def _harvest(self):
+        for slot in range(self.max_slots):
+            st = self._slots[slot]
+            if self._active[slot] and self._finished(st):
+                self._results[st.rid] = np.asarray(st.emitted[:st.budget],
+                                                   np.int32)
+                self._active[slot] = False
+                self._slots[slot] = None
+
+    def run(self, max_ticks=100000):
+        """Drive until queue and slots drain; returns {rid: new_tokens}."""
+        ticks = 0
+        while (self._queue or self._active.any()) and ticks < max_ticks:
+            self.step()
+            ticks += 1
+        out, self._results = self._results, {}
+        return out

@@ -41,24 +41,39 @@ def _ln(x, w, b, eps):
     return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b
 
 
+def _positions(t, b, s):
+    """Absolute positions [B, s] for a step at offset ``t`` — scalar
+    (all rows aligned) or [B] (per-row offsets, continuous batching)."""
+    row = jnp.arange(s, dtype=jnp.int32)
+    if jnp.ndim(t) == 0:
+        return (t + row)[None, :].repeat(b, 0)
+    return t[:, None] + row[None, :]
+
+
 def _cached_attend(q, k_cache, v_cache, t, s, scale):
     """q [B,s,nh,hd] at positions [t, t+s); caches [B,T,nh,hd] already
     updated through t+s. Masks unwritten/future slots: key position p is
-    visible to query row r iff p <= t+r."""
+    visible to query row r iff p <= t+r. ``t`` scalar or [B]."""
     T = k_cache.shape[1]
     logits = jnp.einsum("bsnd,btnd->bnst", q, k_cache) * scale
-    pos = jnp.arange(T)[None, :]
-    row = jnp.arange(s)[:, None]
-    ok = pos <= (t + row)
-    logits = jnp.where(ok[None, None], logits.astype(jnp.float32), -1e30)
+    pos = jnp.arange(T)
+    row = _positions(t, q.shape[0], s)                   # [B, s]
+    ok = pos[None, None] <= row[:, :, None]              # [B, s, T]
+    logits = jnp.where(ok[:, None], logits.astype(jnp.float32), -1e30)
     p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bnst,btnd->bsnd", p, v_cache)
 
 
 def _write_cache(cache, kv, t):
-    """cache [B,T,h,hd] <- kv [B,s,h,hd] at positions [t, t+s)."""
-    return jax.lax.dynamic_update_slice_in_dim(
-        cache, kv.astype(cache.dtype), t, axis=1)
+    """cache [B,T,h,hd] <- kv [B,s,h,hd] at positions [t, t+s); ``t``
+    scalar or [B] (per-row write offsets)."""
+    kv = kv.astype(cache.dtype)
+    if jnp.ndim(t) == 0:
+        return jax.lax.dynamic_update_slice_in_dim(cache, kv, t, axis=1)
+    b, s = kv.shape[0], kv.shape[1]
+    rows = jnp.arange(b)[:, None].repeat(s, 1)           # [B, s]
+    cols = _positions(t, b, s)
+    return cache.at[rows, cols].set(kv)
 
 
 def _make_llama_decode_fns(model, max_cache_len):
@@ -99,7 +114,7 @@ def _make_llama_decode_fns(model, max_cache_len):
     def step_fn(x, caches, t):
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
-        pos = (t + jnp.arange(s))[None, :].repeat(b, 0)   # [B, s]
+        pos = _positions(t, b, s)                         # [B, s]
 
         def layer(xx, xs):
             blk, kc, vc = xs
@@ -163,7 +178,10 @@ def _make_gpt_decode_fns(model, max_cache_len):
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
     def embed_fn(tok, t):
-        return (p["table"][tok] + p["wpe"][t][None])[:, None, :]
+        pos_emb = p["wpe"][t]                # scalar t: [H]; [B] t: [B,H]
+        if jnp.ndim(t) == 0:
+            pos_emb = pos_emb[None]
+        return (p["table"][tok] + pos_emb)[:, None, :]
 
     def step_fn(x, caches, t):
         x = unwrap(x)

@@ -1,0 +1,93 @@
+"""Continuous-batching server (inference/continuous_batching.py): results
+for every request must equal a solo model.generate() run — slots are
+row-wise independent, so batching and mid-flight admission cannot change
+tokens."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference.continuous_batching import ContinuousBatchingServer
+
+
+def _model():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    pt.seed(21)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+def _solo(model, ids, n_new, **kw):
+    out = model.generate(pt.to_tensor(ids[None]), max_new_tokens=n_new,
+                         max_cache_len=64, **kw).numpy()[0]
+    return out[len(ids):]
+
+
+class TestContinuousBatching:
+    def test_more_requests_than_slots_match_solo(self):
+        model = _model()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (3, 5, 4)]
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64)
+        rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        outs = srv.run()
+        assert set(outs) == set(rids)
+        for rid, prompt in zip(rids, prompts):
+            want = _solo(model, prompt, 6)
+            np.testing.assert_array_equal(outs[rid], want)
+
+    def test_mid_flight_admission_does_not_disturb(self):
+        model = _model()
+        rng = np.random.default_rng(1)
+        a = rng.integers(0, 256, (4,)).astype(np.int32)
+        b = rng.integers(0, 256, (6,)).astype(np.int32)
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64)
+        ra = srv.submit(a, max_new_tokens=8)
+        for _ in range(3):          # a is mid-decode when b arrives
+            srv.step()
+        rb = srv.submit(b, max_new_tokens=5)
+        outs = srv.run()
+        np.testing.assert_array_equal(outs[ra], _solo(model, a, 8))
+        np.testing.assert_array_equal(outs[rb], _solo(model, b, 5))
+
+    def test_eos_frees_slot_early(self):
+        model = _model()
+        rng = np.random.default_rng(2)
+        p = rng.integers(0, 256, (4,)).astype(np.int32)
+        solo = _solo(model, p, 8)
+        eos = int(solo[2])          # third generated token acts as eos
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=64,
+                                       eos_token_id=eos)
+        rid = srv.submit(p, max_new_tokens=8)
+        out = srv.run()[rid]
+        assert out[-1] == eos and len(out) <= 8
+        np.testing.assert_array_equal(out, solo[:len(out)])
+
+    def test_length_guard_and_batch_submit_rejected(self):
+        model = _model()
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=16)
+        with pytest.raises(ValueError, match="max_cache_len"):
+            srv.submit(np.zeros((12,), np.int32), max_new_tokens=8)
+        with pytest.raises(ValueError, match="one request"):
+            srv.submit(np.zeros((2, 4), np.int32))
+
+    def test_gpt_greedy_parity_through_server(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        pt.seed(22)
+        model = GPTForCausalLM(gpt2_tiny())
+        model.eval()
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, model.cfg.vocab_size, (n,))
+                   .astype(np.int32) for n in (3, 4)]
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64)
+        rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        outs = srv.run()
+        for rid, prompt in zip(rids, prompts):
+            np.testing.assert_array_equal(outs[rid],
+                                          _solo(model, prompt, 5))
