@@ -149,6 +149,108 @@ def _make_llama_decode_fns(model, max_cache_len):
     return init_caches, embed_fn, step_fn, head_fn
 
 
+def _moe_topk_ffn(h, router_w, wg, wu, wd, top_k):
+    """Dropless dense-expert MoE FFN for decode: every expert runs (E/k
+    FLOP overhead — the measured right choice at decode batch sizes, cf.
+    benchmarks/moe_dispatch_bench.py) and tokens combine their top-k
+    normalized gate weights. Matches the training GShard combine
+    (parallel/moe/gate.py _top2_dense_dispatch) whenever capacity drops
+    nothing — decode batches are far below capacity."""
+    E = router_w.shape[-1]
+    logits = h @ router_w                                  # [b, s, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    g1 = probs.max(-1)
+    i1 = probs.argmax(-1)
+    if top_k >= 2:
+        probs2 = probs * (1.0 - jax.nn.one_hot(i1, E, dtype=probs.dtype))
+        g2 = probs2.max(-1)
+        i2 = probs2.argmax(-1)
+        denom = g1 + g2 + 1e-9
+        w = (jax.nn.one_hot(i1, E, dtype=probs.dtype)
+             * (g1 / denom)[..., None]
+             + jax.nn.one_hot(i2, E, dtype=probs.dtype)
+             * (g2 / denom)[..., None])
+    else:
+        w = jax.nn.one_hot(i1, E, dtype=probs.dtype) * g1[..., None]
+    g = jnp.einsum("bsh,ehf->besf", h, wg)
+    u = jnp.einsum("bsh,ehf->besf", h, wu)
+    o = jnp.einsum("besf,efh->besh", jax.nn.silu(g) * u, wd)
+    return jnp.einsum("bse,besh->bsh", w.astype(o.dtype), o)
+
+
+def _make_mixtral_decode_fns(model, max_cache_len):
+    """Llama-style attention + routed-expert FFN (MixtralForCausalLM)."""
+    from ..ops.pallas import rope as rope_mod
+    cfg = model.cfg
+    nh, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    eps = cfg.rms_eps
+    blocks = [dict(blk.raw_params()) for blk in model.model.layers]
+    p = {
+        "table": unwrap(model.model.embed_tokens.weight),
+        "norm": unwrap(model.model.norm.weight),
+        "head": unwrap(model.lm_head.weight),
+        "ln1": _stacked(blocks, "input_layernorm.weight"),
+        "ln2": _stacked(blocks, "post_attention_layernorm.weight"),
+        "wq": _stacked(blocks, "self_attn.q_proj.weight"),
+        "wk": _stacked(blocks, "self_attn.k_proj.weight"),
+        "wv": _stacked(blocks, "self_attn.v_proj.weight"),
+        "wo": _stacked(blocks, "self_attn.o_proj.weight"),
+        "router": _stacked(blocks, "moe.gate.gate.weight"),
+        "wg": _stacked(blocks, "moe.experts.w_gate"),
+        "wu": _stacked(blocks, "moe.experts.w_up"),
+        "wd": _stacked(blocks, "moe.experts.w_down"),
+    }
+    cos, sin = rope_mod.precompute_freqs(hd, max_cache_len, cfg.rope_theta)
+    dtype = p["table"].dtype
+    L = cfg.num_layers
+    top_k = cfg.top_k
+    scale = 1.0 / np.sqrt(hd)
+
+    def init_caches(batch):
+        shape = (L, batch, max_cache_len, kvh, hd)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def embed_fn(tok, t):
+        return p["table"][tok][:, None, :]
+
+    def step_fn(x, caches, t):
+        x = unwrap(x)
+        b, s = x.shape[0], x.shape[1]
+        pos = _positions(t, b, s)
+
+        def layer(xx, xs):
+            blk, kc, vc = xs
+            h = _rms(xx, blk["ln1"], eps)
+            q = (h @ blk["wq"]).reshape(b, s, nh, hd)
+            k = (h @ blk["wk"]).reshape(b, s, kvh, hd)
+            v = (h @ blk["wv"]).reshape(b, s, kvh, hd)
+            q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
+            k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
+            kc = _write_cache(kc, k, t)
+            vc = _write_cache(vc, v, t)
+            rep = nh // kvh
+            kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+            vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+            att = _cached_attend(q, kk, vv, t, s, scale)
+            xx = xx + att.reshape(b, s, nh * hd) @ blk["wo"]
+            h2 = _rms(xx, blk["ln2"], eps)
+            xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
+                                    blk["wu"], blk["wd"], top_k)
+            return xx, (kc, vc)
+
+        blk_tree = {k_: v_ for k_, v_ in p.items()
+                    if k_ not in ("table", "norm", "head")}
+        x, (kcs, vcs) = jax.lax.scan(
+            layer, x, (blk_tree, caches["k"], caches["v"]))
+        return x, {"k": kcs, "v": vcs}
+
+    def head_fn(out):
+        return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
+                ).astype(jnp.float32)
+
+    return init_caches, embed_fn, step_fn, head_fn
+
+
 def _make_gpt_decode_fns(model, max_cache_len):
     """(init_caches, embed_fn, step_fn, head_fn) for GPTForCausalLM —
     learned positions, fused qkv, tied lm head."""
@@ -228,7 +330,10 @@ class GenerationMixin:
             return cached[1]
         from .gpt import GPTForCausalLM
         from .llama import LlamaForCausalLM
-        if isinstance(self, LlamaForCausalLM):
+        from .mixtral import MixtralForCausalLM
+        if isinstance(self, MixtralForCausalLM):
+            bundle = _make_mixtral_decode_fns(self, max_cache_len)
+        elif isinstance(self, LlamaForCausalLM):
             bundle = _make_llama_decode_fns(self, max_cache_len)
         elif isinstance(self, GPTForCausalLM):
             bundle = _make_gpt_decode_fns(self, max_cache_len)

@@ -13,6 +13,7 @@ from dataclasses import dataclass
 
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
+from paddle_tpu.models.generation import GenerationMixin
 from paddle_tpu.parallel.moe import ExpertSwiGLU, MoELayer
 
 from .llama import LlamaAttention, LlamaConfig
@@ -74,7 +75,7 @@ class MixtralModel(nn.Layer):
         return self.norm(x)
 
 
-class MixtralForCausalLM(nn.Layer):
+class MixtralForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: MixtralConfig):
         super().__init__()
         self.cfg = cfg

@@ -41,6 +41,20 @@ class TestGreedyParity:
         got = model.generate(pt.to_tensor(ids), max_new_tokens=5)
         np.testing.assert_array_equal(np.asarray(got.numpy()), want)
 
+    def test_mixtral_generate_matches_eager(self):
+        """MoE decode (dropless dense-expert top-2 combine) must equal
+        the eager capacity-dispatch forward at under-capacity loads."""
+        from paddle_tpu.models.mixtral import (MixtralForCausalLM,
+                                               mixtral_tiny)
+        pt.seed(31)
+        model = MixtralForCausalLM(mixtral_tiny())
+        model.eval()
+        rng = np.random.default_rng(5)
+        ids = rng.integers(0, 256, (2, 4)).astype(np.int32)
+        want = _naive_greedy(model, ids, 5)
+        got = model.generate(pt.to_tensor(ids), max_new_tokens=5)
+        np.testing.assert_array_equal(np.asarray(got.numpy()), want)
+
     def test_generate_repeated_call_reuses_programs(self):
         from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
         pt.seed(13)
