@@ -47,7 +47,7 @@ class ContinuousBatchingServer:
 
     def __init__(self, model, max_slots=4, max_cache_len=256,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 eos_token_id=None, seed=0):
+                 eos_token_id=None, seed=0, weight_dtype=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -59,7 +59,7 @@ class ContinuousBatchingServer:
         self._key = jax.random.PRNGKey(seed)
         (self._init_caches, self._embed_fn, self._step_fn,
          self._head_fn, self._prefill_jit) = \
-            model._decode_bundle(max_cache_len)
+            model._decode_bundle(max_cache_len, weight_dtype)
 
         self._caches = self._init_caches(self.max_slots)
         self._tok = jnp.zeros((self.max_slots,), jnp.int32)

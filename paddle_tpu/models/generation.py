@@ -28,6 +28,52 @@ def _stacked(blocks, name):
     return jnp.stack([unwrap(b[name]) for b in blocks])
 
 
+_QUANT_WEIGHTS = frozenset({
+    "wq", "wk", "wv", "wo", "wg", "wu", "wd",            # llama/mixtral
+    "attn.qkv.weight", "attn.proj.weight",               # gpt
+    "mlp.fc1.weight", "mlp.fc2.weight",
+})
+
+
+def _quantize_tree(p):
+    """Weight-only int8: every matmul weight (explicit allowlist) becomes
+    an (int8, fp32 scale) pair with per-output-channel scales — decode
+    streams HALF the weight bytes from HBM (the decode roofline; cf.
+    bench.py decode HBM-util accounting). Norms/embeddings/router/biases
+    stay full precision; the lm head does too (logit fidelity)."""
+    def q(name, w):
+        if name not in _QUANT_WEIGHTS:
+            return w
+        # reduce over the contraction dim (axis -2): per-(layer, expert,
+        # out-channel) scales — NOT shared across the stacked layer dim
+        amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)
+        s = amax.astype(jnp.float32) / 127.0 + 1e-12
+        qw = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+        return (qw, s)
+
+    return {k: q(k, v) for k, v in p.items()}
+
+
+def _mm(x, w):
+    """x @ w where w is a raw array or an (int8, scale) pair. The int8
+    path casts tile-wise inside the fused matmul (XLA folds the convert
+    into the HBM read) and applies the per-channel scale on the out."""
+    if isinstance(w, tuple):
+        qw, s = w
+        return (x @ qw.astype(x.dtype)) * s.astype(x.dtype)
+    return x @ w
+
+
+def _emm(spec, x, w):
+    """einsum analogue of _mm for stacked expert weights."""
+    if isinstance(w, tuple):
+        qw, s = w
+        out = jnp.einsum(spec, x, qw.astype(x.dtype))
+        # out [..., E, s, F]; scale [E, 1, F] broadcasts over the token dim
+        return out * s.astype(x.dtype)
+    return jnp.einsum(spec, x, w)
+
+
 def _rms(x, w, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
@@ -76,7 +122,7 @@ def _write_cache(cache, kv, t):
     return cache.at[rows, cols].set(kv)
 
 
-def _make_llama_decode_fns(model, max_cache_len):
+def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None):
     """(init_caches, embed_fn, step_fn, head_fn) for LlamaForCausalLM —
     GQA-aware (kv heads cached unrepeated), rope applied at absolute
     positions."""
@@ -100,6 +146,8 @@ def _make_llama_decode_fns(model, max_cache_len):
         "wd": _stacked(blocks, "mlp.down_proj.weight"),
     }
     cos, sin = rope_mod.precompute_freqs(hd, max_cache_len, cfg.rope_theta)
+    if weight_dtype == "int8":
+        p = _quantize_tree(p)
     dtype = p["table"].dtype
     L = cfg.num_layers
     scale = 1.0 / np.sqrt(hd)
@@ -119,9 +167,9 @@ def _make_llama_decode_fns(model, max_cache_len):
         def layer(xx, xs):
             blk, kc, vc = xs
             h = _rms(xx, blk["ln1"], eps)
-            q = (h @ blk["wq"]).reshape(b, s, nh, hd)
-            k = (h @ blk["wk"]).reshape(b, s, kvh, hd)
-            v = (h @ blk["wv"]).reshape(b, s, kvh, hd)
+            q = _mm(h, blk["wq"]).reshape(b, s, nh, hd)
+            k = _mm(h, blk["wk"]).reshape(b, s, kvh, hd)
+            v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
             q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
             k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
             kc = _write_cache(kc, k, t)
@@ -130,10 +178,10 @@ def _make_llama_decode_fns(model, max_cache_len):
             kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
             vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
             att = _cached_attend(q, kk, vv, t, s, scale)
-            xx = xx + att.reshape(b, s, nh * hd) @ blk["wo"]
+            xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
             h2 = _rms(xx, blk["ln2"], eps)
-            xx = xx + (jax.nn.silu(h2 @ blk["wg"]) * (h2 @ blk["wu"])
-                       ) @ blk["wd"]
+            xx = xx + _mm(jax.nn.silu(_mm(h2, blk["wg"]))
+                          * _mm(h2, blk["wu"]), blk["wd"])
             return xx, (kc, vc)
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
@@ -172,13 +220,13 @@ def _moe_topk_ffn(h, router_w, wg, wu, wd, top_k):
              * (g2 / denom)[..., None])
     else:
         w = jax.nn.one_hot(i1, E, dtype=probs.dtype) * g1[..., None]
-    g = jnp.einsum("bsh,ehf->besf", h, wg)
-    u = jnp.einsum("bsh,ehf->besf", h, wu)
-    o = jnp.einsum("besf,efh->besh", jax.nn.silu(g) * u, wd)
+    g = _emm("bsh,ehf->besf", h, wg)
+    u = _emm("bsh,ehf->besf", h, wu)
+    o = _emm("besf,efh->besh", jax.nn.silu(g) * u, wd)
     return jnp.einsum("bse,besh->bsh", w.astype(o.dtype), o)
 
 
-def _make_mixtral_decode_fns(model, max_cache_len):
+def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None):
     """Llama-style attention + routed-expert FFN (MixtralForCausalLM)."""
     from ..ops.pallas import rope as rope_mod
     cfg = model.cfg
@@ -201,6 +249,8 @@ def _make_mixtral_decode_fns(model, max_cache_len):
         "wd": _stacked(blocks, "moe.experts.w_down"),
     }
     cos, sin = rope_mod.precompute_freqs(hd, max_cache_len, cfg.rope_theta)
+    if weight_dtype == "int8":
+        p = _quantize_tree(p)
     dtype = p["table"].dtype
     L = cfg.num_layers
     top_k = cfg.top_k
@@ -221,9 +271,9 @@ def _make_mixtral_decode_fns(model, max_cache_len):
         def layer(xx, xs):
             blk, kc, vc = xs
             h = _rms(xx, blk["ln1"], eps)
-            q = (h @ blk["wq"]).reshape(b, s, nh, hd)
-            k = (h @ blk["wk"]).reshape(b, s, kvh, hd)
-            v = (h @ blk["wv"]).reshape(b, s, kvh, hd)
+            q = _mm(h, blk["wq"]).reshape(b, s, nh, hd)
+            k = _mm(h, blk["wk"]).reshape(b, s, kvh, hd)
+            v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
             q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
             k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
             kc = _write_cache(kc, k, t)
@@ -232,7 +282,7 @@ def _make_mixtral_decode_fns(model, max_cache_len):
             kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
             vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
             att = _cached_attend(q, kk, vv, t, s, scale)
-            xx = xx + att.reshape(b, s, nh * hd) @ blk["wo"]
+            xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
             h2 = _rms(xx, blk["ln2"], eps)
             xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
                                     blk["wu"], blk["wd"], top_k)
@@ -251,7 +301,7 @@ def _make_mixtral_decode_fns(model, max_cache_len):
     return init_caches, embed_fn, step_fn, head_fn
 
 
-def _make_gpt_decode_fns(model, max_cache_len):
+def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None):
     """(init_caches, embed_fn, step_fn, head_fn) for GPTForCausalLM —
     learned positions, fused qkv, tied lm head."""
     cfg = model.cfg
@@ -271,6 +321,8 @@ def _make_gpt_decode_fns(model, max_cache_len):
                  "mlp.fc1.weight", "mlp.fc1.bias",
                  "mlp.fc2.weight", "mlp.fc2.bias"):
         p[name] = _stacked(blocks, name)
+    if weight_dtype == "int8":
+        p = _quantize_tree(p)
     dtype = p["table"].dtype
     L = cfg.num_layers
     scale = 1.0 / np.sqrt(hd)
@@ -292,18 +344,19 @@ def _make_gpt_decode_fns(model, max_cache_len):
         def layer(xx, xs):
             blk, kc, vc = xs
             h = _ln(xx, blk["ln1.weight"], blk["ln1.bias"], eps)
-            qkv = (h @ blk["attn.qkv.weight"] + blk["attn.qkv.bias"]
+            qkv = (_mm(h, blk["attn.qkv.weight"]) + blk["attn.qkv.bias"]
                    ).reshape(b, s, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             kc = _write_cache(kc, k, t)
             vc = _write_cache(vc, v, t)
             att = _cached_attend(q, kc, vc, t, s, scale)
-            xx = xx + (att.reshape(b, s, nh * hd) @ blk["attn.proj.weight"]
+            xx = xx + (_mm(att.reshape(b, s, nh * hd),
+                           blk["attn.proj.weight"])
                        + blk["attn.proj.bias"])
             h2 = _ln(xx, blk["ln2.weight"], blk["ln2.bias"], eps)
-            ff = jax.nn.gelu(h2 @ blk["mlp.fc1.weight"]
+            ff = jax.nn.gelu(_mm(h2, blk["mlp.fc1.weight"])
                              + blk["mlp.fc1.bias"], approximate=True)
-            xx = xx + ff @ blk["mlp.fc2.weight"] + blk["mlp.fc2.bias"]
+            xx = xx + _mm(ff, blk["mlp.fc2.weight"]) + blk["mlp.fc2.bias"]
             return xx, (kc, vc)
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
@@ -323,8 +376,8 @@ class GenerationMixin:
     """``generate()`` for causal-LM models (greedy + sampling), running
     prefill and the whole decode loop as on-device XLA programs."""
 
-    def _decode_bundle(self, max_cache_len):
-        key = ("_pt_decode_bundle", max_cache_len)
+    def _decode_bundle(self, max_cache_len, weight_dtype=None):
+        key = ("_pt_decode_bundle", max_cache_len, weight_dtype)
         cached = getattr(self, "_pt_decode_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -332,11 +385,14 @@ class GenerationMixin:
         from .llama import LlamaForCausalLM
         from .mixtral import MixtralForCausalLM
         if isinstance(self, MixtralForCausalLM):
-            bundle = _make_mixtral_decode_fns(self, max_cache_len)
+            bundle = _make_mixtral_decode_fns(self, max_cache_len,
+                                              weight_dtype)
         elif isinstance(self, LlamaForCausalLM):
-            bundle = _make_llama_decode_fns(self, max_cache_len)
+            bundle = _make_llama_decode_fns(self, max_cache_len,
+                                            weight_dtype)
         elif isinstance(self, GPTForCausalLM):
-            bundle = _make_gpt_decode_fns(self, max_cache_len)
+            bundle = _make_gpt_decode_fns(self, max_cache_len,
+                                          weight_dtype)
         else:
             raise NotImplementedError(
                 f"generate() not wired for {type(self).__name__}")
@@ -358,7 +414,7 @@ class GenerationMixin:
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=None, max_cache_len=None):
+                 seed=None, max_cache_len=None, weight_dtype=None):
         """Generate continuations for ``input_ids`` ([B, T] int). Returns
         the FULL sequence (prompt + ``max_new_tokens``) as a framework
         tensor; after every row hits ``eos_token_id`` the tail is padded
@@ -369,6 +425,11 @@ class GenerationMixin:
         seeded by ``seed``. Weight-change caveat: decode functions are
         built from the CURRENT weights and cached per ``max_cache_len``;
         call ``model.reset_generate_cache()`` after loading new weights.
+
+        ``weight_dtype="int8"`` turns on weight-only int8 decode: matmul
+        weights are stored int8 with per-channel scales, halving the
+        weight bytes streamed per decode step (the serving roofline);
+        embeddings, norms, routers and the lm head stay full precision.
         """
         from ..inference.decode_loop import greedy_generate, sample_generate
         ids_np = np.asarray(unwrap(input_ids))
@@ -382,7 +443,7 @@ class GenerationMixin:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_cache_len ({max_cache_len})")
-        bundle = self._decode_bundle(max_cache_len)
+        bundle = self._decode_bundle(max_cache_len, weight_dtype)
         init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
 
         caches = init_caches(B)

@@ -120,6 +120,50 @@ class TestSampling:
         assert (out[3:] == eos).all()
 
 
+class TestWeightOnlyInt8:
+    def test_int8_decode_close_to_fp32(self):
+        """Weight-only int8 decode: prefill logits within quantization
+        tolerance of fp32, and generation runs end to end."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(41)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(6)
+        ids = rng.integers(0, 256, (2, 5)).astype(np.int32)
+
+        b32 = model._decode_bundle(64, None)
+        b8 = model._decode_bundle(64, "int8")
+        x0 = model._prefill_embed(jnp.asarray(ids), None)
+        out32, _ = b32[2](x0, b32[0](2), jnp.int32(0))
+        out8, _ = b8[2](x0, b8[0](2), jnp.int32(0))
+        lg32 = np.asarray(b32[3](out32[:, -1:]))
+        lg8 = np.asarray(b8[3](out8[:, -1:]))
+        rel = (np.abs(lg8 - lg32).max()
+               / (np.abs(lg32).max() + 1e-9))
+        assert rel < 0.05, f"int8 drift too large: {rel}"
+
+        out = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                             weight_dtype="int8", max_cache_len=64)
+        assert out.numpy().shape == (2, 9)
+
+    def test_int8_bundle_cached_separately(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        pt.seed(42)
+        model = GPTForCausalLM(gpt2_tiny())
+        model.eval()
+        ids = np.zeros((1, 3), np.int32)
+        a = model.generate(pt.to_tensor(ids), max_new_tokens=3,
+                           max_cache_len=32)
+        b = model.generate(pt.to_tensor(ids), max_new_tokens=3,
+                           weight_dtype="int8", max_cache_len=32)
+        c = model.generate(pt.to_tensor(ids), max_new_tokens=3,
+                           max_cache_len=32)
+        # fp32 results stable across the interleaved int8 call
+        np.testing.assert_array_equal(a.numpy(), c.numpy())
+
+
 def test_process_logits_filters():
     import jax.numpy as jnp
 
