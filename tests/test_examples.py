@@ -13,7 +13,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ALL = ["train_gpt2.py", "finetune_bert.py", "train_moe.py",
        "train_diffusion.py", "data_parallel.py", "tensor_parallel.py",
        "export_serve.py", "hapi_fit.py", "train_hybrid.py",
-       "engine_pipeline.py"]
+       "engine_pipeline.py", "generate_text.py"]
 
 
 def _run(name):
