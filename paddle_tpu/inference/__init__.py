@@ -248,3 +248,4 @@ from .serving import BatchScheduler  # noqa: E402  (reference serving surface)
 from .decode_loop import (scan_decode, greedy_generate,  # noqa: E402,F401
                           sample_generate, process_logits)
 from .continuous_batching import ContinuousBatchingServer  # noqa: E402,F401
+from .speculative import speculative_generate  # noqa: E402,F401

@@ -402,13 +402,15 @@ class GenerationMixin:
         self._pt_decode_cache = (key, bundle)
         return bundle
 
-    def _prefill_embed(self, ids, bundle):
-        """[B, T] ids -> [B, T, H] input embeddings for the prefill call."""
+    def _prefill_embed(self, ids, bundle, t0=0):
+        """[B, T] ids -> [B, T, H] input embeddings for a multi-token
+        step starting at position ``t0`` (prefill: 0; speculative
+        verify: the current decode offset)."""
         from .gpt import GPTForCausalLM
         if isinstance(self, GPTForCausalLM):
             table = unwrap(self.gpt.wte.weight)
             wpe = unwrap(self.gpt.wpe.weight)
-            return table[ids] + wpe[jnp.arange(ids.shape[1])][None]
+            return table[ids] + wpe[t0 + jnp.arange(ids.shape[1])][None]
         table = unwrap(self.model.embed_tokens.weight)
         return table[ids]
 

@@ -1,0 +1,92 @@
+"""Speculative decoding (inference/speculative.py): greedy draft-and-
+verify must be BIT-IDENTICAL to the target model's own greedy decode —
+speculation may only change how many target forwards run."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference.speculative import speculative_generate
+
+
+def _llama(seed):
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    pt.seed(seed)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+class TestSpeculative:
+    def test_perfect_draft_accepts_gamma_every_round(self):
+        """Draft == target: every proposal matches, each round yields
+        gamma+1 tokens."""
+        model = _llama(51)
+        rng = np.random.default_rng(7)
+        ids = rng.integers(0, 256, (1, 5)).astype(np.int32)
+        want = model.generate(pt.to_tensor(ids), max_new_tokens=12,
+                              max_cache_len=64).numpy()
+        got, stats = speculative_generate(
+            model, model, pt.to_tensor(ids), max_new_tokens=12,
+            gamma=3, max_cache_len=64, return_stats=True)
+        np.testing.assert_array_equal(got.numpy()[:, :want.shape[1]],
+                                      want)
+        assert stats["mean_accepted"] == 3.0, stats
+
+    def test_weak_draft_still_exact(self):
+        """A DIFFERENT draft model (other init) mostly mismatches — the
+        output must still equal the target's own greedy decode."""
+        target = _llama(52)
+        draft = _llama(53)
+        rng = np.random.default_rng(8)
+        ids = rng.integers(0, 256, (1, 4)).astype(np.int32)
+        want = target.generate(pt.to_tensor(ids), max_new_tokens=10,
+                               max_cache_len=64).numpy()
+        got, stats = speculative_generate(
+            target, draft, pt.to_tensor(ids), max_new_tokens=10,
+            gamma=4, max_cache_len=64, return_stats=True)
+        np.testing.assert_array_equal(got.numpy()[:, :want.shape[1]],
+                                      want)
+        # weak draft: strictly fewer accepts than perfect drafting
+        assert stats["mean_accepted"] < 4.0
+
+    def test_cross_family_draft(self):
+        """GPT drafting for Llama (shared tiny vocab): exactness does not
+        depend on the draft architecture."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        target = _llama(54)
+        pt.seed(55)
+        draft = GPTForCausalLM(GPTConfig(vocab_size=256, hidden_size=32,
+                                         num_layers=1, num_heads=2,
+                                         max_seq_len=64))
+        draft.eval()
+        rng = np.random.default_rng(9)
+        ids = rng.integers(0, 256, (1, 4)).astype(np.int32)
+        want = target.generate(pt.to_tensor(ids), max_new_tokens=8,
+                               max_cache_len=64).numpy()
+        got = speculative_generate(target, draft, pt.to_tensor(ids),
+                                   max_new_tokens=8, gamma=2,
+                                   max_cache_len=64)
+        np.testing.assert_array_equal(got.numpy()[:, :want.shape[1]],
+                                      want)
+
+    def test_eos_stops_early(self):
+        model = _llama(56)
+        rng = np.random.default_rng(10)
+        ids = rng.integers(0, 256, (1, 4)).astype(np.int32)
+        ref = model.generate(pt.to_tensor(ids), max_new_tokens=10,
+                             max_cache_len=64).numpy()[0, 4:]
+        eos = int(ref[3])
+        got = speculative_generate(model, model, pt.to_tensor(ids),
+                                   max_new_tokens=10, gamma=2,
+                                   eos_token_id=eos,
+                                   max_cache_len=64).numpy()[0, 4:]
+        assert got[-1] == eos
+        np.testing.assert_array_equal(got, ref[:len(got)])
+
+    def test_headroom_guard(self):
+        model = _llama(57)
+        with pytest.raises(ValueError, match="headroom"):
+            speculative_generate(model, model,
+                                 np.zeros((1, 50), np.int32),
+                                 max_new_tokens=10, gamma=4,
+                                 max_cache_len=64)
