@@ -47,7 +47,8 @@ class ContinuousBatchingServer:
 
     def __init__(self, model, max_slots=4, max_cache_len=256,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 eos_token_id=None, seed=0, weight_dtype=None):
+                 eos_token_id=None, seed=0, weight_dtype=None,
+                 prefill_chunk=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -57,9 +58,10 @@ class ContinuousBatchingServer:
         self._top_k = int(top_k)
         self._top_p = float(top_p)
         self._key = jax.random.PRNGKey(seed)
+        self._bundle = model._decode_bundle(max_cache_len, weight_dtype)
         (self._init_caches, self._embed_fn, self._step_fn,
-         self._head_fn, self._prefill_jit) = \
-            model._decode_bundle(max_cache_len, weight_dtype)
+         self._head_fn, self._prefill_jit) = self._bundle
+        self._prefill_chunk = prefill_chunk
 
         self._caches = self._init_caches(self.max_slots)
         self._tok = jnp.zeros((self.max_slots,), jnp.int32)
@@ -99,11 +101,11 @@ class ContinuousBatchingServer:
                 continue
             rid, ids, budget = self._queue.pop(0)
             T = ids.shape[0]
-            # per-request prefill at batch 1, then scatter into the pool
-            caches1 = self._init_caches(1)
-            x0 = self.model._prefill_embed(jnp.asarray(ids[None]), None)
-            out, caches1 = self._prefill_jit(x0, caches1, jnp.int32(0))
-            logits = self._head_fn(out[:, -1:])[:, -1]     # [1, V]
+            # per-request prefill at batch 1 (optionally in fixed-size
+            # chunks: one compiled program for every prompt length),
+            # then scatter into the pool
+            logits, caches1 = self.model._run_prefill(
+                self._bundle, ids[None], chunk=self._prefill_chunk)
             first = self._pick(logits)[0]
             self._caches = jax.tree_util.tree_map(
                 lambda pool, one: pool.at[:, slot].set(one[:, 0]),

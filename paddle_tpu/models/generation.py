@@ -414,9 +414,43 @@ class GenerationMixin:
         table = unwrap(self.model.embed_tokens.weight)
         return table[ids]
 
+    def _run_prefill(self, bundle, ids_np, chunk=None):
+        """Prefill ``ids_np`` [B, T] into fresh caches; returns
+        (last-position logits [B, V], caches).
+
+        ``chunk``: feed the prompt in fixed-size chunks (prompt padded up
+        to a multiple) so ONE compiled prefill program serves every
+        prompt length — the serving-side compile-cache bound. Padded
+        positions sit above the valid frontier: the causal validity mask
+        hides their cache rows, and decode overwrites them."""
+        init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
+        B, T = ids_np.shape
+        caches = init_caches(B)
+        if not chunk or chunk >= T:
+            x0 = self._prefill_embed(jnp.asarray(ids_np), bundle)
+            out, caches = prefill_jit(x0, caches, jnp.int32(0))
+            return head_fn(out[:, -1:])[:, -1], caches
+        pad = (-T) % chunk
+        if T + pad > init_caches(0)["k"].shape[2]:
+            raise ValueError(
+                f"chunked prefill writes {T + pad} cache rows (prompt "
+                f"{T} padded to a multiple of {chunk}) but max_cache_len "
+                f"is {init_caches(0)['k'].shape[2]} — raise max_cache_len "
+                f"by at least {chunk - 1} for chunk headroom")
+        ids_pad = np.pad(ids_np, ((0, 0), (0, pad)))
+        last = None
+        for i in range(0, T + pad, chunk):
+            x = self._prefill_embed(jnp.asarray(ids_pad[:, i:i + chunk]),
+                                    bundle, t0=i)
+            out, caches = prefill_jit(x, caches, jnp.int32(i))
+            if i <= T - 1 < i + chunk:
+                last = head_fn(out[:, T - 1 - i:T - i])[:, -1]
+        return last, caches
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 seed=None, max_cache_len=None, weight_dtype=None):
+                 seed=None, max_cache_len=None, weight_dtype=None,
+                 prefill_chunk=None):
         """Generate continuations for ``input_ids`` ([B, T] int). Returns
         the FULL sequence (prompt + ``max_new_tokens``) as a framework
         tensor; after every row hits ``eos_token_id`` the tail is padded
@@ -448,10 +482,8 @@ class GenerationMixin:
         bundle = self._decode_bundle(max_cache_len, weight_dtype)
         init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
 
-        caches = init_caches(B)
-        x0 = self._prefill_embed(jnp.asarray(ids_np), bundle)
-        out, caches = prefill_jit(x0, caches, jnp.int32(0))
-        last_logits = head_fn(out[:, -1:])[:, -1]         # [B, V]
+        last_logits, caches = self._run_prefill(bundle, ids_np,
+                                                chunk=prefill_chunk)
 
         if do_sample:
             key = jax.random.PRNGKey(0 if seed is None else seed)

@@ -120,6 +120,67 @@ class TestSampling:
         assert (out[3:] == eos).all()
 
 
+class TestChunkedPrefill:
+    def test_chunked_prefill_matches_whole_prompt(self):
+        """Fixed-size prefill chunks (prompt padded up): same tokens as
+        the one-shot prefill — padded rows live above the frontier."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(61)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(11)
+        ids = rng.integers(0, 256, (2, 7)).astype(np.int32)
+        want = model.generate(pt.to_tensor(ids), max_new_tokens=5,
+                              max_cache_len=64)
+        got = model.generate(pt.to_tensor(ids), max_new_tokens=5,
+                             max_cache_len=64, prefill_chunk=3)
+        np.testing.assert_array_equal(got.numpy(), want.numpy())
+
+    def test_chunked_prefill_gpt_positions(self):
+        """GPT learned positions must be offset per chunk."""
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        pt.seed(62)
+        model = GPTForCausalLM(gpt2_tiny())
+        model.eval()
+        rng = np.random.default_rng(12)
+        ids = rng.integers(0, model.cfg.vocab_size, (1, 5)).astype(
+            np.int32)
+        want = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                              max_cache_len=32)
+        got = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                             max_cache_len=32, prefill_chunk=2)
+        np.testing.assert_array_equal(got.numpy(), want.numpy())
+
+    def test_chunk_headroom_guard(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        model = LlamaForCausalLM(llama_tiny())
+        ids = np.zeros((1, 13), np.int32)   # pad-to-18 > cache 16
+        with pytest.raises(ValueError, match="chunk headroom"):
+            model.generate(pt.to_tensor(ids), max_new_tokens=3,
+                           max_cache_len=16, prefill_chunk=6)
+
+    def test_server_chunked_prefill_parity(self):
+        from paddle_tpu.inference.continuous_batching import (
+            ContinuousBatchingServer)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(63)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (5, 8)]
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64,
+                                       prefill_chunk=4)
+        rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        outs = srv.run()
+        for rid, p in zip(rids, prompts):
+            want = model.generate(pt.to_tensor(p[None]),
+                                  max_new_tokens=5,
+                                  max_cache_len=64).numpy()[0, len(p):]
+            np.testing.assert_array_equal(outs[rid], want)
+
+
 class TestWeightOnlyInt8:
     def test_int8_decode_close_to_fp32(self):
         """Weight-only int8 decode: prefill logits within quantization
