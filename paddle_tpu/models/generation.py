@@ -54,6 +54,49 @@ def _quantize_tree(p):
     return {k: q(k, v) for k, v in p.items()}
 
 
+def _apply_mesh(p, mesh, shard_dims, axis="mp"):
+    """Tensor-parallel weight placement for decode: ``shard_dims`` maps
+    weight name -> dimension index to shard over the mesh's ``axis``
+    (column-parallel out-dims, row-parallel contraction dims, or the
+    expert dim). Everything else — and any dim not divisible by the axis
+    size — is placed replicated, so the whole tree lives on the mesh and
+    one jit compiles an SPMD decode (GSPMD inserts the collectives,
+    exactly as the training-side TP layers rely on)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    size = mesh.shape[axis]
+    rep = NamedSharding(mesh, P())
+
+    def place(name, w):
+        main = w[0] if isinstance(w, tuple) else w
+        dim = shard_dims.get(name)
+        if dim is not None and main.shape[dim] % size == 0:
+            spec = P(*[axis if i == dim else None
+                       for i in range(main.ndim)])
+            sh = NamedSharding(mesh, spec)
+        else:
+            sh = rep
+        if isinstance(w, tuple):          # int8 (weights, scales)
+            return (jax.device_put(w[0], sh), jax.device_put(w[1], rep))
+        return jax.device_put(w, sh)
+
+    return {k: place(k, v) for k, v in p.items()}
+
+
+def _mesh_caches(init_caches, mesh):
+    """Replicate fresh KV caches over the mesh so every array in the
+    decode jit shares one device set."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def init(batch):
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P())),
+            init_caches(batch))
+
+    return init
+
+
 def _mm(x, w):
     """x @ w where w is a raw array or an (int8, scale) pair. The int8
     path casts tile-wise inside the fused matmul (XLA folds the convert
@@ -122,7 +165,7 @@ def _write_cache(cache, kv, t):
     return cache.at[rows, cols].set(kv)
 
 
-def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None):
+def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     """(init_caches, embed_fn, step_fn, head_fn) for LlamaForCausalLM —
     GQA-aware (kv heads cached unrepeated), rope applied at absolute
     positions."""
@@ -148,6 +191,11 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None):
     cos, sin = rope_mod.precompute_freqs(hd, max_cache_len, cfg.rope_theta)
     if weight_dtype == "int8":
         p = _quantize_tree(p)
+    if mesh is not None:
+        p = _apply_mesh(p, mesh, {
+            "wq": 2, "wk": 2, "wv": 2, "wg": 2, "wu": 2,   # column-parallel
+            "wo": 1, "wd": 1,                              # row-parallel
+            "head": 1})
     dtype = p["table"].dtype
     L = cfg.num_layers
     scale = 1.0 / np.sqrt(hd)
@@ -155,6 +203,9 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None):
     def init_caches(batch):
         shape = (L, batch, max_cache_len, kvh, hd)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    if mesh is not None:
+        init_caches = _mesh_caches(init_caches, mesh)
 
     def embed_fn(tok, t):
         return p["table"][tok][:, None, :]
@@ -226,7 +277,7 @@ def _moe_topk_ffn(h, router_w, wg, wu, wd, top_k):
     return jnp.einsum("bse,besh->bsh", w.astype(o.dtype), o)
 
 
-def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None):
+def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     """Llama-style attention + routed-expert FFN (MixtralForCausalLM)."""
     from ..ops.pallas import rope as rope_mod
     cfg = model.cfg
@@ -251,6 +302,11 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None):
     cos, sin = rope_mod.precompute_freqs(hd, max_cache_len, cfg.rope_theta)
     if weight_dtype == "int8":
         p = _quantize_tree(p)
+    if mesh is not None:
+        p = _apply_mesh(p, mesh, {
+            "wq": 2, "wk": 2, "wv": 2, "wo": 1,
+            "wg": 1, "wu": 1, "wd": 1,        # expert-parallel decode
+            "head": 1})
     dtype = p["table"].dtype
     L = cfg.num_layers
     top_k = cfg.top_k
@@ -259,6 +315,9 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None):
     def init_caches(batch):
         shape = (L, batch, max_cache_len, kvh, hd)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    if mesh is not None:
+        init_caches = _mesh_caches(init_caches, mesh)
 
     def embed_fn(tok, t):
         return p["table"][tok][:, None, :]
@@ -301,7 +360,7 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None):
     return init_caches, embed_fn, step_fn, head_fn
 
 
-def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None):
+def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     """(init_caches, embed_fn, step_fn, head_fn) for GPTForCausalLM —
     learned positions, fused qkv, tied lm head."""
     cfg = model.cfg
@@ -323,6 +382,10 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None):
         p[name] = _stacked(blocks, name)
     if weight_dtype == "int8":
         p = _quantize_tree(p)
+    if mesh is not None:
+        p = _apply_mesh(p, mesh, {
+            "attn.qkv.weight": 2, "attn.proj.weight": 1,
+            "mlp.fc1.weight": 2, "mlp.fc2.weight": 1})
     dtype = p["table"].dtype
     L = cfg.num_layers
     scale = 1.0 / np.sqrt(hd)
@@ -330,6 +393,9 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None):
     def init_caches(batch):
         shape = (L, batch, max_cache_len, nh, hd)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    if mesh is not None:
+        init_caches = _mesh_caches(init_caches, mesh)
 
     def embed_fn(tok, t):
         pos_emb = p["wpe"][t]                # scalar t: [H]; [B] t: [B,H]
@@ -376,8 +442,9 @@ class GenerationMixin:
     """``generate()`` for causal-LM models (greedy + sampling), running
     prefill and the whole decode loop as on-device XLA programs."""
 
-    def _decode_bundle(self, max_cache_len, weight_dtype=None):
-        key = ("_pt_decode_bundle", max_cache_len, weight_dtype)
+    def _decode_bundle(self, max_cache_len, weight_dtype=None, mesh=None):
+        key = ("_pt_decode_bundle", max_cache_len, weight_dtype,
+               None if mesh is None else id(mesh))
         cached = getattr(self, "_pt_decode_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -386,13 +453,13 @@ class GenerationMixin:
         from .mixtral import MixtralForCausalLM
         if isinstance(self, MixtralForCausalLM):
             bundle = _make_mixtral_decode_fns(self, max_cache_len,
-                                              weight_dtype)
+                                              weight_dtype, mesh)
         elif isinstance(self, LlamaForCausalLM):
             bundle = _make_llama_decode_fns(self, max_cache_len,
-                                            weight_dtype)
+                                            weight_dtype, mesh)
         elif isinstance(self, GPTForCausalLM):
             bundle = _make_gpt_decode_fns(self, max_cache_len,
-                                          weight_dtype)
+                                          weight_dtype, mesh)
         else:
             raise NotImplementedError(
                 f"generate() not wired for {type(self).__name__}")
@@ -450,7 +517,7 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None, max_cache_len=None, weight_dtype=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, mesh=None):
         """Generate continuations for ``input_ids`` ([B, T] int). Returns
         the FULL sequence (prompt + ``max_new_tokens``) as a framework
         tensor; after every row hits ``eos_token_id`` the tail is padded
@@ -479,7 +546,7 @@ class GenerationMixin:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_cache_len ({max_cache_len})")
-        bundle = self._decode_bundle(max_cache_len, weight_dtype)
+        bundle = self._decode_bundle(max_cache_len, weight_dtype, mesh)
         init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
 
         last_logits, caches = self._run_prefill(bundle, ids_np,
