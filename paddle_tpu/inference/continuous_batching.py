@@ -35,9 +35,10 @@ class _Slot:
 
 class ContinuousBatchingServer:
     """Serve ``model.generate``-compatible requests through a fixed slot
-    pool. Greedy or sampled decoding; results for any request are
-    identical to a solo ``model.generate`` call (slots are row-wise
-    independent).
+    pool. Greedy results are bit-identical to a solo ``model.generate``
+    call (slots are row-wise independent). Sampled decoding draws from
+    ONE server-level PRNG stream shared across slots — valid samples,
+    but not the same draws a solo call with the same seed would make.
 
     >>> srv = ContinuousBatchingServer(model, max_slots=4,
     ...                                max_cache_len=256)
@@ -83,10 +84,12 @@ class ContinuousBatchingServer:
                 raise ValueError("submit() takes one request; batch by "
                                  "calling submit() per row")
             ids = ids[0]
-        if ids.shape[0] + max_new_tokens > self.max_cache_len:
+        T = ids.shape[0]
+        pad = (-T) % self._prefill_chunk if self._prefill_chunk else 0
+        if max(T + max_new_tokens, T + pad) > self.max_cache_len:
             raise ValueError(
-                f"prompt ({ids.shape[0]}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_cache_len "
+                f"prompt ({T}) + max({max_new_tokens} new tokens, "
+                f"{pad} prefill-chunk pad rows) exceeds max_cache_len "
                 f"({self.max_cache_len})")
         rid = self._next_rid
         self._next_rid += 1

@@ -104,7 +104,9 @@ def speculative_generate(target, draft, input_ids, max_new_tokens=32,
 
     emitted = emitted[:max_new_tokens]
     if eos_token_id is not None and eos_token_id in emitted:
+        # match generate()'s static-shape contract: eos-pad the tail
         emitted = emitted[:emitted.index(eos_token_id) + 1]
+        emitted += [eos_token_id] * (max_new_tokens - len(emitted))
     full = np.concatenate([ids_np[0], np.asarray(emitted, np.int32)])
     result = wrap(jnp.asarray(full[None]))
     if return_stats:

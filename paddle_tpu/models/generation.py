@@ -367,6 +367,11 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     nh = cfg.num_heads
     hd = cfg.hidden_size // nh
     eps = cfg.layer_norm_eps
+    if max_cache_len > cfg.max_seq_len:
+        raise ValueError(
+            f"max_cache_len ({max_cache_len}) exceeds the learned "
+            f"position table ({cfg.max_seq_len}); positions past it "
+            f"would silently clamp — shorten the cache or grow wpe")
     blocks = [dict(blk.raw_params()) for blk in model.gpt.blocks]
     p = {
         "table": unwrap(model.gpt.wte.weight),           # [V, H] (tied)
@@ -498,11 +503,12 @@ class GenerationMixin:
             out, caches = prefill_jit(x0, caches, jnp.int32(0))
             return head_fn(out[:, -1:])[:, -1], caches
         pad = (-T) % chunk
-        if T + pad > init_caches(0)["k"].shape[2]:
+        cache_rows = jax.tree_util.tree_leaves(caches)[0].shape[2]
+        if T + pad > cache_rows:
             raise ValueError(
                 f"chunked prefill writes {T + pad} cache rows (prompt "
                 f"{T} padded to a multiple of {chunk}) but max_cache_len "
-                f"is {init_caches(0)['k'].shape[2]} — raise max_cache_len "
+                f"is {cache_rows} — raise max_cache_len "
                 f"by at least {chunk - 1} for chunk headroom")
         ids_pad = np.pad(ids_np, ((0, 0), (0, pad)))
         last = None
@@ -525,7 +531,8 @@ class GenerationMixin:
 
         Greedy when ``do_sample=False``; otherwise categorical sampling
         with ``temperature``/``top_k``/``top_p`` filtering and a PRNG
-        seeded by ``seed``. Weight-change caveat: decode functions are
+        seeded by ``seed`` (``seed=None`` draws a fresh seed from numpy's
+        global RNG, so repeated calls differ). Weight-change caveat: decode functions are
         built from the CURRENT weights and cached per ``max_cache_len``;
         call ``model.reset_generate_cache()`` after loading new weights.
 
@@ -540,8 +547,10 @@ class GenerationMixin:
             ids_np = ids_np[None]
         ids_np = ids_np.astype(np.int32)
         B, T = ids_np.shape
+        pad = (-T) % prefill_chunk if prefill_chunk else 0
         if max_cache_len is None:
-            max_cache_len = min(self.cfg.max_seq_len, T + max_new_tokens)
+            max_cache_len = min(self.cfg.max_seq_len,
+                                max(T + max_new_tokens, T + pad))
         if T + max_new_tokens > max_cache_len:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
@@ -553,7 +562,9 @@ class GenerationMixin:
                                                 chunk=prefill_chunk)
 
         if do_sample:
-            key = jax.random.PRNGKey(0 if seed is None else seed)
+            if seed is None:        # fresh entropy per call, like the
+                seed = int(np.random.randint(0, 2**31))  # reference's
+            key = jax.random.PRNGKey(seed)               # global RNG
             new_ids, _ = sample_generate(
                 embed_fn, step_fn, head_fn, caches, last_logits, T,
                 max_new_tokens, key, temperature=temperature,

@@ -75,6 +75,13 @@ class TestContinuousBatching:
             srv.submit(np.zeros((12,), np.int32), max_new_tokens=8)
         with pytest.raises(ValueError, match="one request"):
             srv.submit(np.zeros((2, 4), np.int32))
+        # chunk-pad overflow must be rejected AT SUBMIT (not lost later
+        # inside step(): code-review r5)
+        srv2 = ContinuousBatchingServer(model, max_slots=1,
+                                        max_cache_len=16,
+                                        prefill_chunk=6)
+        with pytest.raises(ValueError, match="pad rows"):
+            srv2.submit(np.zeros((13,), np.int32), max_new_tokens=3)
 
     def test_gpt_greedy_parity_through_server(self):
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny

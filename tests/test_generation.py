@@ -69,6 +69,16 @@ class TestGreedyParity:
         assert model._pt_decode_cache is bundle1, "bundle rebuilt"
         np.testing.assert_array_equal(a.numpy(), b.numpy())
 
+    def test_gpt_cache_beyond_position_table_refused(self):
+        """code-review r5: wpe gathers clamp silently past max_seq_len —
+        the builder must refuse oversized caches instead."""
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        model = GPTForCausalLM(gpt2_tiny())
+        ids = np.zeros((1, 4), np.int32)
+        with pytest.raises(ValueError, match="position table"):
+            model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                           max_cache_len=model.cfg.max_seq_len + 64)
+
     def test_generate_length_guard(self):
         from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
         model = LlamaForCausalLM(llama_tiny())
