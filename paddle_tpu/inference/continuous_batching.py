@@ -73,6 +73,34 @@ class ContinuousBatchingServer:
         self._results = {}
         self._next_rid = 0
         self._decode_jit = None
+        self._prefixes = []       # [(ids, cache_rows, last_logits)]
+        self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0}
+
+    # ------------------------------------------------------ prefix cache
+    def register_prefix(self, prefix_ids):
+        """Prefill a shared prompt prefix (e.g. a system prompt) ONCE and
+        reuse its KV rows for every later request that starts with it —
+        admission then only prefills the remainder. Longest registered
+        match wins. Returns the prefix length."""
+        ids = np.asarray(unwrap(prefix_ids)).astype(np.int32).reshape(-1)
+        T = ids.shape[0]
+        if T + 1 > self.max_cache_len:
+            raise ValueError(f"prefix ({T}) leaves no room in "
+                             f"max_cache_len ({self.max_cache_len})")
+        logits, caches1 = self.model._run_prefill(
+            self._bundle, ids[None], chunk=self._prefill_chunk)
+        self.stats["prefill_tokens"] += T
+        rows = jax.tree_util.tree_map(lambda c: c[:, :, :T], caches1)
+        self._prefixes.append((ids, rows, logits))
+        self._prefixes.sort(key=lambda e: -e[0].shape[0])  # longest first
+        return T
+
+    def _match_prefix(self, ids):
+        for pre_ids, rows, logits in self._prefixes:
+            n = pre_ids.shape[0]
+            if ids.shape[0] >= n and np.array_equal(ids[:n], pre_ids):
+                return pre_ids, rows, logits
+        return None
 
     # ------------------------------------------------------------ queue
     def submit(self, input_ids, max_new_tokens=32):
@@ -106,9 +134,28 @@ class ContinuousBatchingServer:
             T = ids.shape[0]
             # per-request prefill at batch 1 (optionally in fixed-size
             # chunks: one compiled program for every prompt length),
-            # then scatter into the pool
-            logits, caches1 = self.model._run_prefill(
-                self._bundle, ids[None], chunk=self._prefill_chunk)
+            # then scatter into the pool. A registered-prefix hit seeds
+            # the caches and prefills only the remainder.
+            hit = self._match_prefix(ids)
+            if hit is not None:
+                pre_ids, rows, pre_logits = hit
+                n = pre_ids.shape[0]
+                caches1 = jax.tree_util.tree_map(
+                    lambda full, r: full.at[:, :, :r.shape[2]].set(r),
+                    self._init_caches(1), rows)
+                rest = ids[n:]
+                self.stats["prefix_hit_tokens"] += n
+                if rest.shape[0]:
+                    logits, caches1 = self.model._run_prefill(
+                        self._bundle, rest[None],
+                        chunk=self._prefill_chunk, caches=caches1, t0=n)
+                    self.stats["prefill_tokens"] += rest.shape[0]
+                else:
+                    logits = pre_logits
+            else:
+                logits, caches1 = self.model._run_prefill(
+                    self._bundle, ids[None], chunk=self._prefill_chunk)
+                self.stats["prefill_tokens"] += T
             first = self._pick(logits)[0]
             self._caches = jax.tree_util.tree_map(
                 lambda pool, one: pool.at[:, slot].set(one[:, 0]),

@@ -486,9 +486,10 @@ class GenerationMixin:
         table = unwrap(self.model.embed_tokens.weight)
         return table[ids]
 
-    def _run_prefill(self, bundle, ids_np, chunk=None):
-        """Prefill ``ids_np`` [B, T] into fresh caches; returns
-        (last-position logits [B, V], caches).
+    def _run_prefill(self, bundle, ids_np, chunk=None, caches=None, t0=0):
+        """Prefill ``ids_np`` [B, T] starting at position ``t0`` (fresh
+        caches unless ``caches`` resumes a partially-filled tree, e.g. a
+        shared-prefix hit); returns (last-position logits [B, V], caches).
 
         ``chunk``: feed the prompt in fixed-size chunks (prompt padded up
         to a multiple) so ONE compiled prefill program serves every
@@ -497,25 +498,27 @@ class GenerationMixin:
         hides their cache rows, and decode overwrites them."""
         init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
         B, T = ids_np.shape
-        caches = init_caches(B)
+        if caches is None:
+            caches = init_caches(B)
         if not chunk or chunk >= T:
-            x0 = self._prefill_embed(jnp.asarray(ids_np), bundle)
-            out, caches = prefill_jit(x0, caches, jnp.int32(0))
+            x0 = self._prefill_embed(jnp.asarray(ids_np), bundle, t0=t0)
+            out, caches = prefill_jit(x0, caches, jnp.int32(t0))
             return head_fn(out[:, -1:])[:, -1], caches
         pad = (-T) % chunk
         cache_rows = jax.tree_util.tree_leaves(caches)[0].shape[2]
-        if T + pad > cache_rows:
+        if t0 + T + pad > cache_rows:
             raise ValueError(
-                f"chunked prefill writes {T + pad} cache rows (prompt "
-                f"{T} padded to a multiple of {chunk}) but max_cache_len "
-                f"is {cache_rows} — raise max_cache_len "
-                f"by at least {chunk - 1} for chunk headroom")
+                f"chunked prefill writes rows up to {t0 + T + pad} "
+                f"(prompt {T} at offset {t0} padded to a multiple of "
+                f"{chunk}) but max_cache_len is {cache_rows} — raise "
+                f"max_cache_len by at least {chunk - 1} for chunk "
+                f"headroom")
         ids_pad = np.pad(ids_np, ((0, 0), (0, pad)))
         last = None
         for i in range(0, T + pad, chunk):
             x = self._prefill_embed(jnp.asarray(ids_pad[:, i:i + chunk]),
-                                    bundle, t0=i)
-            out, caches = prefill_jit(x, caches, jnp.int32(i))
+                                    bundle, t0=t0 + i)
+            out, caches = prefill_jit(x, caches, jnp.int32(t0 + i))
             if i <= T - 1 < i + chunk:
                 last = head_fn(out[:, T - 1 - i:T - i])[:, -1]
         return last, caches

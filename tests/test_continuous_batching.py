@@ -83,6 +83,48 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="pad rows"):
             srv2.submit(np.zeros((13,), np.int32), max_new_tokens=3)
 
+    def test_prefix_cache_parity_and_savings(self):
+        """Registered shared prefix: identical tokens, remainder-only
+        prefill work."""
+        model = _model()
+        rng = np.random.default_rng(4)
+        prefix = rng.integers(0, 256, (10,)).astype(np.int32)
+        tails = [rng.integers(0, 256, (n,)).astype(np.int32)
+                 for n in (3, 5)]
+        prompts = [np.concatenate([prefix, t]) for t in tails]
+
+        plain = ContinuousBatchingServer(model, max_slots=2,
+                                         max_cache_len=64)
+        rids = [plain.submit(p, max_new_tokens=6) for p in prompts]
+        want = plain.run()
+
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64)
+        srv.register_prefix(prefix)
+        rids2 = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        outs = srv.run()
+        for ra, rb in zip(rids, rids2):
+            np.testing.assert_array_equal(outs[rb], want[ra])
+        # prefill work: 10 (register) + 3 + 5 vs 13 + 15
+        assert srv.stats["prefill_tokens"] == 10 + 3 + 5
+        assert srv.stats["prefix_hit_tokens"] == 20
+        assert plain.stats["prefill_tokens"] == 13 + 15
+
+    def test_prefix_exact_match_uses_stored_logits(self):
+        """A prompt equal to the prefix itself prefills zero tokens."""
+        model = _model()
+        rng = np.random.default_rng(5)
+        prefix = rng.integers(0, 256, (8,)).astype(np.int32)
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=64)
+        srv.register_prefix(prefix)
+        base = srv.stats["prefill_tokens"]
+        rid = srv.submit(prefix, max_new_tokens=5)
+        out = srv.run()[rid]
+        assert srv.stats["prefill_tokens"] == base   # no extra prefill
+        want = _solo(model, prefix, 5)
+        np.testing.assert_array_equal(out, want)
+
     def test_gpt_greedy_parity_through_server(self):
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
         pt.seed(22)
