@@ -35,10 +35,11 @@ class _Slot:
 
 class ContinuousBatchingServer:
     """Serve ``model.generate``-compatible requests through a fixed slot
-    pool. Greedy results are bit-identical to a solo ``model.generate``
-    call (slots are row-wise independent). Sampled decoding draws from
-    ONE server-level PRNG stream shared across slots — valid samples,
-    but not the same draws a solo call with the same seed would make.
+    pool. Results are bit-identical to a solo ``model.generate`` call —
+    greedy trivially (slots are row-wise independent), and sampled
+    decoding too: each request carries its own PRNG chain, split in the
+    same pattern as ``sample_generate``, so ``submit(..., seed=s)``
+    draws exactly what ``generate(..., do_sample=True, seed=s)`` draws.
 
     >>> srv = ContinuousBatchingServer(model, max_slots=4,
     ...                                max_cache_len=256)
@@ -58,7 +59,8 @@ class ContinuousBatchingServer:
         self._temperature = float(temperature)
         self._top_k = int(top_k)
         self._top_p = float(top_p)
-        self._key = jax.random.PRNGKey(seed)
+        self._seed = int(seed)
+        self._keys = jnp.zeros((int(max_slots), 2), jnp.uint32)
         self._bundle = model._decode_bundle(max_cache_len, weight_dtype)
         (self._init_caches, self._embed_fn, self._step_fn,
          self._head_fn, self._prefill_jit) = self._bundle
@@ -103,9 +105,11 @@ class ContinuousBatchingServer:
         return None
 
     # ------------------------------------------------------------ queue
-    def submit(self, input_ids, max_new_tokens=32):
+    def submit(self, input_ids, max_new_tokens=32, seed=None):
         """Queue a prompt; returns a request id. The FIRST generated
-        token is produced by the prefill (same contract as generate())."""
+        token is produced by the prefill (same contract as generate()).
+        ``seed`` drives this request's sampling chain (default: the
+        server seed + request id)."""
         ids = np.asarray(unwrap(input_ids)).astype(np.int32)
         if ids.ndim == 2:
             if ids.shape[0] != 1:
@@ -121,7 +125,9 @@ class ContinuousBatchingServer:
                 f"({self.max_cache_len})")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append((rid, ids, int(max_new_tokens)))
+        if seed is None:
+            seed = self._seed + rid
+        self._queue.append((rid, ids, int(max_new_tokens), int(seed)))
         return rid
 
     # ------------------------------------------------------- scheduling
@@ -130,7 +136,7 @@ class ContinuousBatchingServer:
         for slot in range(self.max_slots):
             if self._active[slot] or not self._queue:
                 continue
-            rid, ids, budget = self._queue.pop(0)
+            rid, ids, budget, req_seed = self._queue.pop(0)
             T = ids.shape[0]
             # per-request prefill at batch 1 (optionally in fixed-size
             # chunks: one compiled program for every prompt length),
@@ -156,7 +162,19 @@ class ContinuousBatchingServer:
                 logits, caches1 = self.model._run_prefill(
                     self._bundle, ids[None], chunk=self._prefill_chunk)
                 self.stats["prefill_tokens"] += T
-            first = self._pick(logits)[0]
+            key = jax.random.PRNGKey(req_seed)
+            if self.do_sample:
+                # same split pattern as sample_generate.run: one split,
+                # sample tok0 from the [1, V] prefill logits
+                key, sub = jax.random.split(key)
+                from .decode_loop import process_logits
+                first = int(jax.random.categorical(
+                    sub, process_logits(logits, self._temperature,
+                                        self._top_k, self._top_p),
+                    axis=-1)[0])
+            else:
+                first = int(jnp.argmax(logits, -1)[0])
+            self._keys = self._keys.at[slot].set(key)
             self._caches = jax.tree_util.tree_map(
                 lambda pool, one: pool.at[:, slot].set(one[:, 0]),
                 self._caches, caches1)
@@ -167,16 +185,6 @@ class ContinuousBatchingServer:
             st.emitted.append(int(first))
             self._slots[slot] = st
 
-    def _pick(self, logits):
-        """Next-token choice for prefill logits [N, V] -> [N] int32."""
-        if not self.do_sample:
-            return jnp.argmax(logits, -1).astype(jnp.int32)
-        from .decode_loop import process_logits
-        self._key, sub = jax.random.split(self._key)
-        return jax.random.categorical(
-            sub, process_logits(logits, self._temperature, self._top_k,
-                                self._top_p), axis=-1).astype(jnp.int32)
-
     # ------------------------------------------------------------ steps
     def _build_decode_step(self):
         embed_p, step_p, head_p = (self._embed_fn, self._step_fn,
@@ -185,7 +193,7 @@ class ContinuousBatchingServer:
         temperature, top_k, top_p = (self._temperature, self._top_k,
                                      self._top_p)
 
-        def step(tok, caches, t, key):
+        def step(tok, caches, t, keys):
             x = embed_p(tok, t)
             out, caches = step_p(x, caches, t)
             logits = head_p(out)
@@ -193,13 +201,20 @@ class ContinuousBatchingServer:
                 logits = logits[:, -1]
             if do_sample:
                 from .decode_loop import process_logits
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(
-                    sub, process_logits(logits, temperature, top_k,
-                                        top_p), axis=-1).astype(jnp.int32)
+
+                def samp(k, row):
+                    # identical draw chain to sample_generate.body:
+                    # split this slot's key, sample over its [1, V] row
+                    k2, sub = jax.random.split(k)
+                    nxt = jax.random.categorical(
+                        sub, process_logits(row[None], temperature,
+                                            top_k, top_p), axis=-1)[0]
+                    return k2, nxt.astype(jnp.int32)
+
+                keys, nxt = jax.vmap(samp)(keys, logits)
             else:
                 nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-            return nxt, caches, t + 1, key
+            return nxt, caches, t + 1, keys
 
         return jax.jit(step, donate_argnums=(1,))
 
@@ -217,8 +232,8 @@ class ContinuousBatchingServer:
             return 0
         if self._decode_jit is None:
             self._decode_jit = self._build_decode_step()
-        self._tok, self._caches, self._t, self._key = self._decode_jit(
-            self._tok, self._caches, self._t, self._key)
+        self._tok, self._caches, self._t, self._keys = self._decode_jit(
+            self._tok, self._caches, self._t, self._keys)
         toks = np.asarray(self._tok)
         for slot in range(self.max_slots):
             if self._active[slot]:

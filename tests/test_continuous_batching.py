@@ -83,6 +83,26 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="pad rows"):
             srv2.submit(np.zeros((13,), np.int32), max_new_tokens=3)
 
+    def test_sampled_requests_match_solo_generate(self):
+        """Per-request PRNG chains: submit(seed=s) draws exactly what a
+        solo generate(do_sample=True, seed=s) draws, even with both
+        slots mid-flight."""
+        model = _model()
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (4, 6, 5)]
+        kw = dict(do_sample=True, temperature=1.5, top_k=7)
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64, **kw)
+        rids = [srv.submit(p, max_new_tokens=7, seed=100 + i)
+                for i, p in enumerate(prompts)]
+        outs = srv.run()
+        for i, (rid, p) in enumerate(zip(rids, prompts)):
+            want = model.generate(pt.to_tensor(p[None]), max_new_tokens=7,
+                                  seed=100 + i, max_cache_len=64,
+                                  **kw).numpy()[0, len(p):]
+            np.testing.assert_array_equal(outs[rid], want)
+
     def test_prefix_cache_parity_and_savings(self):
         """Registered shared prefix: identical tokens, remainder-only
         prefill work."""
