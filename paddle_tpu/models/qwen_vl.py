@@ -128,6 +128,82 @@ class QwenVL(nn.Layer):
             logits = logits[:, num_visual_tokens:]
         return F.cross_entropy(logits[:, :-1, :], labels[:, 1:])
 
+    def generate(self, input_ids, pixel_values=None, max_new_tokens=32,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 eos_token_id=None, seed=None, max_cache_len=None):
+        """Multimodal generation: the image's visual tokens prefill the
+        joint sequence (rope positions cover prefix + text, matching the
+        training forward), then the text decodes through the same
+        on-device scan loop the pure-text models use. Returns the full
+        TEXT sequence (prompt + new tokens); visual tokens are internal.
+        """
+        import types
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..core.tensor import unwrap, wrap
+        from ..inference.decode_loop import greedy_generate, sample_generate
+        from .generation import _make_llama_decode_fns
+
+        ids_np = np.asarray(unwrap(input_ids)).astype(np.int32)
+        if ids_np.ndim == 1:
+            ids_np = ids_np[None]
+        B, T = ids_np.shape
+
+        vis = None
+        n_vis = 0
+        if pixel_values is not None:
+            vis = unwrap(self.projector(self.visual(pixel_values)))
+            n_vis = vis.shape[1]
+        total = n_vis + T
+        if max_cache_len is None:
+            max_cache_len = min(self.cfg.text.max_seq_len,
+                                total + max_new_tokens)
+        if total + max_new_tokens > max_cache_len:
+            raise ValueError(
+                f"visual ({n_vis}) + prompt ({T}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_cache_len "
+                f"({max_cache_len})")
+
+        key = ("_pt_vl_bundle", max_cache_len)
+        cached = getattr(self, "_pt_decode_cache", None)
+        if cached is not None and cached[0] == key:
+            bundle = cached[1]
+        else:
+            view = types.SimpleNamespace(cfg=self.cfg.text,
+                                         model=self.language_model,
+                                         lm_head=self.lm_head)
+            fns = _make_llama_decode_fns(view, max_cache_len)
+            bundle = fns + (jax.jit(fns[2], donate_argnums=(1,)),)
+            self._pt_decode_cache = (key, bundle)
+        init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
+
+        table = unwrap(self.language_model.embed_tokens.weight)
+        x0 = table[jnp.asarray(ids_np)]
+        if vis is not None:
+            x0 = jnp.concatenate([vis.astype(x0.dtype), x0], axis=1)
+        caches = init_caches(B)
+        out, caches = prefill_jit(x0, caches, jnp.int32(0))
+        last_logits = head_fn(out[:, -1:])[:, -1]
+
+        if do_sample:
+            if seed is None:
+                seed = int(np.random.randint(0, 2**31))
+            new_ids, _ = sample_generate(
+                embed_fn, step_fn, head_fn, caches, last_logits, total,
+                max_new_tokens, jax.random.PRNGKey(seed),
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id)
+        else:
+            first = jnp.argmax(last_logits, -1).astype(jnp.int32)
+            new_ids, _ = greedy_generate(
+                embed_fn, step_fn, head_fn, caches, first, total,
+                max_new_tokens, eos_token_id=eos_token_id)
+        full = np.concatenate([ids_np, np.asarray(new_ids)], axis=1)
+        return wrap(jnp.asarray(full))
+
 
 def shard_qwen_vl(model, process_mesh):
     """auto_parallel annotation for a dp×mp ProcessMesh: wide projections

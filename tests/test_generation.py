@@ -130,6 +130,47 @@ class TestSampling:
         assert (out[3:] == eos).all()
 
 
+class TestQwenVLGenerate:
+    def test_vl_generate_matches_eager_joint_forward(self):
+        """Multimodal decode: visual prefix in the cache, text decoding
+        token-for-token equal to the full joint recompute."""
+        from paddle_tpu.models.qwen_vl import QwenVL, qwen_vl_tiny
+        pt.seed(81)
+        model = QwenVL(qwen_vl_tiny())
+        model.eval()
+        rng = np.random.default_rng(17)
+        pixels = pt.to_tensor(
+            rng.standard_normal((1, 3, 16, 16)).astype("float32"))
+        ids = rng.integers(0, 256, (1, 4)).astype(np.int32)
+
+        # naive loop: full joint forward each step, argmax last position
+        cur = ids.copy()
+        for _ in range(5):
+            logits = model(pt.to_tensor(cur), pixels).numpy()
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+        got = model.generate(pt.to_tensor(ids), pixels, max_new_tokens=5,
+                             max_cache_len=64)
+        np.testing.assert_array_equal(got.numpy(), cur)
+
+    def test_vl_generate_text_only(self):
+        """Without pixels it degrades to plain llama-style decode."""
+        from paddle_tpu.models.qwen_vl import QwenVL, qwen_vl_tiny
+        pt.seed(82)
+        model = QwenVL(qwen_vl_tiny())
+        model.eval()
+        ids = np.arange(4, dtype=np.int32)[None]
+        cur = ids.copy()
+        for _ in range(4):
+            logits = model(pt.to_tensor(cur)).numpy()
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        got = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                             max_cache_len=32)
+        np.testing.assert_array_equal(got.numpy(), cur)
+
+
 class TestChunkedPrefill:
     def test_chunked_prefill_matches_whole_prompt(self):
         """Fixed-size prefill chunks (prompt padded up): same tokens as
