@@ -86,6 +86,7 @@ METRICS = {
     "llama350m": "llama_350m_train_tokens_per_sec_per_chip",
     "moe": "mixtral_8e_top2_train_tokens_per_sec_per_chip",
     "llama1b3": "llama_1b3_train_tokens_per_sec_per_chip",
+    "llama2b7": "llama_2b7_train_tokens_per_sec_per_chip",
     "decode": "gpt2_345m_decode_tokens_per_sec",
 }
 
@@ -152,17 +153,25 @@ def _probe_device_responsive(timeout_s=75):
     return False
 
 
-def main_llama1b3():
-    """Largest-fits single-chip run (VERDICT r5 #2): a 1.26B llama
-    (TinyLlama-class: L=22, H=2048, F=5632, 16 heads x 128) trained
-    bf16 with per-block rematerialization, Pallas flash attention, and
-    chunked fused linear+CE — the measured point closest to the
-    BASELINE.md "Llama-2 7B" row that one v5e chip can hold.
+def main_llama1b3(config_name="llama1b3"):
+    """Largest-fits single-chip runs (VERDICT r5 #2).
 
-    HBM budget (16 GB): params 2.5 GB + grads 2.5 GB + bf16 Adam
-    moments 5 GB + remat'd activations ~0.8 GB. The step builds from
-    raw stacked arrays (no Layer objects) so device init is ONE jitted
-    program instead of per-param transfers through the relay.
+    llama1b3: a 1.26B llama (TinyLlama-class: L=22, H=2048, F=5632,
+    16 heads x 128) trained bf16 with per-block rematerialization,
+    Pallas flash attention, and chunked fused linear+CE — HBM budget
+    (16 GB): params 2.5 GB + grads 2.5 GB + bf16 Adam moments 5 GB +
+    remat'd activations ~0.8 GB.
+
+    llama2b7: the stretch point — ~2.7B (L=32, H=2560, F=6912, 20
+    heads x 128) with an Adafactor-style factored second moment (+
+    first-moment-free) update: params 5.4 GB + grads 5.4 GB + factored
+    state ~15 MB + remat'd activations; the moment memory Adam would
+    need (11 GB) does not fit beside them. The measured trend across
+    345M -> 1.26B -> 2.7B is the evidence line toward the 7B row.
+
+    The step builds from raw stacked arrays (no Layer objects) so
+    device init is ONE jitted program instead of per-param transfers
+    through the relay.
     """
     import os
     import jax
@@ -171,13 +180,20 @@ def main_llama1b3():
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.parallel.hybrid import _rope_tables_np
 
-    L_, H_, F_, V_ = 22, 2048, 5632, 32000
-    NH = 16
+    big = config_name == "llama2b7"
+    if big:
+        L_, H_, F_, V_ = 32, 2560, 6912, 32000
+        NH = 20
+    else:
+        L_, H_, F_, V_ = 22, 2048, 5632, 32000
+        NH = 16
+    opt = os.environ.get("PT_BENCH_2B_OPT",
+                         "adafactor" if big else "adam")
     dims = os.environ.get("PT_BENCH_2B_DIMS")    # "L,H,F,V,NH" (smoke)
     if dims:
         L_, H_, F_, V_, NH = (int(x) for x in dims.split(","))
     HD = H_ // NH
-    B = int(os.environ.get("PT_BENCH_2B_BATCH", "4"))
+    B = int(os.environ.get("PT_BENCH_2B_BATCH", "2" if big else "4"))
     S = int(os.environ.get("PT_BENCH_2B_SEQ", "2048"))
     fused = os.environ.get("PT_BENCH_2B_FUSED", "1") != "0"
     eps = 1e-5
@@ -211,10 +227,24 @@ def main_llama1b3():
         params = jax.jit(init)(jax.random.PRNGKey(0))
         params = jax.tree_util.tree_map(
             lambda a: a.block_until_ready(), params)
-        # bf16 moments: the 20-step bench measures throughput; fp32
-        # moments (+5 GB) would not fit beside grads at this size
-        state = {"m": jax.tree_util.tree_map(jnp.zeros_like, params),
-                 "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+        if opt == "adafactor":
+            # factored second moment (Shazeer-Stern): row/col accumulators
+            # over the trailing matrix dims — ~15 MB of state for 2.7B
+            state = {
+                "vr": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        p.shape[:-1] if p.ndim >= 2 else p.shape,
+                        jnp.float32), params),
+                "vc": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        p.shape[:-2] + p.shape[-1:] if p.ndim >= 2
+                        else (1,), jnp.float32), params),
+            }
+        else:
+            # bf16 moments: the 20-step bench measures throughput; fp32
+            # moments (+5 GB) would not fit beside grads at this size
+            state = {"m": jax.tree_util.tree_map(jnp.zeros_like, params),
+                     "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
     n_params = sum(int(np.prod(v.shape))
                    for v in jax.tree_util.tree_leaves(params))
 
@@ -279,6 +309,38 @@ def main_llama1b3():
     def step(params, state, ids, i):
         loss, grads = jax.value_and_grad(fwd)(params, ids)
 
+        is_tup = lambda t: isinstance(t, tuple)  # noqa: E731
+
+        if opt == "adafactor":
+            def upd(p, g, vr, vc):
+                g2 = jnp.square(g.astype(jnp.float32)) + 1e-30
+                if p.ndim >= 2:
+                    vr2 = b2 * vr + (1 - b2) * g2.mean(-1)
+                    vc2 = b2 * vc + (1 - b2) * g2.mean(-2)
+                    vhat = (vr2[..., :, None] * vc2[..., None, :]
+                            / (vr2.sum(-1, keepdims=True)[..., None]
+                               + 1e-30))
+                else:
+                    vr2 = b2 * vr + (1 - b2) * g2
+                    vc2 = vc
+                    vhat = vr2
+                vhat = vhat / (1 - jnp.power(b2, i))
+                u = g.astype(jnp.float32) / jnp.sqrt(vhat + 1e-30)
+                rms = jnp.sqrt(jnp.mean(jnp.square(u)) + 1e-30)
+                u = u / jnp.maximum(1.0, rms)     # update clipping d=1
+                p2 = p.astype(jnp.float32) - lr * u
+                return (p2.astype(p.dtype), vr2, vc2)
+
+            out = jax.tree_util.tree_map(upd, params, grads,
+                                         state["vr"], state["vc"])
+            return (loss,
+                    jax.tree_util.tree_map(lambda t: t[0], out,
+                                           is_leaf=is_tup),
+                    {"vr": jax.tree_util.tree_map(lambda t: t[1], out,
+                                                  is_leaf=is_tup),
+                     "vc": jax.tree_util.tree_map(lambda t: t[2], out,
+                                                  is_leaf=is_tup)})
+
         def upd(p, g, m, v):
             g32 = g.astype(jnp.float32)
             m2 = b1 * m.astype(jnp.float32) + (1 - b1) * g32
@@ -293,14 +355,11 @@ def main_llama1b3():
         out = jax.tree_util.tree_map(upd, params, grads, state["m"],
                                      state["v"])
         new_p = jax.tree_util.tree_map(lambda t: t[0], out,
-                                       is_leaf=lambda t: isinstance(
-                                           t, tuple))
+                                       is_leaf=is_tup)
         new_m = jax.tree_util.tree_map(lambda t: t[1], out,
-                                       is_leaf=lambda t: isinstance(
-                                           t, tuple))
+                                       is_leaf=is_tup)
         new_v = jax.tree_util.tree_map(lambda t: t[2], out,
-                                       is_leaf=lambda t: isinstance(
-                                           t, tuple))
+                                       is_leaf=is_tup)
         return loss, new_p, {"m": new_m, "v": new_v}
 
     step = jax.jit(step, donate_argnums=(0, 1))
@@ -328,14 +387,14 @@ def main_llama1b3():
     attn_flops = 12 * L_ * H_ * S      # causal-pair accounting per token
     mfu = tokens_per_sec * (flops_per_token + attn_flops) / peak_flops_bf16()
     print(json.dumps({
-        "metric": METRICS["llama1b3"],
+        "metric": METRICS[config_name],
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
     }))
     print(f"  loss={final_loss:.4f} mfu={mfu:.3f} "
           f"params={n_params/1e6:.1f}M step_time={dt/iters*1000:.1f}ms "
-          f"B={B} S={S} fused_ce={fused}", file=sys.stderr)
+          f"B={B} S={S} fused_ce={fused} opt={opt}", file=sys.stderr)
 
 
 def main_decode():
@@ -482,8 +541,8 @@ def main(config_name="gpt2"):
               "— no measurement possible this run", file=sys.stderr)
         return
 
-    if config_name == "llama1b3":
-        return main_llama1b3()
+    if config_name in ("llama1b3", "llama2b7"):
+        return main_llama1b3(config_name)
     if config_name == "decode":
         return main_decode()
 
@@ -611,7 +670,7 @@ def main(config_name="gpt2"):
 if __name__ == "__main__":
     _argv = sys.argv[1:]
     _cfg = "gpt2"
-    for _name in ("llama350m", "moe", "llama1b3", "decode"):
+    for _name in ("llama350m", "moe", "llama1b3", "llama2b7", "decode"):
         if f"--config={_name}" in _argv or _name in _argv:
             _cfg = _name
     main(_cfg)
