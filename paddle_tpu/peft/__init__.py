@@ -1,0 +1,162 @@
+"""Parameter-efficient fine-tuning (LoRA).
+
+Beyond the reference snapshot (its core API has no PEFT surface; the
+capability lived in downstream NLP suites) but expected by anyone
+fine-tuning the model zoo. TPU-native shape: the adapter delta is two
+small matmuls XLA fuses into the frozen base layer's, so a LoRA train
+step jits exactly like a full fine-tune — only the optimizer's parameter
+list shrinks.
+
+    from paddle_tpu.peft import apply_lora, lora_parameters, merge_lora
+    apply_lora(model, rank=8, targets=("q_proj", "v_proj"))
+    opt = pt.optimizer.AdamW(parameters=lora_parameters(model))
+    ... train ...
+    merge_lora(model)        # fold deltas into the base weights
+"""
+import numpy as np
+
+import paddle_tpu.nn as nn
+
+from ..core.tensor import unwrap
+
+__all__ = ["LoRALinear", "apply_lora", "merge_lora", "unwrap_lora",
+           "lora_parameters", "lora_state_dict", "load_lora_state_dict"]
+
+
+class LoRALinear(nn.Layer):
+    """Wraps an existing Linear: y = x @ W (frozen) + x @ A @ B * scale.
+
+    A: [in, rank] gaussian-init; B: [rank, out] zero-init (the delta
+    starts at exactly zero, so wrapping never changes the forward until
+    training moves B)."""
+
+    def __init__(self, base, rank=8, alpha=16, name=None):
+        super().__init__()
+        if getattr(base, "weight", None) is None:
+            raise ValueError("LoRALinear wraps Linear-like layers with a "
+                             "weight")
+        in_f, out_f = base.weight.shape
+        self.base = base
+        self.rank = int(rank)
+        self.scale = float(alpha) / float(rank)
+        base.weight.stop_gradient = True
+        if getattr(base, "bias", None) is not None:
+            base.bias.stop_gradient = True
+        from ..nn.initializer import Normal
+        self.lora_A = self.create_parameter(
+            (in_f, self.rank),
+            default_initializer=Normal(0.0, 1.0 / self.rank))
+        self.lora_B = self.create_parameter(
+            (self.rank, out_f),
+            default_initializer=lambda shape, dtype: np.zeros(
+                shape, "float32"))
+        self.merged = False
+
+    def forward(self, x):
+        y = self.base(x)
+        if self.merged:
+            return y
+        return y + (x @ self.lora_A) @ self.lora_B * self.scale
+
+    def merge(self):
+        """Fold the adapter into the frozen base weight (inference)."""
+        if self.merged:
+            return
+        delta = unwrap(self.lora_A) @ unwrap(self.lora_B) * self.scale
+        self.base.weight._replace_value(
+            unwrap(self.base.weight) + delta.astype(
+                unwrap(self.base.weight).dtype))
+        self.merged = True
+
+    def unmerge(self):
+        if not self.merged:
+            return
+        delta = unwrap(self.lora_A) @ unwrap(self.lora_B) * self.scale
+        self.base.weight._replace_value(
+            unwrap(self.base.weight) - delta.astype(
+                unwrap(self.base.weight).dtype))
+        self.merged = False
+
+    def extra_repr(self):
+        return f"rank={self.rank}, scale={self.scale}, merged={self.merged}"
+
+
+def _set_sublayer(root, dotted, new):
+    obj = root
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        obj = getattr(obj, p)
+    setattr(obj, parts[-1], new)
+
+
+def apply_lora(model, rank=8, alpha=16, targets=("q_proj", "v_proj")):
+    """Replace every Linear whose dotted name ends with one of
+    ``targets`` by a LoRALinear wrapper and freeze all OTHER parameters.
+    Returns the (mutated) model."""
+    from ..nn.layers_basic import Linear
+    hits = []
+    for name, sub in model.named_sublayers():
+        leaf = name.split(".")[-1]
+        if isinstance(sub, Linear) and leaf in targets:
+            hits.append((name, sub))
+    if not hits:
+        raise ValueError(f"no Linear sublayers match targets={targets}")
+    for _, p in model.named_parameters():
+        p.stop_gradient = True
+    for name, sub in hits:
+        _set_sublayer(model, name, LoRALinear(sub, rank=rank, alpha=alpha))
+    return model
+
+
+def _lora_layers(model):
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, LoRALinear):
+            yield name, sub
+
+
+def lora_parameters(model):
+    """The trainable adapter parameters (pass to the optimizer)."""
+    out = []
+    for _, sub in _lora_layers(model):
+        out.extend([sub.lora_A, sub.lora_B])
+    if not out:
+        raise ValueError("model has no LoRA layers; call apply_lora first")
+    return out
+
+
+def merge_lora(model):
+    """Fold every adapter into its base weight (deploy/export path)."""
+    for _, sub in _lora_layers(model):
+        sub.merge()
+    return model
+
+
+def unwrap_lora(model):
+    """Merge every adapter and put the ORIGINAL Linear layers back, so
+    the model's layer/param structure matches a never-adapted one —
+    required before structure-sensitive paths (generate()'s decode
+    builders, pipeline_decompose, jit.save archives)."""
+    for name, sub in list(_lora_layers(model)):
+        sub.merge()
+        base = sub.base
+        base.weight.stop_gradient = False
+        if getattr(base, "bias", None) is not None:
+            base.bias.stop_gradient = False
+        _set_sublayer(model, name, base)
+    return model
+
+
+def lora_state_dict(model):
+    """Only the adapter tensors — the artifact to ship/checkpoint."""
+    out = {}
+    for name, sub in _lora_layers(model):
+        out[f"{name}.lora_A"] = sub.lora_A.numpy()
+        out[f"{name}.lora_B"] = sub.lora_B.numpy()
+    return out
+
+
+def load_lora_state_dict(model, state):
+    for name, sub in _lora_layers(model):
+        sub.lora_A._replace_value(np.asarray(state[f"{name}.lora_A"]))
+        sub.lora_B._replace_value(np.asarray(state[f"{name}.lora_B"]))
+    return model

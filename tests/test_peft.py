@@ -1,0 +1,108 @@
+"""LoRA (paddle_tpu.peft): zero-init delta, adapter-only training,
+merge/unmerge round trip, checkpoint surface."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.peft import (LoRALinear, apply_lora, load_lora_state_dict,
+                             lora_parameters, lora_state_dict, merge_lora)
+
+
+def _llama():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    pt.seed(91)
+    return LlamaForCausalLM(llama_tiny())
+
+
+class TestLoRA:
+    def test_wrap_is_identity_until_trained(self):
+        model = _llama()
+        model.eval()
+        ids = np.arange(8, dtype=np.int32).reshape(2, 4)
+        before = model(pt.to_tensor(ids)).numpy()
+        apply_lora(model, rank=4)
+        after = model(pt.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+
+    def test_train_updates_only_adapters(self):
+        model = _llama()
+        apply_lora(model, rank=4, targets=("q_proj", "v_proj"))
+        params = lora_parameters(model)
+        assert len(params) == 2 * 2 * model.cfg.num_layers  # A,B per proj
+        base_w = model.model.layers[0].self_attn.q_proj.base.weight
+        base_before = base_w.numpy().copy()
+
+        opt = pt.optimizer.AdamW(learning_rate=1e-2, parameters=params)
+        ids = pt.to_tensor(np.arange(8, dtype=np.int32).reshape(2, 4))
+        for _ in range(2):
+            logits = model(ids)
+            loss = model.loss(logits, ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        lora_B = model.model.layers[0].self_attn.q_proj.lora_B.numpy()
+        assert np.abs(lora_B).max() > 0, "adapter B never moved"
+        np.testing.assert_array_equal(base_w.numpy(), base_before)
+        # frozen non-target layers hold too
+        assert model.model.layers[0].mlp.gate_proj.weight.stop_gradient
+
+    def test_merge_matches_adapter_forward(self):
+        model = _llama()
+        model.eval()
+        apply_lora(model, rank=4)
+        # push the adapters off zero deterministically
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, LoRALinear):
+                sub.lora_B._replace_value(
+                    np.full(sub.lora_B.shape, 0.01, "float32"))
+        ids = np.arange(6, dtype=np.int32).reshape(1, 6)
+        want = model(pt.to_tensor(ids)).numpy()
+        merge_lora(model)
+        got = model(pt.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+        # unmerge restores the un-adapted weight path
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, LoRALinear):
+                sub.unmerge()
+        again = model(pt.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(again, want, rtol=2e-5, atol=1e-5)
+
+    def test_unwrap_restores_structure_for_generate(self):
+        """After unwrap_lora the decode builders (which read the original
+        raw-param names) work, and greedy tokens reflect the adapters."""
+        from paddle_tpu.peft import unwrap_lora
+        model = _llama()
+        model.eval()
+        ids = np.arange(4, dtype=np.int32)[None]
+        base_out = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                                  max_cache_len=32).numpy()
+        apply_lora(model, rank=4)
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, LoRALinear):
+                sub.lora_B._replace_value(
+                    np.full(sub.lora_B.shape, 0.05, "float32"))
+        want_logits = model(pt.to_tensor(ids)).numpy()
+        unwrap_lora(model)
+        model.reset_generate_cache()
+        np.testing.assert_allclose(model(pt.to_tensor(ids)).numpy(),
+                                   want_logits, rtol=2e-5, atol=1e-5)
+        out = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                             max_cache_len=32).numpy()
+        assert out.shape == base_out.shape
+        assert not np.array_equal(out, base_out), \
+            "adapters had no effect after unwrap (delta lost?)"
+
+    def test_state_dict_roundtrip_and_guards(self):
+        model = _llama()
+        apply_lora(model, rank=2)
+        sd = lora_state_dict(model)
+        assert all(".lora_" in k for k in sd)
+        m2 = _llama()
+        apply_lora(m2, rank=2)
+        load_lora_state_dict(m2, sd)
+        for k, v in lora_state_dict(m2).items():
+            np.testing.assert_array_equal(v, sd[k])
+        with pytest.raises(ValueError, match="no Linear sublayers"):
+            apply_lora(_llama(), targets=("nonexistent",))
+        with pytest.raises(ValueError, match="no LoRA layers"):
+            lora_parameters(_llama())
