@@ -249,3 +249,5 @@ from .decode_loop import (scan_decode, greedy_generate,  # noqa: E402,F401
                           sample_generate, process_logits)
 from .continuous_batching import ContinuousBatchingServer  # noqa: E402,F401
 from .speculative import speculative_generate  # noqa: E402,F401
+from .deploy_decode import (export_decode, load_decode,  # noqa: E402,F401
+                            DeployedGenerator)

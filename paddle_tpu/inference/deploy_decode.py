@@ -1,0 +1,123 @@
+"""Deployable generation: prefill + greedy-decode as StableHLO archives.
+
+The reference deploys LMs by `save_inference_model` + AnalysisPredictor
+driving the fused decode op per token. The TPU-native artifact is TWO
+`jax.export` archives with the weights baked as constants:
+
+- ``<prefix>.prefill``: ids [B, T] -> (first_token [B], KV caches)
+- ``<prefix>.decode``:  (first_token, caches) -> generated ids [B, N]
+  (the whole greedy loop as one serialized scan program)
+
+A serving process needs only these files and jax — no model code, no
+framework import. ``load_decode`` returns a generator handle.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["export_decode", "load_decode", "DeployedGenerator"]
+
+
+def export_decode(path_prefix, model, prompt_len, max_new_tokens,
+                  batch=1, max_cache_len=None, eos_token_id=None,
+                  weight_dtype=None):
+    """Serialize this model's generation pipeline at fixed shapes
+    (``batch`` x ``prompt_len`` prompts, ``max_new_tokens`` outputs —
+    static shapes are the deployment contract, like the reference's
+    baked feed shapes). Returns the two archive paths."""
+    from jax import export as jax_export
+
+    if max_cache_len is None:
+        max_cache_len = prompt_len + max_new_tokens
+    bundle = model._decode_bundle(max_cache_len, weight_dtype)
+    init_caches, embed_fn, step_fn, head_fn, _ = bundle
+
+    def prefill(ids):
+        x0 = model._prefill_embed(ids, bundle)
+        out, caches = step_fn(x0, init_caches(batch), jnp.int32(0))
+        first = jnp.argmax(head_fn(out[:, -1:])[:, -1], -1)
+        return first.astype(jnp.int32), caches
+
+    def decode(first, caches):
+        def body(carry, _):
+            tok, cs, t, done = carry
+            x = embed_fn(tok, t)
+            out, cs2 = step_fn(x, cs, t)
+            logits = head_fn(out)
+            if logits.ndim == 3:
+                logits = logits[:, -1]
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+                done = done | (nxt == eos_token_id)
+            return (nxt, cs2, t + 1, done), tok
+
+        carry = (first, caches, jnp.int32(prompt_len),
+                 jnp.zeros((batch,), bool))
+        _, toks = jax.lax.scan(body, carry, None, length=max_new_tokens)
+        return jnp.transpose(toks, (1, 0))
+
+    ids_aval = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
+    first_aval, caches_aval = jax.eval_shape(prefill, ids_aval)
+
+    def _export(fn, avals):
+        jitted = jax.jit(fn)
+        try:
+            return jax_export.export(jitted, platforms=("cpu", "tpu"))(
+                *avals)
+        except Exception:
+            return jax_export.export(jitted)(*avals)
+
+    os.makedirs(os.path.dirname(os.path.abspath(path_prefix)),
+                exist_ok=True)
+    paths = []
+    for name, fn, avals in (("prefill", prefill, (ids_aval,)),
+                            ("decode", decode,
+                             (first_aval, caches_aval))):
+        exp = _export(fn, avals)
+        path = f"{path_prefix}.{name}"
+        with open(path, "wb") as f:
+            f.write(exp.serialize())
+        paths.append(path)
+    with open(path_prefix + ".genmeta", "w") as f:
+        json.dump({"format": "paddle_tpu-decode-v1",
+                   "batch": batch, "prompt_len": prompt_len,
+                   "max_new_tokens": max_new_tokens,
+                   "max_cache_len": max_cache_len,
+                   "eos_token_id": eos_token_id,
+                   "weight_dtype": weight_dtype}, f)
+    return tuple(paths)
+
+
+class DeployedGenerator:
+    """Runs a ``export_decode`` artifact: ids [B, T] -> [B, T + N]."""
+
+    def __init__(self, path_prefix):
+        from jax import export as jax_export
+        with open(path_prefix + ".genmeta") as f:
+            self.meta = json.load(f)
+        with open(path_prefix + ".prefill", "rb") as f:
+            self._prefill = jax_export.deserialize(f.read())
+        with open(path_prefix + ".decode", "rb") as f:
+            self._decode = jax_export.deserialize(f.read())
+
+    def generate(self, input_ids):
+        ids = np.asarray(input_ids).astype(np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        B, T = ids.shape
+        if (B, T) != (self.meta["batch"], self.meta["prompt_len"]):
+            raise ValueError(
+                f"archive serves shape ({self.meta['batch']}, "
+                f"{self.meta['prompt_len']}), got ({B}, {T}) — export "
+                f"per served shape (static-shape deployment contract)")
+        first, caches = self._prefill.call(jnp.asarray(ids))
+        new_ids = self._decode.call(first, caches)
+        return np.concatenate([ids, np.asarray(new_ids)], axis=1)
+
+
+def load_decode(path_prefix):
+    return DeployedGenerator(path_prefix)

@@ -1,0 +1,43 @@
+"""export_decode/load_decode: the serialized prefill+decode archives must
+reproduce model.generate() without any model code at load time."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference.deploy_decode import export_decode, load_decode
+
+
+class TestDeployDecode:
+    def test_roundtrip_matches_generate(self, tmp_path):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(95)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(20)
+        ids = rng.integers(0, 256, (2, 5)).astype(np.int32)
+        prefix = str(tmp_path / "llama_gen")
+        paths = export_decode(prefix, model, prompt_len=5,
+                              max_new_tokens=6, batch=2)
+        assert all(str(tmp_path) in p for p in paths)
+
+        want = model.generate(pt.to_tensor(ids), max_new_tokens=6,
+                              max_cache_len=11).numpy()
+        gen = load_decode(prefix)
+        got = gen.generate(ids)
+        np.testing.assert_array_equal(got, want)
+
+    def test_shape_contract_enforced(self, tmp_path):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        pt.seed(96)
+        model = GPTForCausalLM(gpt2_tiny())
+        model.eval()
+        prefix = str(tmp_path / "gpt_gen")
+        export_decode(prefix, model, prompt_len=4, max_new_tokens=3,
+                      batch=1)
+        gen = load_decode(prefix)
+        out = gen.generate(np.zeros((1, 4), np.int32))
+        assert out.shape == (1, 7)
+        with pytest.raises(ValueError, match="archive serves shape"):
+            gen.generate(np.zeros((2, 4), np.int32))
+        with pytest.raises(ValueError, match="archive serves shape"):
+            gen.generate(np.zeros((1, 6), np.int32))
