@@ -33,13 +33,39 @@ def main():
     print("int8   :", int8.numpy()[0, 6:].tolist())
 
     srv = ContinuousBatchingServer(model, max_slots=2, max_cache_len=64)
-    rids = [srv.submit(rng.integers(0, 256, (n,)).astype(np.int32),
-                       max_new_tokens=8) for n in (4, 7, 5)]
+    srv.register_prefix(prompt[0])            # shared system-prompt rows
+    reqs = [rng.integers(0, 256, (n,)).astype(np.int32) for n in (4, 7)]
+    # third request extends the registered prefix -> prefills only its tail
+    reqs.append(np.concatenate([prompt[0],
+                                rng.integers(0, 256, (3,)).astype(np.int32)]))
+    rids = [srv.submit(r, max_new_tokens=8) for r in reqs]
     outs = srv.run()
     for rid in rids:
         print(f"server request {rid}:", outs[rid].tolist())
-    # parity: request 0 re-run solo
-    print("continuous batching returned", len(outs), "results")
+    print("continuous batching returned", len(outs), "results;",
+          srv.stats)
+
+    # speculative decoding: the model drafts for itself (gamma accepted
+    # every round); a smaller model would draft in practice
+    from paddle_tpu.inference import speculative_generate
+    spec, stats = speculative_generate(model, model, pt.to_tensor(prompt),
+                                       max_new_tokens=12, gamma=4,
+                                       max_cache_len=64,
+                                       return_stats=True)
+    assert (spec.numpy() == greedy.numpy()).all()
+    print(f"speculative == greedy in {stats['rounds']} target forwards "
+          f"(mean accepted {stats['mean_accepted']:.1f})")
+
+    # deployment: serialize prefill+decode, reload without model code
+    import tempfile
+    from paddle_tpu.inference import export_decode, load_decode
+    with tempfile.TemporaryDirectory() as d:
+        export_decode(f"{d}/gen", model, prompt_len=6, max_new_tokens=12,
+                      batch=1, max_cache_len=64)
+        deployed = load_decode(f"{d}/gen")
+        out = deployed.generate(prompt)
+        assert (out == greedy.numpy()).all()
+        print("deployed archives reproduce generate():", out[0, 6:].tolist())
 
 
 if __name__ == "__main__":

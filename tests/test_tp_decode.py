@@ -74,6 +74,28 @@ class TestTPDecode:
                              mesh=_mesh(4))
         np.testing.assert_array_equal(got.numpy(), want.numpy())
 
+    def test_server_over_mesh(self):
+        """Continuous batching with TP-sharded weights: same tokens."""
+        from paddle_tpu.inference import ContinuousBatchingServer
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(76)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(18)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (4, 6)]
+        want = {}
+        for i, p in enumerate(prompts):
+            want[i] = model.generate(pt.to_tensor(p[None]),
+                                     max_new_tokens=5,
+                                     max_cache_len=64).numpy()[0, len(p):]
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64, mesh=_mesh(4))
+        rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        outs = srv.run()
+        for i, rid in enumerate(rids):
+            np.testing.assert_array_equal(outs[rid], want[i])
+
     def test_indivisible_dims_fall_back_to_replicated(self):
         """llama_tiny kv heads (2) aren't divisible by 8; an 8-way mesh
         must still produce correct tokens (indivisible weights stay
