@@ -88,6 +88,7 @@ METRICS = {
     "llama1b3": "llama_1b3_train_tokens_per_sec_per_chip",
     "llama2b7": "llama_2b7_train_tokens_per_sec_per_chip",
     "decode": "gpt2_345m_decode_tokens_per_sec",
+    "serve": "gpt2_345m_serve_tokens_per_sec",
 }
 
 
@@ -515,6 +516,87 @@ def main_decode():
           f"| HBM util {bw_util:.2f}", file=sys.stderr)
 
 
+def main_serve():
+    """Continuous-batching server throughput (VERDICT r5 #7 follow-on):
+    GPT-2 345M through inference.ContinuousBatchingServer — 16 requests
+    (prompt 256, 128 new tokens each) over 8 slots, chunked prefill,
+    tick_block=16 so each host dispatch runs 16 batched decode steps on
+    device. Value = generated tokens/s; vs_baseline = HBM-bandwidth
+    utilization of the decode phase (weights stream once per step for
+    the whole slot batch).
+    """
+    import os
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.core.tensor import unwrap
+    from paddle_tpu.inference import ContinuousBatchingServer
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt2_345m
+
+    dims = os.environ.get("PT_BENCH_SERVE_DIMS")   # "H,L,NH,V" smoke
+    slots = int(os.environ.get("PT_BENCH_SERVE_SLOTS", "8"))
+    n_req = int(os.environ.get("PT_BENCH_SERVE_REQS", "16"))
+    t_pre = int(os.environ.get("PT_BENCH_SERVE_PROMPT", "256"))
+    t_new = int(os.environ.get("PT_BENCH_SERVE_NEW", "128"))
+    tick = int(os.environ.get("PT_BENCH_SERVE_TICK", "16"))
+
+    devices = _devices_with_retry()
+    dev = devices[0]
+    cpu = _cpu_device_or_none()
+    import contextlib
+    with (jax.default_device(cpu) if cpu is not None
+          else contextlib.nullcontext()):
+        if dims:
+            H, L, NH, V = (int(x) for x in dims.split(","))
+            cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,
+                            num_heads=NH, max_seq_len=t_pre + t_new)
+        else:
+            cfg = gpt2_345m(dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        model.astype("bfloat16")
+    n_params = 0
+    for _, prm in model.named_parameters():
+        v = unwrap(prm)
+        n_params += int(np.prod(v.shape))
+        prm._replace_value(jax.device_put(v, dev))
+    for _, buf in model.named_buffers():
+        buf._replace_value(jax.device_put(unwrap(buf), dev))
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (t_pre,)).astype(np.int32)
+               for _ in range(n_req)]
+    max_cache = min(cfg.max_seq_len, t_pre + t_new)
+
+    srv = ContinuousBatchingServer(
+        model, max_slots=slots, max_cache_len=max_cache,
+        prefill_chunk=t_pre, tick_block=tick)
+
+    def run_batch():
+        for p in prompts:
+            srv.submit(p, max_new_tokens=t_new)
+        t0 = time.perf_counter()
+        outs = srv.run()
+        dt = time.perf_counter() - t0
+        total = sum(len(v) for v in outs.values())
+        return total, dt
+
+    run_batch()                    # warmup/compile (same server: the
+    total, dt = run_batch()        # timed run reuses every program)
+    toks = total / dt
+    bw_util = (toks / slots) * 2.0 * n_params / peak_hbm_bw()
+    print(json.dumps({
+        "metric": METRICS["serve"],
+        "value": round(toks, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(bw_util, 4),
+    }))
+    print(f"  serve: {n_req} reqs x {t_new} new @ prompt {t_pre}, "
+          f"{slots} slots, tick_block={tick}: {toks:,.0f} tok/s "
+          f"({dt:.2f}s) | params {n_params/1e6:.0f}M | HBM util "
+          f"{bw_util:.2f}", file=sys.stderr)
+
+
 def main(config_name="gpt2"):
     import os
     if os.environ.get("PT_BENCH_FORCE_CPU"):
@@ -545,6 +627,8 @@ def main(config_name="gpt2"):
         return main_llama1b3(config_name)
     if config_name == "decode":
         return main_decode()
+    if config_name == "serve":
+        return main_serve()
 
     import jax
     import jax.numpy as jnp
@@ -670,7 +754,8 @@ def main(config_name="gpt2"):
 if __name__ == "__main__":
     _argv = sys.argv[1:]
     _cfg = "gpt2"
-    for _name in ("llama350m", "moe", "llama1b3", "llama2b7", "decode"):
+    for _name in ("llama350m", "moe", "llama1b3", "llama2b7", "decode",
+                  "serve"):
         if f"--config={_name}" in _argv or _name in _argv:
             _cfg = _name
     main(_cfg)

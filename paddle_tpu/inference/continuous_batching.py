@@ -50,7 +50,7 @@ class ContinuousBatchingServer:
     def __init__(self, model, max_slots=4, max_cache_len=256,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=0, weight_dtype=None,
-                 prefill_chunk=None, mesh=None):
+                 prefill_chunk=None, mesh=None, tick_block=1):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -66,6 +66,7 @@ class ContinuousBatchingServer:
         (self._init_caches, self._embed_fn, self._step_fn,
          self._head_fn, self._prefill_jit) = self._bundle
         self._prefill_chunk = prefill_chunk
+        self.tick_block = max(1, int(tick_block))
 
         self._caches = self._init_caches(self.max_slots)
         self._tok = jnp.zeros((self.max_slots,), jnp.int32)
@@ -188,13 +189,21 @@ class ContinuousBatchingServer:
 
     # ------------------------------------------------------------ steps
     def _build_decode_step(self):
+        """One jitted program running ``tick_block`` decode steps per
+        host dispatch (lax.scan; emits the [slots, n] token matrix).
+        Larger blocks amortize dispatch (the measured relay cost is
+        ~8.6 ms/dispatch vs sub-ms chip work) at the price of admission
+        latency and ≤n-1 wasted steps on slots that finish mid-block —
+        wasted rows write out of bounds (dropped) or above the frontier
+        (masked), never corrupting live slots."""
         embed_p, step_p, head_p = (self._embed_fn, self._step_fn,
                                    self._head_fn)
         do_sample = self.do_sample
         temperature, top_k, top_p = (self._temperature, self._top_k,
                                      self._top_p)
+        n = self.tick_block
 
-        def step(tok, caches, t, keys):
+        def one(tok, caches, t, keys):
             x = embed_p(tok, t)
             out, caches = step_p(x, caches, t)
             logits = head_p(out)
@@ -217,11 +226,19 @@ class ContinuousBatchingServer:
                 nxt = jnp.argmax(logits, -1).astype(jnp.int32)
             return nxt, caches, t + 1, keys
 
-        return jax.jit(step, donate_argnums=(1,))
+        def block(tok, caches, t, keys):
+            def body(carry, _):
+                carry = one(*carry)
+                return carry, carry[0]
+            (tok, caches, t, keys), toks = jax.lax.scan(
+                body, (tok, caches, t, keys), None, length=n)
+            return tok, caches, t, keys, jnp.transpose(toks, (1, 0))
+
+        return jax.jit(block, donate_argnums=(1,))
 
     def step(self):
-        """One server tick: admit waiting requests, run ONE batched
-        decode step for every active slot, harvest finished rows.
+        """One server tick: admit waiting requests, run ``tick_block``
+        batched decode steps as one program, harvest finished rows.
         Returns the number of active slots after the tick."""
         self._admit()
         if not self._active.any():
@@ -233,12 +250,18 @@ class ContinuousBatchingServer:
             return 0
         if self._decode_jit is None:
             self._decode_jit = self._build_decode_step()
-        self._tok, self._caches, self._t, self._keys = self._decode_jit(
-            self._tok, self._caches, self._t, self._keys)
-        toks = np.asarray(self._tok)
+        (self._tok, self._caches, self._t, self._keys,
+         toks) = self._decode_jit(self._tok, self._caches, self._t,
+                                  self._keys)
+        toks = np.asarray(toks)                    # [slots, tick_block]
         for slot in range(self.max_slots):
-            if self._active[slot]:
-                self._slots[slot].emitted.append(int(toks[slot]))
+            if not self._active[slot]:
+                continue
+            st = self._slots[slot]
+            for j in range(toks.shape[1]):
+                st.emitted.append(int(toks[slot, j]))
+                if self._finished(st):
+                    break              # later block tokens are waste
         self._harvest()
         self._admit()
         return int(self._active.sum())

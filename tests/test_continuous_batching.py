@@ -83,6 +83,50 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="pad rows"):
             srv2.submit(np.zeros((13,), np.int32), max_new_tokens=3)
 
+    def test_tick_block_parity_greedy_and_sampled(self):
+        """tick_block=4 (four decode steps per dispatch) changes neither
+        greedy nor sampled tokens vs tick_block=1/solo."""
+        model = _model()
+        rng = np.random.default_rng(6)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (4, 6, 5)]
+        for kw in (dict(), dict(do_sample=True, temperature=1.3,
+                                top_k=9)):
+            srv = ContinuousBatchingServer(model, max_slots=2,
+                                           max_cache_len=64,
+                                           tick_block=4, **kw)
+            rids = [srv.submit(p, max_new_tokens=7, seed=200 + i)
+                    for i, p in enumerate(prompts)]
+            outs = srv.run()
+            for i, (rid, p) in enumerate(zip(rids, prompts)):
+                want = model.generate(
+                    pt.to_tensor(p[None]), max_new_tokens=7,
+                    seed=200 + i, max_cache_len=64,
+                    **kw).numpy()[0, len(p):]
+                np.testing.assert_array_equal(outs[rid], want)
+
+    def test_tick_block_eos_mid_block(self):
+        """A slot hitting eos inside a block stops there; trailing block
+        tokens are discarded and the slot refills."""
+        model = _model()
+        rng = np.random.default_rng(7)
+        p = rng.integers(0, 256, (4,)).astype(np.int32)
+        solo = _solo(model, p, 8)
+        # eos = a token whose FIRST occurrence is mid-sequence
+        eos, cut = None, None
+        for j in range(1, len(solo)):
+            if solo[j] not in solo[:j]:
+                eos, cut = int(solo[j]), j
+        assert eos is not None, "degenerate sequence; change seed"
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=64,
+                                       eos_token_id=eos, tick_block=5)
+        rid = srv.submit(p, max_new_tokens=8)
+        rid2 = srv.submit(p, max_new_tokens=8)   # refills the same slot
+        outs = srv.run()
+        np.testing.assert_array_equal(outs[rid], solo[:cut + 1])
+        np.testing.assert_array_equal(outs[rid2], solo[:cut + 1])
+
     def test_sampled_requests_match_solo_generate(self):
         """Per-request PRNG chains: submit(seed=s) draws exactly what a
         solo generate(do_sample=True, seed=s) draws, even with both
