@@ -189,6 +189,31 @@ class TestContinuousBatching:
         want = _solo(model, prefix, 5)
         np.testing.assert_array_equal(out, want)
 
+    def test_mixtral_and_int8_through_server(self):
+        """The server is model-agnostic: MoE decode and weight-only int8
+        both serve with solo-parity."""
+        from paddle_tpu.models.mixtral import (MixtralForCausalLM,
+                                               mixtral_tiny)
+        pt.seed(24)
+        moe = MixtralForCausalLM(mixtral_tiny())
+        moe.eval()
+        rng = np.random.default_rng(8)
+        p = rng.integers(0, 256, (5,)).astype(np.int32)
+        want = moe.generate(pt.to_tensor(p[None]), max_new_tokens=4,
+                            max_cache_len=64).numpy()[0, 5:]
+        srv = ContinuousBatchingServer(moe, max_slots=2, max_cache_len=64)
+        rid = srv.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(srv.run()[rid], want)
+
+        lm = _model()
+        want8 = lm.generate(pt.to_tensor(p[None]), max_new_tokens=4,
+                            max_cache_len=64,
+                            weight_dtype="int8").numpy()[0, 5:]
+        srv8 = ContinuousBatchingServer(lm, max_slots=1, max_cache_len=64,
+                                        weight_dtype="int8")
+        rid = srv8.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(srv8.run()[rid], want8)
+
     def test_gpt_greedy_parity_through_server(self):
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
         pt.seed(22)
