@@ -165,6 +165,31 @@ def _write_cache(cache, kv, t):
     return cache.at[rows, cols].set(kv)
 
 
+def _rope_gqa_attn(blk, xx, kc, vc, t, pos, dims, tables, eps):
+    """Shared llama-family attention sublayer for the decode scan:
+    pre-RMSNorm, rope at absolute positions, GQA cache write + masked
+    cached attention, output projection + residual. Returns
+    (xx, kc, vc, h2) with h2 = the post-attention norm for the FFN."""
+    b, s, nh, kvh, hd, scale = dims
+    cos, sin = tables
+    from ..ops.pallas import rope as rope_mod
+    h = _rms(xx, blk["ln1"], eps)
+    q = _mm(h, blk["wq"]).reshape(b, s, nh, hd)
+    k = _mm(h, blk["wk"]).reshape(b, s, kvh, hd)
+    v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
+    q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
+    k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
+    kc = _write_cache(kc, k, t)
+    vc = _write_cache(vc, v, t)
+    rep = nh // kvh
+    kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+    vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+    att = _cached_attend(q, kk, vv, t, s, scale)
+    xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
+    h2 = _rms(xx, blk["ln2"], eps)
+    return xx, kc, vc, h2
+
+
 def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     """(init_caches, embed_fn, step_fn, head_fn) for LlamaForCausalLM —
     GQA-aware (kv heads cached unrepeated), rope applied at absolute
@@ -217,20 +242,9 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
 
         def layer(xx, xs):
             blk, kc, vc = xs
-            h = _rms(xx, blk["ln1"], eps)
-            q = _mm(h, blk["wq"]).reshape(b, s, nh, hd)
-            k = _mm(h, blk["wk"]).reshape(b, s, kvh, hd)
-            v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
-            q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
-            k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
-            kc = _write_cache(kc, k, t)
-            vc = _write_cache(vc, v, t)
-            rep = nh // kvh
-            kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
-            vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
-            att = _cached_attend(q, kk, vv, t, s, scale)
-            xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
-            h2 = _rms(xx, blk["ln2"], eps)
+            xx, kc, vc, h2 = _rope_gqa_attn(
+                blk, xx, kc, vc, t, pos, (b, s, nh, kvh, hd, scale),
+                (cos, sin), eps)
             xx = xx + _mm(jax.nn.silu(_mm(h2, blk["wg"]))
                           * _mm(h2, blk["wu"]), blk["wd"])
             return xx, (kc, vc)
@@ -329,20 +343,9 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None)
 
         def layer(xx, xs):
             blk, kc, vc = xs
-            h = _rms(xx, blk["ln1"], eps)
-            q = _mm(h, blk["wq"]).reshape(b, s, nh, hd)
-            k = _mm(h, blk["wk"]).reshape(b, s, kvh, hd)
-            v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
-            q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
-            k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
-            kc = _write_cache(kc, k, t)
-            vc = _write_cache(vc, v, t)
-            rep = nh // kvh
-            kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
-            vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
-            att = _cached_attend(q, kk, vv, t, s, scale)
-            xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
-            h2 = _rms(xx, blk["ln2"], eps)
+            xx, kc, vc, h2 = _rope_gqa_attn(
+                blk, xx, kc, vc, t, pos, (b, s, nh, kvh, hd, scale),
+                (cos, sin), eps)
             xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
                                     blk["wu"], blk["wd"], top_k)
             return xx, (kc, vc)
