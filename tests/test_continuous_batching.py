@@ -214,6 +214,30 @@ class TestContinuousBatching:
         rid = srv8.submit(p, max_new_tokens=4)
         np.testing.assert_array_equal(srv8.run()[rid], want8)
 
+    def test_everything_composed(self):
+        """Kitchen sink: prefix cache + chunked prefill + tick_block +
+        weight-only int8, all at once — still solo-parity."""
+        model = _model()
+        rng = np.random.default_rng(9)
+        prefix = rng.integers(0, 256, (8,)).astype(np.int32)
+        tails = [rng.integers(0, 256, (n,)).astype(np.int32)
+                 for n in (3, 6)]
+        prompts = [np.concatenate([prefix, t]) for t in tails] + \
+                  [rng.integers(0, 256, (5,)).astype(np.int32)]
+        srv = ContinuousBatchingServer(
+            model, max_slots=2, max_cache_len=64, weight_dtype="int8",
+            prefill_chunk=4, tick_block=3)
+        srv.register_prefix(prefix)
+        rids = [srv.submit(p, max_new_tokens=6) for p in prompts]
+        outs = srv.run()
+        for rid, p in zip(rids, prompts):
+            want = model.generate(pt.to_tensor(p[None]),
+                                  max_new_tokens=6, max_cache_len=64,
+                                  weight_dtype="int8",
+                                  prefill_chunk=4).numpy()[0, len(p):]
+            np.testing.assert_array_equal(outs[rid], want)
+        assert srv.stats["prefix_hit_tokens"] == 16
+
     def test_gpt_greedy_parity_through_server(self):
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
         pt.seed(22)
