@@ -50,7 +50,8 @@ class ContinuousBatchingServer:
     def __init__(self, model, max_slots=4, max_cache_len=256,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=0, weight_dtype=None,
-                 prefill_chunk=None, mesh=None, tick_block=1):
+                 prefill_chunk=None, mesh=None, tick_block=1,
+                 cache_dtype=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -62,7 +63,7 @@ class ContinuousBatchingServer:
         self._seed = int(seed)
         self._keys = jnp.zeros((int(max_slots), 2), jnp.uint32)
         self._bundle = model._decode_bundle(max_cache_len, weight_dtype,
-                                            mesh)
+                                            mesh, cache_dtype)
         (self._init_caches, self._embed_fn, self._step_fn,
          self._head_fn, self._prefill_jit) = self._bundle
         self._prefill_chunk = prefill_chunk

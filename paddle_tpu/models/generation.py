@@ -165,11 +165,50 @@ def _write_cache(cache, kv, t):
     return cache.at[rows, cols].set(kv)
 
 
-def _rope_gqa_attn(blk, xx, kc, vc, t, pos, dims, tables, eps):
+def _kv_write(lc, name, kv, t):
+    """Write new k/v rows into this layer's cache dict. With an int8
+    cache (a ``<name>s`` scale entry present) the rows are quantized
+    per (batch, position, head): amax/127 scale, int8 payload — half
+    the cache bytes decode streams every step (its roofline)."""
+    if name + "s" in lc:
+        amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), -1) + 1e-8
+        sc = (amax / 127.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(kv.astype(jnp.float32) / sc[..., None]),
+                     -127, 127).astype(jnp.int8)
+        return dict(lc, **{name: _write_cache(lc[name], q, t),
+                           name + "s": _write_cache(lc[name + "s"], sc,
+                                                    t)})
+    return dict(lc, **{name: _write_cache(lc[name], kv, t)})
+
+
+def _kv_read(lc, name, dtype):
+    """Full cache view [B,T,h,hd] in compute dtype (dequantized if the
+    cache is int8 — the cast+scale fuses into the attention einsum)."""
+    c = lc[name]
+    if name + "s" in lc:
+        return c.astype(dtype) * lc[name + "s"].astype(dtype)[..., None]
+    return c
+
+
+def _init_kv(shape, dtype, cache_dtype):
+    lc = {}
+    if cache_dtype == "int8":
+        lc["k"] = jnp.zeros(shape, jnp.int8)
+        lc["v"] = jnp.zeros(shape, jnp.int8)
+        lc["ks"] = jnp.zeros(shape[:-1], jnp.float32)
+        lc["vs"] = jnp.zeros(shape[:-1], jnp.float32)
+    else:
+        lc["k"] = jnp.zeros(shape, dtype)
+        lc["v"] = jnp.zeros(shape, dtype)
+    return lc
+
+
+def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps):
     """Shared llama-family attention sublayer for the decode scan:
     pre-RMSNorm, rope at absolute positions, GQA cache write + masked
-    cached attention, output projection + residual. Returns
-    (xx, kc, vc, h2) with h2 = the post-attention norm for the FFN."""
+    cached attention, output projection + residual. ``lc`` is this
+    layer's cache dict (fp or int8 codec). Returns (xx, lc, h2) with
+    h2 = the post-attention norm for the FFN."""
     b, s, nh, kvh, hd, scale = dims
     cos, sin = tables
     from ..ops.pallas import rope as rope_mod
@@ -179,18 +218,21 @@ def _rope_gqa_attn(blk, xx, kc, vc, t, pos, dims, tables, eps):
     v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
     q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
     k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
-    kc = _write_cache(kc, k, t)
-    vc = _write_cache(vc, v, t)
+    lc = _kv_write(lc, "k", k, t)
+    lc = _kv_write(lc, "v", v, t)
+    kc = _kv_read(lc, "k", q.dtype)
+    vc = _kv_read(lc, "v", q.dtype)
     rep = nh // kvh
     kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
     vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
     att = _cached_attend(q, kk, vv, t, s, scale)
     xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
     h2 = _rms(xx, blk["ln2"], eps)
-    return xx, kc, vc, h2
+    return xx, lc, h2
 
 
-def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
+def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
+                cache_dtype=None):
     """(init_caches, embed_fn, step_fn, head_fn) for LlamaForCausalLM —
     GQA-aware (kv heads cached unrepeated), rope applied at absolute
     positions."""
@@ -226,8 +268,8 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     scale = 1.0 / np.sqrt(hd)
 
     def init_caches(batch):
-        shape = (L, batch, max_cache_len, kvh, hd)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        return _init_kv((L, batch, max_cache_len, kvh, hd), dtype,
+                        cache_dtype)
 
     if mesh is not None:
         init_caches = _mesh_caches(init_caches, mesh)
@@ -241,19 +283,18 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
         pos = _positions(t, b, s)                         # [B, s]
 
         def layer(xx, xs):
-            blk, kc, vc = xs
-            xx, kc, vc, h2 = _rope_gqa_attn(
-                blk, xx, kc, vc, t, pos, (b, s, nh, kvh, hd, scale),
+            blk, lc = xs
+            xx, lc, h2 = _rope_gqa_attn(
+                blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
                 (cos, sin), eps)
             xx = xx + _mm(jax.nn.silu(_mm(h2, blk["wg"]))
                           * _mm(h2, blk["wu"]), blk["wd"])
-            return xx, (kc, vc)
+            return xx, lc
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
                     if k_ not in ("table", "norm", "head")}
-        x, (kcs, vcs) = jax.lax.scan(
-            layer, x, (blk_tree, caches["k"], caches["v"]))
-        return x, {"k": kcs, "v": vcs}
+        x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
+        return x, new_caches
 
     def head_fn(out):
         return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
@@ -291,7 +332,8 @@ def _moe_topk_ffn(h, router_w, wg, wu, wd, top_k):
     return jnp.einsum("bse,besh->bsh", w.astype(o.dtype), o)
 
 
-def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
+def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
+                  cache_dtype=None):
     """Llama-style attention + routed-expert FFN (MixtralForCausalLM)."""
     from ..ops.pallas import rope as rope_mod
     cfg = model.cfg
@@ -327,8 +369,8 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None)
     scale = 1.0 / np.sqrt(hd)
 
     def init_caches(batch):
-        shape = (L, batch, max_cache_len, kvh, hd)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        return _init_kv((L, batch, max_cache_len, kvh, hd), dtype,
+                        cache_dtype)
 
     if mesh is not None:
         init_caches = _mesh_caches(init_caches, mesh)
@@ -342,19 +384,18 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None)
         pos = _positions(t, b, s)
 
         def layer(xx, xs):
-            blk, kc, vc = xs
-            xx, kc, vc, h2 = _rope_gqa_attn(
-                blk, xx, kc, vc, t, pos, (b, s, nh, kvh, hd, scale),
+            blk, lc = xs
+            xx, lc, h2 = _rope_gqa_attn(
+                blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
                 (cos, sin), eps)
             xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
                                     blk["wu"], blk["wd"], top_k)
-            return xx, (kc, vc)
+            return xx, lc
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
                     if k_ not in ("table", "norm", "head")}
-        x, (kcs, vcs) = jax.lax.scan(
-            layer, x, (blk_tree, caches["k"], caches["v"]))
-        return x, {"k": kcs, "v": vcs}
+        x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
+        return x, new_caches
 
     def head_fn(out):
         return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
@@ -363,7 +404,8 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None)
     return init_caches, embed_fn, step_fn, head_fn
 
 
-def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
+def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
+              cache_dtype=None):
     """(init_caches, embed_fn, step_fn, head_fn) for GPTForCausalLM —
     learned positions, fused qkv, tied lm head."""
     cfg = model.cfg
@@ -399,8 +441,8 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
     scale = 1.0 / np.sqrt(hd)
 
     def init_caches(batch):
-        shape = (L, batch, max_cache_len, nh, hd)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        return _init_kv((L, batch, max_cache_len, nh, hd), dtype,
+                        cache_dtype)
 
     if mesh is not None:
         init_caches = _mesh_caches(init_caches, mesh)
@@ -416,14 +458,15 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
         b, s = x.shape[0], x.shape[1]
 
         def layer(xx, xs):
-            blk, kc, vc = xs
+            blk, lc = xs
             h = _ln(xx, blk["ln1.weight"], blk["ln1.bias"], eps)
             qkv = (_mm(h, blk["attn.qkv.weight"]) + blk["attn.qkv.bias"]
                    ).reshape(b, s, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            kc = _write_cache(kc, k, t)
-            vc = _write_cache(vc, v, t)
-            att = _cached_attend(q, kc, vc, t, s, scale)
+            lc = _kv_write(lc, "k", k, t)
+            lc = _kv_write(lc, "v", v, t)
+            att = _cached_attend(q, _kv_read(lc, "k", q.dtype),
+                                 _kv_read(lc, "v", q.dtype), t, s, scale)
             xx = xx + (_mm(att.reshape(b, s, nh * hd),
                            blk["attn.proj.weight"])
                        + blk["attn.proj.bias"])
@@ -431,13 +474,12 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None):
             ff = jax.nn.gelu(_mm(h2, blk["mlp.fc1.weight"])
                              + blk["mlp.fc1.bias"], approximate=True)
             xx = xx + _mm(ff, blk["mlp.fc2.weight"]) + blk["mlp.fc2.bias"]
-            return xx, (kc, vc)
+            return xx, lc
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
                     if k_ not in ("table", "wpe", "lnf_w", "lnf_b")}
-        x, (kcs, vcs) = jax.lax.scan(
-            layer, x, (blk_tree, caches["k"], caches["v"]))
-        return x, {"k": kcs, "v": vcs}
+        x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
+        return x, new_caches
 
     def head_fn(out):
         h = _ln(unwrap(out), p["lnf_w"], p["lnf_b"], eps)
@@ -450,9 +492,10 @@ class GenerationMixin:
     """``generate()`` for causal-LM models (greedy + sampling), running
     prefill and the whole decode loop as on-device XLA programs."""
 
-    def _decode_bundle(self, max_cache_len, weight_dtype=None, mesh=None):
+    def _decode_bundle(self, max_cache_len, weight_dtype=None, mesh=None,
+                       cache_dtype=None):
         key = ("_pt_decode_bundle", max_cache_len, weight_dtype,
-               None if mesh is None else id(mesh))
+               None if mesh is None else id(mesh), cache_dtype)
         cached = getattr(self, "_pt_decode_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -461,13 +504,16 @@ class GenerationMixin:
         from .mixtral import MixtralForCausalLM
         if isinstance(self, MixtralForCausalLM):
             bundle = _make_mixtral_decode_fns(self, max_cache_len,
-                                              weight_dtype, mesh)
+                                              weight_dtype, mesh,
+                                              cache_dtype)
         elif isinstance(self, LlamaForCausalLM):
             bundle = _make_llama_decode_fns(self, max_cache_len,
-                                            weight_dtype, mesh)
+                                            weight_dtype, mesh,
+                                            cache_dtype)
         elif isinstance(self, GPTForCausalLM):
             bundle = _make_gpt_decode_fns(self, max_cache_len,
-                                          weight_dtype, mesh)
+                                          weight_dtype, mesh,
+                                          cache_dtype)
         else:
             raise NotImplementedError(
                 f"generate() not wired for {type(self).__name__}")
@@ -529,7 +575,7 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None, max_cache_len=None, weight_dtype=None,
-                 prefill_chunk=None, mesh=None):
+                 prefill_chunk=None, mesh=None, cache_dtype=None):
         """Generate continuations for ``input_ids`` ([B, T] int). Returns
         the FULL sequence (prompt + ``max_new_tokens``) as a framework
         tensor; after every row hits ``eos_token_id`` the tail is padded
@@ -561,7 +607,8 @@ class GenerationMixin:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_cache_len ({max_cache_len})")
-        bundle = self._decode_bundle(max_cache_len, weight_dtype, mesh)
+        bundle = self._decode_bundle(max_cache_len, weight_dtype, mesh,
+                                     cache_dtype)
         init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
 
         last_logits, caches = self._run_prefill(bundle, ids_np,

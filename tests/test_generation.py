@@ -276,6 +276,53 @@ class TestWeightOnlyInt8:
         np.testing.assert_array_equal(a.numpy(), c.numpy())
 
 
+class TestInt8KVCache:
+    def test_int8_kv_close_to_fp_and_actually_int8(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(43)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(19)
+        ids = rng.integers(0, 256, (2, 6)).astype(np.int32)
+
+        bfp = model._decode_bundle(32)
+        b8 = model._decode_bundle(32, cache_dtype="int8")
+        caches8 = b8[0](2)
+        assert caches8["k"].dtype == jnp.int8 and "ks" in caches8
+        x0 = model._prefill_embed(jnp.asarray(ids), None)
+        outf, _ = bfp[2](x0, bfp[0](2), jnp.int32(0))
+        out8, _ = b8[2](x0, b8[0](2), jnp.int32(0))
+        lf = np.asarray(bfp[3](outf[:, -1:]))
+        l8 = np.asarray(b8[3](out8[:, -1:]))
+        rel = np.abs(l8 - lf).max() / (np.abs(lf).max() + 1e-9)
+        assert rel < 0.05, f"int8 KV drift too large: {rel}"
+
+        out = model.generate(pt.to_tensor(ids), max_new_tokens=4,
+                             max_cache_len=32, cache_dtype="int8")
+        assert out.numpy().shape == (2, 10)
+
+    def test_int8_kv_through_server_parity(self):
+        from paddle_tpu.inference.continuous_batching import (
+            ContinuousBatchingServer)
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt2_tiny
+        pt.seed(44)
+        model = GPTForCausalLM(gpt2_tiny())
+        model.eval()
+        rng = np.random.default_rng(21)
+        p = rng.integers(0, model.cfg.vocab_size, (5,)).astype(np.int32)
+        want = model.generate(pt.to_tensor(p[None]), max_new_tokens=4,
+                              max_cache_len=32,
+                              cache_dtype="int8").numpy()[0, 5:]
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=32,
+                                       cache_dtype="int8")
+        rid = srv.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(srv.run()[rid], want)
+
+
 def test_process_logits_filters():
     import jax.numpy as jnp
 
