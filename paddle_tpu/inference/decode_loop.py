@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ..core.tensor import unwrap
 
 __all__ = ["scan_decode", "greedy_generate", "sample_generate",
-           "process_logits"]
+           "beam_generate", "process_logits"]
 
 
 def _pure(fn):
@@ -225,3 +225,91 @@ def sample_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
         lambda: jax.jit(run))
     return jit_run(unwrap(first_logits),
                    jax.tree_util.tree_map(unwrap, caches), t0, key)
+
+
+def beam_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
+                  max_new_tokens, num_beams, eos_token_id=None):
+    """Beam search as one on-device program (reference analogue:
+    nn.BeamSearchDecoder/dynamic_decode for RNN cells; this is the
+    KV-cache transformer version).
+
+    Beams ride the batch dimension: caches replicate to B*K rows, each
+    scan step scores K*V continuations per sequence, keeps the top K,
+    and REORDERS the cache rows by beam ancestry with a batched gather.
+    Finished beams (eos) are frozen by masking their expansion to the
+    eos token at zero log-prob. Returns (ids [B, max_new_tokens] of the
+    best beam, final scores [B, K]).
+
+    ``caches`` are the PREFILL caches at batch B (they are replicated
+    internally); ``first_logits`` [B, V] the last prefill position.
+    """
+    embed_p, step_p, head_p = _pure(embed_fn), _pure(step_fn), _pure(head_fn)
+    K = int(num_beams)
+
+    def run(first_logits, caches):
+        B, V = first_logits.shape
+        logp0 = jax.nn.log_softmax(
+            first_logits.astype(jnp.float32), -1)
+        k0 = min(K, V)        # only V first tokens exist; pad the rest
+        scores, tok = jax.lax.top_k(logp0, k0)         # [B, k0]
+        if k0 < K:
+            scores = jnp.concatenate(
+                [scores, jnp.full((B, K - k0), -jnp.inf)], axis=1)
+            tok = jnp.concatenate(
+                [tok, jnp.zeros((B, K - k0), tok.dtype)], axis=1)
+        tok = tok.astype(jnp.int32)
+        done = (tok == eos_token_id) if eos_token_id is not None \
+            else jnp.zeros((B, K), bool)
+        # replicate each sequence's cache rows K times -> batch B*K
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, K, axis=1), caches)
+        hist = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+        hist = hist.at[:, :, 0].set(tok)
+
+        def body(carry, step_i):
+            tok, cs, t, scores, done, hist = carry
+            x = embed_p(tok.reshape(B * K), t)
+            out, cs = step_p(x, cs, t)
+            logits = head_p(out)
+            if logits.ndim == 3:
+                logits = logits[:, -1]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            logp = logp.reshape(B, K, V)
+            if eos_token_id is not None:
+                # frozen beams may only "emit" eos at zero cost
+                frozen = jnp.full((V,), -jnp.inf).at[eos_token_id].set(0.0)
+                logp = jnp.where(done[:, :, None], frozen[None, None],
+                                 logp)
+            total = scores[:, :, None] + logp              # [B, K, V]
+            scores, flat_idx = jax.lax.top_k(
+                total.reshape(B, K * V), K)
+            beam_idx = (flat_idx // V).astype(jnp.int32)   # ancestor
+            tok = (flat_idx % V).astype(jnp.int32)
+            # reorder ancestry: cache rows, done flags, histories
+            rows = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+            cs = jax.tree_util.tree_map(lambda c: c[:, rows], cs)
+            done = jnp.take_along_axis(done, beam_idx, axis=1)
+            hist = jnp.take_along_axis(
+                hist, beam_idx[:, :, None], axis=1)
+            hist = jax.lax.dynamic_update_index_in_dim(
+                hist, tok, step_i, axis=2)
+            if eos_token_id is not None:
+                done = done | (tok == eos_token_id)
+            return (tok, cs, t + 1, scores, done, hist), None
+
+        carry = (tok, caches, jnp.asarray(t0, jnp.int32), scores, done,
+                 hist)
+        (tok, cs, t, scores, done, hist), _ = jax.lax.scan(
+            body, carry, jnp.arange(1, max_new_tokens))
+        best = jnp.argmax(scores, axis=1)                  # [B]
+        ids = jnp.take_along_axis(hist, best[:, None, None],
+                                  axis=1)[:, 0]
+        return ids, scores
+
+    jit_run = _cached_jit(
+        step_fn,
+        ("beam_generate", embed_fn, head_fn, max_new_tokens, K,
+         eos_token_id),
+        lambda: jax.jit(run))
+    return jit_run(unwrap(first_logits),
+                   jax.tree_util.tree_map(unwrap, caches))

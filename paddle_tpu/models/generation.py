@@ -575,7 +575,8 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None, max_cache_len=None, weight_dtype=None,
-                 prefill_chunk=None, mesh=None, cache_dtype=None):
+                 prefill_chunk=None, mesh=None, cache_dtype=None,
+                 num_beams=1):
         """Generate continuations for ``input_ids`` ([B, T] int). Returns
         the FULL sequence (prompt + ``max_new_tokens``) as a framework
         tensor; after every row hits ``eos_token_id`` the tail is padded
@@ -593,7 +594,9 @@ class GenerationMixin:
         weight bytes streamed per decode step (the serving roofline);
         embeddings, norms, routers and the lm head stay full precision.
         """
-        from ..inference.decode_loop import greedy_generate, sample_generate
+        from ..inference.decode_loop import (beam_generate,
+                                             greedy_generate,
+                                             sample_generate)
         ids_np = np.asarray(unwrap(input_ids))
         if ids_np.ndim == 1:
             ids_np = ids_np[None]
@@ -614,7 +617,14 @@ class GenerationMixin:
         last_logits, caches = self._run_prefill(bundle, ids_np,
                                                 chunk=prefill_chunk)
 
-        if do_sample:
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError("beam search and sampling are mutually "
+                                 "exclusive (reference decode semantics)")
+            new_ids, _ = beam_generate(
+                embed_fn, step_fn, head_fn, caches, last_logits, T,
+                max_new_tokens, num_beams, eos_token_id=eos_token_id)
+        elif do_sample:
             if seed is None:        # fresh entropy per call, like the
                 seed = int(np.random.randint(0, 2**31))  # reference's
             key = jax.random.PRNGKey(seed)               # global RNG

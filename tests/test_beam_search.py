@@ -1,0 +1,99 @@
+"""Beam search on the KV-cache decode (decode_loop.beam_generate):
+num_beams=1 equals greedy; a beam wide enough to be exhaustive finds the
+global maximum-likelihood sequence; eos freezes beams."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _tiny_vocab_model(V=6):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    pt.seed(97)
+    cfg = LlamaConfig(vocab_size=V, hidden_size=32, num_layers=1,
+                      num_heads=2, num_kv_heads=2, intermediate_size=64,
+                      max_seq_len=32)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+class TestBeamSearch:
+    def test_single_beam_equals_greedy(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(98)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        ids = np.arange(8, dtype=np.int32).reshape(2, 4)
+        greedy = model.generate(pt.to_tensor(ids), max_new_tokens=5,
+                                max_cache_len=32)
+        beam1 = model.generate(pt.to_tensor(ids), max_new_tokens=5,
+                               max_cache_len=32, num_beams=1)
+        np.testing.assert_array_equal(beam1.numpy(), greedy.numpy())
+
+    def test_exhaustive_beam_finds_global_optimum(self):
+        """V=6, 3 new tokens, num_beams=36 >= V^2: the beam holds every
+        depth-2 prefix, so it must return the argmax over all 216
+        completions scored by full-forward log-likelihood."""
+        model = _tiny_vocab_model(V=6)
+        V, NEW = 6, 3
+        rng = np.random.default_rng(22)
+        prompt = rng.integers(0, V, (1, 3)).astype(np.int32)
+
+        def seq_logprob(completion):
+            ids = np.concatenate([prompt[0], completion])[None]
+            logits = model(pt.to_tensor(ids.astype(np.int32))).numpy()[0]
+            logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+            total = 0.0
+            for j, tok in enumerate(completion):
+                total += logp[prompt.shape[1] - 1 + j, tok]
+            return total
+
+        best_score, best_seq = -np.inf, None
+        for a in range(V):
+            for b in range(V):
+                for c in range(V):
+                    sc = seq_logprob(np.array([a, b, c]))
+                    if sc > best_score:
+                        best_score, best_seq = sc, (a, b, c)
+
+        out = model.generate(pt.to_tensor(prompt), max_new_tokens=NEW,
+                             max_cache_len=16, num_beams=36).numpy()
+        assert tuple(out[0, 3:]) == best_seq, (
+            f"beam {tuple(out[0, 3:])} != brute-force {best_seq} "
+            f"(score {best_score:.4f})")
+
+    def test_beam_improves_or_matches_greedy_likelihood(self):
+        model = _tiny_vocab_model(V=16)
+        rng = np.random.default_rng(23)
+        prompt = rng.integers(0, 16, (1, 4)).astype(np.int32)
+
+        def ll(full):
+            logits = model(pt.to_tensor(full[None])).numpy()[0]
+            logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+            return sum(logp[3 + j, tok]
+                       for j, tok in enumerate(full[4:]))
+
+        greedy = model.generate(pt.to_tensor(prompt), max_new_tokens=4,
+                                max_cache_len=16).numpy()[0]
+        beam = model.generate(pt.to_tensor(prompt), max_new_tokens=4,
+                              max_cache_len=16, num_beams=8).numpy()[0]
+        assert ll(beam) >= ll(greedy) - 1e-5
+
+    def test_eos_freezes_beams(self):
+        model = _tiny_vocab_model(V=6)
+        prompt = np.zeros((1, 2), np.int32)
+        greedy = model.generate(pt.to_tensor(prompt), max_new_tokens=6,
+                                max_cache_len=16).numpy()[0, 2:]
+        eos = int(greedy[1])
+        out = model.generate(pt.to_tensor(prompt), max_new_tokens=6,
+                             max_cache_len=16, num_beams=4,
+                             eos_token_id=eos).numpy()[0, 2:]
+        hit = np.where(out == eos)[0]
+        assert len(hit) and (out[hit[0]:] == eos).all()
+
+    def test_beams_exclusive_with_sampling(self):
+        model = _tiny_vocab_model()
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            model.generate(pt.to_tensor(np.zeros((1, 2), np.int32)),
+                           max_new_tokens=2, num_beams=2, do_sample=True)
