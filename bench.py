@@ -12,7 +12,9 @@ import time
 
 import numpy as np
 
-BENCHLOG = __file__.rsplit("/", 1)[0] + "/BENCHLOG.jsonl"
+import os as _os
+BENCHLOG = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                         "BENCHLOG.jsonl")
 
 
 def emit(record):

@@ -246,7 +246,7 @@ def beam_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
     embed_p, step_p, head_p = _pure(embed_fn), _pure(step_fn), _pure(head_fn)
     K = int(num_beams)
 
-    def run(first_logits, caches):
+    def run(first_logits, caches, t0):
         B, V = first_logits.shape
         logp0 = jax.nn.log_softmax(
             first_logits.astype(jnp.float32), -1)
@@ -297,7 +297,7 @@ def beam_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
                 done = done | (tok == eos_token_id)
             return (tok, cs, t + 1, scores, done, hist), None
 
-        carry = (tok, caches, jnp.asarray(t0, jnp.int32), scores, done,
+        carry = (tok, caches, t0.astype(jnp.int32), scores, done,
                  hist)
         (tok, cs, t, scores, done, hist), _ = jax.lax.scan(
             body, carry, jnp.arange(1, max_new_tokens))
@@ -312,4 +312,5 @@ def beam_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
          eos_token_id),
         lambda: jax.jit(run))
     return jit_run(unwrap(first_logits),
-                   jax.tree_util.tree_map(unwrap, caches))
+                   jax.tree_util.tree_map(unwrap, caches),
+                   jnp.asarray(t0, jnp.int32))

@@ -92,6 +92,28 @@ class TestBeamSearch:
         hit = np.where(out == eos)[0]
         assert len(hit) and (out[hit[0]:] == eos).all()
 
+    def test_beam_reuse_across_prompt_lengths(self):
+        """code-review r5: the compiled beam program must take t0 at
+        runtime — a second call with a DIFFERENT prompt length must not
+        reuse a stale baked offset."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(99)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.default_rng(24)
+        ids4 = rng.integers(0, 256, (1, 4)).astype(np.int32)
+        ids6 = rng.integers(0, 256, (1, 6)).astype(np.int32)
+        model.generate(pt.to_tensor(ids4), max_new_tokens=3,
+                       max_cache_len=32, num_beams=2)   # warm t0=4
+        got = model.generate(pt.to_tensor(ids6), max_new_tokens=3,
+                             max_cache_len=32, num_beams=2).numpy()
+        pt.seed(99)
+        fresh = LlamaForCausalLM(llama_tiny())
+        fresh.eval()
+        want = fresh.generate(pt.to_tensor(ids6), max_new_tokens=3,
+                              max_cache_len=32, num_beams=2).numpy()
+        np.testing.assert_array_equal(got, want)
+
     def test_beams_exclusive_with_sampling(self):
         model = _tiny_vocab_model()
         with pytest.raises(ValueError, match="mutually exclusive"):
