@@ -24,13 +24,26 @@ __all__ = ["ContinuousBatchingServer"]
 
 
 class _Slot:
-    __slots__ = ("rid", "prompt_len", "budget", "emitted")
+    __slots__ = ("rid", "prompt_len", "budget", "emitted", "on_token",
+                 "streamed")
 
-    def __init__(self, rid, prompt_len, budget):
+    def __init__(self, rid, prompt_len, budget, on_token=None):
         self.rid = rid
         self.prompt_len = prompt_len
         self.budget = budget          # max_new_tokens remaining
         self.emitted = []
+        self.on_token = on_token
+        self.streamed = 0             # tokens already sent to on_token
+
+    def stream(self):
+        if self.on_token is None:
+            return
+        upto = min(len(self.emitted), self.budget)
+        if upto > self.streamed:
+            self.on_token(self.rid,
+                          np.asarray(self.emitted[self.streamed:upto],
+                                     np.int32))
+            self.streamed = upto
 
 
 class ContinuousBatchingServer:
@@ -108,11 +121,13 @@ class ContinuousBatchingServer:
         return None
 
     # ------------------------------------------------------------ queue
-    def submit(self, input_ids, max_new_tokens=32, seed=None):
+    def submit(self, input_ids, max_new_tokens=32, seed=None,
+               on_token=None):
         """Queue a prompt; returns a request id. The FIRST generated
         token is produced by the prefill (same contract as generate()).
         ``seed`` drives this request's sampling chain (default: the
-        server seed + request id)."""
+        server seed + request id). ``on_token(rid, tokens)`` streams
+        each harvested chunk (1..tick_block tokens) as it lands."""
         ids = np.asarray(unwrap(input_ids)).astype(np.int32)
         if ids.ndim == 2:
             if ids.shape[0] != 1:
@@ -130,8 +145,27 @@ class ContinuousBatchingServer:
         self._next_rid += 1
         if seed is None:
             seed = self._seed + rid
-        self._queue.append((rid, ids, int(max_new_tokens), int(seed)))
+        self._queue.append((rid, ids, int(max_new_tokens), int(seed),
+                            on_token))
         return rid
+
+    def cancel(self, rid):
+        """Drop a request: un-queue it, or free its slot mid-decode (the
+        partial result is recorded under the rid). Returns True if the
+        request was found live."""
+        for i, item in enumerate(self._queue):
+            if item[0] == rid:
+                del self._queue[i]
+                return True
+        for slot in range(self.max_slots):
+            st = self._slots[slot]
+            if self._active[slot] and st.rid == rid:
+                self._results[rid] = np.asarray(st.emitted[:st.budget],
+                                                np.int32)
+                self._active[slot] = False
+                self._slots[slot] = None
+                return True
+        return False
 
     # ------------------------------------------------------- scheduling
     def _admit(self):
@@ -139,7 +173,7 @@ class ContinuousBatchingServer:
         for slot in range(self.max_slots):
             if self._active[slot] or not self._queue:
                 continue
-            rid, ids, budget, req_seed = self._queue.pop(0)
+            rid, ids, budget, req_seed, on_token = self._queue.pop(0)
             T = ids.shape[0]
             # per-request prefill at batch 1 (optionally in fixed-size
             # chunks: one compiled program for every prompt length),
@@ -184,8 +218,9 @@ class ContinuousBatchingServer:
             self._tok = self._tok.at[slot].set(first)
             self._t = self._t.at[slot].set(T)
             self._active[slot] = True
-            st = _Slot(rid, T, budget)
+            st = _Slot(rid, T, budget, on_token)
             st.emitted.append(int(first))
+            st.stream()
             self._slots[slot] = st
 
     # ------------------------------------------------------------ steps
@@ -263,6 +298,7 @@ class ContinuousBatchingServer:
                 st.emitted.append(int(toks[slot, j]))
                 if self._finished(st):
                     break              # later block tokens are waste
+            st.stream()
         self._harvest()
         self._admit()
         return int(self._active.sum())

@@ -214,6 +214,42 @@ class TestContinuousBatching:
         rid = srv8.submit(p, max_new_tokens=4)
         np.testing.assert_array_equal(srv8.run()[rid], want8)
 
+    def test_streaming_chunks_concatenate_to_result(self):
+        model = _model()
+        rng = np.random.default_rng(10)
+        p = rng.integers(0, 256, (4,)).astype(np.int32)
+        chunks = []
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=64, tick_block=3)
+        rid = srv.submit(p, max_new_tokens=7,
+                         on_token=lambda r, t: chunks.append((r, t)))
+        out = srv.run()[rid]
+        assert all(r == rid for r, _ in chunks)
+        np.testing.assert_array_equal(
+            np.concatenate([t for _, t in chunks]), out)
+        assert len(chunks) >= 3       # admission token + >=2 blocks
+
+    def test_cancel_queued_and_mid_flight(self):
+        model = _model()
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 256, (4,)).astype(np.int32)
+        b = rng.integers(0, 256, (5,)).astype(np.int32)
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=64)
+        ra = srv.submit(a, max_new_tokens=10)
+        rb = srv.submit(b, max_new_tokens=5)
+        assert srv.cancel(rb) is True          # still queued
+        for _ in range(3):
+            srv.step()                         # a is mid-decode
+        assert srv.cancel(ra) is True
+        outs = srv.run()
+        assert rb not in outs
+        partial = outs[ra]
+        want = _solo(model, a, 10)
+        assert 1 <= len(partial) < 10
+        np.testing.assert_array_equal(partial, want[:len(partial)])
+        assert srv.cancel(12345) is False
+
     def test_everything_composed(self):
         """Kitchen sink: prefix cache + chunked prefill + tick_block +
         weight-only int8, all at once — still solo-parity."""
