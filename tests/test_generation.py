@@ -276,6 +276,23 @@ class TestWeightOnlyInt8:
         np.testing.assert_array_equal(a.numpy(), c.numpy())
 
 
+class TestBf16Generate:
+    def test_bf16_model_generate_matches_bf16_eager(self):
+        """The serving dtype on TPU is bf16: decode parity must hold
+        against the model's own bf16 eager forward."""
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+        pt.seed(45)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        model.astype("bfloat16")
+        rng = np.random.default_rng(25)
+        ids = rng.integers(0, 256, (1, 5)).astype(np.int32)
+        want = _naive_greedy(model, ids, 5)
+        got = model.generate(pt.to_tensor(ids), max_new_tokens=5,
+                             max_cache_len=32)
+        np.testing.assert_array_equal(np.asarray(got.numpy()), want)
+
+
 class TestInt8KVCache:
     def test_int8_kv_close_to_fp_and_actually_int8(self):
         import jax
