@@ -13,6 +13,8 @@ Host/device split: the device does batched prefill + batched decode
 steps; the host only assigns slots, harvests finished rows, and swaps
 new prompts in — O(requests), not O(tokens), host work.
 """
+import threading
+
 import numpy as np
 
 import jax
@@ -93,6 +95,11 @@ class ContinuousBatchingServer:
         self._decode_jit = None
         self._prefixes = []       # [(ids, cache_rows, last_logits)]
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0}
+        # submit()/cancel() may come from request threads while a serve
+        # thread drives step(); one lock covers the queue/slot state
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread = None
 
     # ------------------------------------------------------ prefix cache
     def register_prefix(self, prefix_ids):
@@ -141,18 +148,23 @@ class ContinuousBatchingServer:
                 f"prompt ({T}) + max({max_new_tokens} new tokens, "
                 f"{pad} prefill-chunk pad rows) exceeds max_cache_len "
                 f"({self.max_cache_len})")
-        rid = self._next_rid
-        self._next_rid += 1
-        if seed is None:
-            seed = self._seed + rid
-        self._queue.append((rid, ids, int(max_new_tokens), int(seed),
-                            on_token))
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            if seed is None:
+                seed = self._seed + rid
+            self._queue.append((rid, ids, int(max_new_tokens), int(seed),
+                                on_token))
         return rid
 
     def cancel(self, rid):
         """Drop a request: un-queue it, or free its slot mid-decode (the
         partial result is recorded under the rid). Returns True if the
         request was found live."""
+        with self._lock:
+            return self._cancel_locked(rid)
+
+    def _cancel_locked(self, rid):
         for i, item in enumerate(self._queue):
             if item[0] == rid:
                 del self._queue[i]
@@ -276,6 +288,10 @@ class ContinuousBatchingServer:
         """One server tick: admit waiting requests, run ``tick_block``
         batched decode steps as one program, harvest finished rows.
         Returns the number of active slots after the tick."""
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self):
         self._admit()
         if not self._active.any():
             return 0
@@ -321,8 +337,52 @@ class ContinuousBatchingServer:
     def run(self, max_ticks=100000):
         """Drive until queue and slots drain; returns {rid: new_tokens}."""
         ticks = 0
-        while (self._queue or self._active.any()) and ticks < max_ticks:
-            self.step()
+        while ticks < max_ticks:
+            with self._lock:
+                if not (self._queue or self._active.any()):
+                    break
+                self._step_locked()
             ticks += 1
-        out, self._results = self._results, {}
+        with self._lock:
+            out, self._results = self._results, {}
         return out
+
+    # ------------------------------------------------------ serve thread
+    def start(self, idle_sleep=0.005):
+        """Run the decode loop on a background thread: submit()/cancel()
+        from any thread; results land in ``pop_result``/``wait``."""
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._stop.clear()
+
+        def loop():
+            import time as _time
+            while not self._stop.is_set():
+                with self._lock:
+                    busy = bool(self._queue or self._active.any())
+                    if busy:
+                        self._step_locked()
+                if not busy:
+                    _time.sleep(idle_sleep)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def wait(self, rid, timeout=120.0):
+        """Block until ``rid`` finishes (requires start()); returns its
+        new tokens."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if rid in self._results:
+                    return self._results.pop(rid)
+            _time.sleep(0.002)
+        raise TimeoutError(f"request {rid} not finished in {timeout}s")

@@ -250,6 +250,38 @@ class TestContinuousBatching:
         np.testing.assert_array_equal(partial, want[:len(partial)])
         assert srv.cancel(12345) is False
 
+    def test_threaded_serving_solo_exact(self):
+        """start() drives decode on a background thread; concurrent
+        submitters get solo-exact results via wait()."""
+        import threading
+        model = _model()
+        rng = np.random.default_rng(12)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (4, 6, 5, 7)]
+        srv = ContinuousBatchingServer(model, max_slots=2,
+                                       max_cache_len=64).start()
+        results = {}
+        errs = []
+
+        def client(i, p):
+            try:
+                rid = srv.submit(p, max_new_tokens=5)
+                results[i] = srv.wait(rid, timeout=300)
+            except Exception as e:     # surface in main thread
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(i, p))
+                   for i, p in enumerate(prompts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        srv.stop()
+        assert not errs, errs
+        assert len(results) == 4
+        for i, p in enumerate(prompts):
+            np.testing.assert_array_equal(results[i], _solo(model, p, 5))
+
     def test_everything_composed(self):
         """Kitchen sink: prefix cache + chunked prefill + tick_block +
         weight-only int8, all at once — still solo-parity."""
