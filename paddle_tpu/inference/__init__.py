@@ -246,7 +246,8 @@ def convert_to_mixed_precision(src_prefix, dst_prefix, mixed_precision="bf16",
 
 from .serving import BatchScheduler  # noqa: E402  (reference serving surface)
 from .decode_loop import (scan_decode, greedy_generate,  # noqa: E402,F401
-                          sample_generate, process_logits)
+                          sample_generate, beam_generate, fsm_generate,
+                          phrases_to_fsm, process_logits)
 from .continuous_batching import ContinuousBatchingServer  # noqa: E402,F401
 from .speculative import speculative_generate  # noqa: E402,F401
 from .deploy_decode import (export_decode, load_decode,  # noqa: E402,F401

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from ..core.tensor import unwrap
 
 __all__ = ["scan_decode", "greedy_generate", "sample_generate",
-           "beam_generate", "process_logits"]
+           "beam_generate", "fsm_generate", "phrases_to_fsm",
+           "process_logits"]
 
 
 def _pure(fn):
@@ -314,3 +315,110 @@ def beam_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
     return jit_run(unwrap(first_logits),
                    jax.tree_util.tree_map(unwrap, caches),
                    jnp.asarray(t0, jnp.int32))
+
+
+def fsm_generate(embed_fn, step_fn, head_fn, caches, first_logits, t0,
+                 max_new_tokens, fsm_mask, fsm_next, start_state=0,
+                 do_sample=False, key=None, temperature=1.0, top_k=0,
+                 top_p=1.0, eos_token_id=None):
+    """Constrained (structured) generation: a token-level finite-state
+    machine masks the logits every step, so the output provably matches
+    the grammar the automaton encodes (JSON schemas, enumerated
+    choices, tool-call formats).
+
+    ``fsm_mask`` [S, V] bool — tokens allowed in each state; ``fsm_next``
+    [S, V] int32 — state after emitting each token. The per-row state
+    rides the scan carry; masking is a gather + where, so constrained
+    decode costs the same one program as unconstrained. The automaton is
+    a runtime ARGUMENT of the compiled program (constraints can change
+    per request without recompiling). Greedy by default;
+    ``do_sample=True`` samples within the allowed set (same filter chain
+    as ``sample_generate``). Returns
+    ``(ids [B, max_new_tokens], final_states [B])``.
+    """
+    embed_p, step_p, head_p = _pure(embed_fn), _pure(step_fn), _pure(head_fn)
+    temperature = float(temperature)
+    top_k = int(top_k)
+    top_p = float(top_p)
+
+    def run(first_logits, caches, t0, key, mask_tab, next_tab):
+        def pick(logits, state, k):
+            if logits.ndim == 3:
+                logits = logits[:, -1]
+            allowed = mask_tab[state]                 # [B, V]
+            logits = jnp.where(allowed, logits.astype(jnp.float32),
+                               -jnp.inf)
+            if do_sample:
+                return jax.random.categorical(
+                    k, process_logits(logits, temperature, top_k,
+                                      top_p), axis=-1).astype(jnp.int32)
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+
+        def body(carry, _):
+            tok, cs, t, state, done, k = carry
+            x = embed_p(tok, t)
+            out, cs2 = step_p(x, cs, t)
+            k, sub = jax.random.split(k)
+            nxt = pick(head_p(out), state, sub)
+            state = next_tab[state, nxt]
+            if eos_token_id is not None:
+                nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+                done = done | (nxt == eos_token_id)
+            return (nxt, cs2, t + 1, state, done, k), tok
+
+        B = first_logits.shape[0]
+        key, sub = jax.random.split(key)
+        state0 = jnp.full((B,), start_state, jnp.int32)
+        tok0 = pick(first_logits, state0, sub)
+        state = next_tab[state0, tok0]
+        done = (tok0 == eos_token_id) if eos_token_id is not None             else jnp.zeros((B,), bool)
+        carry = (tok0, caches, t0.astype(jnp.int32), state, done, key)
+        (_, cs, _, state, _, _), toks = jax.lax.scan(
+            body, carry, None, length=max_new_tokens)
+        return jnp.transpose(toks, (1, 0)), state
+
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    jit_run = _cached_jit(
+        step_fn,
+        ("fsm_generate", embed_fn, head_fn, max_new_tokens, do_sample,
+         temperature, top_k, top_p, eos_token_id, start_state),
+        lambda: jax.jit(run))
+    return jit_run(unwrap(first_logits),
+                   jax.tree_util.tree_map(unwrap, caches),
+                   jnp.asarray(t0, jnp.int32), key,
+                   jnp.asarray(unwrap(fsm_mask), bool),
+                   jnp.asarray(unwrap(fsm_next), jnp.int32))
+
+
+def phrases_to_fsm(phrases, vocab_size, eos_token_id):
+    """Build an (fsm_mask, fsm_next) automaton that forces the output to
+    be exactly one of ``phrases`` (token-id sequences, e.g. a fixed set
+    of tool names or labels) followed by eos — a trie over the phrases.
+    State 0 is the root; the accept state allows only eos."""
+    import numpy as np
+    states = [{}]              # state -> {token: next_state}
+    accept = None
+    for ph in phrases:
+        cur = 0
+        for tok in ph:
+            nxt = states[cur].get(int(tok))
+            if nxt is None:
+                states.append({})
+                nxt = len(states) - 1
+                states[cur][int(tok)] = nxt
+            cur = nxt
+        # phrase end: route to the shared accept state
+        if accept is None:
+            states.append({})
+            accept = len(states) - 1
+        states[cur][int(eos_token_id)] = accept
+    states[accept][int(eos_token_id)] = accept   # absorb
+    S = len(states)
+    mask = np.zeros((S, vocab_size), bool)
+    nxt_tab = np.zeros((S, vocab_size), np.int32)
+    for s, edges in enumerate(states):
+        for tok, n2 in edges.items():
+            mask[s, tok] = True
+            nxt_tab[s, tok] = n2
+    return mask, nxt_tab

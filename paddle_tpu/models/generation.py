@@ -576,7 +576,7 @@ class GenerationMixin:
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  seed=None, max_cache_len=None, weight_dtype=None,
                  prefill_chunk=None, mesh=None, cache_dtype=None,
-                 num_beams=1):
+                 num_beams=1, fsm=None):
         """Generate continuations for ``input_ids`` ([B, T] int). Returns
         the FULL sequence (prompt + ``max_new_tokens``) as a framework
         tensor; after every row hits ``eos_token_id`` the tail is padded
@@ -594,7 +594,7 @@ class GenerationMixin:
         weight bytes streamed per decode step (the serving roofline);
         embeddings, norms, routers and the lm head stay full precision.
         """
-        from ..inference.decode_loop import (beam_generate,
+        from ..inference.decode_loop import (beam_generate, fsm_generate,
                                              greedy_generate,
                                              sample_generate)
         ids_np = np.asarray(unwrap(input_ids))
@@ -617,7 +617,21 @@ class GenerationMixin:
         last_logits, caches = self._run_prefill(bundle, ids_np,
                                                 chunk=prefill_chunk)
 
-        if num_beams > 1:
+        if fsm is not None:
+            if num_beams > 1:
+                raise ValueError("constrained decoding composes with "
+                                 "greedy/sampling, not beam search")
+            mask_tab, next_tab = fsm[0], fsm[1]
+            start = fsm[2] if len(fsm) > 2 else 0
+            if seed is None:
+                seed = int(np.random.randint(0, 2**31))
+            new_ids, _ = fsm_generate(
+                embed_fn, step_fn, head_fn, caches, last_logits, T,
+                max_new_tokens, mask_tab, next_tab, start_state=start,
+                do_sample=do_sample, key=jax.random.PRNGKey(seed),
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id)
+        elif num_beams > 1:
             if do_sample:
                 raise ValueError("beam search and sampling are mutually "
                                  "exclusive (reference decode semantics)")
