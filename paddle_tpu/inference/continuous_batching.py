@@ -37,14 +37,17 @@ class _Slot:
         self.on_token = on_token
         self.streamed = 0             # tokens already sent to on_token
 
-    def stream(self):
+    def stream(self, sink):
+        """Queue this slot's unstreamed chunk on ``sink``; the server
+        fires callbacks AFTER releasing its lock (a slow or blocking
+        callback must not stall decode/submit/cancel)."""
         if self.on_token is None:
             return
         upto = min(len(self.emitted), self.budget)
         if upto > self.streamed:
-            self.on_token(self.rid,
-                          np.asarray(self.emitted[self.streamed:upto],
-                                     np.int32))
+            sink.append((self.on_token, self.rid,
+                         np.asarray(self.emitted[self.streamed:upto],
+                                    np.int32)))
             self.streamed = upto
 
 
@@ -96,10 +99,14 @@ class ContinuousBatchingServer:
         self._prefixes = []       # [(ids, cache_rows, last_logits)]
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0}
         # submit()/cancel() may come from request threads while a serve
-        # thread drives step(); one lock covers the queue/slot state
+        # thread drives step(); one lock covers the queue/slot state and
+        # a condition on it wakes wait()ers at harvest time
         self._lock = threading.RLock()
+        self._done_cv = threading.Condition(self._lock)
         self._stop = threading.Event()
         self._thread = None
+        self._thread_error = None
+        self._deferred_cbs = []   # (cb, rid, tokens) fired OUTSIDE the lock
 
     # ------------------------------------------------------ prefix cache
     def register_prefix(self, prefix_ids):
@@ -232,7 +239,7 @@ class ContinuousBatchingServer:
             self._active[slot] = True
             st = _Slot(rid, T, budget, on_token)
             st.emitted.append(int(first))
-            st.stream()
+            st.stream(self._deferred_cbs)
             self._slots[slot] = st
 
     # ------------------------------------------------------------ steps
@@ -289,7 +296,17 @@ class ContinuousBatchingServer:
         batched decode steps as one program, harvest finished rows.
         Returns the number of active slots after the tick."""
         with self._lock:
-            return self._step_locked()
+            n = self._step_locked()
+        self._fire_callbacks()
+        return n
+
+    def _fire_callbacks(self):
+        """Run streamed-token callbacks collected during locked work.
+        Callback exceptions propagate to the step()/run() caller (or the
+        serve thread's error slot) without corrupting server state."""
+        cbs, self._deferred_cbs = self._deferred_cbs, []
+        for cb, rid, toks in cbs:
+            cb(rid, toks)
 
     def _step_locked(self):
         self._admit()
@@ -314,7 +331,7 @@ class ContinuousBatchingServer:
                 st.emitted.append(int(toks[slot, j]))
                 if self._finished(st):
                     break              # later block tokens are waste
-            st.stream()
+            st.stream(self._deferred_cbs)
         self._harvest()
         self._admit()
         return int(self._active.sum())
@@ -326,6 +343,7 @@ class ContinuousBatchingServer:
                 and st.emitted[-1] == self.eos_token_id)
 
     def _harvest(self):
+        finished = False
         for slot in range(self.max_slots):
             st = self._slots[slot]
             if self._active[slot] and self._finished(st):
@@ -333,6 +351,9 @@ class ContinuousBatchingServer:
                                                    np.int32)
                 self._active[slot] = False
                 self._slots[slot] = None
+                finished = True
+        if finished:
+            self._done_cv.notify_all()
 
     def run(self, max_ticks=100000):
         """Drive until queue and slots drain; returns {rid: new_tokens}."""
@@ -342,6 +363,7 @@ class ContinuousBatchingServer:
                 if not (self._queue or self._active.any()):
                     break
                 self._step_locked()
+            self._fire_callbacks()
             ticks += 1
         with self._lock:
             out, self._results = self._results, {}
@@ -350,39 +372,57 @@ class ContinuousBatchingServer:
     # ------------------------------------------------------ serve thread
     def start(self, idle_sleep=0.005):
         """Run the decode loop on a background thread: submit()/cancel()
-        from any thread; results land in ``pop_result``/``wait``."""
+        from any thread; collect results with ``wait(rid)``."""
         if self._thread is not None:
             raise RuntimeError("server already started")
         self._stop.clear()
+        self._thread_error = None
 
         def loop():
             import time as _time
-            while not self._stop.is_set():
+            try:
+                while not self._stop.is_set():
+                    with self._lock:
+                        busy = bool(self._queue or self._active.any())
+                        if busy:
+                            self._step_locked()
+                    self._fire_callbacks()
+                    if not busy:
+                        _time.sleep(idle_sleep)
+            except BaseException as e:   # surface to waiters, don't wedge
                 with self._lock:
-                    busy = bool(self._queue or self._active.any())
-                    if busy:
-                        self._step_locked()
-                if not busy:
-                    _time.sleep(idle_sleep)
+                    self._thread_error = e
+                    self._done_cv.notify_all()
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, timeout=60.0):
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"serve thread did not stop within {timeout}s (a "
+                    f"tick/compile may still be running); call stop() "
+                    f"again to re-join")
             self._thread = None
 
     def wait(self, rid, timeout=120.0):
         """Block until ``rid`` finishes (requires start()); returns its
-        new tokens."""
+        new tokens. Raises the serve thread's error if it died."""
         import time as _time
         deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
-            with self._lock:
+        with self._done_cv:
+            while True:
                 if rid in self._results:
                     return self._results.pop(rid)
-            _time.sleep(0.002)
-        raise TimeoutError(f"request {rid} not finished in {timeout}s")
+                if self._thread_error is not None:
+                    raise RuntimeError(
+                        "serve thread died") from self._thread_error
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"request {rid} not finished in {timeout}s")
+                self._done_cv.wait(timeout=min(remaining, 1.0))

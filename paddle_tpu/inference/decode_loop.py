@@ -397,6 +397,14 @@ def phrases_to_fsm(phrases, vocab_size, eos_token_id):
     of tool names or labels) followed by eos — a trie over the phrases.
     State 0 is the root; the accept state allows only eos."""
     import numpy as np
+    if not phrases:
+        raise ValueError("phrases must be non-empty")
+    for ph in phrases:
+        if int(eos_token_id) in (int(t) for t in ph):
+            raise ValueError(
+                f"phrase {list(ph)} contains eos_token_id "
+                f"({eos_token_id}); eos terminates phrases and cannot "
+                f"appear inside one")
     states = [{}]              # state -> {token: next_state}
     accept = None
     for ph in phrases:

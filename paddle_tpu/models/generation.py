@@ -623,12 +623,13 @@ class GenerationMixin:
                                  "greedy/sampling, not beam search")
             mask_tab, next_tab = fsm[0], fsm[1]
             start = fsm[2] if len(fsm) > 2 else 0
-            if seed is None:
+            if do_sample and seed is None:   # greedy never draws
                 seed = int(np.random.randint(0, 2**31))
             new_ids, _ = fsm_generate(
                 embed_fn, step_fn, head_fn, caches, last_logits, T,
                 max_new_tokens, mask_tab, next_tab, start_state=start,
-                do_sample=do_sample, key=jax.random.PRNGKey(seed),
+                do_sample=do_sample,
+                key=jax.random.PRNGKey(seed or 0),
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_token_id=eos_token_id)
         elif num_beams > 1:

@@ -282,6 +282,20 @@ class TestContinuousBatching:
         for i, p in enumerate(prompts):
             np.testing.assert_array_equal(results[i], _solo(model, p, 5))
 
+    def test_dead_serve_thread_surfaces_in_wait(self):
+        """code-review r5: a crashing on_token callback must not wedge
+        the server — waiters get the error."""
+        model = _model()
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=64).start()
+        rid = srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=4,
+                         on_token=lambda r, t: 1 / 0)
+        with pytest.raises(RuntimeError, match="serve thread died"):
+            srv.wait(rid, timeout=60)
+        srv._stop.set()
+        srv._thread.join(timeout=10)
+        srv._thread = None
+
     def test_everything_composed(self):
         """Kitchen sink: prefix cache + chunked prefill + tick_block +
         weight-only int8, all at once — still solo-parity."""
