@@ -17,7 +17,13 @@ from .export import (ExportedProgram, export_layer, export_program,  # noqa: F40
 
 __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "export_program", "export_layer", "load_exported",
-           "convert_to_mixed_precision", "get_version"]
+           "convert_to_mixed_precision", "get_version",
+           # serving stack (beyond the reference surface)
+           "BatchScheduler", "ContinuousBatchingServer", "scan_decode",
+           "greedy_generate", "sample_generate", "beam_generate",
+           "fsm_generate", "phrases_to_fsm", "process_logits",
+           "speculative_generate", "export_decode", "load_decode",
+           "DeployedGenerator"]
 
 
 def get_version():
