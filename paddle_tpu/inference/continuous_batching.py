@@ -63,13 +63,24 @@ class ContinuousBatchingServer:
     ...                                max_cache_len=256)
     >>> rid = srv.submit(prompt_ids, max_new_tokens=32)
     >>> outs = srv.run()            # {rid: np.ndarray of new tokens}
+
+    ``cache_backend="paged"`` swaps the dense ``[slots, max_cache_len]``
+    KV buffers for a global page pool + per-slot block tables (ragged
+    paged attention; ops/pallas/paged_attention.py, inference/
+    kv_cache.py): cache HBM and decode attention bandwidth scale with
+    ACTUAL sequence lengths, ``num_pages`` (default: worst case, every
+    slot maxed out) sizes the pool to the real working set, registered
+    prefixes are stored once and page-shared across slots, and tokens
+    stay bit-identical to the dense backend. When the pool is full,
+    admission waits (FIFO) for a harvest to free pages.
     """
 
     def __init__(self, model, max_slots=4, max_cache_len=256,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  eos_token_id=None, seed=0, weight_dtype=None,
                  prefill_chunk=None, mesh=None, tick_block=1,
-                 cache_dtype=None):
+                 cache_dtype=None, cache_backend="dense", page_size=16,
+                 num_pages=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -80,6 +91,8 @@ class ContinuousBatchingServer:
         self._top_p = float(top_p)
         self._seed = int(seed)
         self._keys = jnp.zeros((int(max_slots), 2), jnp.uint32)
+        # the dense bundle always exists: prefill (and the prefix cache)
+        # run on dense batch-1 caches whatever the decode backend is
         self._bundle = model._decode_bundle(max_cache_len, weight_dtype,
                                             mesh, cache_dtype)
         (self._init_caches, self._embed_fn, self._step_fn,
@@ -87,7 +100,36 @@ class ContinuousBatchingServer:
         self._prefill_chunk = prefill_chunk
         self.tick_block = max(1, int(tick_block))
 
-        self._caches = self._init_caches(self.max_slots)
+        if cache_backend not in ("dense", "paged"):
+            raise ValueError(f"cache_backend must be 'dense' or 'paged', "
+                             f"got {cache_backend!r}")
+        self.cache_backend = cache_backend
+        self._kv = None
+        if cache_backend == "paged":
+            # decode runs on a global K/V page pool addressed through
+            # per-slot block tables (ragged paged attention); the pool —
+            # not slots x max_cache_len — is the cache HBM budget, so it
+            # can be sized to the ACTUAL token working set
+            from .kv_cache import PagedKVCache
+            page_size = int(page_size)
+            if self.max_cache_len % page_size:
+                raise ValueError(
+                    f"page_size ({page_size}) must divide max_cache_len "
+                    f"({self.max_cache_len})")
+            pages_per_slot = self.max_cache_len // page_size
+            if num_pages is None:     # worst case: every slot maxed out
+                num_pages = self.max_slots * pages_per_slot + 1
+            self._paged_bundle = model._decode_bundle(
+                max_cache_len, weight_dtype, mesh, cache_dtype,
+                cache_backend="paged", page_size=page_size,
+                num_pages=int(num_pages))
+            self._step_fn = self._paged_bundle[2]
+            self._kv = PagedKVCache(int(num_pages), page_size,
+                                    self.max_slots, pages_per_slot)
+            self._caches = self._paged_bundle[0](self.max_slots)
+            self._pinned_pages = 0     # held forever by register_prefix
+        else:
+            self._caches = self._init_caches(self.max_slots)
         self._tok = jnp.zeros((self.max_slots,), jnp.int32)
         self._t = jnp.zeros((self.max_slots,), jnp.int32)
         self._active = np.zeros((self.max_slots,), bool)   # host-side
@@ -96,7 +138,7 @@ class ContinuousBatchingServer:
         self._results = {}
         self._next_rid = 0
         self._decode_jit = None
-        self._prefixes = []       # [(ids, cache_rows, last_logits)]
+        self._prefixes = []   # [(ids, cache_rows, last_logits, pages)]
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0}
         # submit()/cancel() may come from request threads while a serve
         # thread drives step(); one lock covers the queue/slot state and
@@ -113,25 +155,64 @@ class ContinuousBatchingServer:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE and
         reuse its KV rows for every later request that starts with it —
         admission then only prefills the remainder. Longest registered
-        match wins. Returns the prefix length."""
+        match wins. Returns the prefix length. Safe to call while a
+        serve thread is decoding (the lock serializes it against ticks:
+        the paged path writes pool pages and takes allocator pages, both
+        of which would otherwise race the donating decode program)."""
         ids = np.asarray(unwrap(prefix_ids)).astype(np.int32).reshape(-1)
         T = ids.shape[0]
         if T + 1 > self.max_cache_len:
             raise ValueError(f"prefix ({T}) leaves no room in "
                              f"max_cache_len ({self.max_cache_len})")
-        logits, caches1 = self.model._run_prefill(
-            self._bundle, ids[None], chunk=self._prefill_chunk)
-        self.stats["prefill_tokens"] += T
-        rows = jax.tree_util.tree_map(lambda c: c[:, :, :T], caches1)
-        self._prefixes.append((ids, rows, logits))
-        self._prefixes.sort(key=lambda e: -e[0].shape[0])  # longest first
+        with self._lock:
+            for pre_ids, _, _, _ in self._prefixes:
+                # idempotent: re-registering (e.g. a client retry) must
+                # not re-prefill or pin a second, unreachable page set
+                if (pre_ids.shape[0] == T
+                        and np.array_equal(pre_ids, ids)):
+                    return T
+            logits, caches1 = self.model._run_prefill(
+                self._bundle, ids[None], chunk=self._prefill_chunk)
+            self.stats["prefill_tokens"] += T
+            rows = jax.tree_util.tree_map(lambda c: c[:, :, :T], caches1)
+            pages = []
+            if self._kv is not None:
+                # store the prefix's FULL pages once in the pool; every
+                # slot that hits the prefix points its block table at
+                # them (the alloc ref is the registry's hold — they
+                # outlive slot churn and pin pool capacity forever)
+                nfull = T // self._kv.page_size
+                if nfull:
+                    pages = self._kv.alloc(nfull)
+                    self._pinned_pages += nfull
+            self._prefixes.append((ids, rows, logits, pages))
+            self._prefixes.sort(key=lambda e: -e[0].shape[0])
+            if self._kv is not None and pages:
+                # pinning shrinks the pool for everyone else: a queued
+                # request that can no longer EVER fit would silently
+                # starve the FIFO — refuse the registration instead
+                usable = self._kv.num_pages - 1 - self._pinned_pages
+                for _, q_ids, q_budget, _, _ in self._queue:
+                    if self._request_pages(q_ids, q_budget) > usable:
+                        self._prefixes = [e for e in self._prefixes
+                                          if e[3] is not pages]
+                        self._kv.release(pages)
+                        self._pinned_pages -= len(pages)
+                        raise ValueError(
+                            f"registering this {T}-token prefix pins "
+                            f"{len(pages)} pages and would strand an "
+                            f"already-queued request needing "
+                            f"{self._request_pages(q_ids, q_budget)} of "
+                            f"{usable} usable pages — grow num_pages "
+                            f"or register prefixes before submitting")
+                self._fill_pages(caches1, pages, 0)
         return T
 
     def _match_prefix(self, ids):
-        for pre_ids, rows, logits in self._prefixes:
+        for pre_ids, rows, logits, pages in self._prefixes:
             n = pre_ids.shape[0]
             if ids.shape[0] >= n and np.array_equal(ids[:n], pre_ids):
-                return pre_ids, rows, logits
+                return pre_ids, rows, logits, pages
         return None
 
     # ------------------------------------------------------------ queue
@@ -156,6 +237,19 @@ class ContinuousBatchingServer:
                 f"{pad} prefill-chunk pad rows) exceeds max_cache_len "
                 f"({self.max_cache_len})")
         with self._lock:
+            if self._kv is not None:
+                # full-extent reservation (prompt + budget): a request
+                # that can never fit must fail HERE, not stall the FIFO
+                # forever — pool minus prefix-pinned pages, minus the
+                # pinned pages this request would itself share
+                need = self._request_pages(ids, int(max_new_tokens))
+                usable = self._kv.num_pages - 1 - self._pinned_pages
+                if need > usable:
+                    raise ValueError(
+                        f"prompt ({T}) + max_new_tokens "
+                        f"({max_new_tokens}) needs {need} pages beyond "
+                        f"its prefix hit but only {usable} are not "
+                        f"pinned by prefixes — grow num_pages")
             rid = self._next_rid
             self._next_rid += 1
             if seed is None:
@@ -183,8 +277,55 @@ class ContinuousBatchingServer:
                                                 np.int32)
                 self._active[slot] = False
                 self._slots[slot] = None
+                if self._kv is not None:
+                    self._kv.free_slot(slot)
                 return True
         return False
+
+    # ---------------------------------------------------- paged backend
+    def _fill_pages(self, caches1, pages, start):
+        """Scatter dense batch-1 cache rows [start, start + len(pages) *
+        page_size) into the pool at ``pages`` (position order)."""
+        if not pages:
+            return
+        pg = self._kv.page_size
+        n = len(pages) * pg
+        ids = jnp.asarray(np.asarray(pages, np.int32))
+
+        def seg(c):            # [L, 1, T', h, hd] -> [L, npg, pg, h, hd]
+            s = c[:, 0, start:start + n]
+            return s.reshape(s.shape[0], len(pages), pg, s.shape[2],
+                             s.shape[3])
+
+        pool = jax.tree_util.tree_map(
+            lambda p_, c: p_.at[:, ids].set(seg(c).astype(p_.dtype)),
+            self._caches["pool"],
+            {"k": caches1["k"], "v": caches1["v"]})
+        self._caches = dict(self._caches, pool=pool)
+
+    def _sync_block_table(self):
+        """Push the host block-table mirror to the device copy the
+        decode program reads. Same shape every time — page churn never
+        triggers a recompile."""
+        if self._kv is not None and self._kv.dirty:
+            self._caches = dict(self._caches,
+                                bt=jnp.asarray(self._kv.block_table))
+            self._kv.dirty = False
+
+    def _request_pages(self, ids, budget):
+        """Fresh pages a request needs for its FULL extent (prompt +
+        budget — reserved at admission so decode-time growth can never
+        hit an empty pool mid-flight), net of shared prefix pages."""
+        hit = self._match_prefix(ids)
+        shared = len(hit[3]) if hit is not None else 0
+        return -(-(ids.shape[0] + budget) // self._kv.page_size) - shared
+
+    def _head_fits_pool(self):
+        """Can the pool admit the request at the head of the queue right
+        now? If not it (and everything behind it — FIFO) waits for a
+        harvest to free pages."""
+        _, ids, budget, _, _ = self._queue[0]
+        return self._kv.free_pages() >= self._request_pages(ids, budget)
 
     # ------------------------------------------------------- scheduling
     def _admit(self):
@@ -192,6 +333,8 @@ class ContinuousBatchingServer:
         for slot in range(self.max_slots):
             if self._active[slot] or not self._queue:
                 continue
+            if self._kv is not None and not self._head_fits_pool():
+                break
             rid, ids, budget, req_seed, on_token = self._queue.pop(0)
             T = ids.shape[0]
             # per-request prefill at batch 1 (optionally in fixed-size
@@ -199,8 +342,9 @@ class ContinuousBatchingServer:
             # then scatter into the pool. A registered-prefix hit seeds
             # the caches and prefills only the remainder.
             hit = self._match_prefix(ids)
+            pre_pages = []
             if hit is not None:
-                pre_ids, rows, pre_logits = hit
+                pre_ids, rows, pre_logits, pre_pages = hit
                 n = pre_ids.shape[0]
                 caches1 = jax.tree_util.tree_map(
                     lambda full, r: full.at[:, :, :r.shape[2]].set(r),
@@ -231,9 +375,20 @@ class ContinuousBatchingServer:
             else:
                 first = int(jnp.argmax(logits, -1)[0])
             self._keys = self._keys.at[slot].set(key)
-            self._caches = jax.tree_util.tree_map(
-                lambda pool, one: pool.at[:, slot].set(one[:, 0]),
-                self._caches, caches1)
+            if self._kv is not None:
+                # shared prefix pages join this slot's table by
+                # reference (stored once); the FULL extent (prompt +
+                # budget) is reserved up front so mid-decode growth can
+                # never exhaust the pool; only prompt rows are copied
+                pg = self._kv.page_size
+                own = self._kv.admit_slot(slot, T + budget, pre_pages)
+                n_prompt = -(-T // pg) - len(pre_pages)
+                self._fill_pages(caches1, own[:n_prompt],
+                                 len(pre_pages) * pg)
+            else:
+                self._caches = jax.tree_util.tree_map(
+                    lambda pool, one: pool.at[:, slot].set(one[:, 0]),
+                    self._caches, caches1)
             self._tok = self._tok.at[slot].set(first)
             self._t = self._t.at[slot].set(T)
             self._active[slot] = True
@@ -317,6 +472,12 @@ class ContinuousBatchingServer:
         self._harvest()
         if not self._active.any():
             return 0
+        if self._kv is not None:
+            # admission reserved each slot's FULL extent (prompt +
+            # budget), so no page growth happens mid-flight; writes past
+            # a slot's table (wasted block steps of finished/inactive
+            # rows) are redirected to the null page and need no coverage
+            self._sync_block_table()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode_step()
         (self._tok, self._caches, self._t, self._keys,
@@ -351,6 +512,8 @@ class ContinuousBatchingServer:
                                                    np.int32)
                 self._active[slot] = False
                 self._slots[slot] = None
+                if self._kv is not None:
+                    self._kv.free_slot(slot)
                 finished = True
         if finished:
             self._done_cv.notify_all()

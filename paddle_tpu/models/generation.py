@@ -203,12 +203,87 @@ def _init_kv(shape, dtype, cache_dtype):
     return lc
 
 
-def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps):
+# ------------------------------------------------------ paged KV backend
+
+def _check_paged_config(max_cache_len, page_size, num_pages, cache_dtype,
+                        mesh):
+    """Validate a paged-cache decode bundle request. ``page_size`` must
+    divide ``max_cache_len`` so the block-table width times page size
+    equals the dense cache length — that equality is what makes the
+    paged decode path bit-identical to the dense one."""
+    if cache_dtype == "int8":
+        raise NotImplementedError(
+            "cache_dtype='int8' is not wired for the paged backend yet; "
+            "use cache_backend='dense' with int8 caches")
+    if mesh is not None:
+        raise NotImplementedError(
+            "mesh sharding is not wired for the paged backend yet")
+    if not page_size or int(page_size) < 1:
+        raise ValueError("paged backend needs page_size >= 1")
+    if not num_pages or int(num_pages) < 2:
+        raise ValueError("paged backend needs num_pages >= 2 (page 0 is "
+                         "the reserved null page)")
+    if max_cache_len % int(page_size):
+        raise ValueError(
+            f"page_size ({page_size}) must divide max_cache_len "
+            f"({max_cache_len}) for dense/paged token parity")
+
+
+def _init_paged_kv(batch, layers, num_pages, page_size, pages_per_slot,
+                   kvh, hd, dtype):
+    """Paged decode cache tree: one global K/V page pool per layer plus
+    the per-slot block table (a RUNTIME argument of the decode program —
+    page churn never recompiles)."""
+    shape = (layers, num_pages, page_size, kvh, hd)
+    return {"pool": {"k": jnp.zeros(shape, dtype),
+                     "v": jnp.zeros(shape, dtype)},
+            "bt": jnp.zeros((batch, pages_per_slot), jnp.int32)}
+
+
+def _page_write(pool, kv, bt, t):
+    """pool [P, pg, h, hd] <- kv [B, 1, h, hd] at per-slot positions
+    ``t`` ([B] or scalar). A position past the block-table width is
+    redirected to the null page (page 0), so the wasted decode steps of
+    finished/inactive slots can never corrupt a live slot's pages (the
+    dense analogue relies on out-of-bounds writes being dropped). The
+    redirected payload is ZEROED: past the cache length, rope gathers
+    beyond its table and returns jnp's NaN fill — and since every
+    slot's unused block-table entries point at the null page, a stored
+    NaN would poison every row's attention through 0-weight * NaN."""
+    pg = pool.shape[1]
+    b = kv.shape[0]
+    maxp = bt.shape[1]
+    if jnp.ndim(t) == 0:
+        t = jnp.full((b,), t, jnp.int32)
+    pidx = t // pg
+    oob = pidx >= maxp
+    page = jnp.where(oob, jnp.int32(0),
+                     bt[jnp.arange(b), jnp.minimum(pidx, maxp - 1)])
+    vals = kv[:, 0].astype(pool.dtype)
+    vals = jnp.where(oob[:, None, None], jnp.zeros_like(vals), vals)
+    return pool.at[page, t % pg].set(vals)
+
+
+def _paged_attend(q, k_pool, v_pool, bt, t, scale):
+    """Decode-step attention through the block table: q [B, 1, nh, hd],
+    pools [P, pg, kvh, hd], valid lengths t+1 (cache already written
+    through t). Pallas ragged kernel on TPU, bit-exact dense-mirroring
+    gather composition elsewhere. Returns [B, 1, nh, hd]."""
+    from ..ops.pallas.paged_attention import paged_attention
+    b = q.shape[0]
+    if jnp.ndim(t) == 0:
+        t = jnp.full((b,), t, jnp.int32)
+    return paged_attention(q[:, 0], k_pool, v_pool, bt, t + 1, scale)[:, None]
+
+
+def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
     """Shared llama-family attention sublayer for the decode scan:
     pre-RMSNorm, rope at absolute positions, GQA cache write + masked
     cached attention, output projection + residual. ``lc`` is this
-    layer's cache dict (fp or int8 codec). Returns (xx, lc, h2) with
-    h2 = the post-attention norm for the FFN."""
+    layer's cache dict (fp or int8 codec) — or, when ``bt`` (a per-slot
+    block table) is given, this layer's K/V page pools, written and
+    attended through the table (paged backend, decode steps only).
+    Returns (xx, lc, h2) with h2 = the post-attention norm for the FFN."""
     b, s, nh, kvh, hd, scale = dims
     cos, sin = tables
     from ..ops.pallas import rope as rope_mod
@@ -218,24 +293,33 @@ def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps):
     v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
     q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
     k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
-    lc = _kv_write(lc, "k", k, t)
-    lc = _kv_write(lc, "v", v, t)
-    kc = _kv_read(lc, "k", q.dtype)
-    vc = _kv_read(lc, "v", q.dtype)
-    rep = nh // kvh
-    kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
-    vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
-    att = _cached_attend(q, kk, vv, t, s, scale)
+    if bt is not None:
+        lc = {"k": _page_write(lc["k"], k, bt, t),
+              "v": _page_write(lc["v"], v, bt, t)}
+        att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale)
+    else:
+        lc = _kv_write(lc, "k", k, t)
+        lc = _kv_write(lc, "v", v, t)
+        kc = _kv_read(lc, "k", q.dtype)
+        vc = _kv_read(lc, "v", q.dtype)
+        rep = nh // kvh
+        kk = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+        vv = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+        att = _cached_attend(q, kk, vv, t, s, scale)
     xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
     h2 = _rms(xx, blk["ln2"], eps)
     return xx, lc, h2
 
 
 def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
-                cache_dtype=None):
+                cache_dtype=None, cache_backend="dense", page_size=None,
+                num_pages=None):
     """(init_caches, embed_fn, step_fn, head_fn) for LlamaForCausalLM —
     GQA-aware (kv heads cached unrepeated), rope applied at absolute
-    positions."""
+    positions. ``cache_backend="paged"`` swaps the dense per-slot cache
+    for a global page pool + per-slot block tables (decode steps only;
+    prefill runs on a dense batch-1 bundle and is scattered into
+    pages)."""
     from ..ops.pallas import rope as rope_mod
     cfg = model.cfg
     nh, kvh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -266,8 +350,16 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
     dtype = p["table"].dtype
     L = cfg.num_layers
     scale = 1.0 / np.sqrt(hd)
+    paged = cache_backend == "paged"
+    if paged:
+        _check_paged_config(max_cache_len, page_size, num_pages,
+                            cache_dtype, mesh)
 
     def init_caches(batch):
+        if paged:
+            return _init_paged_kv(batch, L, num_pages, page_size,
+                                  max_cache_len // page_size, kvh, hd,
+                                  dtype)
         return _init_kv((L, batch, max_cache_len, kvh, hd), dtype,
                         cache_dtype)
 
@@ -281,18 +373,22 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
         pos = _positions(t, b, s)                         # [B, s]
+        bt = caches["bt"] if paged else None
 
         def layer(xx, xs):
             blk, lc = xs
             xx, lc, h2 = _rope_gqa_attn(
                 blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
-                (cos, sin), eps)
+                (cos, sin), eps, bt=bt)
             xx = xx + _mm(jax.nn.silu(_mm(h2, blk["wg"]))
                           * _mm(h2, blk["wu"]), blk["wd"])
             return xx, lc
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
                     if k_ not in ("table", "norm", "head")}
+        if paged:
+            x, pool = jax.lax.scan(layer, x, (blk_tree, caches["pool"]))
+            return x, dict(caches, pool=pool)
         x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
         return x, new_caches
 
@@ -333,7 +429,8 @@ def _moe_topk_ffn(h, router_w, wg, wu, wd, top_k):
 
 
 def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
-                  cache_dtype=None):
+                  cache_dtype=None, cache_backend="dense", page_size=None,
+                  num_pages=None):
     """Llama-style attention + routed-expert FFN (MixtralForCausalLM)."""
     from ..ops.pallas import rope as rope_mod
     cfg = model.cfg
@@ -367,8 +464,16 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
     L = cfg.num_layers
     top_k = cfg.top_k
     scale = 1.0 / np.sqrt(hd)
+    paged = cache_backend == "paged"
+    if paged:
+        _check_paged_config(max_cache_len, page_size, num_pages,
+                            cache_dtype, mesh)
 
     def init_caches(batch):
+        if paged:
+            return _init_paged_kv(batch, L, num_pages, page_size,
+                                  max_cache_len // page_size, kvh, hd,
+                                  dtype)
         return _init_kv((L, batch, max_cache_len, kvh, hd), dtype,
                         cache_dtype)
 
@@ -382,18 +487,22 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
         pos = _positions(t, b, s)
+        bt = caches["bt"] if paged else None
 
         def layer(xx, xs):
             blk, lc = xs
             xx, lc, h2 = _rope_gqa_attn(
                 blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
-                (cos, sin), eps)
+                (cos, sin), eps, bt=bt)
             xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
                                     blk["wu"], blk["wd"], top_k)
             return xx, lc
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
                     if k_ not in ("table", "norm", "head")}
+        if paged:
+            x, pool = jax.lax.scan(layer, x, (blk_tree, caches["pool"]))
+            return x, dict(caches, pool=pool)
         x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
         return x, new_caches
 
@@ -405,7 +514,8 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
 
 
 def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
-              cache_dtype=None):
+              cache_dtype=None, cache_backend="dense", page_size=None,
+              num_pages=None):
     """(init_caches, embed_fn, step_fn, head_fn) for GPTForCausalLM —
     learned positions, fused qkv, tied lm head."""
     cfg = model.cfg
@@ -439,8 +549,16 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
     dtype = p["table"].dtype
     L = cfg.num_layers
     scale = 1.0 / np.sqrt(hd)
+    paged = cache_backend == "paged"
+    if paged:
+        _check_paged_config(max_cache_len, page_size, num_pages,
+                            cache_dtype, mesh)
 
     def init_caches(batch):
+        if paged:
+            return _init_paged_kv(batch, L, num_pages, page_size,
+                                  max_cache_len // page_size, nh, hd,
+                                  dtype)
         return _init_kv((L, batch, max_cache_len, nh, hd), dtype,
                         cache_dtype)
 
@@ -456,6 +574,7 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
     def step_fn(x, caches, t):
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
+        bt = caches["bt"] if paged else None
 
         def layer(xx, xs):
             blk, lc = xs
@@ -463,10 +582,16 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
             qkv = (_mm(h, blk["attn.qkv.weight"]) + blk["attn.qkv.bias"]
                    ).reshape(b, s, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            lc = _kv_write(lc, "k", k, t)
-            lc = _kv_write(lc, "v", v, t)
-            att = _cached_attend(q, _kv_read(lc, "k", q.dtype),
-                                 _kv_read(lc, "v", q.dtype), t, s, scale)
+            if paged:
+                lc = {"k": _page_write(lc["k"], k, bt, t),
+                      "v": _page_write(lc["v"], v, bt, t)}
+                att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale)
+            else:
+                lc = _kv_write(lc, "k", k, t)
+                lc = _kv_write(lc, "v", v, t)
+                att = _cached_attend(q, _kv_read(lc, "k", q.dtype),
+                                     _kv_read(lc, "v", q.dtype), t, s,
+                                     scale)
             xx = xx + (_mm(att.reshape(b, s, nh * hd),
                            blk["attn.proj.weight"])
                        + blk["attn.proj.bias"])
@@ -478,6 +603,9 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
 
         blk_tree = {k_: v_ for k_, v_ in p.items()
                     if k_ not in ("table", "wpe", "lnf_w", "lnf_b")}
+        if paged:
+            x, pool = jax.lax.scan(layer, x, (blk_tree, caches["pool"]))
+            return x, dict(caches, pool=pool)
         x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
         return x, new_caches
 
@@ -493,34 +621,47 @@ class GenerationMixin:
     prefill and the whole decode loop as on-device XLA programs."""
 
     def _decode_bundle(self, max_cache_len, weight_dtype=None, mesh=None,
-                       cache_dtype=None):
+                       cache_dtype=None, cache_backend="dense",
+                       page_size=None, num_pages=None):
         key = ("_pt_decode_bundle", max_cache_len, weight_dtype,
-               None if mesh is None else id(mesh), cache_dtype)
+               None if mesh is None else id(mesh), cache_dtype,
+               cache_backend, page_size, num_pages)
         cached = getattr(self, "_pt_decode_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        if cached is None:
+            cached = self._pt_decode_cache = {}
+        if key in cached:
+            cached[key] = cached.pop(key)      # LRU: move to back
+            return cached[key]
         from .gpt import GPTForCausalLM
         from .llama import LlamaForCausalLM
         from .mixtral import MixtralForCausalLM
+        kw = dict(cache_backend=cache_backend, page_size=page_size,
+                  num_pages=num_pages)
         if isinstance(self, MixtralForCausalLM):
             bundle = _make_mixtral_decode_fns(self, max_cache_len,
                                               weight_dtype, mesh,
-                                              cache_dtype)
+                                              cache_dtype, **kw)
         elif isinstance(self, LlamaForCausalLM):
             bundle = _make_llama_decode_fns(self, max_cache_len,
                                             weight_dtype, mesh,
-                                            cache_dtype)
+                                            cache_dtype, **kw)
         elif isinstance(self, GPTForCausalLM):
             bundle = _make_gpt_decode_fns(self, max_cache_len,
                                           weight_dtype, mesh,
-                                          cache_dtype)
+                                          cache_dtype, **kw)
         else:
             raise NotImplementedError(
                 f"generate() not wired for {type(self).__name__}")
         # one prefill program per (bundle, prompt-shape): jit here, not
         # inside generate(), so repeated calls reuse the compile
         bundle = bundle + (jax.jit(bundle[2], donate_argnums=(1,)),)
-        self._pt_decode_cache = (key, bundle)
+        cached[key] = bundle
+        # each bundle closes over a full stacked weight copy: cap the
+        # cache (LRU) so varied generate() shapes can't accumulate
+        # weight copies without bound. 4 covers the server's dense +
+        # paged pair twice over.
+        while len(cached) > 4:
+            cached.pop(next(iter(cached)))
         return bundle
 
     def _prefill_embed(self, ids, bundle, t0=0):

@@ -169,15 +169,18 @@ class QwenVL(nn.Layer):
 
         key = ("_pt_vl_bundle", max_cache_len)
         cached = getattr(self, "_pt_decode_cache", None)
-        if cached is not None and cached[0] == key:
-            bundle = cached[1]
-        else:
+        if cached is None:
+            cached = self._pt_decode_cache = {}
+        bundle = cached.pop(key, None)
+        if bundle is None:
             view = types.SimpleNamespace(cfg=self.cfg.text,
                                          model=self.language_model,
                                          lm_head=self.lm_head)
             fns = _make_llama_decode_fns(view, max_cache_len)
             bundle = fns + (jax.jit(fns[2], donate_argnums=(1,)),)
-            self._pt_decode_cache = (key, bundle)
+        cached[key] = bundle                   # LRU: newest at the back
+        while len(cached) > 4:                 # bundles pin weight copies
+            cached.pop(next(iter(cached)))
         init_caches, embed_fn, step_fn, head_fn, prefill_jit = bundle
 
         table = unwrap(self.language_model.embed_tokens.weight)

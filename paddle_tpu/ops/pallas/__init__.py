@@ -17,3 +17,15 @@ def on_tpu() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def tpu_compiler_params(**kwargs):
+    """JAX-version compat shim for the Mosaic compiler-params struct:
+    newer JAX exposes ``pltpu.CompilerParams``, 0.4.x calls it
+    ``pltpu.TPUCompilerParams``. Every Pallas kernel in this package
+    builds its ``compiler_params`` through here."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

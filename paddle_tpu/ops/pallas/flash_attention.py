@@ -23,7 +23,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from . import on_tpu
+from . import on_tpu, tpu_compiler_params
 
 # v5e-swept defaults (benchmarks/flash_block_sweep.py): 1024/1024 is
 # 3.7x faster fwd and 4.5x fwd+bwd than 128/128; >1024 fails to compile
@@ -133,7 +133,7 @@ def _flash_fwd_pallas(q, k, v, sm_scale, causal,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -284,7 +284,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, sm_scale, causal,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -310,7 +310,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, sm_scale, causal,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)

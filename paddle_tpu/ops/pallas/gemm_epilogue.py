@@ -24,7 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from . import on_tpu
+from . import on_tpu, tpu_compiler_params
 
 DEFAULT_BM = 256
 DEFAULT_BN = 256
@@ -99,7 +99,7 @@ def _gemm_epilogue_pallas(x, w, bias, activation, interpret=False):
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)

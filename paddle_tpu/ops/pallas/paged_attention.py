@@ -1,0 +1,228 @@
+"""Pallas ragged paged-attention decode kernel (TPU).
+
+Serving-side analogue of "Ragged Paged Attention" (PAPERS.md): instead of
+one dense per-slot KV buffer ``[slots, max_cache_len, heads, dim]`` —
+whose HBM footprint and decode read bandwidth scale with the CONFIGURED
+cache length — K/V live in a global page pool
+``[num_pages, page_size, kv_heads, head_dim]`` and each decode slot owns
+an ordered list of page ids (its block table). Decode attention gathers
+pages through the block table, masks by the slot's ACTUAL length, and
+early-exits pages wholly beyond it, so both memory and bandwidth scale
+with real tokens.
+
+Kernel shape: one query token per slot (decode step). Grid is
+``(slots, pages_per_slot)`` with the page axis innermost ("arbitrary"),
+accumulating an online softmax in VMEM scratch exactly like
+``flash_attention._fwd_kernel``; the block table and per-slot lengths
+ride ``PrefetchScalarGridSpec`` scalar prefetch so the page DMA for grid
+step ``(s, p)`` is issued from ``block_tables[s, p]`` before the body
+runs. GQA is handled in-kernel (query-head groups attend to their kv
+head) so the pool stores kv heads unrepeated.
+
+The XLA fallback (`_ref_paged_attention`) gathers pages into the
+contiguous ``[slot, pages*page_size, ...]`` frame and then mirrors
+``models/generation._cached_attend`` operation-for-operation, which makes
+the paged decode path BIT-IDENTICAL to the dense one whenever
+``pages_per_slot * page_size == max_cache_len`` (positions beyond a
+slot's length hit -1e30 in both, contributing exactly 0.0f to softmax
+and output). CPU tests run the Pallas kernel via ``interpret=True``.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import on_tpu, tpu_compiler_params
+
+NEG_INF = -1e30
+
+__all__ = ["paged_attention", "available"]
+
+
+def available() -> bool:
+    return on_tpu()
+
+
+# ----------------------------------------------------------------- kernel
+
+
+def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, page_size, pages_per_slot,
+                       kv_heads, rep, sm_scale):
+    """Grid (slots, pages_per_slot); one query row per slot.
+
+    q_ref  [1, nh, hd]       this slot's query token
+    k_ref  [1, page_size, kvh, hd]   the page block_tables[s, p] points at
+    len_ref[s]               valid KV tokens for slot s (ragged lengths)
+    Scratch m/l/acc carry the online softmax across the page axis.
+    """
+    from jax.experimental import pallas as pl
+
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[s]
+
+    # early-exit: a page whose first position is past the slot's length
+    # holds no valid tokens — skip all compute for it
+    @pl.when(p * page_size < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [nh, hd]
+        k = k_ref[0].astype(jnp.float32)            # [pg, kvh, hd]
+        v = v_ref[0].astype(jnp.float32)
+        nh = q.shape[0]
+        m_prev = m_scr[:]                           # [nh, 128]
+        l_prev = l_scr[:]
+
+        # ragged masking: position p*pg + j is valid iff < length
+        col = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (nh, page_size), 1)
+        valid = col < length
+
+        # per-kv-head-group contractions keep the MXU ops unbatched
+        logits = []
+        for g in range(kv_heads):
+            qg = q[g * rep:(g + 1) * rep]           # [rep, hd]
+            kg = k[:, g]                            # [pg, hd]
+            logits.append(jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s_log = jnp.concatenate(logits, axis=0) * sm_scale   # [nh, pg]
+        s_log = jnp.where(valid, s_log, NEG_INF)
+
+        m_cur = jnp.max(s_log, axis=-1, keepdims=True)       # [nh, 1]
+        m_new = jnp.maximum(m_prev[:, :1], m_cur)
+        corr = jnp.exp(m_prev[:, :1] - m_new)                # [nh, 1]
+        pexp = jnp.exp(s_log - m_new)                        # [nh, pg]
+        pexp = jnp.where(valid, pexp, 0.0)
+        l_scr[:] = jnp.broadcast_to(
+            corr * l_prev[:, :1] + jnp.sum(pexp, -1, keepdims=True),
+            l_scr.shape)
+        pv = []
+        for g in range(kv_heads):
+            pv.append(jax.lax.dot_general(
+                pexp[g * rep:(g + 1) * rep], v[:, g],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))         # [rep, hd]
+        acc_scr[:] = acc_scr[:] * corr + jnp.concatenate(pv, axis=0)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(p == pages_per_slot - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)              # empty slot guard
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, block_tables, lengths,
+                            sm_scale, interpret=False):
+    """q [S, nh, hd]; pages [P, pg, kvh, hd]; block_tables [S, maxp] int32
+    (unused tail entries must hold any VALID page id, e.g. 0); lengths
+    [S] int32. Returns [S, nh, hd]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    S, nh, hd = q.shape
+    P, pg, kvh, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    rep = nh // kvh
+    if nh % kvh:
+        raise ValueError(f"query heads ({nh}) must be a multiple of kv "
+                         f"heads ({kvh})")
+
+    flat_bt = block_tables.reshape(-1).astype(jnp.int32)
+    kernel = functools.partial(
+        _paged_attn_kernel, page_size=pg, pages_per_slot=maxp,
+        kv_heads=kvh, rep=rep, sm_scale=sm_scale)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, maxp),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda s, p, bt, ln: (s, 0, 0)),
+            pl.BlockSpec((1, pg, kvh, hd),
+                         lambda s, p, bt, ln: (bt[s * maxp + p], 0, 0, 0)),
+            pl.BlockSpec((1, pg, kvh, hd),
+                         lambda s, p, bt, ln: (bt[s * maxp + p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd), lambda s, p, bt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nh, hd), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(flat_bt, lengths.astype(jnp.int32), q, k_pages, v_pages)
+
+
+# ------------------------------------------------------ XLA reference path
+
+
+def _ref_paged_attention(q, k_pages, v_pages, block_tables, lengths,
+                         sm_scale):
+    """Gather-through-block-table reference. Mirrors the dense decode
+    attention (`generation._cached_attend` at s=1) op-for-op so the paged
+    server emits bit-identical tokens to the dense backend on every
+    platform: valid positions carry the exact cached values, positions at
+    or beyond ``lengths`` are masked to -1e30 before the same f32 softmax
+    (contributing exactly 0.0), and the einsum specs match."""
+    S, nh, hd = q.shape
+    P, pg, kvh, _ = k_pages.shape
+    maxp = block_tables.shape[1]
+    T = maxp * pg
+    k = k_pages[block_tables].reshape(S, T, kvh, hd)
+    v = v_pages[block_tables].reshape(S, T, kvh, hd)
+    rep = nh // kvh
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qb = q[:, None]                                        # [S, 1, nh, hd]
+    logits = jnp.einsum("bsnd,btnd->bnst", qb, k) * sm_scale
+    pos = jnp.arange(T)
+    ok = pos[None, None] < lengths[:, None, None]          # [S, 1, T]
+    logits = jnp.where(ok[:, None], logits.astype(jnp.float32), -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", p, v)[:, 0]
+
+
+# --------------------------------------------------------------- public
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths,
+                    sm_scale=None, interpret=False):
+    """Ragged paged-attention decode step.
+
+    q            [slots, num_heads, head_dim]   one query token per slot
+    k_pages      [num_pages, page_size, kv_heads, head_dim]  global pool
+    v_pages      same shape as ``k_pages``
+    block_tables [slots, pages_per_slot] int32  page ids, in position
+                 order; entries past a slot's allocation must hold a
+                 valid id (the manager fills them with 0)
+    lengths      [slots] int32  valid KV tokens per slot (ragged)
+
+    Returns [slots, num_heads, head_dim]. Runs the Pallas kernel on TPU
+    (or under ``interpret=True`` anywhere); elsewhere the gather-based
+    XLA composition, which is bit-identical to the dense decode path.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if available() or interpret:
+        return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                       lengths, sm_scale,
+                                       interpret=interpret)
+    return _ref_paged_attention(q, k_pages, v_pages, block_tables,
+                                lengths, sm_scale)

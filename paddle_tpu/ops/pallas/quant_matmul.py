@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from .flash_attention import on_tpu
+from . import tpu_compiler_params
 
 DEFAULT_BLOCK_M = 256
 DEFAULT_BLOCK_N = 256
@@ -81,7 +82,7 @@ def quantized_matmul(x, w, scale_x, scale_w, block_m=DEFAULT_BLOCK_M,
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, w, sx, sw)

@@ -21,6 +21,7 @@ from jax.sharding import NamedSharding
 
 from ..core.tensor import unwrap
 from .mesh import HybridMesh, P, get_mesh
+from .._compat import host_memory_kind as _host_memory_kind
 
 __all__ = ["param_shardings", "shard_params", "parallel_train_step",
            "zero_spec", "scale_and_shard_batch", "DataParallel",
@@ -253,7 +254,7 @@ def parallel_train_step(layer, loss_fn, optimizer, mesh: HybridMesh,
         # pinned_host; scalar counters stay on device (they are bytes, and
         # scalar placement annotations trip the SPMD partitioner)
         s_host = jax.tree_util.tree_map(
-            lambda leaf, sh: (sh.with_memory_kind("pinned_host")
+            lambda leaf, sh: (sh.with_memory_kind(_host_memory_kind())
                               if getattr(leaf, "ndim", 0) >= 1 else sh),
             opt_state, s_shard,
             is_leaf=lambda x: isinstance(x, jax.Array))

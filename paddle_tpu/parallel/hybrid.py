@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding
 
 from .api import zero_spec
 from .mesh import HybridMesh, P
+from .._compat import host_memory_kind as _host_memory_kind
 from .pp_1f1b import build_1f1b_train_step
 
 __all__ = ["make_llama_tp_fns", "make_tied_tp_lm_fns", "make_moe_tp_fns",
@@ -679,7 +680,7 @@ def build_hybrid_train_step(block_fn, embed_fn, head_loss_fn,
         # state — the wrapper streams it pinned_host <-> device around
         # the jitted update
         s_host = jax.tree_util.tree_map(
-            lambda leaf, sh: (sh.with_memory_kind("pinned_host")
+            lambda leaf, sh: (sh.with_memory_kind(_host_memory_kind())
                               if getattr(leaf, "ndim", 0) >= 1 else sh),
             opt_state, s_shard,
             is_leaf=lambda x: isinstance(x, jax.Array))

@@ -21,6 +21,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# JAX 0.4.x compat: tests call jax.shard_map(..., check_vma=...) — the
+# public name (and kwarg spelling) only exists from 0.5; route through
+# the repo shim so one suite runs on both.
+if not hasattr(jax, "shard_map"):
+    from paddle_tpu._compat import shard_map as _compat_shard_map
+    jax.shard_map = _compat_shard_map
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -105,6 +105,7 @@ def test_gradient_merge_updates_every_kth_step():
     assert acc_norm2 == 0, "accumulators must reset after the update"
 
 
+@pytest.mark.slow  # compile-heavy pipeline e2e
 def test_pipeline_routes_to_1f1b():
     from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
     dist.init_mesh(dp=4, pp=2)
@@ -136,6 +137,7 @@ def test_pipeline_routes_to_1f1b():
         "recompose must write trained weights back"
 
 
+@pytest.mark.slow  # compile-heavy pipeline e2e
 def test_pipeline_fp16_loss_scaling():
     """fp16 amp THROUGH the pipeline builder (closes the r4 refusal —
     reference engine.py fp16 pass composes with pipeline): the head
@@ -184,6 +186,7 @@ def test_pipeline_fp16_loss_scaling():
     assert losses[-1] <= losses[0] + 1e-3, losses
 
 
+@pytest.mark.slow  # compile-heavy pipeline e2e
 def test_pipeline_gradient_merge():
     """gradient_merge k_steps>1 composes WITH the pipeline (closes the
     r4 refusal): step 1 only accumulates, step k applies and resets."""
@@ -222,6 +225,7 @@ def test_pipeline_gradient_merge():
     assert acc2 == 0
 
 
+@pytest.mark.slow  # compile-heavy pipeline e2e
 def test_pipeline_evaluate_and_predict():
     """evaluate()/predict() under strategy.pipeline run the forward-only
     tick table over the train step's stage-stacked params (closes the
@@ -271,6 +275,7 @@ def test_dataset_shards_raises():
         eng._prepare()
 
 
+@pytest.mark.slow  # compile-heavy pipeline e2e
 def test_gpt_tied_pipeline_matches_eager():
     """GPT through the Engine pipeline keeps its WEIGHT TYING (the
     reference SharedLayerDesc GPT demo): the builder stores the shared

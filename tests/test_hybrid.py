@@ -12,6 +12,14 @@ import pytest
 
 import paddle_tpu as pt
 import paddle_tpu.parallel as dist
+from paddle_tpu._compat import host_memory_kind
+
+_HOST_KIND = host_memory_kind()
+
+# every test here compiles multi-device shard_map+scan programs (the
+# repo's costliest CPU-mesh compiles, ~200s of tier-1 wall on this
+# container); the whole module rides the slow lane — `pytest -m slow`
+pytestmark = pytest.mark.slow
 from paddle_tpu.parallel.hybrid import (build_hybrid_train_step,
                                         init_llama_tp_params,
                                         make_llama_tp_fns)
@@ -585,14 +593,14 @@ def test_hybrid_offload_keeps_state_on_host():
                 head_param_specs=specs[2], zero_stage=1, offload=off)
         if off:
             kinds = {s_sh["m"]["blocks"]["wq"].memory_kind}
-            assert kinds == {"pinned_host"}, kinds
+            assert kinds == {_HOST_KIND}, kinds
             assert opt_state["m"]["blocks"]["wq"].sharding.memory_kind \
-                == "pinned_host"
+                == _HOST_KIND
         l1, params, opt_state = step_fn(params, opt_state, ids, ids, 1)
         l2, params, opt_state = step_fn(params, opt_state, ids, ids, 2)
         if off:
             assert opt_state["m"]["blocks"]["wq"].sharding.memory_kind \
-                == "pinned_host"
+                == _HOST_KIND
         losses[off] = (float(l1), float(l2))
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 

@@ -7,9 +7,24 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _cpu_multiprocess_supported():
+    """The installed XLA CPU backend may reject cross-process programs
+    outright ("Multiprocess computations aren't implemented on the CPU
+    backend") — probe the version once instead of failing the e2e."""
+    import jax
+    ver = tuple(int(x) for x in jax.__version__.split(".")[:3])
+    return ver >= (0, 5, 0)
+
+
+@pytest.mark.skipif(
+    not _cpu_multiprocess_supported(),
+    reason="XLA CPU backend of this JAX (<0.5) cannot run multiprocess "
+           "computations; e2e needs a newer runtime or real chips")
 def test_two_process_collective(tmp_path):
     worker = os.path.join(REPO, "tests", "dist_collective_worker.py")
     env = {k: v for k, v in os.environ.items()

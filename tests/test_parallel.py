@@ -199,6 +199,7 @@ def test_scatter_eager_fallback():
     np.testing.assert_allclose(t.numpy(), [5.0, 6.0])
 
 
+@pytest.mark.slow  # ragged all_to_all compile is the file's 30s outlier
 def test_alltoall_single_uneven_splits():
     """Uneven alltoall (VERDICT r3 #7): rank-varying splits via the
     [world, world] size matrix — pad-to-max chunks, one all_to_all,

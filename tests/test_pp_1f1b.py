@@ -134,6 +134,7 @@ def _unstack(d_blk, counts, starts, L):
     return out
 
 
+@pytest.mark.slow  # heaviest pp compiles (~20s)
 @pytest.mark.parametrize("v,weights", [
     (1, None),                       # uniform 1F1B
     (1, [3, 1, 1, 1, 1, 3]),         # non-uniform (param-weighted)

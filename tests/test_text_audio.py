@@ -142,7 +142,8 @@ def test_power_to_db():
     s = np.array([1.0, 0.1, 1e-12], np.float32)
     db = AF.power_to_db(pt.to_tensor(s), top_db=None).numpy()
     np.testing.assert_allclose(db[:2], [0.0, -10.0], atol=1e-4)
-    assert db[2] == -100.0  # amin floor
+    # amin floor; f32 log10 rounds differently across XLA backends
+    np.testing.assert_allclose(db[2], -100.0, atol=1e-4)
     db = AF.power_to_db(pt.to_tensor(s), top_db=5.0).numpy()
     assert db.min() >= db.max() - 5.0
 

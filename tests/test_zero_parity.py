@@ -8,6 +8,9 @@ import jax
 
 import paddle_tpu as pt
 import paddle_tpu.parallel as dist
+from paddle_tpu._compat import host_memory_kind
+
+_HOST_KIND = host_memory_kind()
 
 
 def _make(seed=0):
@@ -30,11 +33,13 @@ def _train(zero_stage, steps=5):
     step_fn, params, opt_state, _ = parallel_train_step(
         net, _loss_fn, opt, mesh, zero_stage=zero_stage)
     rng = np.random.RandomState(0)
+    # one FIXED batch: descent on it is deterministic, where per-step
+    # fresh random targets make the loss trend platform-luck
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randn(8, 8).astype(np.float32)
+    batch = {"inputs": (x,), "labels": (y,)}
     losses = []
     for i in range(steps):
-        x = rng.randn(8, 16).astype(np.float32)
-        y = rng.randn(8, 8).astype(np.float32)
-        batch = {"inputs": (x,), "labels": (y,)}
         loss, params, opt_state = step_fn(params, opt_state, batch,
                                           i + 1, None)
         losses.append(float(loss))
@@ -61,20 +66,20 @@ def test_zero_offload_parity_and_host_placement():
     leaves = [l for l in jax.tree_util.tree_leaves(opt_state)
               if hasattr(l, "sharding") and l.ndim >= 1]
     assert leaves and all(
-        l.sharding.memory_kind == "pinned_host" for l in leaves)
+        l.sharding.memory_kind == _HOST_KIND for l in leaves)
     rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    y = rng.randn(8, 8).astype(np.float32)
+    batch = {"inputs": (x,), "labels": (y,)}
     losses = []
     for i in range(5):
-        x = rng.randn(8, 16).astype(np.float32)
-        y = rng.randn(8, 8).astype(np.float32)
-        batch = {"inputs": (x,), "labels": (y,)}
         loss, params, opt_state = step_fn(params, opt_state, batch,
                                           i + 1, None)
         losses.append(float(loss))
     # new state is streamed back to host memory every step
     leaves = [l for l in jax.tree_util.tree_leaves(opt_state)
               if hasattr(l, "sharding") and l.ndim >= 1]
-    assert all(l.sharding.memory_kind == "pinned_host" for l in leaves)
+    assert all(l.sharding.memory_kind == _HOST_KIND for l in leaves)
     np.testing.assert_allclose(losses, _train(2), rtol=2e-4, atol=1e-5)
 
 
@@ -89,4 +94,4 @@ def test_group_sharded_offload_api():
     leaves = [l for l in jax.tree_util.tree_leaves(opt_state)
               if hasattr(l, "sharding") and l.ndim >= 1]
     assert leaves and all(
-        l.sharding.memory_kind == "pinned_host" for l in leaves)
+        l.sharding.memory_kind == _HOST_KIND for l in leaves)
