@@ -54,7 +54,7 @@ def __getattr__(name):
                 "inference", "sparse", "text", "audio", "geometric",
                 "quantization", "distribution", "fft", "signal",
                 "regularizer", "linalg", "onnx", "callbacks", "hub",
-                "sysconfig", "reader", "cost_model"):
+                "sysconfig", "reader", "cost_model", "telemetry"):
         import importlib
         try:
             mod = importlib.import_module(f".{name}", __name__)

@@ -6,7 +6,13 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "VisualDL", "ReduceLROnPlateau"]
+           "EarlyStopping", "LRScheduler", "VisualDL", "ReduceLROnPlateau",
+           "TelemetryCallback"]
+
+# telemetry bridge (step time / loss / tokens-per-second into a
+# MetricRegistry); lives in telemetry.training, duck-typed against the
+# Callback protocol so the import direction stays telemetry -> nothing
+from ..telemetry.training import TelemetryCallback  # noqa: E402,F401
 
 
 class Callback:

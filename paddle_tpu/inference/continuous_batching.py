@@ -73,6 +73,14 @@ class ContinuousBatchingServer:
     prefixes are stored once and page-shared across slots, and tokens
     stay bit-identical to the dense backend. When the pool is full,
     admission waits (FIFO) for a harvest to free pages.
+
+    ``telemetry`` (``paddle_tpu.telemetry.ServerTelemetry``, or ``True``
+    for a default one) turns on SLO instrumentation: per-request
+    lifecycle spans and TTFT/TPOT/queue-wait histograms, per-tick
+    latency/occupancy, page-pool gauges and prefix-cache counters —
+    scrape via ``telemetry.MetricsServer(srv.telemetry.registry)``.
+    Host-side only; with the default ``telemetry=None`` the hot path
+    pays a single attribute check, no locks and no clock reads.
     """
 
     def __init__(self, model, max_slots=4, max_cache_len=256,
@@ -80,7 +88,7 @@ class ContinuousBatchingServer:
                  eos_token_id=None, seed=0, weight_dtype=None,
                  prefill_chunk=None, mesh=None, tick_block=1,
                  cache_dtype=None, cache_backend="dense", page_size=16,
-                 num_pages=None):
+                 num_pages=None, telemetry=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -140,6 +148,17 @@ class ContinuousBatchingServer:
         self._decode_jit = None
         self._prefixes = []   # [(ids, cache_rows, last_logits, pages)]
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0}
+        # telemetry (paddle_tpu.telemetry.ServerTelemetry): True builds
+        # a default-enabled one; None (default) keeps the hot path at
+        # a single attribute check — no locks, no clock reads
+        if telemetry is True:
+            from ..telemetry import ServerTelemetry
+            telemetry = ServerTelemetry()
+        self.telemetry = telemetry
+        self._tele = telemetry if (telemetry is not None
+                                   and telemetry.enabled) else None
+        self._failures = {}   # rid -> admission exception (ADVICE r5 #2)
+        self._run_failures = {}   # last run()'s drained failures
         # submit()/cancel() may come from request threads while a serve
         # thread drives step(); one lock covers the queue/slot state and
         # a condition on it wakes wait()ers at harvest time
@@ -171,9 +190,33 @@ class ContinuousBatchingServer:
                 if (pre_ids.shape[0] == T
                         and np.array_equal(pre_ids, ids)):
                     return T
+            if self._prefill_chunk:
+                # a queued request was bound-checked at submit against
+                # the prefixes registered THEN; refuse a new prefix
+                # whose remainder-chunk pad would overflow its rows
+                # mid-admission (ADVICE r5 #2)
+                for item in self._queue:
+                    q_ids = item[1]
+                    Tq = q_ids.shape[0]
+                    if Tq <= T or not np.array_equal(q_ids[:T], ids):
+                        continue
+                    cur = self._match_prefix(q_ids)
+                    if cur is not None and cur[0].shape[0] >= T:
+                        continue    # a longer match still wins
+                    rpad = self._chunk_pad(Tq - T)
+                    if Tq + rpad > self.max_cache_len:
+                        raise ValueError(
+                            f"registering this {T}-token prefix "
+                            f"would pad the queued {Tq}-token "
+                            f"request's remainder prefill {rpad} "
+                            f"rows past max_cache_len "
+                            f"({self.max_cache_len}) — register "
+                            f"prefixes before submitting")
             logits, caches1 = self.model._run_prefill(
                 self._bundle, ids[None], chunk=self._prefill_chunk)
             self.stats["prefill_tokens"] += T
+            if self._tele is not None:
+                self._tele.add_prefill_tokens(T)
             rows = jax.tree_util.tree_map(lambda c: c[:, :, :T], caches1)
             pages = []
             if self._kv is not None:
@@ -193,7 +236,9 @@ class ContinuousBatchingServer:
                 # starve the FIFO — refuse the registration instead
                 usable = self._kv.num_pages - 1 - self._pinned_pages
                 for _, q_ids, q_budget, _, _ in self._queue:
-                    if self._request_pages(q_ids, q_budget) > usable:
+                    q_need = self._request_pages(
+                        q_ids, q_budget, self._match_prefix(q_ids))
+                    if q_need > usable:
                         self._prefixes = [e for e in self._prefixes
                                           if e[3] is not pages]
                         self._kv.release(pages)
@@ -202,11 +247,22 @@ class ContinuousBatchingServer:
                             f"registering this {T}-token prefix pins "
                             f"{len(pages)} pages and would strand an "
                             f"already-queued request needing "
-                            f"{self._request_pages(q_ids, q_budget)} of "
+                            f"{q_need} of "
                             f"{usable} usable pages — grow num_pages "
                             f"or register prefixes before submitting")
                 self._fill_pages(caches1, pages, 0)
+            self._pool_gauges()
         return T
+
+    def _chunk_pad(self, seg_len):
+        """Rows the chunked prefill pads past ``seg_len`` — zero when
+        the segment runs UNCHUNKED (``seg_len <= chunk``:
+        generation._run_prefill takes the direct path and writes exactly
+        ``seg_len`` rows)."""
+        c = self._prefill_chunk
+        if not c or seg_len <= c:
+            return 0
+        return (-seg_len) % c
 
     def _match_prefix(self, ids):
         for pre_ids, rows, logits, pages in self._prefixes:
@@ -230,19 +286,31 @@ class ContinuousBatchingServer:
                                  "calling submit() per row")
             ids = ids[0]
         T = ids.shape[0]
-        pad = (-T) % self._prefill_chunk if self._prefill_chunk else 0
-        if max(T + max_new_tokens, T + pad) > self.max_cache_len:
-            raise ValueError(
-                f"prompt ({T}) + max({max_new_tokens} new tokens, "
-                f"{pad} prefill-chunk pad rows) exceeds max_cache_len "
-                f"({self.max_cache_len})")
         with self._lock:
+            hit = self._match_prefix(ids)
+            pad = 0
+            if self._prefill_chunk:
+                # a registered-prefix hit prefills only the REMAINDER at
+                # t0=n, whose own chunk pad can exceed the full-prompt
+                # pad (ADVICE r5 #2). Longest match wins at admission,
+                # prefixes are never removed, and register_prefix
+                # refuses new ones that would strand a queued request —
+                # so the CURRENT longest match decides the bound.
+                pad = self._chunk_pad(T - hit[0].shape[0]) \
+                    if hit is not None else self._chunk_pad(T)
+            if max(T + max_new_tokens, T + pad) > self.max_cache_len:
+                seg = "prefix-remainder" \
+                    if hit is not None and self._prefill_chunk else "prompt"
+                raise ValueError(
+                    f"prompt ({T}) + max({max_new_tokens} new tokens, "
+                    f"{pad} prefill-chunk pad rows on the {seg}) "
+                    f"exceeds max_cache_len ({self.max_cache_len})")
             if self._kv is not None:
                 # full-extent reservation (prompt + budget): a request
                 # that can never fit must fail HERE, not stall the FIFO
                 # forever — pool minus prefix-pinned pages, minus the
                 # pinned pages this request would itself share
-                need = self._request_pages(ids, int(max_new_tokens))
+                need = self._request_pages(ids, int(max_new_tokens), hit)
                 usable = self._kv.num_pages - 1 - self._pinned_pages
                 if need > usable:
                     raise ValueError(
@@ -256,6 +324,8 @@ class ContinuousBatchingServer:
                 seed = self._seed + rid
             self._queue.append((rid, ids, int(max_new_tokens), int(seed),
                                 on_token))
+            if self._tele is not None:
+                self._tele.on_submit(rid, T, len(self._queue))
         return rid
 
     def cancel(self, rid):
@@ -269,6 +339,9 @@ class ContinuousBatchingServer:
         for i, item in enumerate(self._queue):
             if item[0] == rid:
                 del self._queue[i]
+                if self._tele is not None:
+                    self._tele.on_cancel(rid)
+                    self._tele.set_queue_depth(len(self._queue))
                 return True
         for slot in range(self.max_slots):
             st = self._slots[slot]
@@ -279,6 +352,9 @@ class ContinuousBatchingServer:
                 self._slots[slot] = None
                 if self._kv is not None:
                     self._kv.free_slot(slot)
+                if self._tele is not None:
+                    self._tele.on_cancel(rid)
+                    self._pool_gauges()
                 return True
         return False
 
@@ -312,11 +388,19 @@ class ContinuousBatchingServer:
                                 bt=jnp.asarray(self._kv.block_table))
             self._kv.dirty = False
 
-    def _request_pages(self, ids, budget):
+    def _pool_gauges(self):
+        """Refresh the page-pool occupancy gauges (paged backend)."""
+        if self._tele is not None and self._kv is not None:
+            used = self._kv.used_pages()
+            self._tele.set_pool(self._kv.free_pages(),
+                                used - self._pinned_pages,
+                                self._pinned_pages)
+
+    def _request_pages(self, ids, budget, hit):
         """Fresh pages a request needs for its FULL extent (prompt +
         budget — reserved at admission so decode-time growth can never
-        hit an empty pool mid-flight), net of shared prefix pages."""
-        hit = self._match_prefix(ids)
+        hit an empty pool mid-flight), net of the shared pages of
+        ``hit`` (the caller's ``_match_prefix`` result)."""
         shared = len(hit[3]) if hit is not None else 0
         return -(-(ids.shape[0] + budget) // self._kv.page_size) - shared
 
@@ -325,77 +409,102 @@ class ContinuousBatchingServer:
         now? If not it (and everything behind it — FIFO) waits for a
         harvest to free pages."""
         _, ids, budget, _, _ = self._queue[0]
-        return self._kv.free_pages() >= self._request_pages(ids, budget)
+        return self._kv.free_pages() >= self._request_pages(
+            ids, budget, self._match_prefix(ids))
 
     # ------------------------------------------------------- scheduling
     def _admit(self):
-        """Fill free slots from the queue (one prefill program each)."""
+        """Fill free slots from the queue (one prefill program each).
+        A request whose admission raises is recorded in ``_failures``
+        (its waiters get the error) instead of killing the serve thread
+        or losing the rest of the queue (ADVICE r5 #2)."""
         for slot in range(self.max_slots):
             if self._active[slot] or not self._queue:
                 continue
             if self._kv is not None and not self._head_fits_pool():
                 break
             rid, ids, budget, req_seed, on_token = self._queue.pop(0)
-            T = ids.shape[0]
-            # per-request prefill at batch 1 (optionally in fixed-size
-            # chunks: one compiled program for every prompt length),
-            # then scatter into the pool. A registered-prefix hit seeds
-            # the caches and prefills only the remainder.
-            hit = self._match_prefix(ids)
-            pre_pages = []
-            if hit is not None:
-                pre_ids, rows, pre_logits, pre_pages = hit
-                n = pre_ids.shape[0]
-                caches1 = jax.tree_util.tree_map(
-                    lambda full, r: full.at[:, :, :r.shape[2]].set(r),
-                    self._init_caches(1), rows)
-                rest = ids[n:]
-                self.stats["prefix_hit_tokens"] += n
-                if rest.shape[0]:
-                    logits, caches1 = self.model._run_prefill(
-                        self._bundle, rest[None],
-                        chunk=self._prefill_chunk, caches=caches1, t0=n)
-                    self.stats["prefill_tokens"] += rest.shape[0]
-                else:
-                    logits = pre_logits
-            else:
+            if self._tele is not None:
+                self._tele.on_admit(rid, len(self._queue))
+            try:
+                self._admit_one(slot, rid, ids, budget, req_seed,
+                                on_token)
+            except Exception as e:
+                if self._kv is not None and self._kv.slot_pages(slot):
+                    self._kv.free_slot(slot)     # roll back a part-admit
+                self._active[slot] = False
+                self._slots[slot] = None
+                self._failures[rid] = e
+                if self._tele is not None:
+                    self._tele.on_admission_failure(rid, e)
+                self._done_cv.notify_all()
+        if self._tele is not None:
+            self._pool_gauges()
+
+    def _admit_one(self, slot, rid, ids, budget, req_seed, on_token):
+        T = ids.shape[0]
+        # per-request prefill at batch 1 (optionally in fixed-size
+        # chunks: one compiled program for every prompt length),
+        # then scatter into the pool. A registered-prefix hit seeds
+        # the caches and prefills only the remainder.
+        hit = self._match_prefix(ids)
+        pre_pages = []
+        if hit is not None:
+            pre_ids, rows, pre_logits, pre_pages = hit
+            n = pre_ids.shape[0]
+            caches1 = jax.tree_util.tree_map(
+                lambda full, r: full.at[:, :, :r.shape[2]].set(r),
+                self._init_caches(1), rows)
+            rest = ids[n:]
+            self.stats["prefix_hit_tokens"] += n
+            if rest.shape[0]:
                 logits, caches1 = self.model._run_prefill(
-                    self._bundle, ids[None], chunk=self._prefill_chunk)
-                self.stats["prefill_tokens"] += T
-            key = jax.random.PRNGKey(req_seed)
-            if self.do_sample:
-                # same split pattern as sample_generate.run: one split,
-                # sample tok0 from the [1, V] prefill logits
-                key, sub = jax.random.split(key)
-                from .decode_loop import process_logits
-                first = int(jax.random.categorical(
-                    sub, process_logits(logits, self._temperature,
-                                        self._top_k, self._top_p),
-                    axis=-1)[0])
+                    self._bundle, rest[None],
+                    chunk=self._prefill_chunk, caches=caches1, t0=n)
+                self.stats["prefill_tokens"] += rest.shape[0]
             else:
-                first = int(jnp.argmax(logits, -1)[0])
-            self._keys = self._keys.at[slot].set(key)
-            if self._kv is not None:
-                # shared prefix pages join this slot's table by
-                # reference (stored once); the FULL extent (prompt +
-                # budget) is reserved up front so mid-decode growth can
-                # never exhaust the pool; only prompt rows are copied
-                pg = self._kv.page_size
-                own = self._kv.admit_slot(slot, T + budget, pre_pages)
-                n_prompt = -(-T // pg) - len(pre_pages)
-                self._fill_pages(caches1, own[:n_prompt],
-                                 len(pre_pages) * pg)
-            else:
-                self._caches = jax.tree_util.tree_map(
-                    lambda pool, one: pool.at[:, slot].set(one[:, 0]),
-                    self._caches, caches1)
-            self._tok = self._tok.at[slot].set(first)
-            self._t = self._t.at[slot].set(T)
-            self._active[slot] = True
-            st = _Slot(rid, T, budget, on_token)
-            st.emitted.append(int(first))
-            st.stream(self._deferred_cbs)
-            self._slots[slot] = st
+                logits = pre_logits
+        else:
+            logits, caches1 = self.model._run_prefill(
+                self._bundle, ids[None], chunk=self._prefill_chunk)
+            self.stats["prefill_tokens"] += T
+        key = jax.random.PRNGKey(req_seed)
+        if self.do_sample:
+            # same split pattern as sample_generate.run: one split,
+            # sample tok0 from the [1, V] prefill logits
+            key, sub = jax.random.split(key)
+            from .decode_loop import process_logits
+            first = int(jax.random.categorical(
+                sub, process_logits(logits, self._temperature,
+                                    self._top_k, self._top_p),
+                axis=-1)[0])
+        else:
+            first = int(jnp.argmax(logits, -1)[0])
+        self._keys = self._keys.at[slot].set(key)
+        if self._kv is not None:
+            # shared prefix pages join this slot's table by
+            # reference (stored once); the FULL extent (prompt +
+            # budget) is reserved up front so mid-decode growth can
+            # never exhaust the pool; only prompt rows are copied
+            pg = self._kv.page_size
+            own = self._kv.admit_slot(slot, T + budget, pre_pages)
+            n_prompt = -(-T // pg) - len(pre_pages)
+            self._fill_pages(caches1, own[:n_prompt],
+                             len(pre_pages) * pg)
+        else:
+            self._caches = jax.tree_util.tree_map(
+                lambda pool, one: pool.at[:, slot].set(one[:, 0]),
+                self._caches, caches1)
+        self._tok = self._tok.at[slot].set(first)
+        self._t = self._t.at[slot].set(T)
+        self._active[slot] = True
+        st = _Slot(rid, T, budget, on_token)
+        st.emitted.append(int(first))
+        st.stream(self._deferred_cbs)
+        self._slots[slot] = st
+        if self._tele is not None:
+            pre_n = hit[0].shape[0] if hit is not None else 0
+            self._tele.on_first_token(rid, T - pre_n, pre_n)
 
     # ------------------------------------------------------------ steps
     def _build_decode_step(self):
@@ -466,11 +575,15 @@ class ContinuousBatchingServer:
     def _step_locked(self):
         self._admit()
         if not self._active.any():
+            if self._tele is not None:     # keep the gauge live when a
+                self._tele.set_active_slots(0)   # drained tick skips decode
             return 0
         # harvest BEFORE stepping: a slot whose budget is spent (or that
         # emitted eos at admission) must not decode further
         self._harvest()
         if not self._active.any():
+            if self._tele is not None:
+                self._tele.set_active_slots(0)
             return 0
         if self._kv is not None:
             # admission reserved each slot's FULL extent (prompt +
@@ -480,10 +593,14 @@ class ContinuousBatchingServer:
             self._sync_block_table()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode_step()
+        tele = self._tele
+        n_active = int(self._active.sum())
+        t_tick = tele.tick_started() if tele is not None else None
         (self._tok, self._caches, self._t, self._keys,
          toks) = self._decode_jit(self._tok, self._caches, self._t,
                                   self._keys)
         toks = np.asarray(toks)                    # [slots, tick_block]
+        decoded = wasted = 0
         for slot in range(self.max_slots):
             if not self._active[slot]:
                 continue
@@ -491,11 +608,27 @@ class ContinuousBatchingServer:
             for j in range(toks.shape[1]):
                 st.emitted.append(int(toks[slot, j]))
                 if self._finished(st):
+                    wasted += toks.shape[1] - (j + 1)
                     break              # later block tokens are waste
+            decoded += min(j + 1, toks.shape[1])
             st.stream(self._deferred_cbs)
+        if tele is not None:
+            # np.asarray above synced the dispatch, so the tick time
+            # covers host dispatch + device work
+            tele.on_tick(t_tick, n_active, decoded)
+            if wasted:
+                tele.add_wasted_block_tokens(wasted)
+            if self._kv is not None:
+                # inactive rows still step; their writes go through an
+                # all-null block table row straight to the null page
+                tele.add_null_writes(
+                    (self.max_slots - n_active) * toks.shape[1])
         self._harvest()
         self._admit()
-        return int(self._active.sum())
+        n = int(self._active.sum())
+        if tele is not None:
+            tele.set_active_slots(n)
+        return n
 
     def _finished(self, st):
         if len(st.emitted) >= st.budget:
@@ -508,18 +641,25 @@ class ContinuousBatchingServer:
         for slot in range(self.max_slots):
             st = self._slots[slot]
             if self._active[slot] and self._finished(st):
-                self._results[st.rid] = np.asarray(st.emitted[:st.budget],
-                                                   np.int32)
+                out = np.asarray(st.emitted[:st.budget], np.int32)
+                self._results[st.rid] = out
                 self._active[slot] = False
                 self._slots[slot] = None
                 if self._kv is not None:
                     self._kv.free_slot(slot)
+                if self._tele is not None:
+                    self._tele.on_finish(st.rid, len(out))
                 finished = True
         if finished:
+            if self._tele is not None:
+                self._pool_gauges()
             self._done_cv.notify_all()
 
     def run(self, max_ticks=100000):
-        """Drive until queue and slots drain; returns {rid: new_tokens}."""
+        """Drive until queue and slots drain; returns {rid: new_tokens}.
+        Requests whose admission failed are left out — their exceptions
+        are drained into ``failures`` (per run, so records never
+        accumulate across runs)."""
         ticks = 0
         while ticks < max_ticks:
             with self._lock:
@@ -530,6 +670,7 @@ class ContinuousBatchingServer:
             ticks += 1
         with self._lock:
             out, self._results = self._results, {}
+            self._run_failures, self._failures = self._failures, {}
         return out
 
     # ------------------------------------------------------ serve thread
@@ -574,13 +715,18 @@ class ContinuousBatchingServer:
 
     def wait(self, rid, timeout=120.0):
         """Block until ``rid`` finishes (requires start()); returns its
-        new tokens. Raises the serve thread's error if it died."""
+        new tokens. Raises this request's admission error if it failed,
+        or the serve thread's error if the whole thread died."""
         import time as _time
         deadline = _time.monotonic() + timeout
         with self._done_cv:
             while True:
                 if rid in self._results:
                     return self._results.pop(rid)
+                if rid in self._failures:
+                    e = self._failures.pop(rid)
+                    raise RuntimeError(
+                        f"request {rid} failed at admission: {e}") from e
                 if self._thread_error is not None:
                     raise RuntimeError(
                         "serve thread died") from self._thread_error
@@ -589,3 +735,11 @@ class ContinuousBatchingServer:
                     raise TimeoutError(
                         f"request {rid} not finished in {timeout}s")
                 self._done_cv.wait(timeout=min(remaining, 1.0))
+
+    @property
+    def failures(self):
+        """{rid: exception} for requests whose admission failed:
+        pending ones (start()/wait() mode — ``wait(rid)`` pops and
+        raises each) plus those drained by the last ``run()``."""
+        with self._lock:
+            return {**self._run_failures, **self._failures}

@@ -129,10 +129,13 @@ def greedy_generate(embed_fn, step_fn, head_fn, caches, first_token, t0,
 
     def run(first_token, caches, t0):
         B = first_token.shape[0]
-        carry = (first_token.astype(jnp.int32),
-                 caches,
-                 jnp.asarray(t0, jnp.int32),
-                 jnp.zeros((B,), bool))
+        tok0 = first_token.astype(jnp.int32)
+        # the prefill's token counts: an eos-first row is already done
+        # and must eos-pad its whole tail, matching sample_generate and
+        # the batching server (ADVICE r5 #1)
+        done = (tok0 == eos_token_id) if eos_token_id is not None \
+            else jnp.zeros((B,), bool)
+        carry = (tok0, caches, jnp.asarray(t0, jnp.int32), done)
         (_, cs, _, _), toks = jax.lax.scan(body, carry, None,
                                            length=max_new_tokens)
         return jnp.transpose(toks, (1, 0)), cs   # [B, T_new]

@@ -32,6 +32,14 @@ def export_decode(path_prefix, model, prompt_len, max_new_tokens,
 
     if max_cache_len is None:
         max_cache_len = prompt_len + max_new_tokens
+    elif prompt_len + max_new_tokens > max_cache_len:
+        # decode writes via lax.dynamic_update_slice, which CLAMPS
+        # out-of-bounds starts — an undersized cache would silently
+        # overwrite its last rows and emit wrong tokens (ADVICE r5 #5);
+        # fail like GenerationMixin.generate does
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds max_cache_len ({max_cache_len})")
     bundle = model._decode_bundle(max_cache_len, weight_dtype)
     init_caches, embed_fn, step_fn, head_fn, _ = bundle
 
@@ -55,8 +63,11 @@ def export_decode(path_prefix, model, prompt_len, max_new_tokens,
                 done = done | (nxt == eos_token_id)
             return (nxt, cs2, t + 1, done), tok
 
-        carry = (first, caches, jnp.int32(prompt_len),
-                 jnp.zeros((batch,), bool))
+        # an eos-first prefill must eos-pad the whole output, matching
+        # the in-process generate() (ADVICE r5 #3)
+        done = (first == eos_token_id) if eos_token_id is not None \
+            else jnp.zeros((batch,), bool)
+        carry = (first, caches, jnp.int32(prompt_len), done)
         _, toks = jax.lax.scan(body, carry, None, length=max_new_tokens)
         return jnp.transpose(toks, (1, 0))
 

@@ -58,6 +58,11 @@ class PagedKVCache:
         self._slot_pages = [[] for _ in range(max_slots)]
         self._slot_shared = [0] * max_slots
         self.dirty = True
+        # cumulative churn counters (telemetry: page-pool pressure and
+        # sharing effectiveness without polling mid-operation)
+        self.alloc_total = 0       # pages taken off the free list
+        self.freed_total = 0       # pages returned (refcount hit 0)
+        self.shared_ref_total = 0  # extra refs taken on shared pages
 
     # ------------------------------------------------------- allocation
     def _npages(self, n_tokens):
@@ -79,6 +84,7 @@ class PagedKVCache:
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
+        self.alloc_total += n
         return pages
 
     def release(self, pages):
@@ -88,6 +94,7 @@ class PagedKVCache:
             self._ref[p] -= 1
             if self._ref[p] == 0:
                 self._free.append(p)
+                self.freed_total += 1
 
     # ------------------------------------------------------- slot state
     def coverage(self, slot):
@@ -116,6 +123,7 @@ class PagedKVCache:
         own = self.alloc(need - len(shared_pages))
         for p in shared_pages:
             self._ref[p] += 1
+        self.shared_ref_total += len(shared_pages)
         pages = list(shared_pages) + own
         self._slot_pages[slot] = pages
         self._slot_shared[slot] = len(shared_pages)
@@ -136,6 +144,17 @@ class PagedKVCache:
         self.dirty = True
 
     # ------------------------------------------------------- accounting
+    def telemetry_stats(self):
+        """Point-in-time pool state + cumulative churn, plain data —
+        the ``/stats`` payload and the page-pool gauges source."""
+        return {"num_pages": self.num_pages,
+                "page_size": self.page_size,
+                "free_pages": self.free_pages(),
+                "used_pages": self.used_pages(),
+                "alloc_total": self.alloc_total,
+                "freed_total": self.freed_total,
+                "shared_ref_total": self.shared_ref_total}
+
     @staticmethod
     def paged_hbm_bytes(num_pages, page_size, layers, kv_heads, head_dim,
                         itemsize=4):

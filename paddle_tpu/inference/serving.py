@@ -15,16 +15,17 @@ from concurrent.futures import Future
 
 import numpy as np
 
-__all__ = ["BatchScheduler"]
+__all__ = ["BatchScheduler", "serve_metrics"]
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "n")
+    __slots__ = ("inputs", "future", "n", "t_submit")
 
-    def __init__(self, inputs):
+    def __init__(self, inputs, t_submit=None):
         self.inputs = inputs
         self.future = Future()
         self.n = int(inputs[0].shape[0])    # rows this request contributes
+        self.t_submit = t_submit
 
 
 class BatchScheduler:
@@ -40,9 +41,16 @@ class BatchScheduler:
     launches after ``max_delay_ms``. Requests whose trailing shapes
     differ batch separately (a shape change would recompile — the
     scheduler never mixes them).
+
+    ``registry`` (``telemetry.MetricRegistry``) publishes
+    ``scheduler_batch_rows`` / ``scheduler_batch_seconds`` /
+    ``scheduler_queue_wait_seconds`` histograms and
+    ``scheduler_{requests,batches,failures}_total`` counters; with the
+    default ``None`` the hot path pays one ``is None`` check.
     """
 
-    def __init__(self, runner, max_batch_size=8, max_delay_ms=5.0):
+    def __init__(self, runner, max_batch_size=8, max_delay_ms=5.0,
+                 registry=None, clock=None):
         self._run = (runner.run if hasattr(runner, "run") else runner)
         self.max_batch = int(max_batch_size)
         self.max_delay = float(max_delay_ms) / 1e3
@@ -50,6 +58,30 @@ class BatchScheduler:
         self._queue = []                    # pending _Request, FIFO
         self._closed = False
         self.batches_run = 0                # introspection for tests
+        self._m = None
+        if registry is not None and registry.enabled:
+            from ..telemetry.clock import MonotonicClock
+            from ..telemetry.serving import (OCCUPANCY_BUCKETS,
+                                             TICK_BUCKETS)
+            self._clock = clock if clock is not None else MonotonicClock()
+            self._m = {
+                "rows": registry.histogram(
+                    "scheduler_batch_rows", "Rows per batched call",
+                    buckets=OCCUPANCY_BUCKETS),
+                "batch_s": registry.histogram(
+                    "scheduler_batch_seconds", "One batched runner call",
+                    buckets=TICK_BUCKETS),
+                "wait_s": registry.histogram(
+                    "scheduler_queue_wait_seconds",
+                    "submit() to batch launch", buckets=TICK_BUCKETS),
+                "requests": registry.counter(
+                    "scheduler_requests_total", "Requests submitted"),
+                "batches": registry.counter(
+                    "scheduler_batches_total", "Batched calls run"),
+                "failures": registry.counter(
+                    "scheduler_failures_total",
+                    "Batched calls that raised"),
+            }
         self._worker = threading.Thread(target=self._loop, daemon=True)
         self._worker.start()
 
@@ -62,6 +94,9 @@ class BatchScheduler:
         with self._lock:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
+            if self._m:        # count only ACCEPTED requests
+                req.t_submit = self._clock.now()
+                self._m["requests"].inc()
             self._queue.append(req)
             self._lock.notify()
         return req.future
@@ -113,16 +148,57 @@ class BatchScheduler:
             if not group:
                 continue
             try:
+                if self._m:
+                    t_launch = self._clock.now()
+                    for r in group:
+                        self._m["wait_s"].observe(t_launch - r.t_submit)
+                    self._m["rows"].observe(sum(r.n for r in group))
                 stacked = [np.concatenate([r.inputs[i] for r in group], 0)
                            for i in range(len(group[0].inputs))]
                 outs = self._run(stacked)
                 self.batches_run += 1
+                if self._m:
+                    self._m["batches"].inc()
+                    self._m["batch_s"].observe(
+                        self._clock.now() - t_launch)
                 off = 0
                 for r in group:
                     r.future.set_result(
                         [np.asarray(o)[off:off + r.n] for o in outs])
                     off += r.n
             except Exception as e:              # propagate to every waiter
+                if self._m:
+                    self._m["failures"].inc()
                 for r in group:
                     if not r.future.done():
                         r.future.set_exception(e)
+
+
+def serve_metrics(target, host="127.0.0.1", port=0):
+    """Expose a serving stack's telemetry over HTTP: ``/metrics``
+    (Prometheus text) and ``/stats`` (JSON snapshot + process stats).
+
+    ``target`` is a ``ContinuousBatchingServer`` (uses its attached
+    ``telemetry``), a ``ServerTelemetry``, or a bare ``MetricRegistry``.
+    Returns a started ``telemetry.MetricsServer`` (``.url``, ``.port``,
+    ``.close()``). ``port=0`` binds an ephemeral port.
+    """
+    from ..telemetry.exposition import MetricsServer
+
+    extra = None
+    tele = getattr(target, "telemetry", target)
+    if tele is None:
+        raise ValueError(
+            "server has no telemetry attached — construct it with "
+            "telemetry=True (or a ServerTelemetry) to expose metrics")
+    registry = getattr(tele, "registry", tele)
+    if hasattr(target, "stats"):          # ContinuousBatchingServer
+        kv = getattr(target, "_kv", None)
+
+        def extra():
+            stats = dict(target.stats)
+            if kv is not None:
+                stats["kv_pool"] = kv.telemetry_stats()
+            return stats
+    return MetricsServer(registry, host=host, port=port,
+                         extra_stats=extra).start()

@@ -10,7 +10,28 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy",
+           "publish"]
+
+
+def publish(metric, registry, name=None):
+    """Publish a ``Metric``'s ``accumulate()`` into a telemetry gauge
+    (``eval_<metric base name>`` by default) so eval-loop quality
+    metrics ride the same ``/metrics`` exposition as the
+    serving/training signals. Multi-valued metrics (e.g. top-k
+    Accuracy) keep one gauge per metric and label each component.
+    Returns the value(s) published."""
+    vals = metric.accumulate()
+    names = metric.name()
+    base = name or f"eval_{getattr(metric, '_name', None) or 'metric'}"
+    if isinstance(vals, (list, tuple)):
+        g = registry.gauge(base, "Eval metric value",
+                           labelnames=("component",))
+        for n, v in zip(names, vals):
+            g.labels(component=n).set(float(v))
+    else:
+        registry.gauge(base, "Eval metric value").set(float(vals))
+    return vals
 
 
 def _np(x):

@@ -162,9 +162,12 @@ class RecordEvent:
 
 class StepTimer:
     """Throughput reporter (reference python/paddle/profiler/timer.py used
-    by fleet to report ips)."""
+    by fleet to report ips). ``publish_to(registry)`` bridges every
+    ``step()`` into the telemetry subsystem (per-step histogram + ips
+    gauge) at zero cost when unattached."""
 
     def __init__(self):
+        self._tele = None
         self.reset()
 
     def reset(self):
@@ -172,6 +175,19 @@ class StepTimer:
         self.samples = 0
         self.total_time = 0.0
         self._t0 = None
+
+    def publish_to(self, registry, prefix="step_timer"):
+        """Publish ``<prefix>_seconds`` (histogram) and ``<prefix>_ips``
+        (gauge) into a ``telemetry.MetricRegistry`` on every step()."""
+        from ..telemetry.training import STEP_BUCKETS
+        if registry.enabled:
+            self._tele = (
+                registry.histogram(f"{prefix}_seconds",
+                                   "Per-step wall time",
+                                   buckets=STEP_BUCKETS),
+                registry.gauge(f"{prefix}_ips",
+                               "Items (samples, else steps) per second"))
+        return self
 
     def start(self):
         self._t0 = time.perf_counter()
@@ -183,12 +199,18 @@ class StepTimer:
 
     def step(self, num_samples=None):
         now = time.perf_counter()
+        dt = None
         if self._t0 is not None:
-            self.total_time += now - self._t0
+            dt = now - self._t0
+            self.total_time += dt
         self._t0 = now
         self.count += 1
         if num_samples:
             self.samples += num_samples
+        if dt is not None and self._tele is not None:
+            hist, gauge = self._tele
+            hist.observe(dt)
+            gauge.set(self.ips())
 
     def ips(self):
         if self.total_time <= 0:
@@ -198,8 +220,10 @@ class StepTimer:
 
 
 @contextlib.contextmanager
-def profiler_step_timer():
+def profiler_step_timer(registry=None, prefix="step_timer"):
     t = StepTimer()
+    if registry is not None:
+        t.publish_to(registry, prefix)
     t.start()
     yield t
     t.stop()

@@ -1,0 +1,54 @@
+"""paddle_tpu.telemetry — runtime observability subsystem.
+
+Framework-wide metrics + tracing, built for the serving/training stack
+(reference analogue: the profiler/tracing layer in
+python/paddle/profiler/, SURVEY §5.1 — here re-centered on PRODUCTION
+observability rather than one-off profiling sessions):
+
+- ``MetricRegistry`` / ``Counter`` / ``Gauge`` / ``Histogram``
+  (metrics.py): thread-safe, labeled, snapshot + Prometheus text
+  exposition. A disabled registry hands out no-op instruments — zero
+  locks and zero clock reads on the hot path.
+- ``Tracer`` / ``Span`` (tracing.py): host-side trace spans on an
+  injectable clock, Chrome-trace JSON export, optional mirroring into
+  ``profiler.RecordEvent`` so spans land inside jax device traces.
+- ``MetricsServer`` (exposition.py): ``/metrics`` (Prometheus text) +
+  ``/stats`` (JSON) scrape endpoint.
+- ``ServerTelemetry`` (serving.py): the continuous-batching server's
+  SLO instrumentation — TTFT/TPOT/queue-wait, tick occupancy, page-pool
+  gauges, prefix-cache counters, per-request lifecycle spans.
+- ``TelemetryCallback`` (training.py): hapi bridge for step time,
+  loss, tokens/s.
+- ``MonotonicClock`` / ``FakeClock`` (clock.py): every time read is
+  injectable; tests script exact latencies with a fake clock.
+
+``default_registry()`` returns the process-wide registry (enabled;
+opt-in wiring — nothing publishes to it unless you pass it somewhere).
+"""
+from .clock import FakeClock, MonotonicClock  # noqa: F401
+from .metrics import (DEFAULT_BUCKETS, Counter, Gauge,  # noqa: F401
+                      Histogram, MetricRegistry, NULL_INSTRUMENT,
+                      NullInstrument)
+from .tracing import NULL_SPAN, NullSpan, Span, Tracer  # noqa: F401
+from .exposition import (MetricsServer, parse_prometheus,  # noqa: F401
+                         render_prometheus)
+from .serving import ServerTelemetry  # noqa: F401
+from .training import TelemetryCallback  # noqa: F401
+
+__all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
+           "NullInstrument", "NULL_INSTRUMENT", "DEFAULT_BUCKETS",
+           "Tracer", "Span", "NullSpan", "NULL_SPAN",
+           "MonotonicClock", "FakeClock",
+           "MetricsServer", "render_prometheus", "parse_prometheus",
+           "ServerTelemetry", "TelemetryCallback",
+           "default_registry"]
+
+_default_registry = None
+
+
+def default_registry():
+    """Process-wide shared registry (created on first use)."""
+    global _default_registry
+    if _default_registry is None:
+        _default_registry = MetricRegistry()
+    return _default_registry

@@ -1,0 +1,233 @@
+"""Prometheus text exposition + a minimal scrape endpoint.
+
+``render_prometheus`` serializes a ``MetricRegistry`` in text format
+0.0.4 (the format every Prometheus-compatible scraper speaks);
+``parse_prometheus`` is the inverse for the sample lines — it exists so
+tests can assert the exposition ROUND-TRIPS (render -> parse -> same
+values), not for scraping production endpoints.
+
+``MetricsServer`` is a stdlib ThreadingHTTPServer exposing
+- ``/metrics`` — Prometheus text (scrape target), and
+- ``/stats``   — the registry snapshot as JSON plus any extra
+  process-level stats the owner passes (e.g. the batching server's
+  ``stats`` dict), for humans and ad-hoc dashboards.
+"""
+import json
+import threading
+
+__all__ = ["render_prometheus", "parse_prometheus", "MetricsServer",
+           "snapshot_json"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape_help(s):
+    return s.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _escape_label(s):
+    return s.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt_value(v):
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _labels_str(names, values, extra=()):
+    pairs = [f'{n}="{_escape_label(str(v))}"'
+             for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape_label(str(v))}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def render_prometheus(registry):
+    """Serialize every instrument in ``registry`` (text format 0.0.4)."""
+    out = []
+    snap = registry.snapshot()
+    for name in sorted(snap):
+        m = snap[name]
+        if m["help"]:
+            out.append(f"# HELP {name} {_escape_help(m['help'])}")
+        out.append(f"# TYPE {name} {m['kind']}")
+        lnames = m["labelnames"]
+        for lvalues in sorted(m["samples"]):
+            sample = m["samples"][lvalues]
+            if m["kind"] == "histogram":
+                for le, cum in sample["buckets"]:
+                    le_s = "+Inf" if le == "+Inf" else _fmt_value(le)
+                    out.append(
+                        f"{name}_bucket"
+                        f"{_labels_str(lnames, lvalues, [('le', le_s)])}"
+                        f" {_fmt_value(cum)}")
+                out.append(f"{name}_sum{_labels_str(lnames, lvalues)} "
+                           f"{repr(float(sample['sum']))}")
+                out.append(f"{name}_count{_labels_str(lnames, lvalues)} "
+                           f"{_fmt_value(sample['count'])}")
+            else:
+                out.append(f"{name}{_labels_str(lnames, lvalues)} "
+                           f"{_fmt_value(sample)}")
+    return "\n".join(out) + "\n"
+
+
+def snapshot_json(registry):
+    """Registry snapshot re-keyed for JSON: the tuple-keyed ``samples``
+    map becomes a list of ``{"labels": {...}, "value"|histogram
+    fields}`` entries (the ``/stats`` payload)."""
+    out = {}
+    for name, m in registry.snapshot().items():
+        samples = []
+        for lvalues, sample in sorted(m["samples"].items()):
+            entry = {"labels": dict(zip(m["labelnames"], lvalues))}
+            if m["kind"] == "histogram":
+                entry.update(
+                    {"buckets": [[str(le), c]
+                                 for le, c in sample["buckets"]],
+                     "sum": sample["sum"], "count": sample["count"]})
+            else:
+                entry["value"] = sample
+            samples.append(entry)
+        out[name] = {"kind": m["kind"], "help": m["help"],
+                     "samples": samples}
+    return out
+
+
+def _parse_labels(s):
+    """``a="x",b="y"`` -> tuple of (name, value) pairs (unescaped)."""
+    pairs, i = [], 0
+    while i < len(s):
+        eq = s.index("=", i)
+        name = s[i:eq].strip()
+        if s[eq + 1] != '"':
+            raise ValueError(f"unquoted label value at {s[eq:]!r}")
+        j, val = eq + 2, []
+        while s[j] != '"':
+            if s[j] == "\\":
+                nxt = s[j + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+            else:
+                val.append(s[j])
+                j += 1
+        pairs.append((name, "".join(val)))
+        i = j + 1
+        if i < len(s) and s[i] == ",":
+            i += 1
+    return tuple(pairs)
+
+
+def parse_prometheus(text):
+    """Parse exposition text back into
+    ``{(metric_name, ((label, value), ...)): float}`` — the inverse of
+    ``render_prometheus`` over sample lines (HELP/TYPE lines are
+    validated for shape and skipped)."""
+    samples = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"malformed comment line: {line!r}")
+            continue
+        if "{" in line:
+            name = line[:line.index("{")]
+            rest = line[line.index("{") + 1:]
+            labels_s, _, value_s = rest.rpartition("}")
+            labels = _parse_labels(labels_s)
+        else:
+            name, _, value_s = line.partition(" ")
+            labels = ()
+        key = (name, labels)
+        if key in samples:
+            raise ValueError(f"duplicate sample {key}")
+        samples[key] = float(value_s)
+    return samples
+
+
+class _Handler:
+    """Request handler factory bound to a registry (built lazily so the
+    http.server import stays off the non-serving path)."""
+
+    def __new__(cls, registry, extra_stats):
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = render_prometheus(registry).encode()
+                    ctype = CONTENT_TYPE
+                elif path == "/stats":
+                    stats = {"metrics": snapshot_json(registry)}
+                    if extra_stats is not None:
+                        stats["stats"] = extra_stats()
+                    body = json.dumps(stats, default=str).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):    # keep scrapes out of stderr
+                pass
+
+        return Handler
+
+
+class MetricsServer:
+    """Background scrape endpoint for one registry.
+
+    >>> ms = MetricsServer(registry, port=0).start()   # 0 = ephemeral
+    >>> ms.url            # http://127.0.0.1:<port>
+    >>> ms.close()
+    """
+
+    def __init__(self, registry, host="127.0.0.1", port=0,
+                 extra_stats=None):
+        self.registry = registry
+        self._host = host
+        self._port = int(port)
+        self._extra = extra_stats
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return f"http://{self._host}:{self.port}"
+
+    def start(self):
+        if self._httpd is not None:
+            raise RuntimeError("metrics server already started")
+        from http.server import ThreadingHTTPServer
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._port), _Handler(self.registry, self._extra))
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout=5.0):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=timeout)
+            self._httpd = self._thread = None
+
+    def __enter__(self):
+        return self.start() if self._httpd is None else self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,269 @@
+"""Serving SLO instrumentation for the continuous-batching server.
+
+One ``ServerTelemetry`` object owns every signal an SLO-aware scheduler
+(or an operator's dashboard) needs from ``ContinuousBatchingServer``:
+
+Request lifecycle (spans ``request.queued`` -> ``request.prefill``
+-> ``request.decode`` per rid, plus histograms):
+- ``serving_queue_wait_seconds``  submit -> admission pop
+- ``serving_ttft_seconds``        submit -> first token available
+                                  (admission prefill emits it)
+- ``serving_tpot_seconds``        (finish - first token) / (tokens - 1)
+- ``serving_e2e_seconds``         submit -> finish
+- ``serving_requests_total{state=submitted|finished|canceled|failed}``
+
+Per-tick engine signals:
+- ``serving_tick_seconds``        one batched decode dispatch (host
+                                  wall, includes device sync)
+- ``serving_tick_occupancy``      active slots entering the tick
+- ``serving_active_slots`` / ``serving_queue_depth`` gauges
+
+Cache signals:
+- ``serving_tokens_total{kind=prefill|prefix_hit|decode}``
+- ``serving_prefix_cache_total{result=hit|miss}``
+- ``kv_pool_pages{state=free|live|pinned}`` (paged backend)
+- ``kv_null_redirected_writes_total``  inactive-slot rows stepped per
+  tick — their all-null block tables redirect every write to the null
+  page. Rows a finished slot wastes INSIDE a block are counted under
+  ``serving_wasted_block_tokens_total`` instead (they land past the
+  frontier in the slot's own pages, null-redirected only when they
+  cross the reserved-extent page boundary).
+
+Every method no-ops when the registry is disabled (no locks, no clock
+reads). All calls happen under the server's own lock, so per-request
+state needs no extra synchronization. Host-side only — never call any
+of this from jit-traced code.
+"""
+from .clock import MonotonicClock
+from .metrics import DEFAULT_BUCKETS, MetricRegistry
+from .tracing import Tracer
+
+__all__ = ["ServerTelemetry", "TPOT_BUCKETS", "TICK_BUCKETS",
+           "OCCUPANCY_BUCKETS"]
+
+# per-token / per-tick scales are finer than request-level latencies
+TPOT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0)
+TICK_BUCKETS = TPOT_BUCKETS
+OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class _ReqState:
+    __slots__ = ("t_submit", "t_first", "queued_span", "prefill_span",
+                 "decode_span")
+
+    def __init__(self, t_submit, queued_span):
+        self.t_submit = t_submit
+        self.t_first = None
+        self.queued_span = queued_span
+        self.prefill_span = None
+        self.decode_span = None
+
+
+class ServerTelemetry:
+    """Bundle of registry + tracer + clock wired for one server.
+
+    >>> tele = ServerTelemetry()
+    >>> srv = ContinuousBatchingServer(model, ..., telemetry=tele)
+    >>> print(tele.registry.render())          # Prometheus text
+    >>> tele.tracer.export_chrome_trace(path)  # request spans
+
+    Tests inject ``clock=FakeClock()`` and advance it between scripted
+    server calls for exact histogram assertions.
+    """
+
+    def __init__(self, registry=None, tracer=None, clock=None):
+        self.clock = clock if clock is not None else MonotonicClock()
+        self.registry = registry if registry is not None \
+            else MetricRegistry()
+        self.tracer = tracer if tracer is not None \
+            else Tracer(clock=self.clock, enabled=self.registry.enabled)
+        self.enabled = self.registry.enabled
+        self._req = {}
+        r = self.registry
+        req = r.counter("serving_requests_total",
+                        "Requests by lifecycle outcome",
+                        labelnames=("state",))
+        self._c_submitted = req.labels(state="submitted")
+        self._c_finished = req.labels(state="finished")
+        self._c_canceled = req.labels(state="canceled")
+        self._c_failed = req.labels(state="failed")
+        self._g_queue = r.gauge("serving_queue_depth",
+                                "Requests waiting for a slot")
+        self._g_active = r.gauge("serving_active_slots",
+                                 "Slots decoding after the last tick")
+        self._h_wait = r.histogram("serving_queue_wait_seconds",
+                                   "submit() to admission pop",
+                                   buckets=DEFAULT_BUCKETS)
+        self._h_ttft = r.histogram("serving_ttft_seconds",
+                                   "submit() to first generated token",
+                                   buckets=DEFAULT_BUCKETS)
+        self._h_tpot = r.histogram("serving_tpot_seconds",
+                                   "Mean per-token decode latency at "
+                                   "finish", buckets=TPOT_BUCKETS)
+        self._h_e2e = r.histogram("serving_e2e_seconds",
+                                  "submit() to finish",
+                                  buckets=DEFAULT_BUCKETS)
+        self._h_tick = r.histogram("serving_tick_seconds",
+                                   "One batched decode dispatch",
+                                   buckets=TICK_BUCKETS)
+        self._h_occ = r.histogram("serving_tick_occupancy",
+                                  "Active slots entering a tick",
+                                  buckets=OCCUPANCY_BUCKETS)
+        tok = r.counter("serving_tokens_total", "Token work by kind",
+                        labelnames=("kind",))
+        self._c_tok_prefill = tok.labels(kind="prefill")
+        self._c_tok_prefix = tok.labels(kind="prefix_hit")
+        self._c_tok_decode = tok.labels(kind="decode")
+        pfx = r.counter("serving_prefix_cache_total",
+                        "Admissions by prefix-cache outcome",
+                        labelnames=("result",))
+        self._c_pfx_hit = pfx.labels(result="hit")
+        self._c_pfx_miss = pfx.labels(result="miss")
+        pool = r.gauge("kv_pool_pages", "Paged KV pool occupancy",
+                       labelnames=("state",))
+        self._g_pool_free = pool.labels(state="free")
+        self._g_pool_live = pool.labels(state="live")
+        self._g_pool_pinned = pool.labels(state="pinned")
+        self._c_null_writes = r.counter(
+            "kv_null_redirected_writes_total",
+            "Inactive-slot decode writes redirected to the null page "
+            "(mid-block waste of live slots is wasted_block_tokens)")
+        self._c_wasted_block = r.counter(
+            "serving_wasted_block_tokens_total",
+            "Block-decode steps run past a slot's finish (tick_block "
+            "amortization cost)")
+
+    # -------------------------------------------------------- lifecycle
+    def on_submit(self, rid, prompt_tokens, queue_depth):
+        if not self.enabled:
+            return
+        t = self.clock.now()
+        self._c_submitted.inc()
+        self._g_queue.set(queue_depth)
+        self._req[rid] = _ReqState(
+            t, self.tracer.begin_span("request.queued", rid=rid,
+                                      prompt_tokens=prompt_tokens))
+
+    def on_admit(self, rid, queue_depth):
+        """Request popped from the queue; admission prefill starts
+        (its span is closed by on_first_token)."""
+        if not self.enabled:
+            return
+        st = self._req.get(rid)
+        if st is None:
+            return
+        t = self.clock.now()
+        self._h_wait.observe(t - st.t_submit)
+        self._g_queue.set(queue_depth)
+        st.queued_span.end()
+        st.queued_span = None
+        st.prefill_span = self.tracer.begin_span("request.prefill",
+                                                 rid=rid)
+
+    def on_first_token(self, rid, prefill_tokens, prefix_hit_tokens):
+        """Admission prefill produced the request's first token."""
+        if not self.enabled:
+            return
+        st = self._req.get(rid)
+        if st is None:
+            return
+        t = self.clock.now()
+        st.t_first = t
+        if st.prefill_span is not None:
+            st.prefill_span.end(prefill_tokens=prefill_tokens,
+                                prefix_hit_tokens=prefix_hit_tokens)
+            st.prefill_span = None
+        self._h_ttft.observe(t - st.t_submit)
+        if prefill_tokens:
+            self._c_tok_prefill.inc(prefill_tokens)
+        if prefix_hit_tokens:
+            self._c_pfx_hit.inc()
+            self._c_tok_prefix.inc(prefix_hit_tokens)
+        else:
+            self._c_pfx_miss.inc()
+        st.decode_span = self.tracer.begin_span("request.decode", rid=rid)
+
+    def on_finish(self, rid, n_tokens):
+        if not self.enabled:
+            return
+        st = self._req.pop(rid, None)
+        if st is None:
+            return
+        t = self.clock.now()
+        self._c_finished.inc()
+        self._h_e2e.observe(t - st.t_submit)
+        if st.t_first is not None and n_tokens > 1:
+            self._h_tpot.observe((t - st.t_first) / (n_tokens - 1))
+        if st.decode_span is not None:
+            st.decode_span.end(tokens=n_tokens)
+
+    def on_cancel(self, rid):
+        if not self.enabled:
+            return
+        st = self._req.pop(rid, None)
+        if st is None:
+            return
+        self._c_canceled.inc()
+        for span in (st.queued_span, st.prefill_span,
+                         st.decode_span):
+            if span is not None:
+                span.end(canceled=True)
+
+    def on_admission_failure(self, rid, exc):
+        if not self.enabled:
+            return
+        st = self._req.pop(rid, None)
+        self._c_failed.inc()
+        if st is not None:
+            for span in (st.queued_span, st.prefill_span,
+                         st.decode_span):
+                if span is not None:
+                    span.end(error=type(exc).__name__)
+        self.tracer.instant("request.failed", rid=rid,
+                            error=type(exc).__name__)
+
+    # ------------------------------------------------------ engine ticks
+    def tick_started(self):
+        """Timestamp handle for on_tick (one clock read)."""
+        if not self.enabled:
+            return None
+        return self.clock.now()
+
+    def on_tick(self, t_started, active_slots, decode_tokens):
+        if not self.enabled:
+            return
+        self._h_tick.observe(self.clock.now() - t_started)
+        self._h_occ.observe(active_slots)
+        self._g_active.set(active_slots)
+        if decode_tokens:
+            self._c_tok_decode.inc(decode_tokens)
+
+    def set_queue_depth(self, n):
+        if self.enabled:
+            self._g_queue.set(n)
+
+    def set_active_slots(self, n):
+        if self.enabled:
+            self._g_active.set(n)
+
+    # ------------------------------------------------------- cache state
+    def set_pool(self, free, live, pinned):
+        if not self.enabled:
+            return
+        self._g_pool_free.set(free)
+        self._g_pool_live.set(live)
+        self._g_pool_pinned.set(pinned)
+
+    def add_null_writes(self, n):
+        if self.enabled and n:
+            self._c_null_writes.inc(n)
+
+    def add_wasted_block_tokens(self, n):
+        if self.enabled and n:
+            self._c_wasted_block.inc(n)
+
+    def add_prefill_tokens(self, n):
+        """Out-of-band prefill work (register_prefix)."""
+        if self.enabled and n:
+            self._c_tok_prefill.inc(n)

@@ -413,3 +413,220 @@ class TestSchedulerOversizedRequest:
         out = sched.submit(big).result(timeout=20)
         sched.close()
         np.testing.assert_allclose(out[0], big * 2.0)
+
+
+# ------------------------------------------------------- (h) advice r5
+
+def _tiny_lm():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    pt.seed(21)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+class TestEosFirstTokenPads:
+    """ADVICE r5 #1/#3: a prefill whose argmax IS eos must eos-pad the
+    whole output — before the fix, done started all-False and decode
+    free-ran real tokens."""
+
+    def test_greedy_generate_stub_model(self):
+        from paddle_tpu.inference.decode_loop import greedy_generate
+        V = 5
+
+        def embed(tok, t):
+            return tok.astype(jnp.float32)[:, None]
+
+        def step(x, caches, t):
+            return x, caches
+
+        def head(out):       # next argmax is always prev + 1 (mod V)
+            nxt = (out[:, 0].astype(jnp.int32) + 1) % V
+            return jax.nn.one_hot(nxt, V)
+
+        ids, _ = greedy_generate(embed, step, head, {},
+                                 jnp.array([3], jnp.int32), 0, 5,
+                                 eos_token_id=3)
+        # before the fix this free-ran to [3, 4, 0, 1, 2]
+        np.testing.assert_array_equal(np.asarray(ids)[0], [3, 3, 3, 3, 3])
+
+    def test_generate_real_model_contract(self):
+        """generate()'s documented contract: tail padded with eos —
+        including when the FIRST generated token is the eos."""
+        model = _tiny_lm()
+        p = np.random.default_rng(0).integers(0, 256, (4,)).astype(
+            np.int32)
+        free = model.generate(pt.to_tensor(p[None]), max_new_tokens=4,
+                              max_cache_len=32).numpy()[0, 4:]
+        eos = int(free[0])          # prefill argmax
+        out = model.generate(pt.to_tensor(p[None]), max_new_tokens=4,
+                             max_cache_len=32,
+                             eos_token_id=eos).numpy()[0, 4:]
+        np.testing.assert_array_equal(out, [eos] * 4)
+
+    def test_deploy_decode_eos_first(self, tmp_path):
+        from paddle_tpu.inference.deploy_decode import (export_decode,
+                                                        load_decode)
+        model = _tiny_lm()
+        p = np.random.default_rng(1).integers(0, 256, (1, 4)).astype(
+            np.int32)
+        free = model.generate(pt.to_tensor(p), max_new_tokens=3,
+                              max_cache_len=7).numpy()[0, 4:]
+        eos = int(free[0])
+        prefix = str(tmp_path / "eos_first")
+        export_decode(prefix, model, prompt_len=4, max_new_tokens=3,
+                      batch=1, eos_token_id=eos)
+        got = load_decode(prefix).generate(p)[0, 4:]
+        # before the fix the archive free-ran past the eos-first token
+        np.testing.assert_array_equal(got, [eos] * 3)
+
+    def test_export_decode_rejects_undersized_cache(self, tmp_path):
+        """ADVICE r5 #5: an explicit max_cache_len too small for
+        prompt + new tokens must raise, not silently clamp decode
+        writes onto the cache's last rows."""
+        from paddle_tpu.inference.deploy_decode import export_decode
+        model = _tiny_lm()
+        with pytest.raises(ValueError, match="max_cache_len"):
+            export_decode(str(tmp_path / "x"), model, prompt_len=8,
+                          max_new_tokens=8, max_cache_len=12)
+
+
+class TestPrefixRemainderChunkPad:
+    """ADVICE r5 #2: a registered-prefix hit prefills only the
+    remainder; when that remainder is LONGER than the chunk, its own
+    pad can overflow max_cache_len even though the full-prompt pad fits
+    — must be rejected at submit(). Remainders <= chunk run UNCHUNKED
+    (generation._run_prefill's direct path, zero pad) and must keep
+    being accepted."""
+
+    def test_submit_rejects_prefix_remainder_overflow(self):
+        from paddle_tpu.inference.continuous_batching import \
+            ContinuousBatchingServer
+        model = _tiny_lm()
+        rng = np.random.default_rng(2)
+        prefix = rng.integers(0, 256, (6,)).astype(np.int32)
+        prompt = np.concatenate(
+            [prefix, rng.integers(0, 256, (6,)).astype(np.int32)])
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=13, prefill_chunk=4)
+        srv.register_prefix(prefix)
+        # T=12, max_new=1: full-prompt pad is 0 (12 % 4 == 0) so the
+        # old check passed (13 <= 13) — but admission prefills the
+        # 6-token remainder at t0=6 padded to 8 rows, writing row 14
+        with pytest.raises(ValueError, match="pad rows"):
+            srv.submit(prompt, max_new_tokens=1)
+        # the same-length prompt WITHOUT the prefix hit fits and serves
+        other = rng.integers(0, 256, (12,)).astype(np.int32)
+        rid = srv.submit(other, max_new_tokens=1)
+        assert len(srv.run()[rid]) == 1
+
+    def test_short_remainder_runs_unchunked_and_serves(self):
+        """A remainder <= chunk takes the unchunked prefill path (no
+        pad): submit must ACCEPT it and tokens must match solo — the
+        bound check may not over-estimate (code-review r6)."""
+        from paddle_tpu.inference.continuous_batching import \
+            ContinuousBatchingServer
+        model = _tiny_lm()
+        rng = np.random.default_rng(6)
+        prefix = rng.integers(0, 256, (6,)).astype(np.int32)
+        prompt = np.concatenate(
+            [prefix, rng.integers(0, 256, (2,)).astype(np.int32)])
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=9, prefill_chunk=4)
+        srv.register_prefix(prefix)
+        rid = srv.submit(prompt, max_new_tokens=1)   # rem 2 <= chunk 4
+        out = srv.run()[rid]
+        want = model.generate(pt.to_tensor(prompt[None]),
+                              max_new_tokens=1, max_cache_len=9,
+                              prefill_chunk=4).numpy()[0, 8:]
+        np.testing.assert_array_equal(out, want)
+
+    def test_longest_match_decides_not_worst_case(self):
+        """Admission is longest-match-wins and prefixes are never
+        removed: a SHORTER matching prefix's larger remainder pad must
+        not reject a request the longest match serves (code-review
+        r6)."""
+        from paddle_tpu.inference.continuous_batching import \
+            ContinuousBatchingServer
+        model = _tiny_lm()
+        rng = np.random.default_rng(7)
+        p10 = rng.integers(0, 256, (10,)).astype(np.int32)
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=12, prefill_chunk=4)
+        srv.register_prefix(p10[:5])   # its remainder (5) would pad 3
+        srv.register_prefix(p10[:8])   # longest: remainder 2, unchunked
+        rid = srv.submit(p10, max_new_tokens=2)
+        out = srv.run()[rid]
+        want = model.generate(pt.to_tensor(p10[None]), max_new_tokens=2,
+                              max_cache_len=12,
+                              prefill_chunk=4).numpy()[0, 10:]
+        np.testing.assert_array_equal(out, want)
+
+    def test_register_prefix_refuses_stranding_queued_request(self):
+        from paddle_tpu.inference.continuous_batching import \
+            ContinuousBatchingServer
+        model = _tiny_lm()
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, 256, (12,)).astype(np.int32)
+        srv = ContinuousBatchingServer(model, max_slots=1,
+                                       max_cache_len=13, prefill_chunk=4)
+        srv.submit(prompt, max_new_tokens=1)      # fits: pad 0
+        # registering its 6-token head now would pad the queued
+        # request's 6-token remainder past the cache — refuse
+        with pytest.raises(ValueError, match="register prefixes before"):
+            srv.register_prefix(prompt[:6])
+
+
+class TestAdmissionFailureRecorded:
+    """ADVICE r5 #2 (second half): one bad request must be recorded as
+    a per-rid failure, not kill the serve thread / drop the queue."""
+
+    def _server_with_poisoned_prefill(self, bad_len):
+        from paddle_tpu.inference.continuous_batching import \
+            ContinuousBatchingServer
+        model = _tiny_lm()
+        orig = model._run_prefill
+
+        def poisoned(bundle, ids, **kw):
+            if ids.shape[1] == bad_len:
+                raise RuntimeError("injected prefill failure")
+            return orig(bundle, ids, **kw)
+
+        model._run_prefill = poisoned
+        return ContinuousBatchingServer(model, max_slots=1,
+                                        max_cache_len=32)
+
+    def test_run_serves_the_rest(self):
+        srv = self._server_with_poisoned_prefill(bad_len=7)
+        rng = np.random.default_rng(4)
+        rid_bad = srv.submit(rng.integers(0, 256, (7,)).astype(np.int32),
+                             max_new_tokens=4)
+        rid_good = srv.submit(rng.integers(0, 256, (5,)).astype(np.int32),
+                              max_new_tokens=4)
+        outs = srv.run()
+        assert rid_bad not in outs and len(outs[rid_good]) == 4
+        assert isinstance(srv.failures[rid_bad], RuntimeError)
+        # failures are drained PER run — a later clean run must not
+        # keep reporting stale records (code-review r6)
+        rid2 = srv.submit(rng.integers(0, 256, (5,)).astype(np.int32),
+                          max_new_tokens=2)
+        assert len(srv.run()[rid2]) == 2
+        assert srv.failures == {}
+
+    def test_wait_raises_per_request_not_thread_death(self):
+        srv = self._server_with_poisoned_prefill(bad_len=7).start()
+        try:
+            rng = np.random.default_rng(5)
+            rid_bad = srv.submit(
+                rng.integers(0, 256, (7,)).astype(np.int32),
+                max_new_tokens=4)
+            rid_good = srv.submit(
+                rng.integers(0, 256, (5,)).astype(np.int32),
+                max_new_tokens=4)
+            with pytest.raises(RuntimeError,
+                               match="failed at admission"):
+                srv.wait(rid_bad, timeout=300)
+            # the serve thread survived and keeps serving
+            assert len(srv.wait(rid_good, timeout=300)) == 4
+        finally:
+            srv.stop()
