@@ -54,7 +54,8 @@ def __getattr__(name):
                 "inference", "sparse", "text", "audio", "geometric",
                 "quantization", "distribution", "fft", "signal",
                 "regularizer", "linalg", "onnx", "callbacks", "hub",
-                "sysconfig", "reader", "cost_model", "telemetry"):
+                "sysconfig", "reader", "cost_model", "telemetry",
+                "reliability"):
         import importlib
         try:
             mod = importlib.import_module(f".{name}", __name__)

@@ -21,21 +21,43 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import unwrap
+from ..reliability import (CallbackError, CircuitOpenError, DEAD,
+                           DEGRADED, DRAINING, DeadlineExceeded, HEALTHY,
+                           HealthMonitor, QueueFullError, ReliabilityError,
+                           RequestCancelled, ServeSupervisor, ServerClosed,
+                           faults)
+from ..telemetry.clock import MonotonicClock
 
 __all__ = ["ContinuousBatchingServer"]
 
 
+class _Pending:
+    """A queued request awaiting a slot."""
+
+    __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline")
+
+    def __init__(self, rid, ids, budget, seed, on_token, deadline):
+        self.rid = rid
+        self.ids = ids
+        self.budget = budget
+        self.seed = seed
+        self.on_token = on_token
+        self.deadline = deadline      # absolute clock time, or None
+
+
 class _Slot:
     __slots__ = ("rid", "prompt_len", "budget", "emitted", "on_token",
-                 "streamed")
+                 "streamed", "deadline")
 
-    def __init__(self, rid, prompt_len, budget, on_token=None):
+    def __init__(self, rid, prompt_len, budget, on_token=None,
+                 deadline=None):
         self.rid = rid
         self.prompt_len = prompt_len
         self.budget = budget          # max_new_tokens remaining
         self.emitted = []
         self.on_token = on_token
         self.streamed = 0             # tokens already sent to on_token
+        self.deadline = deadline      # absolute clock time, or None
 
     def stream(self, sink):
         """Queue this slot's unstreamed chunk on ``sink``; the server
@@ -81,6 +103,18 @@ class ContinuousBatchingServer:
     scrape via ``telemetry.MetricsServer(srv.telemetry.registry)``.
     Host-side only; with the default ``telemetry=None`` the hot path
     pays a single attribute check, no locks and no clock reads.
+
+    Reliability (paddle_tpu.reliability): ``submit(deadline_s=...)``
+    bounds waiting, ``max_queue`` + ``shed_policy`` bound the queue,
+    the ``start()`` serve thread is SUPERVISED (``retry_policy`` /
+    ``breaker`` drive backoff and circuit breaking; a tick exception
+    retries instead of killing the thread), ``stop(drain=True)``
+    drains gracefully, ``srv.health`` walks
+    healthy/degraded/draining/dead (also ``/healthz`` via
+    ``serving.serve_metrics``), and ``fault_injector`` arms named
+    chaos failure points (prefill / decode tick / page alloc /
+    on_token). All typed failures reach waiters as
+    ``reliability.ReliabilityError`` subclasses from ``wait()``.
     """
 
     def __init__(self, model, max_slots=4, max_cache_len=256,
@@ -88,7 +122,9 @@ class ContinuousBatchingServer:
                  eos_token_id=None, seed=0, weight_dtype=None,
                  prefill_chunk=None, mesh=None, tick_block=1,
                  cache_dtype=None, cache_backend="dense", page_size=16,
-                 num_pages=None, telemetry=None):
+                 num_pages=None, telemetry=None, max_queue=None,
+                 shed_policy="reject", retry_policy=None, breaker=None,
+                 fault_injector=None, clock=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -133,7 +169,8 @@ class ContinuousBatchingServer:
                 num_pages=int(num_pages))
             self._step_fn = self._paged_bundle[2]
             self._kv = PagedKVCache(int(num_pages), page_size,
-                                    self.max_slots, pages_per_slot)
+                                    self.max_slots, pages_per_slot,
+                                    fault_injector=fault_injector)
             self._caches = self._paged_bundle[0](self.max_slots)
             self._pinned_pages = 0     # held forever by register_prefix
         else:
@@ -168,6 +205,23 @@ class ContinuousBatchingServer:
         self._thread = None
         self._thread_error = None
         self._deferred_cbs = []   # (cb, rid, tokens) fired OUTSIDE the lock
+        # ------------------------------------------------- reliability
+        # admission control: a bounded queue sheds instead of growing
+        # without limit under overload; deadlines bound waiting
+        if shed_policy not in ("reject", "evict_oldest"):
+            raise ValueError(f"shed_policy must be 'reject' or "
+                             f"'evict_oldest', got {shed_policy!r}")
+        self._max_queue = None if max_queue is None else int(max_queue)
+        self._shed_policy = shed_policy
+        self._clock = clock if clock is not None else (
+            telemetry.clock if self._tele is not None else MonotonicClock())
+        self._faults = fault_injector
+        self._sup = ServeSupervisor(retry=retry_policy, breaker=breaker)
+        self._health = HealthMonitor(on_change=self._publish_health)
+        self._accepting = True     # False while draining / after stop
+        self._draining = False
+        if self._tele is not None:
+            self._tele.set_health(HEALTHY)
 
     # ------------------------------------------------------ prefix cache
     def register_prefix(self, prefix_ids):
@@ -196,7 +250,7 @@ class ContinuousBatchingServer:
                 # whose remainder-chunk pad would overflow its rows
                 # mid-admission (ADVICE r5 #2)
                 for item in self._queue:
-                    q_ids = item[1]
+                    q_ids = item.ids
                     Tq = q_ids.shape[0]
                     if Tq <= T or not np.array_equal(q_ids[:T], ids):
                         continue
@@ -235,9 +289,10 @@ class ContinuousBatchingServer:
                 # request that can no longer EVER fit would silently
                 # starve the FIFO — refuse the registration instead
                 usable = self._kv.num_pages - 1 - self._pinned_pages
-                for _, q_ids, q_budget, _, _ in self._queue:
+                for item in self._queue:
+                    q_ids = item.ids
                     q_need = self._request_pages(
-                        q_ids, q_budget, self._match_prefix(q_ids))
+                        q_ids, item.budget, self._match_prefix(q_ids))
                     if q_need > usable:
                         self._prefixes = [e for e in self._prefixes
                                           if e[3] is not pages]
@@ -273,12 +328,21 @@ class ContinuousBatchingServer:
 
     # ------------------------------------------------------------ queue
     def submit(self, input_ids, max_new_tokens=32, seed=None,
-               on_token=None):
+               on_token=None, deadline_s=None):
         """Queue a prompt; returns a request id. The FIRST generated
         token is produced by the prefill (same contract as generate()).
         ``seed`` drives this request's sampling chain (default: the
         server seed + request id). ``on_token(rid, tokens)`` streams
-        each harvested chunk (1..tick_block tokens) as it lands."""
+        each harvested chunk (1..tick_block tokens) as it lands.
+
+        ``deadline_s`` bounds the request's TOTAL time from submit: a
+        request still queued when it expires fails with
+        ``DeadlineExceeded`` (no prefill is wasted on it); one expiring
+        mid-decode is cancelled and its PARTIAL tokens are recorded as
+        the result. With ``max_queue`` set, a full queue sheds per
+        ``shed_policy`` — ``"reject"`` raises ``QueueFullError`` here,
+        ``"evict_oldest"`` fails the oldest queued request instead and
+        accepts this one."""
         ids = np.asarray(unwrap(input_ids)).astype(np.int32)
         if ids.ndim == 2:
             if ids.shape[0] != 1:
@@ -287,6 +351,13 @@ class ContinuousBatchingServer:
             ids = ids[0]
         T = ids.shape[0]
         with self._lock:
+            if not self._accepting:
+                raise ServerClosed(
+                    f"server is {self._health.state}; not accepting "
+                    f"new requests")
+            if deadline_s is not None and deadline_s <= 0:
+                raise DeadlineExceeded(
+                    f"deadline_s={deadline_s} is already expired")
             hit = self._match_prefix(ids)
             pad = 0
             if self._prefill_chunk:
@@ -318,12 +389,35 @@ class ContinuousBatchingServer:
                         f"({max_new_tokens}) needs {need} pages beyond "
                         f"its prefix hit but only {usable} are not "
                         f"pinned by prefixes — grow num_pages")
+            if (self._max_queue is not None
+                    and len(self._queue) >= self._max_queue):
+                # evict_oldest with nobody to evict (max_queue=0) must
+                # still shed SOMETHING — fall back to rejecting
+                if self._shed_policy == "reject" or not self._queue:
+                    if self._tele is not None:
+                        self._tele.on_shed("reject")
+                    raise QueueFullError(
+                        f"queue holds {len(self._queue)} requests "
+                        f"(max_queue={self._max_queue}); shed_policy="
+                        f"'reject' — resubmit with backoff")
+                old = self._queue.pop(0)
+                err = QueueFullError(
+                    f"request {old.rid} evicted by a newer submit "
+                    f"(queue full at max_queue={self._max_queue}, "
+                    f"shed_policy='evict_oldest')")
+                self._failures[old.rid] = err
+                if self._tele is not None:
+                    self._tele.on_shed("evict_oldest")
+                    self._tele.on_admission_failure(old.rid, err)
+                self._done_cv.notify_all()
             rid = self._next_rid
             self._next_rid += 1
             if seed is None:
                 seed = self._seed + rid
-            self._queue.append((rid, ids, int(max_new_tokens), int(seed),
-                                on_token))
+            deadline = None if deadline_s is None \
+                else self._clock.now() + float(deadline_s)
+            self._queue.append(_Pending(rid, ids, int(max_new_tokens),
+                                        int(seed), on_token, deadline))
             if self._tele is not None:
                 self._tele.on_submit(rid, T, len(self._queue))
         return rid
@@ -337,26 +431,47 @@ class ContinuousBatchingServer:
 
     def _cancel_locked(self, rid):
         for i, item in enumerate(self._queue):
-            if item[0] == rid:
+            if item.rid == rid:
                 del self._queue[i]
+                # a still-queued cancel produces no result; record the
+                # typed failure so a blocked wait(rid) raises instead
+                # of running out its timeout
+                self._failures[rid] = RequestCancelled(
+                    f"request {rid} cancelled while queued")
                 if self._tele is not None:
                     self._tele.on_cancel(rid)
                     self._tele.set_queue_depth(len(self._queue))
+                self._done_cv.notify_all()
                 return True
         for slot in range(self.max_slots):
             st = self._slots[slot]
             if self._active[slot] and st.rid == rid:
-                self._results[rid] = np.asarray(st.emitted[:st.budget],
-                                                np.int32)
-                self._active[slot] = False
-                self._slots[slot] = None
-                if self._kv is not None:
-                    self._kv.free_slot(slot)
+                self._finish_partial_locked(slot)
                 if self._tele is not None:
                     self._tele.on_cancel(rid)
                     self._pool_gauges()
+                # wake waiters NOW — without this a blocked wait(rid)
+                # only notices the recorded partial at its next 1 s poll
+                self._done_cv.notify_all()
                 return True
         return False
+
+    def _release_slot(self, slot):
+        """Tear down a slot's host + page state (no result recording)."""
+        self._active[slot] = False
+        self._slots[slot] = None
+        if self._kv is not None:
+            self._kv.free_slot(slot)
+
+    def _finish_partial_locked(self, slot):
+        """Record the slot's partial tokens as its rid's RESULT and tear
+        the slot down — the one way a live request leaves early with its
+        output kept (cancel, deadline expiry, hard stop)."""
+        st = self._slots[slot]
+        self._results[st.rid] = np.asarray(st.emitted[:st.budget],
+                                           np.int32)
+        self._release_slot(slot)
+        return st
 
     # ---------------------------------------------------- paged backend
     def _fill_pages(self, caches1, pages, start):
@@ -396,6 +511,19 @@ class ContinuousBatchingServer:
                                 used - self._pinned_pages,
                                 self._pinned_pages)
 
+    def pool_balance(self):
+        """(free, live, pinned) page counts summing to the usable pool
+        (``num_pages - 1``; page 0 is the null page). Chaos suites
+        assert ``live == 0`` once drained — i.e. free + pinned covers
+        the whole pool and no injected failure leaked a page. Dense
+        backend returns None."""
+        if self._kv is None:
+            return None
+        with self._lock:
+            free = self._kv.free_pages()
+            live = self._kv.used_pages() - self._pinned_pages
+            return free, live, self._pinned_pages
+
     def _request_pages(self, ids, budget, hit):
         """Fresh pages a request needs for its FULL extent (prompt +
         budget — reserved at admission so decode-time growth can never
@@ -408,9 +536,9 @@ class ContinuousBatchingServer:
         """Can the pool admit the request at the head of the queue right
         now? If not it (and everything behind it — FIFO) waits for a
         harvest to free pages."""
-        _, ids, budget, _, _ = self._queue[0]
+        head = self._queue[0]
         return self._kv.free_pages() >= self._request_pages(
-            ids, budget, self._match_prefix(ids))
+            head.ids, head.budget, self._match_prefix(head.ids))
 
     # ------------------------------------------------------- scheduling
     def _admit(self):
@@ -423,12 +551,13 @@ class ContinuousBatchingServer:
                 continue
             if self._kv is not None and not self._head_fits_pool():
                 break
-            rid, ids, budget, req_seed, on_token = self._queue.pop(0)
+            req = self._queue.pop(0)
+            rid = req.rid
             if self._tele is not None:
                 self._tele.on_admit(rid, len(self._queue))
             try:
-                self._admit_one(slot, rid, ids, budget, req_seed,
-                                on_token)
+                self._admit_one(slot, rid, req.ids, req.budget, req.seed,
+                                req.on_token, req.deadline)
             except Exception as e:
                 if self._kv is not None and self._kv.slot_pages(slot):
                     self._kv.free_slot(slot)     # roll back a part-admit
@@ -441,7 +570,12 @@ class ContinuousBatchingServer:
         if self._tele is not None:
             self._pool_gauges()
 
-    def _admit_one(self, slot, rid, ids, budget, req_seed, on_token):
+    def _admit_one(self, slot, rid, ids, budget, req_seed, on_token,
+                   deadline=None):
+        if self._faults is not None:
+            # chaos failure point: an admission prefill that dies is a
+            # PER-REQUEST failure (_admit records it), never a server one
+            self._faults.check(faults.PREFILL, rid=rid)
         T = ids.shape[0]
         # per-request prefill at batch 1 (optionally in fixed-size
         # chunks: one compiled program for every prompt length),
@@ -498,7 +632,7 @@ class ContinuousBatchingServer:
         self._tok = self._tok.at[slot].set(first)
         self._t = self._t.at[slot].set(T)
         self._active[slot] = True
-        st = _Slot(rid, T, budget, on_token)
+        st = _Slot(rid, T, budget, on_token, deadline)
         st.emitted.append(int(first))
         st.stream(self._deferred_cbs)
         self._slots[slot] = st
@@ -566,13 +700,27 @@ class ContinuousBatchingServer:
 
     def _fire_callbacks(self):
         """Run streamed-token callbacks collected during locked work.
-        Callback exceptions propagate to the step()/run() caller (or the
-        serve thread's error slot) without corrupting server state."""
+        EVERY queued callback fires even when one raises — a poisoned
+        stream must not starve the other requests' chunks (they were
+        already swapped out of ``_deferred_cbs`` and would be lost) —
+        then the failures are re-raised together as a ``CallbackError``
+        (``.errors`` per rid, ``__cause__`` the first) to the
+        step()/run() caller or the supervised serve loop, which fails
+        exactly the offending requests."""
         cbs, self._deferred_cbs = self._deferred_cbs, []
+        errors = []
         for cb, rid, toks in cbs:
-            cb(rid, toks)
+            try:
+                if self._faults is not None:
+                    self._faults.check(faults.ON_TOKEN, rid=rid)
+                cb(rid, toks)
+            except Exception as e:
+                errors.append((rid, e))
+        if errors:
+            raise CallbackError(errors)
 
     def _step_locked(self):
+        self._expire_locked()
         self._admit()
         if not self._active.any():
             if self._tele is not None:     # keep the gauge live when a
@@ -593,6 +741,11 @@ class ContinuousBatchingServer:
             self._sync_block_table()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode_step()
+        if self._faults is not None:
+            # chaos failure point: a dying decode tick is a SERVER-level
+            # transient — the supervisor retries it (host state is
+            # consistent: nothing was dispatched yet)
+            self._faults.check(faults.DECODE_TICK)
         tele = self._tele
         n_active = int(self._active.sum())
         t_tick = tele.tick_started() if tele is not None else None
@@ -655,6 +808,126 @@ class ContinuousBatchingServer:
                 self._pool_gauges()
             self._done_cv.notify_all()
 
+    # ------------------------------------------------------- reliability
+    def _expire_locked(self):
+        """Fail queued requests whose deadline passed (BEFORE a prefill
+        is wasted on them) and cancel expired mid-decode slots (their
+        partial tokens become the recorded result). Reads the clock at
+        most once, and only when some live request carries a deadline."""
+        now = None
+        notify = False
+        if any(item.deadline is not None for item in self._queue):
+            now = self._clock.now()
+            keep = []
+            for item in self._queue:
+                if item.deadline is not None and now >= item.deadline:
+                    err = DeadlineExceeded(
+                        f"request {item.rid} expired in queue "
+                        f"(deadline passed before admission)")
+                    self._failures[item.rid] = err
+                    notify = True
+                    if self._tele is not None:
+                        self._tele.on_deadline_expired("queued")
+                        self._tele.on_admission_failure(item.rid, err)
+                else:
+                    keep.append(item)
+            if len(keep) != len(self._queue):
+                self._queue[:] = keep
+                if self._tele is not None:
+                    self._tele.set_queue_depth(len(self._queue))
+        for slot in range(self.max_slots):
+            st = self._slots[slot]
+            if not self._active[slot] or st.deadline is None:
+                continue
+            if now is None:
+                now = self._clock.now()
+            if now >= st.deadline:
+                self._finish_partial_locked(slot)
+                notify = True
+                if self._tele is not None:
+                    self._tele.on_deadline_expired("decoding")
+                    self._tele.on_cancel(st.rid)
+                    self._pool_gauges()
+        if notify:
+            self._done_cv.notify_all()
+
+    def _fail_request_locked(self, rid, err):
+        """Fail ONE request still LIVE (queued or in-flight) with
+        ``err`` — the per-request channel the supervisor uses so a
+        poisoned callback or injected per-request fault never takes the
+        server down. A rid that is in neither place already settled
+        (harvested — result recorded or even collected — or failed):
+        e.g. the FINAL stream chunk's callback raised after harvest.
+        Recording a failure then would leave a phantom ``failures``
+        entry no wait() ever pops, so it is skipped."""
+        found = False
+        for i, item in enumerate(self._queue):
+            if item.rid == rid:
+                del self._queue[i]
+                found = True
+                break
+        if not found:
+            for slot in range(self.max_slots):
+                st = self._slots[slot]
+                if self._active[slot] and st.rid == rid:
+                    self._release_slot(slot)
+                    if self._tele is not None:
+                        self._pool_gauges()
+                    found = True
+                    break
+        if not found:
+            return
+        # a failed request has no result: its undelivered stream chunks
+        # must not fire later as if it were still live
+        self._deferred_cbs = [c for c in self._deferred_cbs
+                              if c[1] != rid]
+        self._failures[rid] = err
+        if self._tele is not None:
+            self._tele.on_admission_failure(rid, err)
+        self._done_cv.notify_all()
+
+    def _fail_all_locked(self, cause):
+        """Breaker-open path: fail EVERY queued and in-flight request
+        with a ``CircuitOpenError`` so no waiter wedges on a server
+        that cannot currently tick."""
+        thresh = self._sup.breaker.failure_threshold
+        rids = [item.rid for item in self._queue]
+        self._queue.clear()
+        for slot in range(self.max_slots):
+            if self._active[slot]:
+                rids.append(self._slots[slot].rid)
+                self._release_slot(slot)
+        # chunks queued by the failed tick belong to rids that now have
+        # no result — firing them after recovery would stream tokens
+        # for requests whose wait() already raised
+        self._deferred_cbs.clear()
+        for rid in rids:
+            err = CircuitOpenError(
+                f"request {rid} aborted: circuit breaker opened after "
+                f"{thresh} consecutive tick failures")
+            err.__cause__ = cause
+            self._failures[rid] = err
+            if self._tele is not None:
+                self._tele.on_admission_failure(rid, err)
+        if self._tele is not None:
+            self._tele.set_queue_depth(0)
+            self._tele.set_active_slots(0)
+            self._pool_gauges()
+        self._done_cv.notify_all()
+
+    @property
+    def health(self):
+        """Current health state: ``healthy`` / ``degraded`` /
+        ``draining`` / ``dead`` (see reliability.health). Lock-free
+        read of a plain-string attribute — /healthz must answer while
+        a tick (or its first jit compile) holds the serve lock, or the
+        readiness probe times out exactly when the server warms up."""
+        return self._health.state
+
+    def _publish_health(self, state, code):
+        if self._tele is not None:
+            self._tele.set_health(state)
+
     def run(self, max_ticks=100000):
         """Drive until queue and slots drain; returns {rid: new_tokens}.
         Requests whose admission failed are left out — their exceptions
@@ -675,34 +948,121 @@ class ContinuousBatchingServer:
 
     # ------------------------------------------------------ serve thread
     def start(self, idle_sleep=0.005):
-        """Run the decode loop on a background thread: submit()/cancel()
-        from any thread; collect results with ``wait(rid)``."""
+        """Run the decode loop on a SUPERVISED background thread:
+        submit()/cancel() from any thread; collect results with
+        ``wait(rid)``.
+
+        Supervision (reliability.ServeSupervisor): a failing tick is
+        retried with exponential backoff (``retry_policy``); a failing
+        REQUEST (poisoned on_token callback, injected per-request fault)
+        is failed individually through the per-rid failures channel
+        while every other slot keeps decoding; after
+        ``breaker.failure_threshold`` consecutive tick failures the
+        circuit breaker opens — in-flight waiters are unblocked with
+        ``CircuitOpenError``, health flips to ``degraded``, and after
+        the cooldown a half-open probe tick restores ``healthy``. The
+        thread itself survives everything short of interpreter
+        shutdown."""
         if self._thread is not None:
             raise RuntimeError("server already started")
         self._stop.clear()
         self._thread_error = None
+        with self._lock:
+            self._accepting = True
+            self._draining = False
+            if self._health.state != HEALTHY:
+                self._health.reset()   # explicit restart after stop()
 
         def loop():
             import time as _time
+            sup = self._sup
             try:
-                while not self._stop.is_set():
+                while True:
                     with self._lock:
                         busy = bool(self._queue or self._active.any())
-                        if busy:
-                            self._step_locked()
-                    self._fire_callbacks()
+                    if self._stop.is_set():
+                        if not (self._draining and busy):
+                            break
                     if not busy:
+                        if (sup.breaker.state != sup.breaker.CLOSED
+                                and sup.allow()):
+                            # cooldown elapsed with nothing failing:
+                            # close the breaker so an IDLE server does
+                            # not stay degraded (and alerting) forever
+                            sup.success()
+                            self._recover_health()
                         _time.sleep(idle_sleep)
+                        continue
+                    if not sup.allow():          # breaker cooldown
+                        with self._lock:
+                            # deadlines keep their promise even while
+                            # the breaker gates ticks: expire queued/
+                            # decoding requests during the cooldown
+                            self._expire_locked()
+                        _time.sleep(idle_sleep)
+                        continue
+                    try:
+                        with self._lock:
+                            if self._queue or self._active.any():
+                                self._step_locked()
+                        self._fire_callbacks()
+                    except CallbackError as ce:
+                        # the ENGINE is fine — fail exactly the
+                        # requests whose streams are poisoned (typed,
+                        # so wait(rid) raises it directly)
+                        with self._lock:
+                            for rid, err in ce.errors:
+                                self._fail_request_locked(
+                                    rid, CallbackError([(rid, err)]))
+                        sup.success()
+                        self._recover_health()
+                    except Exception as e:
+                        self._on_tick_failure(e)
+                    else:
+                        sup.success()
+                        self._recover_health()
             except BaseException as e:   # surface to waiters, don't wedge
                 with self._lock:
                     self._thread_error = e
+                    self._health.to(DEAD)
                     self._done_cv.notify_all()
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
         return self
 
-    def stop(self, timeout=60.0):
+    def _on_tick_failure(self, e):
+        """Supervised-tick failure path (called WITHOUT the lock — the
+        retry backoff sleeps here)."""
+        if self._tele is not None:
+            self._tele.on_tick_retry()
+        if self._sup.failure(e) == "open":
+            with self._lock:
+                self._health.to(DEGRADED)
+                self._fail_all_locked(e)
+            if self._tele is not None:
+                self._tele.on_breaker_open()
+
+    def _recover_health(self):
+        with self._lock:
+            if self._health.state == DEGRADED:
+                self._health.to(HEALTHY)
+
+    def stop(self, timeout=60.0, drain=False):
+        """Stop the serve thread. ``drain=True`` is the graceful path:
+        admission closes immediately (submits raise ``ServerClosed``),
+        health goes ``draining``, the loop keeps ticking until every
+        queued and in-flight request has finished (results/failures
+        flushed to their waiters), then the thread exits. ``drain=False``
+        stops after the current tick; still-pending requests are failed
+        with ``ServerClosed`` so no waiter wedges. Either way the server
+        ends ``dead`` (503 on /healthz) until ``start()`` is called
+        again."""
+        with self._lock:
+            self._accepting = False
+            if drain and self._thread is not None:
+                self._draining = True
+                self._health.to(DRAINING)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
@@ -712,11 +1072,30 @@ class ContinuousBatchingServer:
                     f"tick/compile may still be running); call stop() "
                     f"again to re-join")
             self._thread = None
+        with self._lock:
+            self._draining = False
+            if not drain:
+                # hard stop: flush partials for in-flight slots, fail
+                # what never ran — every waiter unblocks
+                for slot in range(self.max_slots):
+                    if self._active[slot]:
+                        self._finish_partial_locked(slot)
+                for item in self._queue:
+                    self._failures[item.rid] = ServerClosed(
+                        f"request {item.rid} was still queued when the "
+                        f"server stopped")
+                self._queue.clear()
+                self._deferred_cbs.clear()   # nobody will fire them
+            self._health.to(DEAD)
+            self._done_cv.notify_all()
 
     def wait(self, rid, timeout=120.0):
         """Block until ``rid`` finishes (requires start()); returns its
-        new tokens. Raises this request's admission error if it failed,
-        or the serve thread's error if the whole thread died."""
+        new tokens. Typed reliability failures (``DeadlineExceeded``,
+        ``QueueFullError``, ``CircuitOpenError``, ...) are raised
+        directly; other per-request errors are wrapped in a
+        ``RuntimeError``; a dead serve thread raises for every
+        waiter."""
         import time as _time
         deadline = _time.monotonic() + timeout
         with self._done_cv:
@@ -725,6 +1104,8 @@ class ContinuousBatchingServer:
                     return self._results.pop(rid)
                 if rid in self._failures:
                     e = self._failures.pop(rid)
+                    if isinstance(e, ReliabilityError):
+                        raise e
                     raise RuntimeError(
                         f"request {rid} failed at admission: {e}") from e
                 if self._thread_error is not None:

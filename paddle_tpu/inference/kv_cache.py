@@ -21,6 +21,8 @@ corrupt a live slot's pages.
 """
 import numpy as np
 
+from ..reliability.faults import PAGE_ALLOC
+
 __all__ = ["PagedKVCache", "OutOfPages", "NULL_PAGE"]
 
 NULL_PAGE = 0
@@ -42,7 +44,8 @@ class PagedKVCache:
     device copy needs a refresh.
     """
 
-    def __init__(self, num_pages, page_size, max_slots, pages_per_slot):
+    def __init__(self, num_pages, page_size, max_slots, pages_per_slot,
+                 fault_injector=None):
         if page_size < 1 or pages_per_slot < 1:
             raise ValueError("page_size and pages_per_slot must be >= 1")
         if num_pages < 2:
@@ -58,6 +61,10 @@ class PagedKVCache:
         self._slot_pages = [[] for _ in range(max_slots)]
         self._slot_shared = [0] * max_slots
         self.dirty = True
+        # chaos hook (reliability.FaultInjector): alloc() checks the
+        # "kv.alloc" point BEFORE touching the free list, so an injected
+        # allocation failure can never leak pages
+        self._faults = fault_injector
         # cumulative churn counters (telemetry: page-pool pressure and
         # sharing effectiveness without polling mid-operation)
         self.alloc_total = 0       # pages taken off the free list
@@ -76,6 +83,8 @@ class PagedKVCache:
 
     def alloc(self, n):
         """Take ``n`` pages off the free list (refcount 1 each)."""
+        if self._faults is not None:
+            self._faults.check(PAGE_ALLOC, need=n)
         if n > len(self._free):
             raise OutOfPages(
                 f"need {n} pages but only {len(self._free)} of "

@@ -11,21 +11,37 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
+
+from ..reliability import (DeadlineExceeded, QueueFullError,
+                           SchedulerClosed)
 
 __all__ = ["BatchScheduler", "serve_metrics"]
 
 
 class _Request:
-    __slots__ = ("inputs", "future", "n", "t_submit")
+    __slots__ = ("inputs", "future", "n", "t_submit", "deadline")
 
-    def __init__(self, inputs, t_submit=None):
+    def __init__(self, inputs, t_submit=None, deadline=None):
         self.inputs = inputs
         self.future = Future()
         self.n = int(inputs[0].shape[0])    # rows this request contributes
         self.t_submit = t_submit
+        self.deadline = deadline            # absolute clock time, or None
+
+    def settle(self, result=None, error=None):
+        """Resolve the future, losing gracefully if the other side of a
+        close()/worker race settled it first (whoever wins, the waiter
+        sees exactly one outcome)."""
+        try:
+            if error is not None:
+                self.future.set_exception(error)
+            else:
+                self.future.set_result(result)
+        except InvalidStateError:
+            pass
 
 
 class BatchScheduler:
@@ -42,6 +58,12 @@ class BatchScheduler:
     differ batch separately (a shape change would recompile — the
     scheduler never mixes them).
 
+    ``max_queue`` bounds the pending-request count: a full queue REJECTS
+    the submit with ``QueueFullError`` instead of growing without bound
+    under overload. ``submit(..., deadline_s=)`` bounds waiting: a
+    request still queued when its deadline passes fails its future with
+    ``DeadlineExceeded`` before any runner time is spent on it.
+
     ``registry`` (``telemetry.MetricRegistry``) publishes
     ``scheduler_batch_rows`` / ``scheduler_batch_seconds`` /
     ``scheduler_queue_wait_seconds`` histograms and
@@ -50,20 +72,22 @@ class BatchScheduler:
     """
 
     def __init__(self, runner, max_batch_size=8, max_delay_ms=5.0,
-                 registry=None, clock=None):
+                 registry=None, clock=None, max_queue=None):
         self._run = (runner.run if hasattr(runner, "run") else runner)
         self.max_batch = int(max_batch_size)
         self.max_delay = float(max_delay_ms) / 1e3
+        self.max_queue = None if max_queue is None else int(max_queue)
         self._lock = threading.Condition()
         self._queue = []                    # pending _Request, FIFO
+        self._inflight = []                 # popped group the runner holds
         self._closed = False
         self.batches_run = 0                # introspection for tests
         self._m = None
+        from ..telemetry.clock import MonotonicClock
+        self._clock = clock if clock is not None else MonotonicClock()
         if registry is not None and registry.enabled:
-            from ..telemetry.clock import MonotonicClock
             from ..telemetry.serving import (OCCUPANCY_BUCKETS,
                                              TICK_BUCKETS)
-            self._clock = clock if clock is not None else MonotonicClock()
             self._m = {
                 "rows": registry.histogram(
                     "scheduler_batch_rows", "Rows per batched call",
@@ -86,14 +110,22 @@ class BatchScheduler:
         self._worker.start()
 
     # ------------------------------------------------------------ client
-    def submit(self, *arrays):
+    def submit(self, *arrays, deadline_s=None):
         arrays = [np.asarray(a) for a in arrays]
         if not arrays:
             raise ValueError("submit() needs at least one input array")
-        req = _Request(arrays)
+        deadline = None if deadline_s is None \
+            else self._clock.now() + float(deadline_s)
+        req = _Request(arrays, deadline=deadline)
         with self._lock:
             if self._closed:
-                raise RuntimeError("scheduler is closed")
+                raise SchedulerClosed("scheduler is closed")
+            if (self.max_queue is not None
+                    and len(self._queue) >= self.max_queue):
+                raise QueueFullError(
+                    f"scheduler queue holds {len(self._queue)} requests "
+                    f"(max_queue={self.max_queue}) — resubmit with "
+                    f"backoff")
             if self._m:        # count only ACCEPTED requests
                 req.t_submit = self._clock.now()
                 self._m["requests"].inc()
@@ -102,10 +134,28 @@ class BatchScheduler:
         return req.future
 
     def close(self, timeout=10.0):
+        """Stop the worker after it drains the queue. If the worker is
+        WEDGED inside a runner call, every still-pending future (queued
+        or held by the stuck batch) is failed with ``SchedulerClosed``
+        — a waiter must never hang on a scheduler that already gave up
+        — and the join timeout is surfaced as ``TimeoutError``."""
         with self._lock:
             self._closed = True
             self._lock.notify()
         self._worker.join(timeout=timeout)
+        if self._worker.is_alive():
+            with self._lock:
+                victims = list(self._queue) + list(self._inflight)
+                self._queue.clear()
+            err = SchedulerClosed(
+                "scheduler closed while its runner was wedged; this "
+                "request will never run")
+            for r in victims:
+                r.settle(error=err)
+            raise TimeoutError(
+                f"scheduler worker did not exit within {timeout}s (the "
+                f"runner call is still blocked); {len(victims)} pending "
+                f"future(s) were failed with SchedulerClosed")
 
     # ------------------------------------------------------------ worker
     @staticmethod
@@ -130,6 +180,21 @@ class BatchScheduler:
         self._queue = rest
         return group
 
+    def _expire_locked(self):
+        """Fail queued requests whose deadline passed BEFORE any runner
+        time is spent on them (called with the lock held)."""
+        if not any(r.deadline is not None for r in self._queue):
+            return
+        now = self._clock.now()
+        keep = []
+        for r in self._queue:
+            if r.deadline is not None and now >= r.deadline:
+                r.settle(error=DeadlineExceeded(
+                    "request expired in the scheduler queue"))
+            else:
+                keep.append(r)
+        self._queue = keep
+
     def _loop(self):
         while True:
             with self._lock:
@@ -144,7 +209,9 @@ class BatchScheduler:
                        and not self._closed
                        and time.monotonic() - first_seen < self.max_delay):
                     self._lock.wait(timeout=self.max_delay / 4)
+                self._expire_locked()
                 group = self._take_group()
+                self._inflight = group or []
             if not group:
                 continue
             try:
@@ -163,20 +230,26 @@ class BatchScheduler:
                         self._clock.now() - t_launch)
                 off = 0
                 for r in group:
-                    r.future.set_result(
-                        [np.asarray(o)[off:off + r.n] for o in outs])
+                    # settle() resolves the race with a close() that
+                    # already failed this future
+                    r.settle([np.asarray(o)[off:off + r.n] for o in outs])
                     off += r.n
             except Exception as e:              # propagate to every waiter
                 if self._m:
                     self._m["failures"].inc()
                 for r in group:
-                    if not r.future.done():
-                        r.future.set_exception(e)
+                    r.settle(error=e)
+            finally:
+                with self._lock:
+                    self._inflight = []
 
 
 def serve_metrics(target, host="127.0.0.1", port=0):
     """Expose a serving stack's telemetry over HTTP: ``/metrics``
-    (Prometheus text) and ``/stats`` (JSON snapshot + process stats).
+    (Prometheus text), ``/stats`` (JSON snapshot + process stats), and
+    — when ``target`` reports health (``ContinuousBatchingServer``) —
+    ``/healthz`` (200 healthy/degraded, 503 draining/dead: the
+    load-balancer readiness contract).
 
     ``target`` is a ``ContinuousBatchingServer`` (uses its attached
     ``telemetry``), a ``ServerTelemetry``, or a bare ``MetricRegistry``.
@@ -200,5 +273,10 @@ def serve_metrics(target, host="127.0.0.1", port=0):
             if kv is not None:
                 stats["kv_pool"] = kv.telemetry_stats()
             return stats
+    health = None
+    if hasattr(target, "health"):
+
+        def health():
+            return target.health
     return MetricsServer(registry, host=host, port=port,
-                         extra_stats=extra).start()
+                         extra_stats=extra, health=health).start()

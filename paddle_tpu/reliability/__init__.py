@@ -1,0 +1,41 @@
+"""paddle_tpu.reliability — serving reliability layer.
+
+What keeps the serving stack (paddle_tpu/inference/) upright under
+heavy, hostile traffic: typed failure contracts, bounded waiting,
+supervised retries, health reporting, and deterministic chaos testing.
+
+- errors.py: the ``ReliabilityError`` family — ``DeadlineExceeded``,
+  ``QueueFullError``, ``CircuitOpenError``, ... ``wait()`` raises these
+  directly so clients can branch on type.
+- retry.py: ``RetryPolicy`` (exponential backoff, seeded jitter,
+  injectable sleep) and ``CircuitBreaker`` (consecutive-failure trip,
+  half-open probe, injectable clock).
+- supervisor.py: ``ServeSupervisor`` — the retry/breaker bookkeeping
+  the serve thread consults around every tick.
+- health.py: ``HealthMonitor`` — ``healthy / degraded / draining /
+  dead``, published as the ``server_health`` gauge and ``/healthz``.
+- faults.py: ``FaultInjector`` — named failure points with seeded
+  per-point PRNG streams; chaos runs reproduce exactly.
+
+Everything here is host-side, dependency-free (stdlib + the telemetry
+clock protocol), and deterministic under test.
+"""
+from .errors import (CallbackError, CircuitOpenError,  # noqa: F401
+                     DeadlineExceeded, InjectedFault, QueueFullError,
+                     ReliabilityError, RequestCancelled, SchedulerClosed,
+                     ServerClosed)
+from .faults import (DECODE_TICK, FaultInjector, ON_TOKEN,  # noqa: F401
+                     PAGE_ALLOC, PREFILL)
+from .health import (DEAD, DEGRADED, DRAINING, HEALTH_CODES,  # noqa: F401
+                     HEALTHY, HealthMonitor, is_serving_state)
+from .retry import CircuitBreaker, RetryPolicy  # noqa: F401
+from .supervisor import ServeSupervisor  # noqa: F401
+
+__all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
+           "RequestCancelled", "ServerClosed", "SchedulerClosed",
+           "CircuitOpenError", "InjectedFault", "CallbackError",
+           "RetryPolicy", "CircuitBreaker", "ServeSupervisor",
+           "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "DEAD",
+           "HEALTH_CODES", "is_serving_state",
+           "FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
+           "ON_TOKEN"]

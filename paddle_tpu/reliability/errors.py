@@ -1,0 +1,82 @@
+"""Typed errors for the serving reliability layer.
+
+Every failure the reliability layer can hand a waiter is a
+``ReliabilityError`` subclass, so callers can catch the whole family or
+match a specific condition. ``ContinuousBatchingServer.wait`` raises
+these DIRECTLY (no RuntimeError wrapping) — a client distinguishing
+"shed, resubmit later" (``QueueFullError``) from "never resubmit"
+(``DeadlineExceeded``) only needs the type.
+"""
+
+__all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
+           "RequestCancelled", "ServerClosed", "SchedulerClosed",
+           "CircuitOpenError", "InjectedFault", "CallbackError"]
+
+
+class ReliabilityError(RuntimeError):
+    """Base class for every typed serving-reliability failure."""
+
+
+class DeadlineExceeded(ReliabilityError, TimeoutError):
+    """The request's ``deadline_s`` elapsed before it finished. Raised
+    at submit (deadline already in the past), while queued (expired
+    before a prefill was spent on it), or surfaced as a PARTIAL result
+    when a mid-decode request runs out of time (the server cancels the
+    slot and records what it generated)."""
+
+
+class QueueFullError(ReliabilityError):
+    """Admission control shed this request: the queue held ``max_queue``
+    entries. Under ``shed_policy="reject"`` the NEW submit raises this;
+    under ``"evict_oldest"`` the OLDEST queued request fails with it
+    (its waiter sees the eviction) and the new one is accepted."""
+
+
+class RequestCancelled(ReliabilityError):
+    """``cancel()`` dropped the request while it was still queued (a
+    mid-decode cancel records the partial result instead)."""
+
+
+class ServerClosed(ReliabilityError):
+    """The server is draining or stopped: submits are refused, and a
+    hard ``stop()`` fails still-queued requests with this."""
+
+
+class SchedulerClosed(ReliabilityError):
+    """``BatchScheduler.close()`` gave up on a wedged runner; pending
+    futures are failed with this instead of hanging forever."""
+
+
+class CircuitOpenError(ReliabilityError):
+    """The serve loop's circuit breaker opened (N consecutive tick
+    failures): in-flight and queued requests are failed with this so no
+    waiter wedges, and the server goes ``degraded`` until a half-open
+    probe tick succeeds. ``__cause__`` is the last tick error."""
+
+
+class InjectedFault(ReliabilityError):
+    """A ``FaultInjector`` failure point fired (chaos testing)."""
+
+    def __init__(self, point="", visit=None):
+        self.point = point
+        self.visit = visit
+        msg = point if visit is None else f"{point} (visit {visit})"
+        super().__init__(f"injected fault at {msg}")
+
+
+class CallbackError(ReliabilityError):
+    """One or more ``on_token`` streaming callbacks raised during a
+    callback sweep. EVERY queued callback still fires (one poisoned
+    stream must not starve the others); this carries the per-request
+    errors so the supervisor can fail exactly the offending requests.
+
+    ``rid``/``__cause__`` are the first failure; ``errors`` is the full
+    ``[(rid, exception), ...]`` list in firing order."""
+
+    def __init__(self, errors):
+        self.errors = list(errors)
+        self.rid, first = self.errors[0]
+        super().__init__(
+            f"{len(self.errors)} on_token callback(s) raised; first: "
+            f"request {self.rid}: {first!r}")
+        self.__cause__ = first

@@ -1,0 +1,157 @@
+"""Deterministic fault injection for chaos-testing the serving stack.
+
+A ``FaultInjector`` owns named FAILURE POINTS. Production code calls
+``injector.check("server.decode_tick")`` at each point (the server does
+this only when an injector is attached — the default ``None`` costs one
+attribute check); the injector decides, deterministically, whether that
+visit fails, and raises ``InjectedFault`` if so.
+
+Two trigger modes per point, combinable:
+
+- ``schedule``: explicit 0-based visit indices that ALWAYS fire — exact
+  regression scripts ("fail the 3rd prefill").
+- ``probability``: each visit fires with probability p, drawn from a
+  PER-POINT PRNG seeded by ``(seed, point name)`` — chaos at a rate,
+  yet two runs with the same seed and the same visit sequence produce
+  IDENTICAL injection traces (the per-point streams make the decision
+  sequence independent of how visits to different points interleave).
+
+``trace`` records every fired injection as ``(point, visit_index)`` —
+the determinism contract tests assert two runs' traces are equal.
+``reset()`` rewinds counters AND re-seeds the RNGs so one injector can
+replay itself.
+"""
+import random
+import threading
+
+from .errors import InjectedFault
+
+__all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
+           "ON_TOKEN"]
+
+# failure points wired into the serving stack (callers may add their own)
+PREFILL = "server.prefill"          # _admit_one: admission prefill
+DECODE_TICK = "server.decode_tick"  # _step_locked: batched decode dispatch
+PAGE_ALLOC = "kv.alloc"             # PagedKVCache.alloc
+ON_TOKEN = "server.on_token"        # streamed-token callback delivery
+
+
+class _Rule:
+    __slots__ = ("probability", "schedule", "error", "start", "stop",
+                 "max_fires", "fired")
+
+    def __init__(self, probability, schedule, error, start, stop,
+                 max_fires):
+        self.probability = float(probability)
+        self.schedule = frozenset(int(i) for i in schedule)
+        self.error = error
+        self.start = int(start)
+        self.stop = stop if stop is None else int(stop)
+        self.max_fires = max_fires if max_fires is None else int(max_fires)
+        self.fired = 0
+
+
+class FaultInjector:
+    """Seeded, thread-safe failure-point registry.
+
+    >>> fi = FaultInjector(seed=7).on(PREFILL, probability=0.2) \\
+    ...                           .on(DECODE_TICK, schedule=[3])
+    >>> srv = ContinuousBatchingServer(model, ..., fault_injector=fi)
+
+    ``enabled=False`` (or ``disarm()``) turns every ``check`` into a
+    counter-only visit, so one test can run the same script with and
+    without chaos.
+    """
+
+    def __init__(self, seed=0, enabled=True):
+        self.seed = int(seed)
+        self.enabled = bool(enabled)
+        self._rules = {}
+        self._rngs = {}
+        self._visits = {}
+        self.trace = []               # (point, visit_index) of FIRES
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ registration
+    def on(self, point, probability=0.0, schedule=(), error=None,
+           start=0, stop=None, max_fires=None):
+        """Arm ``point``. ``probability`` fires per visit; ``schedule``
+        lists visit indices that always fire; ``start``/``stop`` bound
+        the probabilistic window (visit indices, half-open); ``max_fires``
+        caps total probabilistic fires. ``error``: an exception CLASS
+        (instantiated with a message) or zero-arg factory; default
+        ``InjectedFault``. Returns self for chaining."""
+        if not 0.0 <= float(probability) <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        with self._lock:
+            self._rules[point] = _Rule(probability, schedule, error,
+                                       start, stop, max_fires)
+            self._rngs[point] = random.Random(f"{self.seed}:{point}")
+            self._visits.setdefault(point, 0)
+        return self
+
+    def arm(self):
+        self.enabled = True
+        return self
+
+    def disarm(self):
+        self.enabled = False
+        return self
+
+    def reset(self):
+        """Rewind visit counters, fire counts, trace, and RNG streams —
+        the injector will replay the exact same decision sequence."""
+        with self._lock:
+            self.trace = []
+            for point, rule in self._rules.items():
+                rule.fired = 0
+                self._visits[point] = 0
+                self._rngs[point] = random.Random(f"{self.seed}:{point}")
+        return self
+
+    # ----------------------------------------------------------- runtime
+    def check(self, point, **ctx):
+        """Count a visit to ``point``; raise if this visit fires.
+        ``ctx`` (e.g. ``rid=...``) is attached to the raised error as
+        ``.ctx`` for debugging chaos traces."""
+        with self._lock:
+            n = self._visits.get(point, 0)
+            self._visits[point] = n + 1
+            rule = self._rules.get(point)
+            if rule is None or not self.enabled:
+                return
+            fire = n in rule.schedule
+            if not fire and rule.probability > 0.0:
+                in_window = n >= rule.start and (rule.stop is None
+                                                 or n < rule.stop)
+                budget_ok = (rule.max_fires is None
+                             or rule.fired < rule.max_fires)
+                # always DRAW when armed+windowed so the stream position
+                # depends only on the visit count, not on max_fires state
+                if in_window:
+                    draw = self._rngs[point].random()
+                    fire = budget_ok and draw < rule.probability
+            if not fire:
+                return
+            rule.fired += 1
+            self.trace.append((point, n))
+        if rule.error is None:
+            err = InjectedFault(point, n)
+        else:
+            err = rule.error() if not isinstance(rule.error, type) \
+                else rule.error(f"injected fault at {point} (visit {n})")
+        err.ctx = dict(ctx)
+        raise err
+
+    # ------------------------------------------------------ introspection
+    def visits(self, point):
+        with self._lock:
+            return self._visits.get(point, 0)
+
+    def fired(self, point=None):
+        """Fires at ``point``, or total across all points."""
+        with self._lock:
+            if point is not None:
+                rule = self._rules.get(point)
+                return 0 if rule is None else rule.fired
+            return sum(r.fired for r in self._rules.values())

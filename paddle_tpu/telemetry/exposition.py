@@ -7,10 +7,14 @@ tests can assert the exposition ROUND-TRIPS (render -> parse -> same
 values), not for scraping production endpoints.
 
 ``MetricsServer`` is a stdlib ThreadingHTTPServer exposing
-- ``/metrics`` — Prometheus text (scrape target), and
+- ``/metrics`` — Prometheus text (scrape target),
 - ``/stats``   — the registry snapshot as JSON plus any extra
   process-level stats the owner passes (e.g. the batching server's
-  ``stats`` dict), for humans and ad-hoc dashboards.
+  ``stats`` dict), for humans and ad-hoc dashboards, and
+- ``/healthz`` — when a ``health`` callback is wired (see
+  ``inference.serving.serve_metrics``): 200 with ``{"state": ...}``
+  while the server is healthy or degraded, 503 while draining or dead
+  — the load-balancer / readiness contract.
 """
 import json
 import threading
@@ -152,12 +156,13 @@ class _Handler:
     """Request handler factory bound to a registry (built lazily so the
     http.server import stays off the non-serving path)."""
 
-    def __new__(cls, registry, extra_stats):
+    def __new__(cls, registry, extra_stats, health=None):
         from http.server import BaseHTTPRequestHandler
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path == "/metrics":
                     body = render_prometheus(registry).encode()
                     ctype = CONTENT_TYPE
@@ -167,10 +172,20 @@ class _Handler:
                         stats["stats"] = extra_stats()
                     body = json.dumps(stats, default=str).encode()
                     ctype = "application/json"
+                elif path == "/healthz" and health is not None:
+                    # the serving verdict lives in ONE place
+                    # (reliability.health, shared with the admission
+                    # gate); late import keeps telemetry loadable
+                    # without the reliability package on odd paths
+                    from ..reliability.health import is_serving_state
+                    state = health()
+                    status = 200 if is_serving_state(state) else 503
+                    body = json.dumps({"state": state}).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -191,11 +206,12 @@ class MetricsServer:
     """
 
     def __init__(self, registry, host="127.0.0.1", port=0,
-                 extra_stats=None):
+                 extra_stats=None, health=None):
         self.registry = registry
         self._host = host
         self._port = int(port)
         self._extra = extra_stats
+        self._health = health      # () -> health-state name, for /healthz
         self._httpd = None
         self._thread = None
 
@@ -212,7 +228,8 @@ class MetricsServer:
             raise RuntimeError("metrics server already started")
         from http.server import ThreadingHTTPServer
         self._httpd = ThreadingHTTPServer(
-            (self._host, self._port), _Handler(self.registry, self._extra))
+            (self._host, self._port),
+            _Handler(self.registry, self._extra, self._health))
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
             daemon=True)

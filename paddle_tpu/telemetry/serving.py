@@ -29,6 +29,14 @@ Cache signals:
   frontier in the slot's own pages, null-redirected only when they
   cross the reserved-extent page boundary).
 
+Reliability signals (paddle_tpu.reliability wiring):
+- ``server_shed_total{policy=reject|evict_oldest}``  admission control
+- ``server_deadline_expired_total{where=queued|decoding}``
+- ``server_tick_retries_total``   supervised serve-loop retries
+- ``server_breaker_open_total``   circuit-breaker opens
+- ``server_health``               0 healthy / 1 degraded / 2 draining /
+                                  3 dead (also served on ``/healthz``)
+
 Every method no-ops when the registry is disabled (no locks, no clock
 reads). All calls happen under the server's own lock, so per-request
 state needs no extra synchronization. Host-side only — never call any
@@ -133,6 +141,28 @@ class ServerTelemetry:
             "serving_wasted_block_tokens_total",
             "Block-decode steps run past a slot's finish (tick_block "
             "amortization cost)")
+        # reliability signals (paddle_tpu.reliability): admission
+        # control, supervised-loop retries, breaker, health
+        shed = r.counter("server_shed_total",
+                         "Requests shed by admission control",
+                         labelnames=("policy",))
+        self._c_shed_reject = shed.labels(policy="reject")
+        self._c_shed_evict = shed.labels(policy="evict_oldest")
+        exp = r.counter("server_deadline_expired_total",
+                        "Requests that outran their deadline",
+                        labelnames=("where",))
+        self._c_exp_queued = exp.labels(where="queued")
+        self._c_exp_decoding = exp.labels(where="decoding")
+        self._c_tick_retries = r.counter(
+            "server_tick_retries_total",
+            "Supervised serve-loop tick failures retried")
+        self._c_breaker_open = r.counter(
+            "server_breaker_open_total",
+            "Circuit-breaker opens (waiters failed, health degraded)")
+        self._g_health = r.gauge(
+            "server_health",
+            "Health state code: 0 healthy / 1 degraded / 2 draining / "
+            "3 dead (alert on >= 2)")
 
     # -------------------------------------------------------- lifecycle
     def on_submit(self, rid, prompt_tokens, queue_depth):
@@ -267,3 +297,32 @@ class ServerTelemetry:
         """Out-of-band prefill work (register_prefix)."""
         if self.enabled and n:
             self._c_tok_prefill.inc(n)
+
+    # ------------------------------------------------------- reliability
+    def on_shed(self, policy):
+        if not self.enabled:
+            return
+        (self._c_shed_reject if policy == "reject"
+         else self._c_shed_evict).inc()
+
+    def on_deadline_expired(self, where):
+        if not self.enabled:
+            return
+        (self._c_exp_queued if where == "queued"
+         else self._c_exp_decoding).inc()
+
+    def on_tick_retry(self):
+        if self.enabled:
+            self._c_tick_retries.inc()
+
+    def on_breaker_open(self):
+        if self.enabled:
+            self._c_breaker_open.inc()
+
+    def set_health(self, state):
+        """Publish the health gauge; ``state`` is the reliability
+        health-state name (healthy/degraded/draining/dead)."""
+        if not self.enabled:
+            return
+        from ..reliability.health import HEALTH_CODES
+        self._g_health.set(HEALTH_CODES[state])

@@ -1,0 +1,90 @@
+"""A serving-contract test double for ContinuousBatchingServer.
+
+Reliability and chaos tests exercise HOST-side machinery — queues,
+deadlines, supervision, page accounting — where real transformer
+numerics only add compile time and noise. ``StubModel`` implements
+exactly the decode-bundle contract the server consumes
+(``_decode_bundle`` + ``_run_prefill``, dense AND paged) with a closed
+-form token recurrence, so every test can predict full outputs:
+
+    first  = (7 * prompt[-1] + len(prompt)) % V          (prefill)
+    tok_k+1 = (7 * tok_k + t_k + 1) % V,  t_k = T, T+1, ...
+
+``stub_tokens(prompt, n)`` is the oracle. Prefill writes token values
+into the cache rows it covers, so page fills / prefix sharing move real
+data; decode steps pass caches through untouched (logits depend only on
+(token, position), which is what makes the oracle exact).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+V = 16
+
+
+def stub_tokens(prompt, n):
+    """The n new tokens a StubModel-backed server must emit."""
+    prompt = np.asarray(prompt).reshape(-1)
+    T = len(prompt)
+    toks = [(7 * int(prompt[-1]) + T) % V]
+    t = T
+    while len(toks) < n:
+        toks.append((7 * toks[-1] + t + 1) % V)
+        t += 1
+    return np.asarray(toks[:n], np.int32)
+
+
+class StubModel:
+    L, H, HD = 1, 1, 2           # layers / kv heads / head dim
+    V = V
+
+    def _decode_bundle(self, max_cache_len, weight_dtype=None, mesh=None,
+                       cache_dtype=None, cache_backend="dense",
+                       page_size=None, num_pages=None):
+        L, h, hd, vocab = self.L, self.H, self.HD, self.V
+        C = int(max_cache_len)
+
+        if cache_backend == "paged":
+            def init_caches(batch):
+                shape = (L, int(num_pages), int(page_size), h, hd)
+                return {"pool": {"k": jnp.zeros(shape, jnp.float32),
+                                 "v": jnp.zeros(shape, jnp.float32)},
+                        "bt": jnp.zeros((batch, C // int(page_size)),
+                                        jnp.int32)}
+        else:
+            def init_caches(batch):
+                shape = (L, batch, C, h, hd)
+                return {"k": jnp.zeros(shape, jnp.float32),
+                        "v": jnp.zeros(shape, jnp.float32)}
+
+        def embed_fn(tok, t):
+            return jnp.stack([tok.astype(jnp.float32),
+                              t.astype(jnp.float32)], axis=-1)
+
+        def step_fn(x, caches, t):
+            return x, caches
+
+        def head_fn(out):
+            tok = out[..., 0].astype(jnp.int32)
+            t = out[..., 1].astype(jnp.int32)
+            nxt = (7 * tok + t + 1) % vocab
+            return jax.nn.one_hot(nxt, vocab, dtype=jnp.float32) * 10.0
+
+        return init_caches, embed_fn, step_fn, head_fn, None
+
+    def _run_prefill(self, bundle, ids_np, chunk=None, caches=None, t0=0):
+        init_caches = bundle[0]
+        ids = np.asarray(ids_np)
+        B, T = ids.shape
+        if caches is None:
+            caches = init_caches(B)
+        L, h, hd = self.L, self.H, self.HD
+        vals = jnp.asarray(ids, jnp.float32)[None, :, :, None, None]
+        vals = jnp.broadcast_to(vals, (L, B, T, h, hd))
+        caches = {"k": caches["k"].at[:, :, t0:t0 + T].set(vals),
+                  "v": caches["v"].at[:, :, t0:t0 + T].set(vals)}
+        nxt = (7 * ids[:, -1].astype(np.int64) + (t0 + T - 1) + 1) % self.V
+        logits = jax.nn.one_hot(jnp.asarray(nxt), self.V,
+                                dtype=jnp.float32) * 10.0
+        return logits, caches

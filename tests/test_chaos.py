@@ -1,0 +1,224 @@
+"""Chaos suite: deterministic fault injection against the serving
+stack (reliability.FaultInjector wired into prefill, decode tick, page
+alloc, and token callbacks).
+
+Contracts under 10-30% injected failure rates:
+- the server RECOVERS: breaker closed, later requests succeed;
+- every wait() resolves to a result or a TYPED error (no wedged
+  waiters, no raw thread death);
+- the paged pool never leaks: free + pinned == usable pool once
+  drained, across every failure path;
+- same seed => identical injection trace AND identical final state.
+
+Everything runs on the StubModel double with zero-delay retry policies
+— no sleeps, so the whole suite is tier-1 fast."""
+import numpy as np
+import pytest
+
+from _serving_stub import StubModel, stub_tokens
+from paddle_tpu.inference.continuous_batching import ContinuousBatchingServer
+from paddle_tpu.reliability import (CallbackError, CircuitBreaker,
+                                    FaultInjector, ReliabilityError,
+                                    RetryPolicy, faults)
+
+pytestmark = pytest.mark.chaos
+
+
+def _prompts(n, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    return [rng.integers(0, 16, (int(k),)).astype(np.int32)
+            for k in rng.integers(2, 9, (n,))]
+
+
+def _chaos_injector(seed, p_prefill=0.25, p_tick=0.2, p_alloc=0.15):
+    return (FaultInjector(seed=seed)
+            .on(faults.PREFILL, probability=p_prefill)
+            .on(faults.DECODE_TICK, probability=p_tick)
+            .on(faults.PAGE_ALLOC, probability=p_alloc))
+
+
+def _chaos_server(fi, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_cache_len", 64)
+    kw.setdefault("cache_backend", "paged")
+    kw.setdefault("page_size", 8)
+    kw.setdefault("retry_policy", RetryPolicy(base_delay_s=0.0,
+                                              jitter=0.0))
+    # high threshold: these tests exercise per-request failure + retry;
+    # breaker-open recovery has its own test below
+    kw.setdefault("breaker", CircuitBreaker(failure_threshold=10_000))
+    return ContinuousBatchingServer(StubModel(), fault_injector=fi, **kw)
+
+
+def _drive(srv, max_ticks=5000):
+    """Single-threaded supervisor stand-in: retry every failed tick.
+    Deterministic (no thread scheduling), used where the test must
+    replay exactly; the threaded tests use start()/wait()."""
+    ticks = 0
+    while True:
+        with srv._lock:
+            busy = bool(srv._queue or srv._active.any())
+        if not busy:
+            return
+        try:
+            srv.step()
+        except CallbackError:
+            pass                       # per-request; requests already failed
+        except Exception:
+            pass                       # transient tick fault: retry
+        ticks += 1
+        assert ticks < max_ticks, "chaos drive did not converge"
+
+
+def _final_state(srv, fi):
+    """(trace, results, failure types, pool balance) for determinism
+    comparisons."""
+    results = {r: tuple(int(x) for x in v)
+               for r, v in srv._results.items()}
+    fails = {r: type(e).__name__ for r, e in srv.failures.items()}
+    return fi.trace, results, fails, srv.pool_balance()
+
+
+class TestChaos:
+    def test_threaded_chaos_recovers_no_leaks(self):
+        """Acceptance: faults in prefill/decode/page-alloc at 10-30%,
+        server recovers, every wait() resolves typed, pool balanced."""
+        fi = _chaos_injector(seed=1234)
+        srv = _chaos_server(fi).start()
+        prompts = _prompts(14)
+        rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        ok, failed = {}, {}
+        for rid in rids:
+            try:
+                ok[rid] = srv.wait(rid, timeout=120)
+            except ReliabilityError as e:
+                failed[rid] = e
+        assert len(ok) + len(failed) == len(rids)   # nobody wedged
+        for rid, p in zip(rids, prompts):
+            if rid in ok:                # survivors are bit-exact
+                np.testing.assert_array_equal(ok[rid], stub_tokens(p, 5))
+        assert fi.fired() > 0, "chaos never fired; raise rates"
+        # recovery: chaos off, the same server keeps serving
+        fi.disarm()
+        p = _prompts(1, rng_seed=99)[0]
+        rid = srv.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(srv.wait(rid, timeout=60),
+                                      stub_tokens(p, 4))
+        assert srv.health == "healthy"
+        srv.stop()
+        free, live, pinned = srv.pool_balance()
+        assert live == 0, f"leaked {live} pages"
+        assert free + pinned == srv._kv.num_pages - 1
+
+    def test_chaos_with_prefix_pinning_no_leaks(self):
+        """Injected admission failures must roll back cleanly even when
+        slots share refcounted prefix pages."""
+        fi = _chaos_injector(seed=77, p_tick=0.1)
+        srv = _chaos_server(fi, max_cache_len=64)
+        fi.disarm()
+        prefix = np.arange(8, dtype=np.int32) % 16
+        srv.register_prefix(prefix)
+        fi.arm()
+        prompts = [np.concatenate([prefix, t]) for t in _prompts(8)]
+        rids = [srv.submit(p, max_new_tokens=4) for p in prompts]
+        _drive(srv)
+        outs = srv._results
+        for rid, p in zip(rids, prompts):
+            if rid in outs:
+                np.testing.assert_array_equal(outs[rid],
+                                              stub_tokens(p, 4))
+        free, live, pinned = srv.pool_balance()
+        assert live == 0 and pinned == 1         # only the prefix pin
+        assert free + pinned == srv._kv.num_pages - 1
+
+    def test_same_seed_identical_trace_and_state(self):
+        """Satellite: two chaos runs with the same seed produce
+        identical injection traces and identical final server state
+        (results, failure types, free-page count)."""
+        def run_once():
+            fi = _chaos_injector(seed=4242)
+            srv = _chaos_server(fi)
+            for p in _prompts(10, rng_seed=3):
+                srv.submit(p, max_new_tokens=5)
+            _drive(srv)
+            return _final_state(srv, fi)
+
+        trace_a, res_a, fail_a, pool_a = run_once()
+        trace_b, res_b, fail_b, pool_b = run_once()
+        assert trace_a == trace_b
+        assert res_a == res_b
+        assert fail_a == fail_b
+        assert pool_a == pool_b
+        assert trace_a, "deterministic run injected nothing"
+
+    def test_injector_reset_replays_one_server_script(self):
+        """reset() rewinds the PRNG streams: the same injector replays
+        the same script against a fresh server."""
+        fi = _chaos_injector(seed=9, p_alloc=0.0)
+
+        def run():
+            srv = _chaos_server(fi)
+            for p in _prompts(6, rng_seed=5):
+                srv.submit(p, max_new_tokens=4)
+            _drive(srv)
+            return list(fi.trace), srv.pool_balance()
+
+        first = run()
+        fi.reset()
+        assert run() == first
+
+    def test_callback_chaos_fails_streams_not_server(self):
+        """ON_TOKEN faults: poisoned streams fail individually, clean
+        requests stream to completion, pool stays balanced."""
+        fi = FaultInjector(seed=21).on(faults.ON_TOKEN, probability=0.3)
+        srv = _chaos_server(fi).start()
+        prompts = _prompts(8, rng_seed=7)
+        chunks = {i: [] for i in range(len(prompts))}
+        rids = [srv.submit(p, max_new_tokens=4,
+                           on_token=lambda r, t, i=i: chunks[i].append(t))
+                for i, p in enumerate(prompts)]
+        done = failed = 0
+        for i, rid in enumerate(rids):
+            try:
+                out = srv.wait(rid, timeout=120)
+                done += 1
+                np.testing.assert_array_equal(out,
+                                              stub_tokens(prompts[i], 4))
+            except ReliabilityError:
+                failed += 1
+        assert done + failed == len(rids)
+        assert srv.health == "healthy"           # engine never degraded
+        srv.stop()
+        free, live, pinned = srv.pool_balance()
+        assert live == 0
+
+    def test_breaker_storm_then_full_recovery(self):
+        """A sustained decode-fault storm opens the breaker (typed
+        errors for everyone in flight); once the storm passes and the
+        cooldown elapses, the SAME server serves again — acceptance
+        'breaker closed, subsequent requests succeed'."""
+        from paddle_tpu.telemetry import FakeClock
+        fcb = FakeClock()
+        fi = FaultInjector(seed=0).on(faults.DECODE_TICK,
+                                      probability=1.0)
+        srv = _chaos_server(
+            fi, breaker=CircuitBreaker(failure_threshold=4,
+                                       reset_after_s=5.0,
+                                       clock=fcb)).start()
+        rids = [srv.submit(p, max_new_tokens=4) for p in _prompts(5)]
+        errs = []
+        for rid in rids:
+            with pytest.raises(ReliabilityError) as ei:
+                srv.wait(rid, timeout=120)
+            errs.append(ei.value)
+        assert srv.health == "degraded"
+        fi.disarm()                       # storm over
+        fcb.advance(6.0)                  # cooldown elapses
+        p = _prompts(1, rng_seed=11)[0]
+        rid = srv.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(srv.wait(rid, timeout=60),
+                                      stub_tokens(p, 4))
+        assert srv.health == "healthy"
+        srv.stop()
+        free, live, pinned = srv.pool_balance()
+        assert live == 0

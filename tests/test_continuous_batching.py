@@ -282,19 +282,25 @@ class TestContinuousBatching:
         for i, p in enumerate(prompts):
             np.testing.assert_array_equal(results[i], _solo(model, p, 5))
 
-    def test_dead_serve_thread_surfaces_in_wait(self):
-        """code-review r5: a crashing on_token callback must not wedge
-        the server — waiters get the error."""
+    def test_poisoned_callback_fails_only_its_request(self):
+        """code-review r5 + PR 3 supervision: a crashing on_token
+        callback must not wedge (or kill) the server — ITS waiter gets
+        the typed error, and the server keeps serving new requests on
+        the same thread."""
+        from paddle_tpu.reliability import CallbackError
         model = _model()
         srv = ContinuousBatchingServer(model, max_slots=1,
                                        max_cache_len=64).start()
         rid = srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=4,
                          on_token=lambda r, t: 1 / 0)
-        with pytest.raises(RuntimeError, match="serve thread died"):
+        with pytest.raises(CallbackError, match="on_token"):
             srv.wait(rid, timeout=60)
-        srv._stop.set()
-        srv._thread.join(timeout=10)
-        srv._thread = None
+        # the serve thread survived: a fresh request completes normally
+        p = np.arange(4, dtype=np.int32)
+        rid2 = srv.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(srv.wait(rid2, timeout=300),
+                                      _solo(model, p, 4))
+        srv.stop()
 
     def test_everything_composed(self):
         """Kitchen sink: prefix cache + chunked prefill + tick_block +

@@ -1,0 +1,257 @@
+"""Unit tests for paddle_tpu.reliability: retry backoff, circuit
+breaker, health state machine, and the deterministic fault injector —
+all on fake clocks / seeded RNGs (no sleeps, no wall-time flake)."""
+import pytest
+
+from paddle_tpu.reliability import (CallbackError, CircuitBreaker,
+                                    DEAD, DEGRADED, DRAINING,
+                                    FaultInjector, HEALTHY,
+                                    HealthMonitor, InjectedFault,
+                                    ReliabilityError, RetryPolicy,
+                                    ServeSupervisor, faults)
+from paddle_tpu.telemetry import FakeClock
+
+
+# ------------------------------------------------------------- retry
+
+class TestRetryPolicy:
+    def test_exponential_growth_and_cap(self):
+        p = RetryPolicy(base_delay_s=0.01, multiplier=2.0,
+                        max_delay_s=0.05, jitter=0.0)
+        assert [p.delay(a) for a in range(5)] == \
+            pytest.approx([0.01, 0.02, 0.04, 0.05, 0.05])
+
+    def test_jitter_bounded_and_seeded(self):
+        a = RetryPolicy(base_delay_s=1.0, multiplier=1.0, max_delay_s=1.0,
+                        jitter=0.25, seed=5)
+        b = RetryPolicy(base_delay_s=1.0, multiplier=1.0, max_delay_s=1.0,
+                        jitter=0.25, seed=5)
+        da = [a.delay(0) for _ in range(50)]
+        assert da == [b.delay(0) for _ in range(50)]   # same seed, same
+        assert all(0.75 <= d <= 1.25 for d in da)
+        assert len(set(da)) > 1                        # jitter is live
+
+    def test_sleep_hook_receives_delays(self):
+        slept = []
+        p = RetryPolicy(base_delay_s=0.5, multiplier=2.0, max_delay_s=8.0,
+                        jitter=0.0, sleep=slept.append)
+        for attempt in range(3):
+            p.sleep(attempt)
+        assert slept == pytest.approx([0.5, 1.0, 2.0])
+        assert p.slept == slept
+
+    def test_zero_delay_never_calls_sleep(self):
+        p = RetryPolicy(base_delay_s=0.0, jitter=0.0,
+                        sleep=lambda s: pytest.fail("slept"))
+        assert p.sleep(3) == 0.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="multiplier"):
+            RetryPolicy(multiplier=0.5)
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter=1.5)
+
+
+# ----------------------------------------------------------- breaker
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_half_open_probe(self):
+        fc = FakeClock()
+        br = CircuitBreaker(failure_threshold=3, reset_after_s=10.0,
+                            clock=fc)
+        assert br.allow()
+        assert br.record_failure() is False
+        assert br.record_failure() is False
+        assert br.record_failure() is True        # opened exactly here
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()                     # cooldown running
+        fc.advance(9.0)
+        assert not br.allow()
+        fc.advance(1.5)
+        assert br.allow()                         # half-open probe
+        assert br.state == CircuitBreaker.HALF_OPEN
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.allow()
+
+    def test_failed_probe_reopens_immediately(self):
+        fc = FakeClock()
+        br = CircuitBreaker(failure_threshold=5, reset_after_s=1.0,
+                            clock=fc)
+        for _ in range(5):
+            br.record_failure()
+        fc.advance(2.0)
+        assert br.allow()                          # probe admitted
+        assert br.record_failure() is True         # 1 failure re-opens
+        assert br.state == CircuitBreaker.OPEN
+        assert br.open_total == 2
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failure_threshold=2, clock=FakeClock())
+        br.record_failure()
+        br.record_success()
+        assert br.record_failure() is False        # streak restarted
+
+
+# ------------------------------------------------------------ health
+
+class TestHealthMonitor:
+    def test_transitions_and_codes(self):
+        seen = []
+        hm = HealthMonitor(on_change=lambda s, c: seen.append((s, c)))
+        assert hm.state == HEALTHY and hm.code == 0 and hm.is_serving
+        hm.to(DEGRADED)
+        assert hm.code == 1 and hm.is_serving
+        hm.to(HEALTHY)
+        hm.to(DRAINING)
+        assert not hm.is_serving
+        assert hm.to(HEALTHY) == DRAINING          # draining is one-way
+        hm.to(DEAD)
+        assert hm.to(DEGRADED) == DEAD             # dead is terminal
+        assert seen == [(DEGRADED, 1), (HEALTHY, 0), (DRAINING, 2),
+                        (DEAD, 3)]
+
+    def test_reset_restarts(self):
+        hm = HealthMonitor()
+        hm.to(DEGRADED)
+        hm.to(DEAD)
+        assert hm.reset() == HEALTHY
+        with pytest.raises(ValueError, match="unknown"):
+            hm.to("sideways")
+
+
+# -------------------------------------------------------- supervisor
+
+class TestServeSupervisor:
+    def test_retry_then_open(self):
+        slept = []
+        sup = ServeSupervisor(
+            retry=RetryPolicy(base_delay_s=0.1, multiplier=2.0,
+                              jitter=0.0, sleep=slept.append),
+            breaker=CircuitBreaker(failure_threshold=3,
+                                   clock=FakeClock()))
+        boom = RuntimeError("boom")
+        assert sup.failure(boom) == "retry"
+        assert sup.failure(boom) == "retry"
+        assert sup.failure(boom) == "open"          # breaker trips; no
+        assert slept == pytest.approx([0.1, 0.2])   # backoff on "open"
+        assert sup.last_error is boom
+        sup.success()
+        assert sup.attempt == 0 and sup.last_error is None
+
+
+# ------------------------------------------------------------ faults
+
+class TestFaultInjector:
+    def test_schedule_fires_exact_visits(self):
+        fi = FaultInjector().on("pt", schedule=[1, 3])
+        fired = []
+        for i in range(5):
+            try:
+                fi.check("pt")
+            except InjectedFault as e:
+                fired.append(i)
+                assert e.point == "pt" and e.visit == i
+        assert fired == [1, 3]
+        assert fi.trace == [("pt", 1), ("pt", 3)]
+        assert fi.visits("pt") == 5 and fi.fired("pt") == 2
+
+    def test_probability_deterministic_per_seed(self):
+        def trace(seed):
+            fi = FaultInjector(seed=seed).on("a", probability=0.4) \
+                                         .on("b", probability=0.4)
+            for _ in range(30):
+                for pt in ("a", "b"):
+                    try:
+                        fi.check(pt)
+                    except InjectedFault:
+                        pass
+            return fi.trace
+        assert trace(7) == trace(7)
+        assert trace(7) != trace(8)
+
+    def test_per_point_streams_ignore_interleaving(self):
+        """Fire decisions at one point must not depend on visits to
+        another — the property that makes chaos traces reproducible
+        when unrelated code paths add or drop visits."""
+        fi1 = FaultInjector(seed=3).on("a", probability=0.5).on("b")
+        for _ in range(20):
+            try:
+                fi1.check("a")
+            except InjectedFault:
+                pass
+            fi1.check("b")               # interleaved unarmed visits
+        fi2 = FaultInjector(seed=3).on("a", probability=0.5)
+        for _ in range(20):
+            try:
+                fi2.check("a")
+            except InjectedFault:
+                pass
+        assert [e for e in fi1.trace if e[0] == "a"] == fi2.trace
+
+    def test_window_and_max_fires(self):
+        fi = FaultInjector(seed=0).on("w", probability=1.0, start=2,
+                                      stop=6, max_fires=3)
+        fired = []
+        for i in range(10):
+            try:
+                fi.check("w")
+            except InjectedFault:
+                fired.append(i)
+        assert fired == [2, 3, 4]          # window opens at 2, cap 3
+
+    def test_reset_replays_identically(self):
+        fi = FaultInjector(seed=9).on("p", probability=0.3)
+        for _ in range(25):
+            try:
+                fi.check("p")
+            except InjectedFault:
+                pass
+        first = list(fi.trace)
+        fi.reset()
+        assert fi.trace == [] and fi.visits("p") == 0
+        for _ in range(25):
+            try:
+                fi.check("p")
+            except InjectedFault:
+                pass
+        assert fi.trace == first
+
+    def test_disarm_counts_but_never_fires(self):
+        fi = FaultInjector().on("p", schedule=[0, 1, 2, 3]).disarm()
+        for _ in range(3):
+            fi.check("p")                # visits 0-2 counted, no fire
+        assert fi.visits("p") == 3 and fi.fired() == 0
+        fi.arm()
+        with pytest.raises(InjectedFault):
+            fi.check("p")                # visit 3 fires once re-armed
+
+    def test_custom_error_class_and_ctx(self):
+        class Boom(RuntimeError):
+            pass
+        fi = FaultInjector().on("p", schedule=[0], error=Boom)
+        with pytest.raises(Boom) as ei:
+            fi.check("p", rid=42)
+        assert ei.value.ctx == {"rid": 42}
+
+    def test_wired_point_names_exported(self):
+        assert faults.PREFILL == "server.prefill"
+        assert faults.DECODE_TICK == "server.decode_tick"
+        assert faults.PAGE_ALLOC == "kv.alloc"
+        assert faults.ON_TOKEN == "server.on_token"
+
+
+# ------------------------------------------------------------ errors
+
+class TestErrors:
+    def test_callback_error_carries_rids(self):
+        z = ZeroDivisionError("x")
+        e = CallbackError([(3, z), (5, ValueError("y"))])
+        assert e.rid == 3 and e.__cause__ is z
+        assert [r for r, _ in e.errors] == [3, 5]
+        assert isinstance(e, ReliabilityError)
+
+    def test_injected_fault_is_typed(self):
+        e = InjectedFault("server.prefill", 4)
+        assert isinstance(e, ReliabilityError)
+        assert "visit 4" in str(e)
