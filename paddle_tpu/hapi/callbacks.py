@@ -65,8 +65,19 @@ class CallbackList:
     def __getattr__(self, name):
         if name.startswith("on_"):
             def call(*args, **kwargs):
+                # fire EVERY callback even when one raises (mirrors the
+                # serving _fire_callbacks contract: a poisoned logger
+                # must not starve EarlyStopping/checkpointing), then
+                # re-raise the failures together, first as __cause__
+                errors = []
                 for c in self.callbacks:
-                    getattr(c, name)(*args, **kwargs)
+                    try:
+                        getattr(c, name)(*args, **kwargs)
+                    except Exception as e:
+                        errors.append((type(c).__name__, e))
+                if errors:
+                    from ..reliability.errors import CallbackError
+                    raise CallbackError(errors, what=f"{name} callback")
             return call
         raise AttributeError(name)
 

@@ -11,10 +11,16 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor, unwrap
-from ..io.dataloader import DataLoader, Dataset
+from ..io.dataloader import (DataLoader, Dataset,  # noqa: F401
+                             DistributedBatchSampler, IterableDataset)
 from .callbacks import CallbackList, ProgBarLogger
 
 __all__ = ["Model"]
+
+
+def _rel_faults():
+    from ..reliability import faults
+    return faults
 
 
 class Model:
@@ -39,6 +45,21 @@ class Model:
         return self
 
     # ------------------------------------------------------------- build
+    def _make_loss_of(self):
+        """The pure (params, inputs, labels, rng) -> scalar loss
+        closure both step builders differentiate — ONE definition so
+        the fast and guarded paths can never diverge."""
+        from ..jit import functional_call
+        net = self.network
+        loss_layer = self._loss
+
+        def loss_of(ps, inputs, labels, rng):
+            out = functional_call(net, ps, *inputs, rng=rng)
+            l = loss_layer(Tensor(out), *[Tensor(x) for x in labels])
+            return unwrap(l) if isinstance(l, Tensor) else l
+
+        return loss_of
+
     def _build_steps(self):
         if self._step_fn is not None:
             return
@@ -48,15 +69,16 @@ class Model:
         init_fn, update_fn = self._optimizer.functional()
         self._params = net.raw_params()
         self._opt_state = init_fn(self._params)
+        loss_of = self._make_loss_of()
 
-        def loss_of(ps, inputs, labels, rng):
-            out = functional_call(net, ps, *inputs, rng=rng)
-            l = loss_layer(Tensor(out), *[Tensor(x) for x in labels])
-            return unwrap(l) if isinstance(l, Tensor) else l
-
-        def step(ps, st, inputs, labels, i, rng):
+        # lr is a traced ARGUMENT, not closed over: update_fn's default
+        # evaluates get_lr() at trace time, which would bake the
+        # epoch-0 LR as a compile-time constant and freeze any
+        # LRScheduler for the whole run (and break exact resume — a
+        # restored process re-traces with the advanced schedule)
+        def step(ps, st, inputs, labels, i, rng, lr):
             loss, grads = jax.value_and_grad(loss_of)(ps, inputs, labels, rng)
-            new_p, new_s = update_fn(grads, ps, st, step=i)
+            new_p, new_s = update_fn(grads, ps, st, lr=lr, step=i)
             return loss, new_p, new_s
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
@@ -73,6 +95,43 @@ class Model:
 
         self._pred_fn = jax.jit(pred_step)
 
+    def _build_guarded_step(self, check_grads=True):
+        """Anomaly-guarded train step for supervised fit: computes the
+        usual update but COMMITS it only when loss and (optionally)
+        every gradient are finite — a NaN batch leaves params/opt state
+        bit-untouched (the supervisor decides skip vs rollback host-
+        side). Returns (loss, loss_finite, grads_finite, params, state)."""
+        if getattr(self, "_gstep_fn", None) is not None and \
+                self._gstep_check_grads == check_grads:
+            return
+        self._gstep_check_grads = check_grads
+        self._build_steps()
+        import jax.numpy as jnp
+        _, update_fn = self._optimizer.functional()
+        loss_of = self._make_loss_of()
+
+        def gstep(ps, st, inputs, labels, i, rng, lr):
+            loss, grads = jax.value_and_grad(loss_of)(ps, inputs, labels,
+                                                      rng)
+            loss_fin = jnp.isfinite(loss)
+            grad_fin = jnp.bool_(True)
+            if check_grads:
+                for g in jax.tree_util.tree_leaves(grads):
+                    grad_fin &= jnp.all(jnp.isfinite(g))
+            ok = loss_fin & grad_fin
+            new_p, new_s = update_fn(grads, ps, st, lr=lr, step=i)
+            new_p = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new_p, ps)
+            new_s = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new_s, st)
+            return loss, loss_fin, grad_fin, new_p, new_s
+
+        # NO buffer donation here (unlike the fast path): the
+        # supervisor may RETRY a step after a transient failure, and a
+        # retried call must still be able to read the old params/opt
+        # state — donation would have invalidated them at first dispatch
+        self._gstep_fn = jax.jit(gstep)
+
     @staticmethod
     def _split(batch):
         if isinstance(batch, (list, tuple)):
@@ -84,43 +143,196 @@ class Model:
         return (np.asarray(batch),), ()
 
     # ------------------------------------------------------------- train
+    def _ckpt_state(self):
+        return {"params": self._params, "opt_state": self._opt_state}
+
+    def _lr_sched(self):
+        from ..optimizer.lr import LRScheduler
+        lr = getattr(self._optimizer, "_lr", None)
+        return lr if isinstance(lr, LRScheduler) else None
+
+    def _cur_lr(self):
+        # plain Python float → jit traces it as a weak-typed f32
+        # scalar, numerically identical to the constant update_fn used
+        # to bake, but now live per call
+        return float(self._optimizer.get_lr())
+
+    def _fit_meta(self, epoch, batch, rng):
+        meta = {"step_count": self._step_count,
+                "cursor": {"epoch": epoch, "batch": batch},
+                "fit_rng": rng}
+        sched = self._lr_sched()
+        if sched is not None:
+            meta["lr"] = sched.state_dict()
+        return meta
+
+    def _apply_checkpoint(self, state, meta):
+        """Load a supervisor checkpoint's model-side pieces (params,
+        optimizer state, step count, LR schedule) — shared by fresh
+        resume and anomaly rollback."""
+        self._params = state["params"]
+        self._opt_state = state["opt_state"]
+        self._step_count = int(meta.get("step_count",
+                                        meta.get("step", 0)))
+        sched = self._lr_sched()
+        if sched is not None and "lr" in meta:
+            sched.set_state_dict(meta["lr"])
+
+    def _restore_fit(self, supervisor):
+        """Load the newest valid checkpoint into the model; returns
+        (rng, start_epoch, skip_batches) or None for a fresh start."""
+        state, meta, done = supervisor.restore_state()
+        if done is None:
+            return None
+        self._apply_checkpoint(state, meta)
+        cursor = meta.get("cursor", {"epoch": 0, "batch": 0})
+        rng = meta.get("fit_rng")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return jax.numpy.asarray(rng), int(cursor["epoch"]), \
+            int(cursor["batch"])
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, supervisor=None):
+        """Train. With ``supervisor`` (a ``reliability.TrainSupervisor``)
+        the loop becomes fault-tolerant: durable periodic checkpoints
+        (params, optimizer state, RNG, LR schedule, epoch/batch cursor),
+        EXACT resume on re-invocation after a kill, NaN/Inf steps
+        skipped in-step (guarded update) with rollback-to-last-good
+        after K in a row, transient STEP failures retried with backoff
+        (data-side retry covers INJECTED faults only — a real loader
+        failure surfaces loudly, since a raised-through generator is
+        closed and blindly re-nexting it would silently truncate the
+        epoch; the standalone ``TrainSupervisor.run`` loop retries its
+        ``next_batch`` fetches too), and SIGTERM /
+        ``request_preemption`` → checkpoint + clean early return. Exact resume additionally needs a
+        deterministic batch order, so the self-built loader switches to
+        a per-epoch-seeded sampler (``DistributedBatchSampler`` at
+        nranks=1); pass ``shuffle=False`` or your own epoch-seeded
+        loader otherwise."""
         self._build_steps()
-        loader = train_data if isinstance(train_data, DataLoader) else \
-            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
-                       drop_last=drop_last, num_workers=num_workers)
+        if supervisor is not None:
+            self._build_guarded_step(supervisor.anomaly.check_grads)
+            ds = (train_data.dataset if isinstance(train_data, DataLoader)
+                  else train_data)
+            if isinstance(ds, IterableDataset):
+                # an iterable stream has no index space: the epoch-
+                # seeded sampler, {epoch,batch} cursor, and sampler-
+                # level resume skip are all meaningless, so the exact-
+                # resume contract CANNOT hold — refuse loudly rather
+                # than stamp cursors that silently lie on resume
+                raise ValueError(
+                    "supervised fit needs a map-style dataset for its "
+                    "exact-resume contract; IterableDataset streams "
+                    "cannot be cursored. Use TrainSupervisor.run with "
+                    "a resumable loader instead.")
+        if isinstance(train_data, DataLoader):
+            loader = train_data
+        elif supervisor is not None:
+            sampler = DistributedBatchSampler(
+                train_data, batch_size=batch_size, num_replicas=1, rank=0,
+                shuffle=shuffle, drop_last=drop_last)
+            loader = DataLoader(train_data, batch_sampler=sampler,
+                                num_workers=num_workers)
+        else:
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, drop_last=drop_last,
+                                num_workers=num_workers)
         cbs = CallbackList(callbacks or [ProgBarLogger(log_freq,
                                                        verbose=verbose)])
         cbs.set_model(self)
         cbs.on_train_begin()
+        self.stop_training = False     # a new fit() is a new run
         rng = jax.random.PRNGKey(0)
-        for epoch in range(epochs):
+        start_epoch, skip_batches = 0, 0
+        if supervisor is not None:
+            # a pending preemption belonged to the run it interrupted;
+            # re-invoking IS the resume, so start with a clean flag
+            supervisor.clear_preemption()
+            restored = self._restore_fit(supervisor)
+            if restored is not None:
+                rng, start_epoch, skip_batches = restored
+        preempted = False
+        for epoch in range(start_epoch, epochs):
+            sampler = getattr(loader, "batch_sampler", None)
+            if sampler is not None and hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(epoch)
             cbs.on_epoch_begin(epoch)
             logs = {}
-            for it, batch in enumerate(loader):
+            # mid-epoch resume: skip the already-trained prefix at the
+            # sampler level (index lists only — no data fetch), keeping
+            # `it` aligned with absolute batch indices for the cursor
+            skip = skip_batches if epoch == start_epoch else 0
+            batches = loader.resume_iter(skip)
+            it = skip - 1
+            stop_cursor = None         # set on ANY mid-epoch stop: the
+            #                            next unprocessed batch index
+            while True:
+                if supervisor is not None:
+                    # retry INJECTED data faults only; the actual
+                    # next() runs unretried — a generator that raised
+                    # is closed, and re-nexting it would read as a
+                    # silently truncated epoch
+                    supervisor.run_with_retries(lambda: None,
+                                                _rel_faults().DATA_NEXT)
+                try:
+                    batch = next(batches)
+                except StopIteration:
+                    break
+                it += 1
                 if num_iters is not None and self._step_count >= num_iters:
+                    stop_cursor = it             # batch `it` not run
+                    break
+                if supervisor is not None and supervisor.preempted:
+                    preempted = True
+                    stop_cursor = it
                     break
                 cbs.on_train_batch_begin(it)
                 inputs, labels = self._split(batch)
                 self._step_count += 1
                 rng, sub = jax.random.split(rng)
-                loss, self._params, self._opt_state = self._step_fn(
-                    self._params, self._opt_state, inputs, labels,
-                    self._step_count, sub)
+                if supervisor is None:
+                    loss, self._params, self._opt_state = self._step_fn(
+                        self._params, self._opt_state, inputs, labels,
+                        self._step_count, sub, self._cur_lr())
+                else:
+                    loss = self._supervised_step(
+                        supervisor, inputs, labels, sub, epoch, it, rng)
                 logs = {"loss": float(loss), "step": it}
                 cbs.on_train_batch_end(it, logs)
                 if self.stop_training:
+                    stop_cursor = it + 1         # batch `it` ran
                     break
-            if isinstance(self._optimizer._lr, object) and hasattr(
-                    self._optimizer._lr, "step"):
+            if preempted:
+                supervisor.note_preempt()
+                supervisor.save_state(
+                    self._step_count, self._ckpt_state(),
+                    self._fit_meta(epoch, stop_cursor, rng), force=True)
+                supervisor.wait_for_saves()
+                self.stop_training = True
+                break
+            if supervisor is not None and stop_cursor is not None:
+                # mid-epoch stop (num_iters / early stopping): the
+                # durable cursor must say the epoch is UNFINISHED —
+                # stamping (epoch+1, 0) here would silently skip the
+                # untrained remainder on resume
+                supervisor.save_state(
+                    self._step_count, self._ckpt_state(),
+                    self._fit_meta(epoch, stop_cursor, rng), force=True)
+            if hasattr(self._optimizer._lr, "step"):
                 try:
                     self._optimizer._lr.step()
                 except TypeError:
                     pass
             cbs.on_epoch_end(epoch, logs)
+            if supervisor is not None and stop_cursor is None:
+                # end-of-epoch durability point: cursor rolls to the
+                # next epoch so resume never replays a finished one
+                supervisor.save_state(
+                    self._step_count, self._ckpt_state(),
+                    self._fit_meta(epoch + 1, 0, rng), force=True)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
                               verbose=verbose)
@@ -128,9 +340,63 @@ class Model:
                 self.save(f"{save_dir}/epoch_{epoch}")
             if self.stop_training:
                 break
+            if supervisor is not None and num_iters is not None and \
+                    self._step_count >= num_iters:
+                # stop the EPOCH loop too: spinning through the
+                # remaining epochs would re-save the cursor as
+                # (epoch, 0) each time, advancing the resume point past
+                # data that was never trained. Plain fit keeps the
+                # legacy behavior (remaining epochs still run their
+                # epoch-end eval/save/LR hooks with zero batches).
+                break
+        if supervisor is not None:
+            supervisor.wait_for_saves()
         self.network.load_raw_params(self._params)
         cbs.on_train_end()
         return self
+
+    def _supervised_step(self, supervisor, inputs, labels, sub, epoch,
+                        it, rng):
+        """One guarded train step under the supervisor: retry transient
+        failures, skip non-finite updates, roll back after K in a row,
+        checkpoint on the save interval."""
+        from ..reliability import training as _rt
+
+        def run():
+            return self._gstep_fn(self._params, self._opt_state, inputs,
+                                  labels, self._step_count, sub,
+                                  self._cur_lr())
+
+        loss, loss_fin, grad_fin, new_p, new_s = \
+            supervisor.run_with_retries(run, _rel_faults().TRAIN_STEP)
+        if bool(loss_fin) and bool(grad_fin):
+            supervisor.note_ok()
+            self._params, self._opt_state = new_p, new_s
+            supervisor.save_state(self._step_count, self._ckpt_state(),
+                                  lambda: self._fit_meta(epoch, it + 1, rng))
+        else:
+            # guarded step already refused the commit: new_p/new_s ARE
+            # the old values, passed through the in-jit where()
+            self._params, self._opt_state = new_p, new_s
+            kind = (_rt.ANOMALY_NONFINITE_LOSS if not bool(loss_fin)
+                    else _rt.ANOMALY_NONFINITE_GRAD)
+            action = supervisor.note_anomaly(kind, step=self._step_count)
+            if action == "rollback":
+                state, meta, done = supervisor.restore_state(
+                    restore_rng=False)
+                if done is None:
+                    # mirror TrainSupervisor.run: continuing here would
+                    # silently burn the rollback budget restoring
+                    # nothing
+                    raise _rt.TrainAnomalyError(
+                        "anomalies before any checkpoint existed: "
+                        "nothing to roll back to", kind=kind,
+                        step=self._step_count)
+                # model state only: fit's rollback keeps moving
+                # FORWARD through the data (the poisoned region is
+                # skipped); kill+resume restores the full cursor
+                self._apply_checkpoint(state, meta)
+        return loss
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
@@ -182,7 +448,7 @@ class Model:
         self._step_count += 1
         loss, self._params, self._opt_state = self._step_fn(
             self._params, self._opt_state, inputs, labels, self._step_count,
-            jax.random.PRNGKey(self._step_count))
+            jax.random.PRNGKey(self._step_count), self._cur_lr())
         return [float(loss)]
 
     def eval_batch(self, inputs, labels=None):

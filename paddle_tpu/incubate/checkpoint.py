@@ -2,14 +2,19 @@
 python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py — decorated
 train loops snapshot program+epoch state keyed by a run hash).
 
-TPU-native: epoch-granular snapshots through io.checkpoint (orbax-style
-sharded save) into $PADDLE_CHECKPOINT_DIR; `train_epoch_range` resumes from
-the newest complete snapshot after preemption."""
+TPU-native + crash-safe: each epoch snapshot is ONE durable checkpoint
+committed through ``reliability.CheckpointStore`` (per-object
+checksums, fsync, atomic rename, interrupted-swap recovery, retention).
+There is no separate ``meta.json`` that could tear against the payload:
+the resume epoch IS the store's newest snapshot that passes
+verification, so a kill at ANY instant — mid-write, between write and
+rename, between save and the next epoch — neither re-runs a completed
+epoch nor skips an unfinished one. ``train_epoch_range`` resumes from
+the newest complete snapshot after preemption.
+"""
 from __future__ import annotations
 
-import json
 import os
-import shutil
 
 
 def _ckpt_root():
@@ -17,53 +22,58 @@ def _ckpt_root():
 
 
 class TrainEpochRange:
-    """Iterate epochs with save/restore (reference TrainEpochRange)."""
+    """Iterate epochs with save/restore (reference TrainEpochRange).
+
+    Backed by a ``CheckpointStore`` keyed by epoch number with
+    ``max_to_keep=1`` — the store owns validity scanning, retention,
+    and crash recovery; this class only maps the epoch-loop protocol
+    onto it."""
 
     def __init__(self, max_epoch_num, name, save_checkpoint_inter=1,
-                 checkpoint_dir=None):
+                 checkpoint_dir=None, fault_injector=None):
+        from ..reliability.ckpt import CheckpointStore
         self.name = name
         self.max_epoch_num = max_epoch_num
         self.save_inter = save_checkpoint_inter
         self.dir = os.path.join(checkpoint_dir or _ckpt_root(), name)
-        os.makedirs(self.dir, exist_ok=True)
-        self._state = {"epoch": -1}
+        self.store = CheckpointStore(self.dir, max_to_keep=1,
+                                     injector=fault_injector)
         self._objs = {}
-        meta = os.path.join(self.dir, "meta.json")
-        if os.path.exists(meta):
-            with open(meta) as f:
-                self._state = json.load(f)
+        self._restored_state = None      # lazy-loaded snapshot payload
+        latest = self.store.latest_valid_step()
+        self._epoch = -1 if latest is None else latest
+        if latest is None:
+            from ..reliability.ckpt import warn_if_foreign_dir
+            warn_if_foreign_dir(self.dir, f"TrainEpochRange({self.name!r})",
+                                "resuming from epoch 0.", stacklevel=3)
 
     def restored_from(self):
-        return self._state["epoch"]
+        return self._epoch
 
     def add(self, name, obj):
-        """Register a state_dict-capable object (model/optimizer)."""
+        """Register a state_dict-capable object (model/optimizer); its
+        state is restored from the resume snapshot when one exists."""
         self._objs[name] = obj
-        epoch = self._state["epoch"]
-        if epoch >= 0:
-            path = os.path.join(self.dir, f"e{epoch}", f"{name}.pdparams")
-            if os.path.exists(path):
-                from ..io.save_load import load
-                obj.set_state_dict(load(path))
+        if self._epoch >= 0:
+            if self._restored_state is None:
+                self._restored_state, _, _ = self.store.restore(
+                    step=self._epoch)
+            if name in self._restored_state:
+                obj.set_state_dict(self._restored_state[name])
         return self
 
     def save(self, epoch):
-        from ..io.save_load import save
-        edir = os.path.join(self.dir, f"e{epoch}")
-        os.makedirs(edir, exist_ok=True)
-        for name, obj in self._objs.items():
-            save(obj.state_dict(), os.path.join(edir, f"{name}.pdparams"))
-        self._state["epoch"] = epoch
-        with open(os.path.join(self.dir, "meta.json"), "w") as f:
-            json.dump(self._state, f)
-        # keep only the newest complete snapshot (reference keeps max_num)
-        for d in os.listdir(self.dir):
-            if d.startswith("e") and d != f"e{epoch}":
-                shutil.rmtree(os.path.join(self.dir, d),
-                              ignore_errors=True)
+        """Durably commit epoch ``epoch``'s snapshot; only a COMMITTED
+        snapshot advances the resume point — an injected/real crash
+        anywhere inside leaves the previous epoch authoritative.
+        Retention (``max_to_keep=1``) drops the older snapshot only
+        after the new one is durable."""
+        state = {name: obj.state_dict() for name, obj in self._objs.items()}
+        self.store.save(epoch, state, {"epoch": epoch})
+        self._epoch = epoch
 
     def __iter__(self):
-        start = self._state["epoch"] + 1
+        start = self._epoch + 1
         for epoch in range(start, self.max_epoch_num):
             yield epoch
             if (epoch + 1) % self.save_inter == 0:

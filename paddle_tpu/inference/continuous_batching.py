@@ -717,7 +717,7 @@ class ContinuousBatchingServer:
             except Exception as e:
                 errors.append((rid, e))
         if errors:
-            raise CallbackError(errors)
+            raise CallbackError(errors, what="on_token callback")
 
     def _step_locked(self):
         self._expire_locked()
@@ -1013,7 +1013,9 @@ class ContinuousBatchingServer:
                         with self._lock:
                             for rid, err in ce.errors:
                                 self._fail_request_locked(
-                                    rid, CallbackError([(rid, err)]))
+                                    rid, CallbackError(
+                                        [(rid, err)],
+                                        what="on_token callback"))
                         sup.success()
                         self._recover_health()
                     except Exception as e:

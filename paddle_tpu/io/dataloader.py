@@ -453,6 +453,29 @@ class DataLoader:
     def _fetch(self, indices):
         return self.collate_fn([self.dataset[i] for i in indices])
 
+    def resume_iter(self, skip):
+        """Batches starting at batch index ``skip`` — mid-epoch exact
+        resume. Single-process map-style loaders skip by consuming only
+        the sampler's index lists (no ``__getitem__``/collate for the
+        already-trained prefix, so resume cost is independent of the
+        position in the epoch); iterable datasets and multiprocess
+        loaders fall back to fetch-and-discard."""
+        if skip <= 0:
+            yield from self
+            return
+        if isinstance(self.dataset, IterableDataset) or self.num_workers > 0:
+            it = iter(self)
+            for _ in range(skip):
+                try:
+                    next(it)
+                except StopIteration:
+                    return
+            yield from it
+            return
+        for i, indices in enumerate(self.batch_sampler):
+            if i >= skip:
+                yield self._fetch(indices)
+
     # ---------------------------------------------------- worker control
     def _start_workers(self):
         import os as os_mod

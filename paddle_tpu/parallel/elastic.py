@@ -99,7 +99,9 @@ class ElasticManager:
     def _watch_loop(self):
         known = set(self.healthy_nodes())
         while not self._stop.is_set():
-            time.sleep(self.interval)
+            # interruptible wait: close() must not block a full interval
+            if self._stop.wait(self.interval):
+                return
             cur = set(self.healthy_nodes())
             if cur != known:
                 event = ("scale_out" if len(cur) > len(known)
@@ -173,8 +175,24 @@ class ElasticManager:
         env["PADDLE_TRAINER_ID"] = str(rank)
         return env
 
-    def exit(self, completed=True):
+    def close(self, timeout=2.0):
+        """Stop and JOIN the heartbeat/watch threads. They are daemon
+        threads (a finished run can't hang interpreter shutdown), but a
+        test/run that owns the manager should close it so no loop keeps
+        touching the store after teardown. Idempotent."""
         self._stop.set()
-        if self._hb_thread:
-            self._hb_thread.join(timeout=2)
+        for t in (self._hb_thread, self._watch_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=timeout)
+        self._hb_thread = None
+        self._watch_thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def exit(self, completed=True):
+        self.close()
         return ElasticStatus.COMPLETED if completed else ElasticStatus.ERROR

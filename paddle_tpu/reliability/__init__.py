@@ -1,8 +1,10 @@
-"""paddle_tpu.reliability — serving reliability layer.
+"""paddle_tpu.reliability — serving AND training reliability layer.
 
 What keeps the serving stack (paddle_tpu/inference/) upright under
-heavy, hostile traffic: typed failure contracts, bounded waiting,
-supervised retries, health reporting, and deterministic chaos testing.
+heavy, hostile traffic — and multi-hour training runs alive through
+crashes, preemptions, and NaN storms: typed failure contracts, bounded
+waiting, supervised retries, health reporting, crash-safe checkpoints,
+and deterministic chaos testing.
 
 - errors.py: the ``ReliabilityError`` family — ``DeadlineExceeded``,
   ``QueueFullError``, ``CircuitOpenError``, ... ``wait()`` raises these
@@ -16,26 +18,48 @@ supervised retries, health reporting, and deterministic chaos testing.
   dead``, published as the ``server_health`` gauge and ``/healthz``.
 - faults.py: ``FaultInjector`` — named failure points with seeded
   per-point PRNG streams; chaos runs reproduce exactly.
+- ckpt.py: durable checkpoints — per-leaf checksummed manifest, fsync
+  + atomic rename, ``CheckpointStore`` newest-VALID restore fallback,
+  ``AsyncCheckpointer`` background saves with an overlap barrier.
+- training.py: ``TrainSupervisor`` — exact resume from the last durable
+  checkpoint, NaN/Inf anomaly skip/rollback, SIGTERM-to-clean-exit,
+  per-step retry/backoff, plus the ``ResumableLoader`` data cursor.
 
 Everything here is host-side, dependency-free (stdlib + the telemetry
 clock protocol), and deterministic under test.
 """
-from .errors import (CallbackError, CircuitOpenError,  # noqa: F401
-                     DeadlineExceeded, InjectedFault, QueueFullError,
-                     ReliabilityError, RequestCancelled, SchedulerClosed,
-                     ServerClosed)
-from .faults import (DECODE_TICK, FaultInjector, ON_TOKEN,  # noqa: F401
-                     PAGE_ALLOC, PREFILL)
+from .errors import (CallbackError, CheckpointCorruptError,  # noqa: F401
+                     CircuitOpenError, DeadlineExceeded, InjectedFault,
+                     QueueFullError, ReliabilityError, RequestCancelled,
+                     SchedulerClosed, ServerClosed, StepFailedError,
+                     TrainAnomalyError)
+from .faults import (CKPT_RENAME, CKPT_SWAP, CKPT_WRITE,  # noqa: F401
+                     DATA_NEXT, DECODE_TICK, FaultInjector, ON_TOKEN,
+                     PAGE_ALLOC, PREFILL, TRAIN_STEP)
 from .health import (DEAD, DEGRADED, DRAINING, HEALTH_CODES,  # noqa: F401
                      HEALTHY, HealthMonitor, is_serving_state)
 from .retry import CircuitBreaker, RetryPolicy  # noqa: F401
 from .supervisor import ServeSupervisor  # noqa: F401
+from .ckpt import (AsyncCheckpointer, CheckpointStore,  # noqa: F401
+                   checkpoint_meta, read_checkpoint,
+                   recover_interrupted_swaps, verify_checkpoint,
+                   write_checkpoint)
+from .training import (AnomalyPolicy, ResumableLoader,  # noqa: F401
+                       TrainReport, TrainSupervisor)
 
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
            "CircuitOpenError", "InjectedFault", "CallbackError",
+           "CheckpointCorruptError", "TrainAnomalyError",
+           "StepFailedError",
            "RetryPolicy", "CircuitBreaker", "ServeSupervisor",
            "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "DEAD",
            "HEALTH_CODES", "is_serving_state",
            "FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
-           "ON_TOKEN"]
+           "ON_TOKEN", "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
+           "TRAIN_STEP", "DATA_NEXT",
+           "write_checkpoint", "read_checkpoint", "verify_checkpoint",
+           "checkpoint_meta", "recover_interrupted_swaps",
+           "CheckpointStore", "AsyncCheckpointer",
+           "TrainSupervisor", "AnomalyPolicy", "TrainReport",
+           "ResumableLoader"]

@@ -10,7 +10,8 @@ these DIRECTLY (no RuntimeError wrapping) — a client distinguishing
 
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
-           "CircuitOpenError", "InjectedFault", "CallbackError"]
+           "CircuitOpenError", "InjectedFault", "CallbackError",
+           "CheckpointCorruptError", "TrainAnomalyError", "StepFailedError"]
 
 
 class ReliabilityError(RuntimeError):
@@ -64,19 +65,52 @@ class InjectedFault(ReliabilityError):
         super().__init__(f"injected fault at {msg}")
 
 
+class CheckpointCorruptError(ReliabilityError):
+    """A checkpoint directory failed integrity verification: missing
+    manifest, missing leaf file, byte-count mismatch, or a per-leaf
+    checksum that does not match the manifest. ``restore()`` raises this
+    for an explicit step; latest-checkpoint restore SKIPS corrupt
+    directories and falls back to the newest checkpoint that verifies."""
+
+    def __init__(self, path, reason=""):
+        self.path = str(path)
+        self.reason = reason
+        msg = self.path if not reason else f"{self.path}: {reason}"
+        super().__init__(f"corrupt checkpoint at {msg}")
+
+
+class TrainAnomalyError(ReliabilityError):
+    """The supervised train loop gave up on anomalies: K consecutive
+    non-finite losses/grads persisted through ``max_rollbacks``
+    rollbacks to the last good checkpoint. ``kind`` is the last anomaly
+    kind observed (``nonfinite_loss`` / ``nonfinite_grad``)."""
+
+    def __init__(self, msg, kind="nonfinite_loss", step=None):
+        self.kind = kind
+        self.step = step
+        super().__init__(msg)
+
+
+class StepFailedError(ReliabilityError):
+    """A train step (or data fetch) kept failing after the supervisor's
+    retry budget was exhausted (or its circuit breaker opened).
+    ``__cause__`` is the last underlying error."""
+
+
 class CallbackError(ReliabilityError):
-    """One or more ``on_token`` streaming callbacks raised during a
-    callback sweep. EVERY queued callback still fires (one poisoned
-    stream must not starve the others); this carries the per-request
-    errors so the supervisor can fail exactly the offending requests.
+    """One or more callbacks raised during a fire-them-all sweep
+    (serving ``on_token`` streams, hapi ``CallbackList`` events). EVERY
+    queued callback still fires (one poisoned callback must not starve
+    the others); this carries the per-callback errors so the caller can
+    fail exactly the offending parties.
 
     ``rid``/``__cause__`` are the first failure; ``errors`` is the full
-    ``[(rid, exception), ...]`` list in firing order."""
+    ``[(rid_or_name, exception), ...]`` list in firing order."""
 
-    def __init__(self, errors):
+    def __init__(self, errors, what="callback"):
         self.errors = list(errors)
         self.rid, first = self.errors[0]
         super().__init__(
-            f"{len(self.errors)} on_token callback(s) raised; first: "
-            f"request {self.rid}: {first!r}")
+            f"{len(self.errors)} {what}(s) raised; first: "
+            f"{self.rid}: {first!r}")
         self.__cause__ = first

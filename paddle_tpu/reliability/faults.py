@@ -27,13 +27,23 @@ import threading
 from .errors import InjectedFault
 
 __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
-           "ON_TOKEN"]
+           "ON_TOKEN", "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
+           "TRAIN_STEP", "DATA_NEXT"]
 
 # failure points wired into the serving stack (callers may add their own)
 PREFILL = "server.prefill"          # _admit_one: admission prefill
 DECODE_TICK = "server.decode_tick"  # _step_locked: batched decode dispatch
 PAGE_ALLOC = "kv.alloc"             # PagedKVCache.alloc
 ON_TOKEN = "server.on_token"        # streamed-token callback delivery
+
+# failure points wired into the training / checkpoint stack
+CKPT_WRITE = "ckpt.write"           # durable save: per-file payload write
+CKPT_RENAME = "ckpt.rename"         # durable save: the atomic commit rename
+CKPT_SWAP = "ckpt.swap"             # overwrite save: between the two
+#                                     swap renames (old parked, new not
+#                                     yet live — the recovery window)
+TRAIN_STEP = "train.step"           # supervised loop: one train step
+DATA_NEXT = "data.next"             # supervised loop: next-batch fetch
 
 
 class _Rule:

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Lint: no bare ``except:`` clauses inside paddle_tpu/.
+
+A bare except swallows KeyboardInterrupt/SystemExit and — worse for a
+reliability layer — erases the TYPE of the failure, which is the whole
+contract (clients branch on ``ReliabilityError`` subclasses; the chaos
+suites assert on them). ``except Exception`` is the floor.
+
+Usage: python scripts/check_no_bare_except.py [root]
+Exit status 1 lists every offending file:line. Wired into the test
+suite (tests/test_train_reliability.py) so a regression fails tier-1.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+
+def bare_excepts(root):
+    """[(path, lineno), ...] of bare ``except:`` handlers under root."""
+    hits = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "rb") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as e:
+                hits.append((path, e.lineno or 0))
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ExceptHandler) and node.type is None:
+                    hits.append((path, node.lineno))
+    return hits
+
+
+def main(argv):
+    root = argv[1] if len(argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "paddle_tpu")
+    hits = bare_excepts(root)
+    for path, line in hits:
+        print(f"{path}:{line}: bare 'except:' — name the exception type "
+              "(at least 'except Exception')")
+    if hits:
+        return 1
+    print(f"OK: no bare excepts under {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -227,3 +227,28 @@ def test_native_ring_object_heavy_batch_reports_instead_of_dying():
     with pytest.raises(RuntimeError, match="ring slot"):
         list(dl)
     dl._shutdown_workers()
+
+
+def test_resume_iter_skips_without_fetching():
+    """Mid-epoch resume support: the skipped prefix must consume only
+    the sampler's index lists — zero __getitem__/collate work — so
+    resume cost is independent of the position in the epoch."""
+    seen = []
+
+    class ProbeDataset(ArrayDataset):
+        def __getitem__(self, i):
+            seen.append(i)
+            return super().__getitem__(i)
+
+    dl = DataLoader(ProbeDataset(n=32), batch_size=4, shuffle=False,
+                    num_workers=0)
+    full = [b for b in dl]
+    seen.clear()
+    resumed = list(dl.resume_iter(5))
+    assert len(resumed) == 3
+    for got, want in zip(resumed, full[5:]):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert min(seen) == 20                  # nothing before batch 5 fetched
+    # skip=0 and skip-past-the-end degenerate cleanly
+    assert len(list(dl.resume_iter(0))) == 8
+    assert list(dl.resume_iter(99)) == []

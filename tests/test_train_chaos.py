@@ -1,0 +1,235 @@
+"""Training chaos suite: kill the run at EVERY injected point and
+prove the fault-tolerance contract (extends test_chaos.py's serving
+patterns to training):
+
+- a save killed mid-write / at the commit rename NEVER yields a loadable
+  half-checkpoint: restore always lands on a checksum-valid checkpoint;
+- a run resumed after any such kill bit-matches the uninterrupted
+  same-seed run's per-step losses (the acceptance criterion);
+- same seed => identical injection trace AND identical training
+  trajectory;
+- transient step/data faults are retried invisibly — the loss
+  trajectory is unchanged.
+
+Everything is numpy-step or tiny-Linear based with zero-delay retry
+policies — no sleeps, tier-1 fast."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.reliability import (FaultInjector, ReliabilityError,
+                                    ResumableLoader, RetryPolicy,
+                                    TrainSupervisor, faults,
+                                    verify_checkpoint)
+
+pytestmark = pytest.mark.chaos
+
+MAX_STEPS = 8
+SAVE_EVERY = 2
+
+
+def _loader():
+    return ResumableLoader(list(np.arange(10, dtype=np.float64)),
+                           batch_size=3, shuffle=True, seed=5)
+
+
+def _step(s, b):
+    m = float(np.mean(b))
+    return s * 0.9 + 0.01 * m, s * 0.95 + 0.01 * m
+
+
+def _sup(d, injector=None):
+    return TrainSupervisor(d, save_interval_steps=SAVE_EVERY,
+                           injector=injector,
+                           retry=RetryPolicy(base_delay_s=0.0, jitter=0.0),
+                           max_step_retries=100)
+
+
+def _baseline(tmp_path):
+    rep = _sup(str(tmp_path / "baseline")).run(_step, 1.0, _loader(),
+                                               max_steps=MAX_STEPS)
+    assert rep.status == "completed"
+    return dict(rep.losses), rep.final_state
+
+
+def _count_visits(tmp_path, point):
+    """Visits to ``point`` in one clean run (defines the kill sweep)."""
+    fi = FaultInjector(seed=0, enabled=False).on(point, probability=0.0)
+    _sup(str(tmp_path / "probe"), injector=fi).run(
+        _step, 1.0, _loader(), max_steps=MAX_STEPS)
+    return fi.visits(point)
+
+
+class TestKillAtEveryInjectedPoint:
+    """THE acceptance test: for every single visit to ckpt.write and
+    ckpt.rename in the training run, kill the process there; restore
+    must land on a checksum-valid checkpoint and the resumed run's
+    losses must bit-match the uninterrupted run."""
+
+    @pytest.mark.parametrize("point", [faults.CKPT_WRITE,
+                                       faults.CKPT_RENAME,
+                                       faults.CKPT_SWAP])
+    def test_kill_sweep_restores_valid_and_bit_matches(self, tmp_path,
+                                                       point):
+        truth, final_truth = _baseline(tmp_path)
+        n = _count_visits(tmp_path, point)
+        # ckpt.swap only fires on overwrite saves (the final force-save
+        # re-commits the interval-saved step) — fewer visits by design
+        floor = 1 if point == faults.CKPT_SWAP else 4
+        assert n >= floor, f"too few {point} visits to sweep meaningfully"
+        for kill_at in range(n):
+            d = str(tmp_path / f"kill_{point.replace('.', '_')}_{kill_at}")
+            fi = FaultInjector(seed=0).on(point, schedule=[kill_at])
+            with pytest.raises(ReliabilityError):
+                _sup(d, injector=fi).run(_step, 1.0, _loader(),
+                                         max_steps=MAX_STEPS)
+            # whatever survived on disk, the newest VALID checkpoint
+            # loads cleanly (verify re-hashes every file); a kill
+            # during the FIRST save legitimately leaves nothing — but
+            # then nothing half-written is visible either
+            sup2 = _sup(d)
+            state, meta, got = sup2.store.restore()
+            if got is None:
+                assert sup2.store.all_steps() == [], \
+                    f"kill at {point}#{kill_at}: torn dir became visible"
+            else:
+                verify_checkpoint(sup2.store.step_path(got))
+            # exact resume: every committed step bit-matches the truth
+            rep = sup2.run(_step, 1.0, _loader(), max_steps=MAX_STEPS)
+            assert rep.status == "completed"
+            for s, loss in rep.losses:
+                assert truth[s] == loss, \
+                    f"kill at {point}#{kill_at}: step {s} diverged"
+            assert rep.final_state == final_truth, \
+                f"kill at {point}#{kill_at}: final state diverged"
+
+    def test_kill_rate_storm_still_converges(self, tmp_path):
+        """Random kills at 30% per checkpoint write: keep resuming
+        until done; the final state still bit-matches."""
+        truth, final_truth = _baseline(tmp_path)
+        d = str(tmp_path / "storm")
+        seed = 77
+        for attempt in range(50):
+            fi = FaultInjector(seed=seed + attempt).on(
+                faults.CKPT_WRITE, probability=0.3)
+            try:
+                rep = _sup(d, injector=fi).run(_step, 1.0, _loader(),
+                                               max_steps=MAX_STEPS)
+            except ReliabilityError:
+                continue                          # died again; resume
+            assert rep.status == "completed"
+            break
+        else:
+            pytest.fail("storm never let the run finish")
+        assert rep.final_state == final_truth
+        for s, loss in rep.losses:
+            assert truth[s] == loss
+
+
+class TestChaosDeterminism:
+    def test_same_seed_identical_trace_and_trajectory(self, tmp_path):
+        """Satellite acceptance: same seed => identical injection trace
+        and identical training results."""
+        def run_once(tag):
+            fi = (FaultInjector(seed=4242)
+                  .on(faults.TRAIN_STEP, probability=0.25)
+                  .on(faults.DATA_NEXT, probability=0.15))
+            rep = _sup(str(tmp_path / tag), injector=fi).run(
+                _step, 1.0, _loader(), max_steps=MAX_STEPS)
+            return list(fi.trace), rep.losses, rep.saved_steps, \
+                rep.retries
+
+        a, b = run_once("a"), run_once("b")
+        assert a == b
+        assert a[0], "deterministic chaos run injected nothing"
+
+    def test_injector_reset_replays_training_script(self, tmp_path):
+        fi = FaultInjector(seed=9).on(faults.TRAIN_STEP, probability=0.3)
+
+        def run(tag):
+            rep = _sup(str(tmp_path / tag), injector=fi).run(
+                _step, 1.0, _loader(), max_steps=MAX_STEPS)
+            return list(fi.trace), rep.losses
+
+        first = run("a")
+        fi.reset()
+        assert run("b") == first
+
+    def test_transient_faults_do_not_perturb_trajectory(self, tmp_path):
+        truth, final_truth = _baseline(tmp_path)
+        fi = (FaultInjector(seed=31)
+              .on(faults.TRAIN_STEP, probability=0.3)
+              .on(faults.DATA_NEXT, probability=0.2))
+        rep = _sup(str(tmp_path / "chaos"), injector=fi).run(
+            _step, 1.0, _loader(), max_steps=MAX_STEPS)
+        assert rep.retries > 0, "chaos never fired; raise rates"
+        assert dict(rep.losses) == truth
+        assert rep.final_state == final_truth
+
+
+class TestFitChaos:
+    """Chaos through the hapi path: a compiled guarded step under
+    injected faults and checkpoint kills."""
+
+    def _model(self):
+        pt.seed(7)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()),
+            loss=nn.BCEWithLogitsLoss())
+        return m
+
+    def _dataset(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+        return TensorDataset([x, y])
+
+    class _Rec:
+        def __init__(self):
+            self.losses = []
+
+        def set_model(self, m):
+            pass
+
+        def __getattr__(self, name):
+            if name.startswith("on_"):
+                return lambda *a, **k: None
+            raise AttributeError(name)
+
+        def on_train_batch_end(self, step, logs=None):
+            self.losses.append(logs["loss"])
+
+    def _fit(self, d, injector=None, rec=None):
+        sup = TrainSupervisor(d, save_interval_steps=3, injector=injector,
+                              retry=RetryPolicy(base_delay_s=0.0,
+                                                jitter=0.0),
+                              max_step_retries=100)
+        rec = rec if rec is not None else self._Rec()
+        self._model().fit(self._dataset(), batch_size=8, epochs=2,
+                          verbose=0, callbacks=[rec], supervisor=sup)
+        return rec.losses
+
+    def test_step_fault_storm_trajectory_unchanged(self, tmp_path):
+        clean = self._fit(str(tmp_path / "clean"))
+        fi = FaultInjector(seed=13).on(faults.TRAIN_STEP, probability=0.25)
+        chaotic = self._fit(str(tmp_path / "chaos"), injector=fi)
+        assert fi.fired() > 0, "chaos never fired; raise rates"
+        assert chaotic == clean
+
+    def test_ckpt_kill_mid_fit_resumes_bit_exact(self, tmp_path):
+        clean = self._fit(str(tmp_path / "clean"))
+        d = str(tmp_path / "killed")
+        # die at the 2nd checkpoint's commit rename
+        fi = FaultInjector(seed=0).on(faults.CKPT_RENAME, schedule=[1])
+        rec1 = self._Rec()
+        with pytest.raises(ReliabilityError):
+            self._fit(d, injector=fi, rec=rec1)
+        rec2 = self._Rec()
+        self._fit(d, rec=rec2)
+        # the resumed tail bit-matches; nothing was lost or doubled
+        assert rec2.losses == clean[len(clean) - len(rec2.losses):]
+        assert rec1.losses == clean[:len(rec1.losses)]

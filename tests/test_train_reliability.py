@@ -1,0 +1,753 @@
+"""TrainSupervisor + supervised hapi fit: exact resume, anomaly
+policy, retries, preemption — plus the PR's satellites (CallbackList
+fire-all contract, ElasticManager.close, TrainEpochRange atomic save,
+bare-except lint)."""
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.reliability import (AnomalyPolicy, FaultInjector,
+                                    ResumableLoader, RetryPolicy,
+                                    CircuitBreaker, StepFailedError,
+                                    TrainAnomalyError, TrainSupervisor,
+                                    faults)
+from paddle_tpu.telemetry import FakeClock, MetricRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------- tiny pure model
+def _data(n=10):
+    return list(np.arange(n, dtype=np.float64))
+
+
+def _loader(seed=5, batch_size=3, shuffle=True):
+    return ResumableLoader(_data(), batch_size=batch_size, shuffle=shuffle,
+                           seed=seed)
+
+
+def _step(s, b):
+    m = float(np.mean(b))
+    return s * 0.9 + 0.01 * m, s * 0.95 + 0.01 * m
+
+
+def _zero_retry(**kw):
+    return RetryPolicy(base_delay_s=0.0, jitter=0.0, **kw)
+
+
+class TestResumableLoader:
+    def test_order_is_pure_function_of_seed_and_epoch(self):
+        a, b = _loader(), _loader()
+        for _ in range(9):                 # crosses an epoch boundary
+            np.testing.assert_array_equal(a.next_batch(), b.next_batch())
+
+    def test_cursor_resume_is_exact(self):
+        a = _loader()
+        seen = [a.next_batch() for _ in range(5)]
+        sd = a.state_dict()
+        rest_a = [a.next_batch() for _ in range(5)]
+        b = _loader()
+        b.set_state_dict(sd)
+        rest_b = [b.next_batch() for _ in range(5)]
+        for x, y in zip(rest_a, rest_b):
+            np.testing.assert_array_equal(x, y)
+        assert len(seen) == 5
+
+    def test_drop_last_and_epoch_wrap(self):
+        dl = ResumableLoader(_data(10), batch_size=4, drop_last=True)
+        assert len(dl) == 2
+        sizes = [len(dl.next_batch()) for _ in range(5)]
+        assert sizes == [4] * 5            # partial tail batch dropped
+        assert dl.epoch >= 2
+
+    def test_set_state_dict_adopts_saved_seed(self):
+        """Resuming onto a loader rebuilt with a DIFFERENT seed must
+        replay the run's original batch stream, not the new seed's."""
+        a = _loader(seed=7)
+        for _ in range(2):
+            a.next_batch()
+        sd = a.state_dict()
+        b = _loader(seed=0)                  # wrong seed at rebuild
+        b.set_state_dict(sd)
+        assert b.seed == 7
+        for _ in range(4):
+            np.testing.assert_array_equal(a.next_batch(), b.next_batch())
+
+    def test_shuffle_epochs_differ(self):
+        dl = _loader(batch_size=10)
+        e0 = dl.next_batch()
+        e1 = dl.next_batch()
+        assert not np.array_equal(e0, e1)
+
+    def test_drop_last_smaller_than_batch_refused(self):
+        """Regression: this combination used to spin forever in
+        next_batch (every epoch dropped its only, short batch)."""
+        with pytest.raises(ValueError, match="drop_last"):
+            ResumableLoader(_data(3), batch_size=8, drop_last=True)
+
+
+class TestSupervisorLoop:
+    def test_exact_resume_bit_matches_uninterrupted(self, tmp_path):
+        full = TrainSupervisor(str(tmp_path / "a"), save_interval_steps=4) \
+            .run(_step, 1.0, _loader(), max_steps=11).losses
+        d = str(tmp_path / "b")
+        r1 = TrainSupervisor(d, save_interval_steps=4).run(
+            _step, 1.0, _loader(), max_steps=5)
+        r2 = TrainSupervisor(d, save_interval_steps=4).run(
+            _step, 1.0, _loader(), max_steps=11)
+        assert r2.resumed_from == 5
+        assert r1.losses + r2.losses == full
+
+    def test_transient_faults_retried_without_perturbing_losses(
+            self, tmp_path):
+        full = TrainSupervisor(str(tmp_path / "a"), save_interval_steps=4) \
+            .run(_step, 1.0, _loader(), max_steps=11).losses
+        fi = (FaultInjector(seed=3)
+              .on(faults.TRAIN_STEP, probability=0.3)
+              .on(faults.DATA_NEXT, probability=0.2))
+        sup = TrainSupervisor(str(tmp_path / "b"), save_interval_steps=4,
+                              injector=fi, retry=_zero_retry(),
+                              max_step_retries=50)
+        rep = sup.run(_step, 1.0, _loader(), max_steps=11)
+        assert rep.retries > 0
+        assert rep.losses == full           # retries are invisible
+
+    def test_retry_budget_exhaustion_is_typed(self, tmp_path):
+        fi = FaultInjector(seed=0).on(faults.TRAIN_STEP, probability=1.0)
+        sup = TrainSupervisor(str(tmp_path), injector=fi,
+                              retry=_zero_retry(), max_step_retries=3)
+        with pytest.raises(StepFailedError):
+            sup.run(_step, 1.0, _loader(), max_steps=2)
+
+    def test_open_breaker_gates_next_attempt(self, tmp_path):
+        """An already-open breaker (e.g. shared with another loop)
+        short-circuits run_with_retries during its cooldown window;
+        after the cooldown the half-open probe attempt runs."""
+        clk = FakeClock()
+        cb = CircuitBreaker(failure_threshold=1, reset_after_s=60,
+                            clock=clk)
+        cb.record_failure()                     # pre-opened
+        sup = TrainSupervisor(str(tmp_path), breaker=cb)
+        with pytest.raises(StepFailedError, match="open"):
+            sup.run_with_retries(lambda: 1, faults.TRAIN_STEP)
+        clk.advance(61)
+        assert sup.run_with_retries(lambda: 1, faults.TRAIN_STEP) == 1
+        assert cb.state == cb.CLOSED            # probe success closed it
+
+    def test_breaker_open_aborts_typed(self, tmp_path):
+        fi = FaultInjector(seed=0).on(faults.TRAIN_STEP, probability=1.0)
+        sup = TrainSupervisor(
+            str(tmp_path), injector=fi, retry=_zero_retry(),
+            max_step_retries=100,
+            breaker=CircuitBreaker(failure_threshold=4, clock=FakeClock()))
+        with pytest.raises(StepFailedError, match="breaker"):
+            sup.run(_step, 1.0, _loader(), max_steps=2)
+
+    def test_anomaly_skip_then_rollback_then_recover(self, tmp_path):
+        calls = {"n": 0}
+
+        def poison(s, b):
+            calls["n"] += 1
+            if 6 <= calls["n"] <= 8:       # one burst of 3 NaN steps
+                return float("nan"), s
+            return _step(s, b)
+
+        reg = MetricRegistry()
+        sup = TrainSupervisor(
+            str(tmp_path), save_interval_steps=2, registry=reg,
+            anomaly=AnomalyPolicy(max_consecutive=3, max_rollbacks=1))
+        rep = sup.run(poison, 1.0, _loader(), max_steps=8)
+        assert rep.status == "completed"
+        assert rep.anomalies == 3 and rep.rollbacks == 1
+        c = reg.counter("train_anomaly_total", "", labelnames=("kind",))
+        assert c.labels(kind="nonfinite_loss").value == 3
+        assert reg.counter("train_rollback_total", "").value == 1
+
+    def test_persistent_anomaly_aborts_typed(self, tmp_path):
+        sup = TrainSupervisor(
+            str(tmp_path), save_interval_steps=1,
+            anomaly=AnomalyPolicy(max_consecutive=2, max_rollbacks=1))
+        with pytest.raises(TrainAnomalyError) as ei:
+            sup.run(lambda s, b: (float("nan"), s), 1.0, _loader(),
+                    max_steps=4)
+        assert ei.value.kind == "nonfinite_loss"
+
+    def test_anomaly_before_any_checkpoint_aborts(self, tmp_path):
+        sup = TrainSupervisor(
+            str(tmp_path), save_interval_steps=100,
+            anomaly=AnomalyPolicy(max_consecutive=1, max_rollbacks=5))
+        with pytest.raises(TrainAnomalyError, match="nothing to roll"):
+            sup.run(lambda s, b: (float("inf"), s), 1.0, _loader(),
+                    max_steps=4)
+
+    def test_request_preemption_checkpoints_and_exits_clean(self,
+                                                            tmp_path):
+        d = str(tmp_path)
+        sup = TrainSupervisor(d, save_interval_steps=100)
+        n = {"v": 0}
+
+        def step(s, b):
+            n["v"] += 1
+            if n["v"] == 3:
+                sup.request_preemption()
+            return _step(s, b)
+
+        rep = sup.run(step, 1.0, _loader(), max_steps=11)
+        assert rep.status == "preempted" and rep.steps_done == 3
+        assert sup.preempts_total == 1
+        full = TrainSupervisor(str(tmp_path / "x"),
+                               save_interval_steps=100).run(
+            _step, 1.0, _loader(), max_steps=11).losses
+        rep2 = TrainSupervisor(d, save_interval_steps=100).run(
+            _step, 1.0, _loader(), max_steps=11)
+        assert rep2.resumed_from == 3
+        assert rep.losses + rep2.losses == full
+
+    def test_sigterm_routes_to_preemption(self, tmp_path):
+        if threading.current_thread() is not threading.main_thread():
+            pytest.skip("signal handlers need the main thread")
+        sup = TrainSupervisor(str(tmp_path), save_interval_steps=100)
+        sup.install_signal_handlers()
+        try:
+            n = {"v": 0}
+
+            def step(s, b):
+                n["v"] += 1
+                if n["v"] == 2:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                return _step(s, b)
+
+            rep = sup.run(step, 1.0, _loader(), max_steps=50)
+        finally:
+            sup.uninstall_signal_handlers()
+        assert rep.status == "preempted"
+        assert rep.steps_done < 50
+        # the clean exit left a durable, valid checkpoint
+        assert sup.store.latest_valid_step() == rep.steps_done
+
+    def test_same_supervisor_reinvoked_after_preempt_resumes(self,
+                                                             tmp_path):
+        """Regression: the preempt flag used to stay sticky, so an
+        IN-PROCESS re-invocation of the same supervisor instantly
+        re-preempted at step 0 forever."""
+        d = str(tmp_path)
+        sup = TrainSupervisor(d, save_interval_steps=100)
+        n = {"v": 0}
+
+        def step(s, b):
+            n["v"] += 1
+            if n["v"] == 3:
+                sup.request_preemption()
+            return _step(s, b)
+
+        rep = sup.run(step, 1.0, _loader(), max_steps=11)
+        assert rep.status == "preempted"
+        rep2 = sup.run(_step, 1.0, _loader(), max_steps=11)  # SAME sup
+        assert rep2.status == "completed"
+        assert rep2.resumed_from == 3 and rep2.steps_done == 8
+
+    def test_finite_data_source_completes_with_durable_final(self,
+                                                             tmp_path):
+        """Regression: a data source that raises StopIteration used to
+        escape run() raw, skipping the final save and the report."""
+        class Finite:
+            def __init__(self, n):
+                self.n = n
+
+            def next_batch(self):
+                if self.n == 0:
+                    raise StopIteration
+                self.n -= 1
+                return np.full(3, float(self.n))
+
+        sup = TrainSupervisor(str(tmp_path), save_interval_steps=100)
+        rep = sup.run(_step, 1.0, Finite(4), max_steps=50)
+        assert rep.status == "completed" and rep.steps_done == 4
+        assert sup.store.latest_valid_step() == 4   # final save landed
+
+    def test_async_save_run_resumes(self, tmp_path):
+        d = str(tmp_path)
+        full = TrainSupervisor(str(tmp_path / "x")).run(
+            _step, 1.0, _loader(), max_steps=9).losses
+        TrainSupervisor(d, save_interval_steps=2, async_save=True).run(
+            _step, 1.0, _loader(), max_steps=4)
+        rep = TrainSupervisor(d, save_interval_steps=2,
+                              async_save=True).run(
+            _step, 1.0, _loader(), max_steps=9)
+        assert rep.resumed_from == 4
+        assert full[4:] == rep.losses
+
+    def test_global_rng_state_round_trips(self, tmp_path):
+        """track_global_rng: the core.random stream continues across a
+        kill exactly where it stopped."""
+        def rng_step(s, b):
+            u = float(np.asarray(
+                pt.rand([1]).numpy()))   # consumes the global stream
+            return s + u, s + u
+
+        def run(d, k, fresh_seed):
+            if fresh_seed:
+                pt.seed(123)
+            return TrainSupervisor(d, save_interval_steps=1).run(
+                rng_step, 0.0, _loader(shuffle=False), max_steps=k)
+
+        full = run(str(tmp_path / "a"), 6, True).losses
+        run(str(tmp_path / "b"), 3, True)
+        pt.seed(999)      # clobber: restore must bring the real state back
+        rep = run(str(tmp_path / "b"), 6, False)
+        assert full[3:] == rep.losses
+
+    def test_restore_state_can_leave_global_rng_alone(self, tmp_path):
+        """restore_state(restore_rng=False): fit's model-state-only
+        anomaly rollback keeps moving FORWARD through data — rewinding
+        the global stream there would replay past subkeys."""
+        from paddle_tpu.core import random as _random
+        sup = TrainSupervisor(str(tmp_path), save_interval_steps=1)
+        pt.seed(41)
+        sup.save_state(1, {"w": 1.0}, force=True)
+        pt.rand([1])                       # advance the global stream
+        moved = _random.get_rng_state()
+        _, meta, done = sup.restore_state(restore_rng=False)
+        assert done == 1
+        assert _random.get_rng_state()[1] == moved[1]   # not rewound
+        sup.restore_state()                # default still rewinds
+        assert _random.get_rng_state()[1] != moved[1]
+
+
+class TestSupervisedFit:
+    def _model(self, learning_rate=0.01):
+        pt.seed(7)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        m = pt.Model(net)
+        m.prepare(optimizer=pt.optimizer.Adam(
+            learning_rate=learning_rate, parameters=net.parameters()),
+            loss=nn.BCEWithLogitsLoss())
+        return m
+
+    def _dataset(self, n=48):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+        return TensorDataset([x, y])
+
+    class _Rec:
+        def __init__(self, hook=None):
+            self.losses = []
+            self.hook = hook
+
+        def set_model(self, m):
+            pass
+
+        def __getattr__(self, name):
+            if name.startswith("on_"):
+                return lambda *a, **k: None
+            raise AttributeError(name)
+
+        def on_train_batch_end(self, step, logs=None):
+            self.losses.append(logs["loss"])
+            if self.hook:
+                self.hook(len(self.losses))
+
+    def test_fit_preempt_resume_bit_matches(self, tmp_path):
+        ds = self._dataset()
+        rec_full = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=2, verbose=0,
+                          callbacks=[rec_full],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "a"), save_interval_steps=4))
+        assert len(rec_full.losses) == 12
+        sup = TrainSupervisor(str(tmp_path / "b"), save_interval_steps=4)
+        rec1 = self._Rec(hook=lambda n: n == 5
+                         and sup.request_preemption())
+        self._model().fit(ds, batch_size=8, epochs=2, verbose=0,
+                          callbacks=[rec1], supervisor=sup)
+        assert len(rec1.losses) == 5
+        rec2 = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=2, verbose=0,
+                          callbacks=[rec2],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "b"), save_interval_steps=4))
+        assert rec1.losses + rec2.losses == rec_full.losses
+
+    def test_fit_lr_schedule_live_and_resume_bit_matches(self, tmp_path):
+        """Regression: update_fn's default lr evaluated get_lr() at jit
+        TRACE time, baking the epoch-0 LR as a compile-time constant.
+        Two visible symptoms, both asserted here: the scheduler never
+        took effect in-run (trajectory identical to a constant-LR run),
+        and a killed run re-traced on resume with the restored advanced
+        schedule, diverging from the uninterrupted run. lr is now a
+        traced argument."""
+        def sched_model():
+            return self._model(pt.optimizer.lr.StepDecay(
+                0.05, step_size=1, gamma=0.5))
+
+        ds = self._dataset()                       # 6 batches per epoch
+        rec_full, m_full = self._Rec(), sched_model()
+        m_full.fit(ds, batch_size=8, epochs=3, verbose=0,
+                   callbacks=[rec_full],
+                   supervisor=TrainSupervisor(str(tmp_path / "a"),
+                                              save_interval_steps=4))
+        assert len(rec_full.losses) == 18
+        # schedule takes effect: identical to a constant-LR run through
+        # epoch 0, diverging once the first epoch-end step() halves it
+        rec_const = self._Rec()
+        self._model(0.05).fit(ds, batch_size=8, epochs=2, verbose=0,
+                              callbacks=[rec_const],
+                              supervisor=TrainSupervisor(
+                                  str(tmp_path / "c"),
+                                  save_interval_steps=4))
+        assert rec_const.losses[:6] == rec_full.losses[:6]
+        assert rec_const.losses[6:12] != rec_full.losses[6:12]
+        # kill mid-epoch-1 (8 steps in), resume in a fresh model:
+        # per-step losses must bit-match the uninterrupted run
+        sup = TrainSupervisor(str(tmp_path / "b"), save_interval_steps=4)
+        rec1 = self._Rec(hook=lambda n: n == 8
+                         and sup.request_preemption())
+        sched_model().fit(ds, batch_size=8, epochs=3, verbose=0,
+                          callbacks=[rec1], supervisor=sup)
+        assert len(rec1.losses) == 8
+        rec2, m2 = self._Rec(), sched_model()
+        m2.fit(ds, batch_size=8, epochs=3, verbose=0, callbacks=[rec2],
+               supervisor=TrainSupervisor(str(tmp_path / "b"),
+                                          save_interval_steps=4))
+        assert rec1.losses + rec2.losses == rec_full.losses
+        assert m2._optimizer.get_lr() == m_full._optimizer.get_lr()
+
+    def test_fit_resume_across_epoch_boundary(self, tmp_path):
+        ds = self._dataset()
+        rec_full = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=2, verbose=0,
+                          callbacks=[rec_full],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "a"), save_interval_steps=4))
+        sup = TrainSupervisor(str(tmp_path / "b"), save_interval_steps=4)
+        self._model().fit(ds, batch_size=8, epochs=1, verbose=0,
+                          callbacks=[self._Rec()], supervisor=sup)
+        rec2 = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=2, verbose=0,
+                          callbacks=[rec2],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "b"), save_interval_steps=4))
+        assert rec2.losses == rec_full.losses[6:]   # epoch 0 not re-run
+
+    def test_fit_same_model_and_supervisor_resume_in_process(self,
+                                                             tmp_path):
+        """Re-invoking fit on the SAME model + supervisor after a
+        preemption resumes (stop_training and the preempt flag reset at
+        fit entry) and stays bit-exact."""
+        ds = self._dataset()
+        rec_full = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=2, verbose=0,
+                          callbacks=[rec_full],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "a"), save_interval_steps=4))
+        sup = TrainSupervisor(str(tmp_path / "b"), save_interval_steps=4)
+        m = self._model()
+        rec1 = self._Rec(hook=lambda n: n == 5
+                         and sup.request_preemption())
+        m.fit(ds, batch_size=8, epochs=2, verbose=0, callbacks=[rec1],
+              supervisor=sup)
+        assert m.stop_training
+        rec2 = self._Rec()
+        m.fit(ds, batch_size=8, epochs=2, verbose=0, callbacks=[rec2],
+              supervisor=sup)                     # same model, same sup
+        assert rec1.losses + rec2.losses == rec_full.losses
+
+    def test_fit_num_iters_stop_saves_mid_epoch_cursor(self, tmp_path):
+        """Regression: a num_iters (or early-stopping) break used to
+        stamp the end-of-epoch cursor (epoch+1, 0), silently skipping
+        the epoch's untrained remainder on resume."""
+        ds = self._dataset()                   # 6 batches per epoch
+        rec_full = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=1, verbose=0,
+                          callbacks=[rec_full],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "a"),
+                              save_interval_steps=100))
+        d = str(tmp_path / "b")
+        self._model().fit(ds, batch_size=8, epochs=1, verbose=0,
+                          num_iters=2, callbacks=[self._Rec()],
+                          supervisor=TrainSupervisor(
+                              d, save_interval_steps=100))
+        rec2 = self._Rec()
+        self._model().fit(ds, batch_size=8, epochs=1, verbose=0,
+                          callbacks=[rec2],
+                          supervisor=TrainSupervisor(
+                              d, save_interval_steps=100))
+        # batches 2..5 of epoch 0 run now — nothing skipped, bit-equal
+        assert rec2.losses == rec_full.losses[2:]
+
+    def test_fit_num_iters_does_not_spin_remaining_epochs(self, tmp_path):
+        """Regression: after num_iters the epoch loop used to keep
+        cycling through the remaining epochs, force-saving a cursor of
+        (epoch, 0) each time — advancing the resume point past data
+        that was never trained."""
+        ds = self._dataset()
+        sup = TrainSupervisor(str(tmp_path), save_interval_steps=100)
+        epochs_seen = []
+
+        class EpochRec(self._Rec):
+            def on_epoch_begin(self, epoch, logs=None):
+                epochs_seen.append(epoch)
+
+        self._model().fit(ds, batch_size=8, epochs=50, num_iters=2,
+                          verbose=0, callbacks=[EpochRec()],
+                          supervisor=sup)
+        assert epochs_seen == [0]             # no zombie epochs
+        _, meta, _ = sup.restore_state()
+        assert meta["cursor"] == {"epoch": 0, "batch": 2}
+
+    def test_fit_iterable_dataset_refused(self, tmp_path):
+        """An iterable stream has no index space, so the exact-resume
+        contract cannot hold — supervised fit must refuse loudly, not
+        stamp cursors that lie on resume."""
+        from paddle_tpu.io import IterableDataset
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                yield (np.zeros(4, np.float32), np.zeros(1, np.float32))
+
+        sup = TrainSupervisor(str(tmp_path))
+        with pytest.raises(ValueError, match="map-style"):
+            self._model().fit(Stream(), batch_size=8, verbose=0,
+                              supervisor=sup)
+
+    def test_fit_rollback_before_any_checkpoint_aborts_typed(self,
+                                                             tmp_path):
+        """Parity with TrainSupervisor.run: a rollback decision with an
+        empty store must raise TrainAnomalyError, not silently burn the
+        rollback budget restoring nothing."""
+        x = np.full((16, 4), np.nan, np.float32)   # NaN loss from step 1
+        y = np.zeros((16, 1), np.float32)
+        sup = TrainSupervisor(
+            str(tmp_path), save_interval_steps=1000,
+            anomaly=AnomalyPolicy(max_consecutive=1, max_rollbacks=2))
+        with pytest.raises(TrainAnomalyError, match="nothing to roll"):
+            self._model().fit(TensorDataset([x, y]), batch_size=8,
+                              epochs=1, verbose=0, supervisor=sup)
+
+    def test_fit_real_data_error_propagates_loudly(self, tmp_path):
+        """A non-injected dataset failure must surface, not silently
+        truncate the epoch (a raised-through generator is closed, so a
+        blind retry would read as end-of-data)."""
+        class Bad:
+            def __len__(self):
+                return 24
+
+            def __getitem__(self, i):
+                if i == 13:
+                    raise RuntimeError("disk hiccup")
+                x = np.zeros(4, np.float32)
+                return x, np.zeros(1, np.float32)
+
+        sup = TrainSupervisor(str(tmp_path), save_interval_steps=4)
+        with pytest.raises(RuntimeError, match="disk hiccup"):
+            self._model().fit(Bad(), batch_size=8, epochs=1, shuffle=False,
+                              verbose=0, callbacks=[self._Rec()],
+                              supervisor=sup)
+
+    def test_guarded_step_rebuilds_when_check_grads_changes(self):
+        m = self._model()
+        m._build_guarded_step(check_grads=True)
+        first = m._gstep_fn
+        m._build_guarded_step(check_grads=True)
+        assert m._gstep_fn is first             # cache hit
+        m._build_guarded_step(check_grads=False)
+        assert m._gstep_fn is not first         # policy change rebuilds
+
+    def test_fit_nan_step_skipped_params_unpoisoned(self, tmp_path):
+        """A poisoned batch (NaN labels) must not touch params: the
+        guarded step refuses the commit, training continues, and the
+        final params are finite."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((24, 4)).astype(np.float32)
+        y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+        y[8:16] = np.nan                    # batch 1 of 3 is poisoned
+        ds = TensorDataset([x, y])
+        reg = MetricRegistry()
+        sup = TrainSupervisor(str(tmp_path), save_interval_steps=100,
+                              registry=reg,
+                              anomaly=AnomalyPolicy(max_consecutive=10))
+        m = self._model()
+        m.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+              callbacks=[self._Rec()], supervisor=sup)
+        w = m.network.state_dict()
+        for v in w.values():
+            assert np.isfinite(np.asarray(v.numpy())).all()
+        c = reg.counter("train_anomaly_total", "", labelnames=("kind",))
+        total = sum(child for child in (
+            c.labels(kind="nonfinite_loss").value,
+            c.labels(kind="nonfinite_grad").value))
+        assert total == 2                   # poisoned batch, both epochs
+
+
+# ------------------------------------------------------------ satellites
+class TestCallbackListFiresAll:
+    def test_all_callbacks_fire_then_first_error_raised(self):
+        from paddle_tpu.hapi.callbacks import Callback, CallbackList
+        from paddle_tpu.reliability import CallbackError
+        fired = []
+
+        class Boom(Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                fired.append("boom")
+                raise ValueError("poisoned logger")
+
+        class Quiet(Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                fired.append("quiet")
+
+        cbs = CallbackList([Boom(), Quiet(), Boom()])
+        with pytest.raises(CallbackError) as ei:
+            cbs.on_epoch_end(0, {})
+        assert fired == ["boom", "quiet", "boom"]   # nobody starved
+        assert ei.value.rid == "Boom"
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert len(ei.value.errors) == 2
+
+    def test_clean_sweep_raises_nothing(self):
+        from paddle_tpu.hapi.callbacks import Callback, CallbackList
+        cbs = CallbackList([Callback(), Callback()])
+        cbs.on_epoch_end(0, {})
+        cbs.on_train_end()
+
+
+class _DictStore:
+    """Minimal TCPStore stand-in for ElasticManager unit tests."""
+
+    def __init__(self):
+        self.d = {}
+
+    def set(self, k, v):
+        self.d[k] = v.encode() if isinstance(v, str) else bytes(v)
+
+    def get(self, k):
+        return self.d[k]
+
+    def check(self, k):
+        return k in self.d
+
+
+class TestElasticClose:
+    def test_close_joins_heartbeat_and_watch_threads(self):
+        from paddle_tpu.parallel.elastic import ElasticManager
+        mgr = ElasticManager(store=_DictStore(), node_id="0", np=1,
+                             heartbeat_interval=0.01)
+        mgr.register()
+        mgr.watch()
+        hb, watch = mgr._hb_thread, mgr._watch_thread
+        assert hb.daemon and watch.daemon       # can't hang shutdown
+        mgr.close()
+        assert not hb.is_alive() and not watch.is_alive()
+        assert mgr._hb_thread is None and mgr._watch_thread is None
+        mgr.close()                              # idempotent
+
+    def test_context_manager_closes(self):
+        from paddle_tpu.parallel.elastic import ElasticManager
+        with ElasticManager(store=_DictStore(), node_id="0", np=1,
+                            heartbeat_interval=0.01) as mgr:
+            mgr.register()
+            hb = mgr._hb_thread
+        assert not hb.is_alive()
+
+
+class TestTrainEpochRangeAtomic:
+    def test_crash_during_save_reruns_not_skips_epoch(self, tmp_path):
+        """Satellite regression: a kill between 'save' and 'epoch
+        advance' re-runs the unsaved epoch on resume (never skips), and
+        never re-runs an epoch whose save committed."""
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+        from paddle_tpu.reliability import InjectedFault
+        d = str(tmp_path)
+        model = nn.Linear(4, 4)
+        # crash e1's commit: rename visit 0 = e0 (ok), visit 1 = e1
+        fi = FaultInjector(seed=0).on(faults.CKPT_RENAME, schedule=[1])
+        r1 = TrainEpochRange(4, "job", checkpoint_dir=d, fault_injector=fi)
+        r1.add("model", model)
+        seen = []
+        with pytest.raises(InjectedFault):
+            for epoch in r1:
+                seen.append(epoch)
+        assert seen == [0, 1]                   # died saving e1
+        model2 = nn.Linear(4, 4)
+        r2 = TrainEpochRange(4, "job", checkpoint_dir=d)
+        r2.add("model", model2)
+        assert r2.restored_from() == 0          # e1's torn save invisible
+        assert list(r2) == [1, 2, 3]            # e1 re-runs, e0 does not
+        np.testing.assert_allclose(model2.weight.numpy(),
+                                   model.weight.numpy())
+
+    def test_torn_epoch_dir_ignored_on_scan(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+        d = str(tmp_path)
+        model = nn.Linear(2, 2)
+        r1 = TrainEpochRange(3, "job", checkpoint_dir=d)
+        r1.add("model", model)
+        for _ in r1:
+            pass
+        # corrupt the newest snapshot post-commit (bit rot)
+        newest = os.path.join(r1.store.step_path(2), "manifest.json")
+        with open(newest, "w") as f:
+            f.write("{broken")
+        r2 = TrainEpochRange(3, "job", checkpoint_dir=d)
+        assert r2.restored_from() == -1   # only epoch 2 kept; it's torn
+        assert list(r2) == [0, 1, 2]
+
+    def test_foreign_format_run_dir_warns(self, tmp_path):
+        """A run directory holding pre-durable-format checkpoints
+        (meta.json + per-epoch payload dirs) must not be silently
+        mistaken for a fresh run."""
+        from paddle_tpu.incubate.checkpoint import TrainEpochRange
+        d = tmp_path / "job"
+        d.mkdir()
+        (d / "meta.json").write_text('{"epoch": 7}')
+        (d / "e7").mkdir()
+        with pytest.warns(RuntimeWarning, match="cannot read"):
+            r = TrainEpochRange(9, "job", checkpoint_dir=str(tmp_path))
+        assert r.restored_from() == -1
+
+
+class TestNoBareExcept:
+    def test_lint_clean_on_package(self):
+        """Satellite: scripts/check_no_bare_except.py stays green over
+        paddle_tpu/ (wired here so a regression fails tier-1)."""
+        from importlib import util
+        spec = util.spec_from_file_location(
+            "check_no_bare_except",
+            os.path.join(REPO, "scripts", "check_no_bare_except.py"))
+        mod = util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        hits = mod.bare_excepts(os.path.join(REPO, "paddle_tpu"))
+        assert hits == [], f"bare excepts found: {hits}"
+
+    def test_lint_flags_a_bare_except(self, tmp_path):
+        from importlib import util
+        spec = util.spec_from_file_location(
+            "check_no_bare_except",
+            os.path.join(REPO, "scripts", "check_no_bare_except.py"))
+        mod = util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+        hits = mod.bare_excepts(str(tmp_path))
+        assert len(hits) == 1 and hits[0][1] == 3
+
+    def test_cli_exit_codes(self, tmp_path):
+        script = os.path.join(REPO, "scripts", "check_no_bare_except.py")
+        ok = subprocess.run([sys.executable, script,
+                             os.path.join(REPO, "paddle_tpu")],
+                            capture_output=True)
+        assert ok.returncode == 0
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+        r = subprocess.run([sys.executable, script, str(tmp_path)],
+                           capture_output=True, text=True)
+        assert r.returncode == 1 and "bare 'except:'" in r.stdout
