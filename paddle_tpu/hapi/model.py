@@ -185,6 +185,14 @@ class Model:
         if done is None:
             return None
         self._apply_checkpoint(state, meta)
+        return self._fit_cursor(meta)
+
+    @staticmethod
+    def _fit_cursor(meta):
+        """Decode a checkpoint's fit position — ``(rng, epoch, batch)``
+        — the ONE meta-to-cursor mapping both kill+resume
+        (``_restore_fit``) and in-process anomaly rollback
+        (``_supervised_step``) restore through."""
         cursor = meta.get("cursor", {"epoch": 0, "batch": 0})
         rng = meta.get("fit_rng")
         if rng is None:
@@ -201,7 +209,11 @@ class Model:
         (params, optimizer state, RNG, LR schedule, epoch/batch cursor),
         EXACT resume on re-invocation after a kill, NaN/Inf steps
         skipped in-step (guarded update) with rollback-to-last-good
-        after K in a row, transient STEP failures retried with backoff
+        after K in a row — a rollback restores the DATA CURSOR and rng
+        chain alongside model state, replaying the same batches from
+        the same state (a persistent anomaly therefore replays into the
+        same wall and aborts typed, never silently trains past
+        unlearned data), transient STEP failures retried with backoff
         (data-side retry covers INJECTED faults only — a real loader
         failure surfaces loudly, since a raised-through generator is
         closed and blindly re-nexting it would silently truncate the
@@ -255,7 +267,8 @@ class Model:
             if restored is not None:
                 rng, start_epoch, skip_batches = restored
         preempted = False
-        for epoch in range(start_epoch, epochs):
+        epoch = start_epoch
+        while epoch < epochs:
             sampler = getattr(loader, "batch_sampler", None)
             if sampler is not None and hasattr(sampler, "set_epoch"):
                 sampler.set_epoch(epoch)
@@ -269,6 +282,8 @@ class Model:
             it = skip - 1
             stop_cursor = None         # set on ANY mid-epoch stop: the
             #                            next unprocessed batch index
+            rolled_back = False        # anomaly rollback: restart the
+            #                            epoch loop at the restored cursor
             while True:
                 if supervisor is not None:
                     # retry INJECTED data faults only; the actual
@@ -297,14 +312,26 @@ class Model:
                     loss, self._params, self._opt_state = self._step_fn(
                         self._params, self._opt_state, inputs, labels,
                         self._step_count, sub, self._cur_lr())
+                    rb = None
                 else:
-                    loss = self._supervised_step(
+                    loss, rb = self._supervised_step(
                         supervisor, inputs, labels, sub, epoch, it, rng)
                 logs = {"loss": float(loss), "step": it}
                 cbs.on_train_batch_end(it, logs)
+                if rb is not None:
+                    # anomaly rollback restored the checkpoint's params
+                    # AND its data cursor + rng: rewind the loop to
+                    # replay the same batches from the same state (the
+                    # same contract as kill+resume, in-process)
+                    rng, start_epoch, skip_batches = rb
+                    rolled_back = True
+                    break
                 if self.stop_training:
                     stop_cursor = it + 1         # batch `it` ran
                     break
+            if rolled_back:
+                epoch = start_epoch
+                continue
             if preempted:
                 supervisor.note_preempt()
                 supervisor.save_state(
@@ -349,6 +376,7 @@ class Model:
                 # legacy behavior (remaining epochs still run their
                 # epoch-end eval/save/LR hooks with zero batches).
                 break
+            epoch += 1
         if supervisor is not None:
             supervisor.wait_for_saves()
         self.network.load_raw_params(self._params)
@@ -359,7 +387,9 @@ class Model:
                         it, rng):
         """One guarded train step under the supervisor: retry transient
         failures, skip non-finite updates, roll back after K in a row,
-        checkpoint on the save interval."""
+        checkpoint on the save interval. Returns ``(loss, rollback)``;
+        ``rollback`` is None, or ``(rng, epoch, batch)`` — the restored
+        checkpoint's cursor the fit loop must rewind to."""
         from ..reliability import training as _rt
 
         def run():
@@ -374,29 +404,30 @@ class Model:
             self._params, self._opt_state = new_p, new_s
             supervisor.save_state(self._step_count, self._ckpt_state(),
                                   lambda: self._fit_meta(epoch, it + 1, rng))
-        else:
-            # guarded step already refused the commit: new_p/new_s ARE
-            # the old values, passed through the in-jit where()
-            self._params, self._opt_state = new_p, new_s
-            kind = (_rt.ANOMALY_NONFINITE_LOSS if not bool(loss_fin)
-                    else _rt.ANOMALY_NONFINITE_GRAD)
-            action = supervisor.note_anomaly(kind, step=self._step_count)
-            if action == "rollback":
-                state, meta, done = supervisor.restore_state(
-                    restore_rng=False)
-                if done is None:
-                    # mirror TrainSupervisor.run: continuing here would
-                    # silently burn the rollback budget restoring
-                    # nothing
-                    raise _rt.TrainAnomalyError(
-                        "anomalies before any checkpoint existed: "
-                        "nothing to roll back to", kind=kind,
-                        step=self._step_count)
-                # model state only: fit's rollback keeps moving
-                # FORWARD through the data (the poisoned region is
-                # skipped); kill+resume restores the full cursor
-                self._apply_checkpoint(state, meta)
-        return loss
+            return loss, None
+        # guarded step already refused the commit: new_p/new_s ARE
+        # the old values, passed through the in-jit where()
+        self._params, self._opt_state = new_p, new_s
+        kind = (_rt.ANOMALY_NONFINITE_LOSS if not bool(loss_fin)
+                else _rt.ANOMALY_NONFINITE_GRAD)
+        action = supervisor.note_anomaly(kind, step=self._step_count)
+        if action != "rollback":
+            return loss, None
+        state, meta, done = supervisor.restore_state()
+        if done is None:
+            # mirror TrainSupervisor.run: continuing here would
+            # silently burn the rollback budget restoring nothing
+            raise _rt.TrainAnomalyError(
+                "anomalies before any checkpoint existed: "
+                "nothing to roll back to", kind=kind,
+                step=self._step_count)
+        # full rollback — params/opt, LR schedule, global RNG, AND the
+        # data cursor + fit rng chain: the loop rewinds and replays the
+        # same batches from the same state, exactly like kill+resume
+        # (PR 4 shipped model-state-only rollback that kept moving
+        # forward in data; ISSUE 5 closes that scope cut)
+        self._apply_checkpoint(state, meta)
+        return loss, self._fit_cursor(meta)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):

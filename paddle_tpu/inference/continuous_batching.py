@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import unwrap
+from .kv_cache import OutOfPages
 from ..reliability import (CallbackError, CircuitOpenError, DEAD,
                            DEGRADED, DRAINING, DeadlineExceeded, HEALTHY,
                            HealthMonitor, QueueFullError, ReliabilityError,
@@ -46,12 +47,13 @@ class _Pending:
 
 
 class _Slot:
-    __slots__ = ("rid", "prompt_len", "budget", "emitted", "on_token",
-                 "streamed", "deadline")
+    __slots__ = ("rid", "ids", "prompt_len", "budget", "emitted",
+                 "on_token", "streamed", "deadline")
 
-    def __init__(self, rid, prompt_len, budget, on_token=None,
+    def __init__(self, rid, ids, prompt_len, budget, on_token=None,
                  deadline=None):
         self.rid = rid
+        self.ids = ids                # prompt tokens (donated at release)
         self.prompt_len = prompt_len
         self.budget = budget          # max_new_tokens remaining
         self.emitted = []
@@ -96,6 +98,17 @@ class ContinuousBatchingServer:
     stay bit-identical to the dense backend. When the pool is full,
     admission waits (FIFO) for a harvest to free pages.
 
+    With ``auto_prefix_cache=True`` (the paged default; see
+    inference/prefix_cache.py) prefix reuse needs no operator calls at
+    all: every finished request donates its full prompt pages into a
+    radix tree keyed by token content, every admission looks up the
+    longest cached page-aligned prefix automatically and prefills only
+    the remainder, and unpinned cached pages are evicted LRU whenever
+    the allocator runs short — the cache soaks up idle pool capacity
+    and shrinks under load with zero correctness impact (auto hits are
+    bit-identical to cold runs). ``register_prefix`` entries live in
+    the same tree as PINNED nodes that eviction never touches.
+
     ``telemetry`` (``paddle_tpu.telemetry.ServerTelemetry``, or ``True``
     for a default one) turns on SLO instrumentation: per-request
     lifecycle spans and TTFT/TPOT/queue-wait histograms, per-tick
@@ -122,9 +135,9 @@ class ContinuousBatchingServer:
                  eos_token_id=None, seed=0, weight_dtype=None,
                  prefill_chunk=None, mesh=None, tick_block=1,
                  cache_dtype=None, cache_backend="dense", page_size=16,
-                 num_pages=None, telemetry=None, max_queue=None,
-                 shed_policy="reject", retry_policy=None, breaker=None,
-                 fault_injector=None, clock=None):
+                 num_pages=None, auto_prefix_cache=True, telemetry=None,
+                 max_queue=None, shed_policy="reject", retry_policy=None,
+                 breaker=None, fault_injector=None, clock=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -172,9 +185,21 @@ class ContinuousBatchingServer:
                                     self.max_slots, pages_per_slot,
                                     fault_injector=fault_injector)
             self._caches = self._paged_bundle[0](self.max_slots)
-            self._pinned_pages = 0     # held forever by register_prefix
+            # the radix tree indexes EVERY page-granular prefix in the
+            # pool: register_prefix entries live in it pinned; with
+            # auto_prefix_cache (default) finished requests donate
+            # their prompt pages into it and lookups happen on every
+            # admission — unpinned entries are evicted LRU whenever
+            # the allocator runs short
+            from .prefix_cache import PrefixCache
+            self._prefix = PrefixCache(self._kv,
+                                       fault_injector=fault_injector)
+            self._kv.reclaimer = self._reclaim_pages
+            self._auto_prefix = bool(auto_prefix_cache)
         else:
             self._caches = self._init_caches(self.max_slots)
+            self._prefix = None
+            self._auto_prefix = False
         self._tok = jnp.zeros((self.max_slots,), jnp.int32)
         self._t = jnp.zeros((self.max_slots,), jnp.int32)
         self._active = np.zeros((self.max_slots,), bool)   # host-side
@@ -184,7 +209,8 @@ class ContinuousBatchingServer:
         self._next_rid = 0
         self._decode_jit = None
         self._prefixes = []   # [(ids, cache_rows, last_logits, pages)]
-        self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0}
+        self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0,
+                      "prefix_auto_hits": 0, "prefix_auto_hit_tokens": 0}
         # telemetry (paddle_tpu.telemetry.ServerTelemetry): True builds
         # a default-enabled one; None (default) keeps the hot path at
         # a single attribute check — no locks, no clock reads
@@ -228,10 +254,15 @@ class ContinuousBatchingServer:
         """Prefill a shared prompt prefix (e.g. a system prompt) ONCE and
         reuse its KV rows for every later request that starts with it —
         admission then only prefills the remainder. Longest registered
-        match wins. Returns the prefix length. Safe to call while a
-        serve thread is decoding (the lock serializes it against ticks:
-        the paged path writes pool pages and takes allocator pages, both
-        of which would otherwise race the donating decode program)."""
+        match wins. Returns the prefix length; the entry it pins is
+        PERMANENT — unlike automatically cached (donated) pages, pinned
+        entries are never evicted, whatever the pool pressure. Safe to
+        call while a serve thread is decoding (the lock serializes it
+        against ticks: the paged path writes pool pages and takes
+        allocator pages, both of which would otherwise race the
+        donating decode program). Paged backend: full pages the auto
+        prefix cache already holds for these tokens are adopted (and
+        pinned) rather than re-allocated."""
         ids = np.asarray(unwrap(prefix_ids)).astype(np.int32).reshape(-1)
         T = ids.shape[0]
         if T + 1 > self.max_cache_len:
@@ -272,32 +303,46 @@ class ContinuousBatchingServer:
             if self._tele is not None:
                 self._tele.add_prefill_tokens(T)
             rows = jax.tree_util.tree_map(lambda c: c[:, :, :T], caches1)
-            pages = []
+            pages, run, own, pin_delta = [], [], [], 0
             if self._kv is not None:
                 # store the prefix's FULL pages once in the pool; every
                 # slot that hits the prefix points its block table at
-                # them (the alloc ref is the registry's hold — they
-                # outlive slot churn and pin pool capacity forever)
+                # them. The radix tree is the page index: nodes the
+                # auto cache already donated for these tokens are
+                # adopted (pinned below), only the missing tail is
+                # freshly allocated and filled
                 nfull = T // self._kv.page_size
                 if nfull:
-                    pages = self._kv.alloc(nfull)
-                    self._pinned_pages += nfull
-            self._prefixes.append((ids, rows, logits, pages))
+                    aligned = ids[:nfull * self._kv.page_size]
+                    run = self._prefix.node_run(aligned)
+                    pin_delta = nfull - sum(1 for nd in run if nd.pinned)
+                    if nfull > len(run):
+                        # the adopted run must survive the allocation's
+                        # own LRU reclaim sweep
+                        self._prefix.protect(run)
+                        try:
+                            own = self._kv.alloc(nfull - len(run))
+                        finally:
+                            self._prefix.protect(())
+                    pages = [nd.page for nd in run] + own
+            entry = (ids, rows, logits, pages)
+            self._prefixes.append(entry)
             self._prefixes.sort(key=lambda e: -e[0].shape[0])
             if self._kv is not None and pages:
                 # pinning shrinks the pool for everyone else: a queued
                 # request that can no longer EVER fit would silently
                 # starve the FIFO — refuse the registration instead
-                usable = self._kv.num_pages - 1 - self._pinned_pages
+                usable = self._kv.num_pages - 1 \
+                    - (self._prefix.pinned_pages + pin_delta)
                 for item in self._queue:
                     q_ids = item.ids
                     q_need = self._request_pages(
                         q_ids, item.budget, self._match_prefix(q_ids))
                     if q_need > usable:
                         self._prefixes = [e for e in self._prefixes
-                                          if e[3] is not pages]
-                        self._kv.release(pages)
-                        self._pinned_pages -= len(pages)
+                                          if e is not entry]
+                        if own:
+                            self._kv.release(own)
                         raise ValueError(
                             f"registering this {T}-token prefix pins "
                             f"{len(pages)} pages and would strand an "
@@ -305,7 +350,11 @@ class ContinuousBatchingServer:
                             f"{q_need} of "
                             f"{usable} usable pages — grow num_pages "
                             f"or register prefixes before submitting")
-                self._fill_pages(caches1, pages, 0)
+                if own:
+                    self._fill_pages(caches1, own,
+                                     len(run) * self._kv.page_size)
+                self._prefix.extend_pinned(
+                    ids[:len(pages) * self._kv.page_size], run, own)
             self._pool_gauges()
         return T
 
@@ -382,7 +431,8 @@ class ContinuousBatchingServer:
                 # forever — pool minus prefix-pinned pages, minus the
                 # pinned pages this request would itself share
                 need = self._request_pages(ids, int(max_new_tokens), hit)
-                usable = self._kv.num_pages - 1 - self._pinned_pages
+                usable = self._kv.num_pages - 1 \
+                    - self._prefix.pinned_pages
                 if need > usable:
                     raise ValueError(
                         f"prompt ({T}) + max_new_tokens "
@@ -457,11 +507,33 @@ class ContinuousBatchingServer:
         return False
 
     def _release_slot(self, slot):
-        """Tear down a slot's host + page state (no result recording)."""
+        """Tear down a slot's host + page state (no result recording).
+        Paged backend with auto prefix caching: the request's full
+        prompt pages are DONATED into the radix tree (future prompts
+        sharing the prefix auto-hit them; eviction reclaims them under
+        pressure) instead of being freed; everything else — partial
+        prompt tail, decode budget — returns to the free list. An
+        injected ``prefix.donate`` fault abandons the insert and the
+        pages are simply freed: donation is best-effort cache
+        maintenance, never a correctness or leak risk."""
+        st = self._slots[slot]
         self._active[slot] = False
         self._slots[slot] = None
-        if self._kv is not None:
-            self._kv.free_slot(slot)
+        if self._kv is None:
+            return
+        pages = self._kv.detach_slot(slot)
+        if not pages:
+            return
+        if self._auto_prefix and st is not None:
+            try:
+                new = self._prefix.donate(st.ids, pages, st.prompt_len)
+            except Exception:
+                self._kv.release(pages)
+            else:
+                if new and self._tele is not None:
+                    self._tele.on_prefix_donate(new)
+        else:
+            self._kv.release(pages)
 
     def _finish_partial_locked(self, slot):
         """Record the slot's partial tokens as its rid's RESULT and tear
@@ -494,6 +566,26 @@ class ContinuousBatchingServer:
             {"k": caches1["k"], "v": caches1["v"]})
         self._caches = dict(self._caches, pool=pool)
 
+    def _seed_from_pages(self, pages):
+        """Inverse of ``_fill_pages``: gather cached pool pages back
+        into a dense batch-1 cache covering [0, len(pages) *
+        page_size) — the auto-hit remainder prefill attends to these
+        rows. The decode program reads the SAME pages through the block
+        table, so the pool copy stays the single source of truth."""
+        pg = self._kv.page_size
+        n = len(pages) * pg
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        base = self._init_caches(1)
+
+        def take(pool, dense):         # [L, P, pg, h, hd] -> dense rows
+            s = pool[:, idx]
+            s = s.reshape(s.shape[0], 1, n, s.shape[3], s.shape[4])
+            return dense.at[:, :, :n].set(s.astype(dense.dtype))
+
+        pool = self._caches["pool"]
+        return {"k": take(pool["k"], base["k"]),
+                "v": take(pool["v"], base["v"])}
+
     def _sync_block_table(self):
         """Push the host block-table mirror to the device copy the
         decode program reads. Same shape every time — page churn never
@@ -507,22 +599,67 @@ class ContinuousBatchingServer:
         """Refresh the page-pool occupancy gauges (paged backend)."""
         if self._tele is not None and self._kv is not None:
             used = self._kv.used_pages()
+            pinned = self._prefix.pinned_pages
+            cached = self._prefix.cached_pages
             self._tele.set_pool(self._kv.free_pages(),
-                                used - self._pinned_pages,
-                                self._pinned_pages)
+                                used - pinned - cached, pinned, cached)
 
     def pool_balance(self):
-        """(free, live, pinned) page counts summing to the usable pool
-        (``num_pages - 1``; page 0 is the null page). Chaos suites
-        assert ``live == 0`` once drained — i.e. free + pinned covers
-        the whole pool and no injected failure leaked a page. Dense
-        backend returns None."""
+        """(free, live, pinned, cached) page counts summing to the
+        usable pool (``num_pages - 1``; page 0 is the null page):
+        ``live`` pages belong to decoding slots, ``pinned`` to
+        registered prefixes (never evicted), ``cached`` to the auto
+        prefix cache (evictable LRU). Chaos suites assert ``live == 0``
+        once drained — free + pinned + cached then covers the whole
+        pool and no injected failure leaked a page. Dense backend
+        returns None."""
         if self._kv is None:
             return None
         with self._lock:
             free = self._kv.free_pages()
-            live = self._kv.used_pages() - self._pinned_pages
-            return free, live, self._pinned_pages
+            pinned = self._prefix.pinned_pages
+            cached = self._prefix.cached_pages
+            live = self._kv.used_pages() - pinned - cached
+            return free, live, pinned, cached
+
+    def _reclaim_pages(self, shortfall):
+        """``PagedKVCache.alloc``'s reclaimer: evict LRU cached prefix
+        pages when the free list runs short. An injected
+        ``prefix.evict`` fault aborts THIS sweep — alloc then raises
+        OutOfPages and admission defers to the next tick; either way
+        no page leaks and no request fails."""
+        try:
+            freed = self._prefix.evict(shortfall)
+        except Exception:
+            return 0
+        if freed and self._tele is not None:
+            self._tele.on_prefix_evict(freed)
+        return freed
+
+    def _best_hit(self, ids):
+        """The longest reusable prefix state for ``ids``: the
+        registered match (dense rows + final logits, token-exact
+        length) vs the radix tree's page-aligned cached run — whichever
+        covers more tokens. Returns ``("reg", entry)``, ``("tree",
+        PrefixMatch)``, or None. A tree match is trimmed page-by-page
+        until the remainder's prefill-chunk pad still fits
+        ``max_cache_len`` (submit() bound-checked the pad against the
+        hits known THEN; the tree moves underneath queued requests),
+        and capped one token short of the prompt — the remainder
+        prefill must emit the first-token logits."""
+        reg = self._match_prefix(ids)
+        best = None if reg is None else ("reg", reg)
+        if self._auto_prefix:
+            T = int(ids.shape[0])
+            tree = self._prefix.lookup(ids, T - 1)
+            while tree is not None and \
+                    T + self._chunk_pad(T - tree.tokens) \
+                    > self.max_cache_len:
+                tree = tree.shrink()
+            reg_n = reg[0].shape[0] if reg is not None else 0
+            if tree is not None and tree.tokens > reg_n:
+                best = ("tree", tree)
+        return best
 
     def _request_pages(self, ids, budget, hit):
         """Fresh pages a request needs for its FULL extent (prompt +
@@ -530,15 +667,31 @@ class ContinuousBatchingServer:
         hit an empty pool mid-flight), net of the shared pages of
         ``hit`` (the caller's ``_match_prefix`` result)."""
         shared = len(hit[3]) if hit is not None else 0
-        return -(-(ids.shape[0] + budget) // self._kv.page_size) - shared
+        return self._npages_for(ids.shape[0] + budget) - shared
 
-    def _head_fits_pool(self):
+    def _head_fits_pool(self, best):
         """Can the pool admit the request at the head of the queue right
         now? If not it (and everything behind it — FIFO) waits for a
-        harvest to free pages."""
+        harvest to free pages. Evictable prefix-cache pages count as
+        available headroom (alloc reclaims them on demand) — minus the
+        nodes the head's own cache hit (``best``, computed once per
+        admission attempt and shared with ``_admit_one``) is about to
+        take by reference, which obviously cannot be evicted to make
+        room for it."""
         head = self._queue[0]
-        return self._kv.free_pages() >= self._request_pages(
-            head.ids, head.budget, self._match_prefix(head.ids))
+        if best is None:
+            shared, nodes = 0, ()
+        elif best[0] == "reg":
+            shared, nodes = len(best[1][3]), ()
+        else:
+            shared, nodes = len(best[1].pages), best[1].nodes
+        need = self._npages_for(head.ids.shape[0] + head.budget) - shared
+        avail = self._kv.free_pages() \
+            + self._prefix.evictable_pages(exclude=nodes)
+        return avail >= need
+
+    def _npages_for(self, n_tokens):
+        return -(-int(n_tokens) // self._kv.page_size)
 
     # ------------------------------------------------------- scheduling
     def _admit(self):
@@ -549,7 +702,12 @@ class ContinuousBatchingServer:
         for slot in range(self.max_slots):
             if self._active[slot] or not self._queue:
                 continue
-            if self._kv is not None and not self._head_fits_pool():
+            # one _best_hit per admission attempt: the radix walk (and
+            # registered-prefix scan) feeds the fits check AND the
+            # admission itself — same lock, same tick, the tree cannot
+            # move between the two
+            best = self._best_hit(self._queue[0].ids)
+            if self._kv is not None and not self._head_fits_pool(best):
                 break
             req = self._queue.pop(0)
             rid = req.rid
@@ -557,7 +715,23 @@ class ContinuousBatchingServer:
                 self._tele.on_admit(rid, len(self._queue))
             try:
                 self._admit_one(slot, rid, req.ids, req.budget, req.seed,
-                                req.on_token, req.deadline)
+                                req.on_token, req.deadline, best)
+            except OutOfPages:
+                # eviction could not free enough right now (an injected
+                # ``prefix.evict`` fault aborted the sweep, or a cache
+                # hit shrank the headroom mid-admission): roll back and
+                # DEFER — the request returns to the head of the queue
+                # (FIFO preserved) and is retried next tick, it does
+                # NOT fail
+                if self._kv is not None and self._kv.slot_pages(slot):
+                    self._kv.free_slot(slot)
+                self._active[slot] = False
+                self._slots[slot] = None
+                self._queue.insert(0, req)
+                if self._tele is not None:
+                    self._tele.on_admission_deferred(rid,
+                                                     len(self._queue))
+                break
             except Exception as e:
                 if self._kv is not None and self._kv.slot_pages(slot):
                     self._kv.free_slot(slot)     # roll back a part-admit
@@ -571,7 +745,7 @@ class ContinuousBatchingServer:
             self._pool_gauges()
 
     def _admit_one(self, slot, rid, ids, budget, req_seed, on_token,
-                   deadline=None):
+                   deadline=None, best=None):
         if self._faults is not None:
             # chaos failure point: an admission prefill that dies is a
             # PER-REQUEST failure (_admit records it), never a server one
@@ -580,28 +754,66 @@ class ContinuousBatchingServer:
         # per-request prefill at batch 1 (optionally in fixed-size
         # chunks: one compiled program for every prompt length),
         # then scatter into the pool. A registered-prefix hit seeds
-        # the caches and prefills only the remainder.
-        hit = self._match_prefix(ids)
-        pre_pages = []
-        if hit is not None:
-            pre_ids, rows, pre_logits, pre_pages = hit
-            n = pre_ids.shape[0]
+        # the caches from the stored dense rows; an AUTOMATIC
+        # prefix-cache hit (radix tree over donated pages) gathers the
+        # cached pages back into a dense batch-1 cache — either way
+        # only the remainder is prefilled.
+        if best is None:
+            best = self._best_hit(ids)
+        if best is not None and best[0] == "tree":
+            n_pre, pre_pages = best[1].tokens, best[1].pages
+        elif best is not None:
+            n_pre, pre_pages = best[1][0].shape[0], best[1][3]
+        else:
+            n_pre, pre_pages = 0, []
+        own = []
+        if self._kv is not None:
+            # reserve the slot's FULL extent (prompt + budget) before
+            # any prefill work or stats: an OutOfPages here (aborted
+            # eviction sweep, headroom shrunk mid-tick) defers the
+            # request with no prefill wasted and nothing counted — the
+            # retry starts from zero, so counters see each admission
+            # ONCE. Shared cache-hit pages join the slot's table by
+            # reference and are referenced before the alloc, so its
+            # reclaim sweep can never evict them; mid-decode growth can
+            # never exhaust the pool.
+            own = self._kv.admit_slot(slot, T + budget, pre_pages)
+        if best is not None and best[0] == "tree":
+            m = best[1]
+            self._prefix.use(m)               # LRU: reuse is recency
+            caches1 = self._seed_from_pages(m.pages)
+            rest = ids[n_pre:]                # never empty (lookup cap)
+            self.stats["prefix_hit_tokens"] += n_pre
+            self.stats["prefix_auto_hits"] += 1
+            self.stats["prefix_auto_hit_tokens"] += n_pre
+            logits, caches1 = self.model._run_prefill(
+                self._bundle, rest[None], chunk=self._prefill_chunk,
+                caches=caches1, t0=n_pre)
+            self.stats["prefill_tokens"] += rest.shape[0]
+            if self._tele is not None:
+                self._tele.on_prefix_auto(True, n_pre)
+        elif best is not None:
+            rows, pre_logits = best[1][1], best[1][2]
             caches1 = jax.tree_util.tree_map(
                 lambda full, r: full.at[:, :, :r.shape[2]].set(r),
                 self._init_caches(1), rows)
-            rest = ids[n:]
-            self.stats["prefix_hit_tokens"] += n
+            rest = ids[n_pre:]
+            self.stats["prefix_hit_tokens"] += n_pre
             if rest.shape[0]:
                 logits, caches1 = self.model._run_prefill(
                     self._bundle, rest[None],
-                    chunk=self._prefill_chunk, caches=caches1, t0=n)
+                    chunk=self._prefill_chunk, caches=caches1, t0=n_pre)
                 self.stats["prefill_tokens"] += rest.shape[0]
             else:
                 logits = pre_logits
+            if self._tele is not None and self._auto_prefix:
+                self._tele.on_prefix_auto(False, 0)
         else:
             logits, caches1 = self.model._run_prefill(
                 self._bundle, ids[None], chunk=self._prefill_chunk)
             self.stats["prefill_tokens"] += T
+            if self._tele is not None and self._auto_prefix:
+                self._tele.on_prefix_auto(False, 0)
         key = jax.random.PRNGKey(req_seed)
         if self.do_sample:
             # same split pattern as sample_generate.run: one split,
@@ -616,12 +828,9 @@ class ContinuousBatchingServer:
             first = int(jnp.argmax(logits, -1)[0])
         self._keys = self._keys.at[slot].set(key)
         if self._kv is not None:
-            # shared prefix pages join this slot's table by
-            # reference (stored once); the FULL extent (prompt +
-            # budget) is reserved up front so mid-decode growth can
-            # never exhaust the pool; only prompt rows are copied
+            # only prompt rows are copied into the reserved pages; the
+            # shared prefix pages ahead of them are already filled
             pg = self._kv.page_size
-            own = self._kv.admit_slot(slot, T + budget, pre_pages)
             n_prompt = -(-T // pg) - len(pre_pages)
             self._fill_pages(caches1, own[:n_prompt],
                              len(pre_pages) * pg)
@@ -632,13 +841,12 @@ class ContinuousBatchingServer:
         self._tok = self._tok.at[slot].set(first)
         self._t = self._t.at[slot].set(T)
         self._active[slot] = True
-        st = _Slot(rid, T, budget, on_token, deadline)
+        st = _Slot(rid, ids, T, budget, on_token, deadline)
         st.emitted.append(int(first))
         st.stream(self._deferred_cbs)
         self._slots[slot] = st
         if self._tele is not None:
-            pre_n = hit[0].shape[0] if hit is not None else 0
-            self._tele.on_first_token(rid, T - pre_n, pre_n)
+            self._tele.on_first_token(rid, T - n_pre, n_pre)
 
     # ------------------------------------------------------------ steps
     def _build_decode_step(self):
@@ -796,10 +1004,7 @@ class ContinuousBatchingServer:
             if self._active[slot] and self._finished(st):
                 out = np.asarray(st.emitted[:st.budget], np.int32)
                 self._results[st.rid] = out
-                self._active[slot] = False
-                self._slots[slot] = None
-                if self._kv is not None:
-                    self._kv.free_slot(slot)
+                self._release_slot(slot)   # paged: donates prompt pages
                 if self._tele is not None:
                     self._tele.on_finish(st.rid, len(out))
                 finished = True

@@ -65,6 +65,12 @@ class PagedKVCache:
         # "kv.alloc" point BEFORE touching the free list, so an injected
         # allocation failure can never leak pages
         self._faults = fault_injector
+        # last-resort page source: when the free list runs short,
+        # ``alloc`` calls ``reclaimer(shortfall)`` once before giving
+        # up — the prefix cache hooks in here to evict LRU cached
+        # pages. The callback must release pages (growing the free
+        # list) and MUST NOT raise; it returns the count it freed.
+        self.reclaimer = None
         # cumulative churn counters (telemetry: page-pool pressure and
         # sharing effectiveness without polling mid-operation)
         self.alloc_total = 0       # pages taken off the free list
@@ -82,9 +88,13 @@ class PagedKVCache:
         return self.num_pages - 1 - len(self._free)
 
     def alloc(self, n):
-        """Take ``n`` pages off the free list (refcount 1 each)."""
+        """Take ``n`` pages off the free list (refcount 1 each). A
+        short free list first asks ``reclaimer`` (the prefix cache's
+        LRU eviction) to make up the difference."""
         if self._faults is not None:
             self._faults.check(PAGE_ALLOC, need=n)
+        if n > len(self._free) and self.reclaimer is not None:
+            self.reclaimer(n - len(self._free))
         if n > len(self._free):
             raise OutOfPages(
                 f"need {n} pages but only {len(self._free)} of "
@@ -104,6 +114,11 @@ class PagedKVCache:
             if self._ref[p] == 0:
                 self._free.append(p)
                 self.freed_total += 1
+
+    def refcount(self, page):
+        """Live references on ``page`` (prefix-cache eviction treats
+        anything above the tree's own 1 as in-use)."""
+        return int(self._ref[page])
 
     # ------------------------------------------------------- slot state
     def coverage(self, slot):
@@ -129,10 +144,20 @@ class PagedKVCache:
             raise ValueError(
                 f"{n_tokens} tokens need {need} pages > pages_per_slot "
                 f"({self.pages_per_slot})")
-        own = self.alloc(need - len(shared_pages))
+        # reference the shared pages BEFORE allocating: alloc may evict
+        # via the reclaimer, and a cached page this slot is about to
+        # reuse must already read as in-use (refcount > 1) or the sweep
+        # could free-and-recycle it mid-admission
         for p in shared_pages:
             self._ref[p] += 1
         self.shared_ref_total += len(shared_pages)
+        try:
+            own = self.alloc(need - len(shared_pages))
+        except Exception:
+            for p in shared_pages:
+                self._ref[p] -= 1
+            self.shared_ref_total -= len(shared_pages)
+            raise
         pages = list(shared_pages) + own
         self._slot_pages[slot] = pages
         self._slot_shared[slot] = len(shared_pages)
@@ -146,11 +171,18 @@ class PagedKVCache:
         """Release the slot's pages (shared pages just drop a ref) and
         null its block-table row so stale decode writes are redirected
         to the null page."""
-        self.release(self._slot_pages[slot])
+        self.release(self.detach_slot(slot))
+
+    def detach_slot(self, slot):
+        """Hand the slot's pages to the caller WITHOUT dropping any
+        references — prefix-cache donation takes over their ownership —
+        and null the block-table row like ``free_slot``."""
+        pages = self._slot_pages[slot]
         self._slot_pages[slot] = []
         self._slot_shared[slot] = 0
         self.block_table[slot, :] = NULL_PAGE
         self.dirty = True
+        return pages
 
     # ------------------------------------------------------- accounting
     def telemetry_stats(self):

@@ -272,6 +272,7 @@ def serve_metrics(target, host="127.0.0.1", port=0):
             stats = dict(target.stats)
             if kv is not None:
                 stats["kv_pool"] = kv.telemetry_stats()
+                stats["prefix_cache"] = target._prefix.stats()
             return stats
     health = None
     if hasattr(target, "health"):

@@ -27,14 +27,17 @@ import threading
 from .errors import InjectedFault
 
 __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
-           "ON_TOKEN", "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
-           "TRAIN_STEP", "DATA_NEXT"]
+           "ON_TOKEN", "PREFIX_EVICT", "PREFIX_DONATE", "CKPT_WRITE",
+           "CKPT_RENAME", "CKPT_SWAP", "TRAIN_STEP", "DATA_NEXT"]
 
 # failure points wired into the serving stack (callers may add their own)
 PREFILL = "server.prefill"          # _admit_one: admission prefill
 DECODE_TICK = "server.decode_tick"  # _step_locked: batched decode dispatch
 PAGE_ALLOC = "kv.alloc"             # PagedKVCache.alloc
 ON_TOKEN = "server.on_token"        # streamed-token callback delivery
+PREFIX_EVICT = "prefix.evict"       # PrefixCache.evict: LRU reclaim sweep
+PREFIX_DONATE = "prefix.donate"     # PrefixCache.donate: harvest-time
+#                                     adoption of a slot's prompt pages
 
 # failure points wired into the training / checkpoint stack
 CKPT_WRITE = "ckpt.write"           # durable save: per-file payload write

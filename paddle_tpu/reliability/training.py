@@ -327,9 +327,12 @@ class TrainSupervisor:
         checkpoint (corrupt ones are skipped), restoring the global RNG
         when tracked; ``(None, None, None)`` on an empty store.
         ``restore_rng=False`` leaves the global ``core.random`` stream
-        untouched — for model-state-only rollbacks that keep moving
-        FORWARD through data (rewinding the stream there would replay
-        past subkeys into augmentation/callback randomness)."""
+        untouched — for callers doing a model-state-only rollback that
+        keeps moving FORWARD through data (rewinding the stream there
+        would replay past subkeys into augmentation/callback
+        randomness). Both the standalone ``run`` loop and
+        ``hapi.Model.fit`` roll back the FULL cursor (state + data +
+        RNG), so they use the default."""
         self.wait_for_saves()
         state, meta, found = self.store.restore()
         if found is None:

@@ -20,8 +20,15 @@ Per-tick engine signals:
 
 Cache signals:
 - ``serving_tokens_total{kind=prefill|prefix_hit|decode}``
-- ``serving_prefix_cache_total{result=hit|miss}``
-- ``kv_pool_pages{state=free|live|pinned}`` (paged backend)
+- ``serving_prefix_cache_total{result=hit|miss|auto_hit|auto_miss}``
+  (``hit``/``miss`` count registered-prefix outcomes at admission;
+  ``auto_hit``/``auto_miss`` count the AUTOMATIC radix-tree lookups —
+  auto_hit when the tree supplied pages beyond any registered match)
+- ``kv_pool_pages{state=free|live|pinned|cached}`` (paged backend;
+  ``cached`` = evictable auto-prefix-cache pages)
+- ``kv_prefix_cached_pages`` gauge / ``kv_prefix_hit_tokens`` gauge
+  (tokens covered by the most recent auto hit)
+- ``kv_prefix_donated_pages_total`` / ``kv_prefix_evicted_pages_total``
 - ``kv_null_redirected_writes_total``  inactive-slot rows stepped per
   tick — their all-null block tables redirect every write to the null
   page. Rows a finished slot wastes INSIDE a block are counted under
@@ -57,11 +64,12 @@ OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 
 class _ReqState:
-    __slots__ = ("t_submit", "t_first", "queued_span", "prefill_span",
-                 "decode_span")
+    __slots__ = ("t_submit", "t_admit", "t_first", "queued_span",
+                 "prefill_span", "decode_span")
 
     def __init__(self, t_submit, queued_span):
         self.t_submit = t_submit
+        self.t_admit = None
         self.t_first = None
         self.queued_span = queued_span
         self.prefill_span = None
@@ -128,11 +136,26 @@ class ServerTelemetry:
                         labelnames=("result",))
         self._c_pfx_hit = pfx.labels(result="hit")
         self._c_pfx_miss = pfx.labels(result="miss")
+        self._c_pfx_auto_hit = pfx.labels(result="auto_hit")
+        self._c_pfx_auto_miss = pfx.labels(result="auto_miss")
         pool = r.gauge("kv_pool_pages", "Paged KV pool occupancy",
                        labelnames=("state",))
         self._g_pool_free = pool.labels(state="free")
         self._g_pool_live = pool.labels(state="live")
         self._g_pool_pinned = pool.labels(state="pinned")
+        self._g_pool_cached = pool.labels(state="cached")
+        self._g_pfx_cached = r.gauge(
+            "kv_prefix_cached_pages",
+            "Evictable pages held by the automatic prefix cache")
+        self._g_pfx_hit_tokens = r.gauge(
+            "kv_prefix_hit_tokens",
+            "Tokens covered by the most recent automatic prefix hit")
+        self._c_pfx_donated = r.counter(
+            "kv_prefix_donated_pages_total",
+            "Prompt pages donated into the prefix cache at harvest")
+        self._c_pfx_evicted = r.counter(
+            "kv_prefix_evicted_pages_total",
+            "Cached prefix pages reclaimed by LRU eviction")
         self._c_null_writes = r.counter(
             "kv_null_redirected_writes_total",
             "Inactive-slot decode writes redirected to the null page "
@@ -183,13 +206,33 @@ class ServerTelemetry:
         st = self._req.get(rid)
         if st is None:
             return
-        t = self.clock.now()
-        self._h_wait.observe(t - st.t_submit)
+        # the queue-wait histogram is observed by on_first_token, not
+        # here: this attempt may still be DEFERRED back to the queue,
+        # and a request must contribute exactly one (full) sample
+        st.t_admit = self.clock.now()
         self._g_queue.set(queue_depth)
-        st.queued_span.end()
-        st.queued_span = None
+        if st.queued_span is not None:   # None after a deferred admit
+            st.queued_span.end()
+            st.queued_span = None
         st.prefill_span = self.tracer.begin_span("request.prefill",
                                                  rid=rid)
+
+    def on_admission_deferred(self, rid, queue_depth):
+        """Admission rolled back (the pool could not be made to fit —
+        e.g. an aborted eviction sweep) and the request returned to the
+        queue head; it will be admitted again later."""
+        if not self.enabled:
+            return
+        st = self._req.get(rid)
+        self._g_queue.set(queue_depth)
+        if st is None:
+            return
+        if st.prefill_span is not None:
+            st.prefill_span.end(deferred=True)
+            st.prefill_span = None
+        if st.queued_span is None:
+            st.queued_span = self.tracer.begin_span(
+                "request.queued", rid=rid, requeued=True)
 
     def on_first_token(self, rid, prefill_tokens, prefix_hit_tokens):
         """Admission prefill produced the request's first token."""
@@ -200,6 +243,10 @@ class ServerTelemetry:
             return
         t = self.clock.now()
         st.t_first = t
+        if st.t_admit is not None:
+            # the wait that ended at the SUCCESSFUL admission (deferred
+            # attempts updated t_admit and observed nothing)
+            self._h_wait.observe(st.t_admit - st.t_submit)
         if st.prefill_span is not None:
             st.prefill_span.end(prefill_tokens=prefill_tokens,
                                 prefix_hit_tokens=prefix_hit_tokens)
@@ -278,12 +325,34 @@ class ServerTelemetry:
             self._g_active.set(n)
 
     # ------------------------------------------------------- cache state
-    def set_pool(self, free, live, pinned):
+    def set_pool(self, free, live, pinned, cached=0):
         if not self.enabled:
             return
         self._g_pool_free.set(free)
         self._g_pool_live.set(live)
         self._g_pool_pinned.set(pinned)
+        self._g_pool_cached.set(cached)
+        self._g_pfx_cached.set(cached)
+
+    def on_prefix_auto(self, hit, tokens):
+        """One automatic (radix-tree) prefix lookup at admission:
+        ``hit`` when the tree supplied pages beyond any registered
+        match, covering ``tokens`` prompt tokens."""
+        if not self.enabled:
+            return
+        if hit:
+            self._c_pfx_auto_hit.inc()
+            self._g_pfx_hit_tokens.set(tokens)
+        else:
+            self._c_pfx_auto_miss.inc()
+
+    def on_prefix_donate(self, pages):
+        if self.enabled and pages:
+            self._c_pfx_donated.inc(pages)
+
+    def on_prefix_evict(self, pages):
+        if self.enabled and pages:
+            self._c_pfx_evicted.inc(pages)
 
     def add_null_writes(self, n):
         if self.enabled and n:

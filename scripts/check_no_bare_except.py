@@ -1,12 +1,15 @@
 #!/usr/bin/env python
-"""Lint: no bare ``except:`` clauses inside paddle_tpu/.
+"""Lint: no bare ``except:`` clauses in paddle_tpu/, benchmarks/, or
+scripts/.
 
 A bare except swallows KeyboardInterrupt/SystemExit and — worse for a
 reliability layer — erases the TYPE of the failure, which is the whole
 contract (clients branch on ``ReliabilityError`` subclasses; the chaos
-suites assert on them). ``except Exception`` is the floor.
+suites assert on them). ``except Exception`` is the floor. Benchmarks
+and tooling are covered too: a bench that swallows its own failure
+reports numbers for work that never ran.
 
-Usage: python scripts/check_no_bare_except.py [root]
+Usage: python scripts/check_no_bare_except.py [root ...]
 Exit status 1 lists every offending file:line. Wired into the test
 suite (tests/test_train_reliability.py) so a regression fails tier-1.
 """
@@ -39,17 +42,21 @@ def bare_excepts(root):
     return hits
 
 
+DEFAULT_DIRS = ("paddle_tpu", "benchmarks", "scripts")
+
+
 def main(argv):
-    root = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "paddle_tpu")
-    hits = bare_excepts(root)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = argv[1:] or [os.path.join(repo, d) for d in DEFAULT_DIRS]
+    hits = []
+    for root in roots:
+        hits += bare_excepts(root)
     for path, line in hits:
         print(f"{path}:{line}: bare 'except:' — name the exception type "
               "(at least 'except Exception')")
     if hits:
         return 1
-    print(f"OK: no bare excepts under {root}")
+    print(f"OK: no bare excepts under {', '.join(roots)}")
     return 0
 
 

@@ -6,8 +6,8 @@ Contracts under 10-30% injected failure rates:
 - the server RECOVERS: breaker closed, later requests succeed;
 - every wait() resolves to a result or a TYPED error (no wedged
   waiters, no raw thread death);
-- the paged pool never leaks: free + pinned == usable pool once
-  drained, across every failure path;
+- the paged pool never leaks: free + pinned + cached == usable pool
+  once drained, across every failure path;
 - same seed => identical injection trace AND identical final state.
 
 Everything runs on the StubModel double with zero-delay retry policies
@@ -106,9 +106,9 @@ class TestChaos:
                                       stub_tokens(p, 4))
         assert srv.health == "healthy"
         srv.stop()
-        free, live, pinned = srv.pool_balance()
+        free, live, pinned, cached = srv.pool_balance()
         assert live == 0, f"leaked {live} pages"
-        assert free + pinned == srv._kv.num_pages - 1
+        assert free + pinned + cached == srv._kv.num_pages - 1
 
     def test_chaos_with_prefix_pinning_no_leaks(self):
         """Injected admission failures must roll back cleanly even when
@@ -127,9 +127,9 @@ class TestChaos:
             if rid in outs:
                 np.testing.assert_array_equal(outs[rid],
                                               stub_tokens(p, 4))
-        free, live, pinned = srv.pool_balance()
+        free, live, pinned, cached = srv.pool_balance()
         assert live == 0 and pinned == 1         # only the prefix pin
-        assert free + pinned == srv._kv.num_pages - 1
+        assert free + pinned + cached == srv._kv.num_pages - 1
 
     def test_same_seed_identical_trace_and_state(self):
         """Satellite: two chaos runs with the same seed produce
@@ -189,7 +189,7 @@ class TestChaos:
         assert done + failed == len(rids)
         assert srv.health == "healthy"           # engine never degraded
         srv.stop()
-        free, live, pinned = srv.pool_balance()
+        free, live, pinned, cached = srv.pool_balance()
         assert live == 0
 
     def test_breaker_storm_then_full_recovery(self):
@@ -220,5 +220,5 @@ class TestChaos:
                                       stub_tokens(p, 4))
         assert srv.health == "healthy"
         srv.stop()
-        free, live, pinned = srv.pool_balance()
+        free, live, pinned, cached = srv.pool_balance()
         assert live == 0

@@ -97,7 +97,7 @@ class TestDeadlines:
         fc.advance(2.0)
         srv.step()
         srv.run()
-        free, live, pinned = srv.pool_balance()
+        free, live, pinned, cached = srv.pool_balance()
         assert live == 0 and pinned == 0
         assert rid is not None
 

@@ -306,9 +306,11 @@ class TestSupervisorLoop:
         assert full[3:] == rep.losses
 
     def test_restore_state_can_leave_global_rng_alone(self, tmp_path):
-        """restore_state(restore_rng=False): fit's model-state-only
-        anomaly rollback keeps moving FORWARD through data — rewinding
-        the global stream there would replay past subkeys."""
+        """restore_state(restore_rng=False) is for callers doing a
+        model-state-only rollback that keeps moving FORWARD through
+        data (rewinding the global stream there would replay past
+        subkeys); fit and the standalone loop both roll back the full
+        cursor and use the default."""
         from paddle_tpu.core import random as _random
         sup = TrainSupervisor(str(tmp_path), save_interval_steps=1)
         pt.seed(41)
@@ -554,6 +556,73 @@ class TestSupervisedFit:
                               verbose=0, callbacks=[self._Rec()],
                               supervisor=sup)
 
+    def test_fit_rollback_replays_same_batches_bit_exact(self, tmp_path):
+        """ISSUE 5 satellite (PR 4 scope cut): a NaN rollback restores
+        the DATA CURSOR and rng chain alongside model state, so the
+        rolled-back run replays the same batches from the same state
+        and its committed losses bit-match a clean run. (Before: the
+        rollback kept moving forward in data, silently skipping the
+        batches between the checkpoint and the anomaly.)"""
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((48, 4)).astype(np.float32)
+        y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+
+        class Transient:
+            """Batches 4-5 corrupt until the rollback 'repairs' the
+            pipeline — a transient data fault, not a poisoned
+            dataset (which must replay into the same wall and abort
+            typed instead)."""
+            healed = False
+
+            def __len__(self):
+                return 48
+
+            def __getitem__(self, i):
+                if not Transient.healed and i >= 32:
+                    return x[i], np.full((1,), np.nan, np.float32)
+                return x[i], y[i]
+
+        clean = self._Rec()
+        self._model().fit(TensorDataset([x, y]), batch_size=8, epochs=1,
+                          shuffle=False, verbose=0, callbacks=[clean],
+                          supervisor=TrainSupervisor(
+                              str(tmp_path / "a"), save_interval_steps=2))
+        assert len(clean.losses) == 6
+        sup = TrainSupervisor(
+            str(tmp_path / "b"), save_interval_steps=2,
+            anomaly=AnomalyPolicy(max_consecutive=2, max_rollbacks=1))
+        rec = self._Rec(hook=lambda n: (sup.rollbacks
+                                        and setattr(Transient, "healed",
+                                                    True)))
+        Transient.healed = False
+        self._model().fit(Transient(), batch_size=8, epochs=1,
+                          shuffle=False, verbose=0, callbacks=[rec],
+                          supervisor=sup)
+        assert sup.rollbacks == 1 and sup.anomalies == 2
+        committed = [l for l in rec.losses if np.isfinite(l)]
+        # 4 committed before the anomaly burst + the REPLAYED batches
+        # 4 and 5 — identical to the uninterrupted run, bit for bit
+        assert committed == clean.losses
+
+    def test_fit_persistent_nan_replays_into_wall_and_aborts(self,
+                                                             tmp_path):
+        """With the cursor restored, a DETERMINISTIC data anomaly
+        replays after rollback, burns the budget, and aborts typed —
+        it can no longer be silently skipped over by drifting forward
+        in data."""
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((24, 4)).astype(np.float32)
+        y = (x.sum(-1, keepdims=True) > 0).astype(np.float32)
+        y[8:] = np.nan                       # batches 1-2 always poisoned
+        sup = TrainSupervisor(
+            str(tmp_path), save_interval_steps=1,
+            anomaly=AnomalyPolicy(max_consecutive=2, max_rollbacks=1))
+        with pytest.raises(TrainAnomalyError):
+            self._model().fit(TensorDataset([x, y]), batch_size=8,
+                              epochs=2, shuffle=False, verbose=0,
+                              supervisor=sup)
+        assert sup.rollbacks == 1
+
     def test_guarded_step_rebuilds_when_check_grads_changes(self):
         m = self._model()
         m._build_guarded_step(check_grads=True)
@@ -716,17 +785,21 @@ class TestTrainEpochRangeAtomic:
 
 
 class TestNoBareExcept:
-    def test_lint_clean_on_package(self):
+    def test_lint_clean_on_package_benchmarks_and_scripts(self):
         """Satellite: scripts/check_no_bare_except.py stays green over
-        paddle_tpu/ (wired here so a regression fails tier-1)."""
+        every directory it now covers — paddle_tpu/, benchmarks/ and
+        scripts/ (wired here so a regression fails tier-1)."""
         from importlib import util
         spec = util.spec_from_file_location(
             "check_no_bare_except",
             os.path.join(REPO, "scripts", "check_no_bare_except.py"))
         mod = util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        hits = mod.bare_excepts(os.path.join(REPO, "paddle_tpu"))
-        assert hits == [], f"bare excepts found: {hits}"
+        assert mod.DEFAULT_DIRS == ("paddle_tpu", "benchmarks",
+                                    "scripts")
+        for d in mod.DEFAULT_DIRS:
+            hits = mod.bare_excepts(os.path.join(REPO, d))
+            assert hits == [], f"bare excepts found in {d}: {hits}"
 
     def test_lint_flags_a_bare_except(self, tmp_path):
         from importlib import util
