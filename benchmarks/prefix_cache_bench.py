@@ -1,15 +1,30 @@
-"""Automatic prefix caching on the paged serving stack (ISSUE 5).
+"""Automatic prefix caching + ragged prefill on the paged serving stack
+(ISSUES 5 + 6).
 
 Drives a shared-system-prompt workload — the canonical serving shape:
 every request is ``system_prompt + short user tail`` — through
-``ContinuousBatchingServer(cache_backend="paged")`` twice, with
-``auto_prefix_cache`` OFF and ON, and reports:
+``ContinuousBatchingServer(cache_backend="paged")`` in three modes:
 
-- auto hit rate (hits / requests; the first request per unique prefix
-  run is necessarily cold),
+- ``auto off``   no prefix reuse, dense per-admission prefill,
+- ``dense  on``  auto prefix cache + the PR-5 dense prefill path (every
+  auto hit pays the page-gather -> dense-seed -> scatter detour),
+- ``ragged on``  auto prefix cache + batched ragged prefill straight
+  into pool pages (ISSUE 6, the paged default),
+
+and reports:
+
+- steady-state auto hit rate: hits / (requests - expected cold misses).
+  The warmup admissions are submitted together BEFORE any donation has
+  happened, so each is a structurally-guaranteed miss (BENCHNOTES
+  Round 7 recorded them as "4 misses" without the exclusion) — the raw
+  rate is printed alongside,
 - prefill tokens per mode and the tokens SAVED by page reuse (the
-  counter-backed number that generalizes — host wall time on a CPU
-  bench is dominated by XLA dispatch, not the avoided FLOPs),
+  counter-backed number that generalizes),
+- admission-path DISPATCHES per admission (``prefill_dispatches`` /
+  ``admissions``) — the ISSUE 6 acceptance signal: ragged must drop
+  this vs the dense-on baseline,
+- TTFT p50/p99 (measured at the first ``on_token`` callback) and the
+  prefill wall-clock split (``prefill_wall_s``) per mode,
 - cached/pinned/free page occupancy at drain, plus eviction churn when
   ``--num-pages`` squeezes the pool,
 - drain wall time per mode (best of N reps, compiles warmed first;
@@ -17,7 +32,7 @@ every request is ``system_prompt + short user tail`` — through
 
     python benchmarks/prefix_cache_bench.py [--requests N]
         [--system-tokens N] [--tail-tokens N] [--new-tokens N]
-        [--slots N] [--num-pages N] [--reps N]
+        [--slots N] [--num-pages N] [--reps N] [--budget N]
 """
 import argparse
 import os
@@ -47,25 +62,53 @@ def _prompts(args):
          .astype(np.int32)]) for _ in range(args.requests)]
 
 
-def _drain(model, prompts, args, auto):
+def _drain(model, prompts, args, auto, prefill_mode):
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
     srv = ContinuousBatchingServer(
         model, max_slots=args.slots, max_cache_len=args.max_cache_len,
         cache_backend="paged", page_size=args.page_size,
-        num_pages=args.num_pages, auto_prefix_cache=auto)
+        num_pages=args.num_pages, auto_prefix_cache=auto,
+        prefill_mode=prefill_mode,
+        prefill_tokens_per_tick=args.budget)
     for p in prompts[:args.slots]:                  # warm the compiles
         srv.submit(p, max_new_tokens=2)
     srv.run()
+    for p in prompts[:2]:       # warm the HIT path's programs too (the
+        srv.submit(p, max_new_tokens=2)   # remainder chunk geometry
+    srv.run()                             # differs from the cold one)
     best = float("inf")
+    ttfts = []
     for _ in range(args.reps):
+        first_seen = {}
+
+        def on_token(rid, toks):
+            if rid not in first_seen:
+                first_seen[rid] = time.perf_counter()
+
         t0 = time.perf_counter()
-        rids = [srv.submit(p, max_new_tokens=args.new_tokens)
-                for p in prompts]
+        submits = {srv.submit(p, max_new_tokens=args.new_tokens,
+                              on_token=on_token): time.perf_counter()
+                   for p in prompts}
         outs = srv.run()
         best = min(best, time.perf_counter() - t0)
-        assert all(r in outs for r in rids)
-    return best, srv
+        assert all(r in outs for r in submits)
+        ttfts += [first_seen[r] - t for r, t in submits.items()
+                  if r in first_seen]
+    return best, ttfts, srv
+
+
+def _row(name, t_wall, ttfts, srv):
+    s = srv.stats
+    disp = s["prefill_dispatches"] / max(s["admissions"], 1)
+    p50, p99 = (np.percentile(ttfts, 50) * 1e3,
+                np.percentile(ttfts, 99) * 1e3) if ttfts else (0, 0)
+    print(f"{name:10s}: prefill {s['prefill_tokens']:6d} tok, "
+          f"{disp:5.2f} disp/admission, "
+          f"prefill wall {s['prefill_wall_s'] * 1e3:7.1f} ms, "
+          f"TTFT p50 {p50:6.1f} / p99 {p99:6.1f} ms, "
+          f"drain best {t_wall * 1e3:7.1f} ms")
+    return disp
 
 
 def main():
@@ -79,37 +122,58 @@ def main():
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--num-pages", type=int, default=None)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--budget", type=int, default=None,
+                    help="prefill_tokens_per_tick (ragged mode)")
     args = ap.parse_args()
 
     model = _build_model()
     prompts = _prompts(args)
-    t_off, off = _drain(model, prompts, args, auto=False)
-    t_on, on = _drain(model, prompts, args, auto=True)
+    t_off, tt_off, off = _drain(model, prompts, args, auto=False,
+                                prefill_mode="dense")
+    t_dn, tt_dn, dense_on = _drain(model, prompts, args, auto=True,
+                                   prefill_mode="dense")
+    t_rg, tt_rg, ragged = _drain(model, prompts, args, auto=True,
+                                 prefill_mode="ragged")
 
-    n_req = args.requests * args.reps + args.slots  # incl. warmup
-    hits = on.stats["prefix_auto_hits"]
-    hit_tok = on.stats["prefix_auto_hit_tokens"]
-    saved = off.stats["prefill_tokens"] - on.stats["prefill_tokens"]
-    free, live, pinned, cached = on.pool_balance()
+    # total admissions incl. warmup, derived from what _drain actually
+    # submits (prompts[:slots] cold + prompts[:2] hit-path warmers —
+    # both clamp when --requests is small)
+    warm = min(args.requests, args.slots)   # pre-donation => cold
+    n_req = args.requests * args.reps + warm + min(args.requests, 2)
     shared_run = args.system_tokens // args.page_size * args.page_size
 
     print(f"workload: {args.requests} requests x {args.reps} reps "
-          f"(+{args.slots} warmup), system {args.system_tokens} tok "
+          f"(+{warm} warmup), system {args.system_tokens} tok "
           f"(shared page run {shared_run}), tail {args.tail_tokens}, "
           f"{args.new_tokens} new")
-    print(f"auto hit rate     : {hits}/{n_req} = {hits / n_req:.2f}  "
-          f"({hit_tok} tokens served from cached pages)")
-    print(f"prefill tokens    : off {off.stats['prefill_tokens']}, "
-          f"on {on.stats['prefill_tokens']}  (saved {saved}, "
-          f"{saved / max(off.stats['prefill_tokens'], 1) * 100:.0f}%)")
-    print(f"pool at drain     : free {free}, live {live}, "
-          f"pinned {pinned}, cached {cached} "
-          f"(evicted {on._prefix.evicted_pages_total}, "
-          f"donated {on._prefix.donated_pages_total})")
-    print(f"drain wall (best) : off {t_off * 1e3:8.1f} ms, "
-          f"on {t_on * 1e3:8.1f} ms  (counters are the signal; CPU "
-          f"wall time is dispatch-dominated)")
-    ok = hits >= (n_req - 1) * 0.9 and saved > 0 and live == 0
+    _row("auto off", t_off, tt_off, off)
+    d_dn = _row("dense  on", t_dn, tt_dn, dense_on)
+    d_rg = _row("ragged on", t_rg, tt_rg, ragged)
+
+    ok = True
+    for name, srv in (("dense", dense_on), ("ragged", ragged)):
+        hits = srv.stats["prefix_auto_hits"]
+        steady = hits / max(n_req - warm, 1)
+        print(f"{name:6s} hit rate  : steady-state {hits}/{n_req - warm}"
+              f" = {steady:.2f}  (raw {hits}/{n_req} = "
+              f"{hits / n_req:.2f}; the {warm} warmup admissions are "
+              f"structurally cold)")
+        saved = off.stats["prefill_tokens"] - srv.stats["prefill_tokens"]
+        print(f"{name:6s} saved     : {saved} prefill tokens "
+              f"({saved / max(off.stats['prefill_tokens'], 1) * 100:.0f}"
+              f"% of cold)")
+        free, live, pinned, cached = srv.pool_balance()
+        print(f"{name:6s} pool      : free {free}, live {live}, pinned "
+              f"{pinned}, cached {cached} (evicted "
+              f"{srv._prefix.evicted_pages_total}, donated "
+              f"{srv._prefix.donated_pages_total})")
+        ok = ok and steady >= 0.95 and saved > 0 and live == 0
+    # ISSUE 6 acceptance: ragged kills the auto-hit dispatch detour
+    print(f"dispatch ratio    : ragged {d_rg:.2f} vs dense-on {d_dn:.2f}"
+          f" per admission ({'OK' if d_rg < d_dn else 'REGRESSION'}; "
+          f"counters are the signal, CPU wall time is "
+          f"dispatch-dominated)")
+    ok = ok and d_rg < d_dn
     return 0 if ok else 1
 
 

@@ -14,6 +14,7 @@ steps; the host only assigns slots, harvests finished rows, and swaps
 new prompts in — O(requests), not O(tokens), host work.
 """
 import threading
+import time as _time_mod
 
 import numpy as np
 
@@ -48,7 +49,8 @@ class _Pending:
 
 class _Slot:
     __slots__ = ("rid", "ids", "prompt_len", "budget", "emitted",
-                 "on_token", "streamed", "deadline")
+                 "on_token", "streamed", "deadline", "phase", "fill_pos",
+                 "filled", "n_pre", "seed")
 
     def __init__(self, rid, ids, prompt_len, budget, on_token=None,
                  deadline=None):
@@ -60,6 +62,14 @@ class _Slot:
         self.on_token = on_token
         self.streamed = 0             # tokens already sent to on_token
         self.deadline = deadline      # absolute clock time, or None
+        # ragged-prefill lifecycle (dense admission completes prefill
+        # atomically, so its slots are born in the "decode" phase with
+        # the whole prompt marked filled)
+        self.phase = "decode"         # "prefill" until first token
+        self.fill_pos = prompt_len    # next prompt position to prefill
+        self.filled = prompt_len      # prompt rows actually written
+        self.n_pre = 0                # prefix-cache tokens reused
+        self.seed = 0                 # sampling chain seed
 
     def stream(self, sink):
         """Queue this slot's unstreamed chunk on ``sink``; the server
@@ -109,6 +119,21 @@ class ContinuousBatchingServer:
     bit-identical to cold runs). ``register_prefix`` entries live in
     the same tree as PINNED nodes that eviction never touches.
 
+    Paged serving prefills RAGGED by default (``prefill_mode="ragged"``):
+    admissions only reserve pages, and every tick runs the next chunk
+    of ALL mid-prefill slots as ONE packed launch straight into pool
+    pages (ops/pallas/ragged_prefill.py) — several admissions per tick,
+    no dense batch-1 seed/gather/scatter detour on prefix-cache hits,
+    and Sarathi-style interleaving: ``prefill_tokens_per_tick`` (default
+    ``max_cache_len``) bounds the prefill work done per tick so a long
+    prompt streams in across ticks while in-flight slots keep decoding
+    every tick. ``max_admissions_per_tick`` caps reservations per
+    scheduling pass; ``prefill_mode="dense"`` restores the PR-5
+    per-admission dense prefill (the dispatch-count baseline;
+    ``prefill_chunk`` only applies there and to ``register_prefix``).
+    Tokens are bit-identical across all three of dense backend, paged+
+    dense prefill, and paged+ragged prefill.
+
     ``telemetry`` (``paddle_tpu.telemetry.ServerTelemetry``, or ``True``
     for a default one) turns on SLO instrumentation: per-request
     lifecycle spans and TTFT/TPOT/queue-wait histograms, per-tick
@@ -135,7 +160,9 @@ class ContinuousBatchingServer:
                  eos_token_id=None, seed=0, weight_dtype=None,
                  prefill_chunk=None, mesh=None, tick_block=1,
                  cache_dtype=None, cache_backend="dense", page_size=16,
-                 num_pages=None, auto_prefix_cache=True, telemetry=None,
+                 num_pages=None, auto_prefix_cache=True,
+                 prefill_mode=None, prefill_tokens_per_tick=None,
+                 max_admissions_per_tick=None, telemetry=None,
                  max_queue=None, shed_policy="reject", retry_policy=None,
                  breaker=None, fault_injector=None, clock=None):
         self.model = model
@@ -196,10 +223,56 @@ class ContinuousBatchingServer:
                                        fault_injector=fault_injector)
             self._kv.reclaimer = self._reclaim_pages
             self._auto_prefix = bool(auto_prefix_cache)
+            self._ragged_fn = (self._paged_bundle[5]
+                               if len(self._paged_bundle) > 5 else None)
         else:
             self._caches = self._init_caches(self.max_slots)
             self._prefix = None
             self._auto_prefix = False
+            self._ragged_fn = None
+        # ------------------------------------------------ prefill mode
+        # "ragged" (the paged default): admissions reserve pages only;
+        # their prompt chunks run BATCHED as one ragged launch per tick
+        # straight into pool pages — no dense batch-1 seed/gather/
+        # scatter detour — interleaved with decode under a token budget.
+        # "dense" keeps the PR-5 per-admission dense prefill (the only
+        # mode for the dense cache backend, and the baseline the
+        # benchmarks compare dispatch counts against).
+        if prefill_mode is None:
+            prefill_mode = "ragged" if self._ragged_fn is not None \
+                else "dense"
+        if prefill_mode not in ("dense", "ragged"):
+            raise ValueError(f"prefill_mode must be 'dense' or 'ragged',"
+                             f" got {prefill_mode!r}")
+        if prefill_mode == "ragged":
+            if cache_backend != "paged":
+                raise ValueError("prefill_mode='ragged' needs "
+                                 "cache_backend='paged' (prefill writes "
+                                 "straight into pool pages)")
+            if self._ragged_fn is None:
+                raise ValueError(
+                    "prefill_mode='ragged' but this model's paged "
+                    "decode bundle has no ragged-prefill entry point "
+                    "(6th element); use prefill_mode='dense'")
+        self.prefill_mode = prefill_mode
+        self._ragged = prefill_mode == "ragged"
+        if prefill_tokens_per_tick is None:
+            prefill_tokens_per_tick = self.max_cache_len
+        self._prefill_budget = int(prefill_tokens_per_tick)
+        if self._prefill_budget < 1:
+            raise ValueError("prefill_tokens_per_tick must be >= 1")
+        self._admit_cap = None if max_admissions_per_tick is None \
+            else int(max_admissions_per_tick)
+        if self._admit_cap is not None and self._admit_cap < 1:
+            raise ValueError("max_admissions_per_tick must be >= 1 "
+                             "(0 would admit nothing, forever)")
+        self._prefill_fifo = []   # slot ids mid-prefill, admission order
+        self._prefill_used = 0    # tokens prefilled this tick
+        # slot-state updates batched into one device push per array per
+        # tick (the dense path paid 3 dispatches per admission)
+        self._pending_tok = {}
+        self._pending_t = {}
+        self._pending_key = {}
         self._tok = jnp.zeros((self.max_slots,), jnp.int32)
         self._t = jnp.zeros((self.max_slots,), jnp.int32)
         self._active = np.zeros((self.max_slots,), bool)   # host-side
@@ -210,7 +283,9 @@ class ContinuousBatchingServer:
         self._decode_jit = None
         self._prefixes = []   # [(ids, cache_rows, last_logits, pages)]
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0,
-                      "prefix_auto_hits": 0, "prefix_auto_hit_tokens": 0}
+                      "prefix_auto_hits": 0, "prefix_auto_hit_tokens": 0,
+                      "admissions": 0, "prefill_dispatches": 0,
+                      "prefill_wall_s": 0.0}
         # telemetry (paddle_tpu.telemetry.ServerTelemetry): True builds
         # a default-enabled one; None (default) keeps the hot path at
         # a single attribute check — no locks, no clock reads
@@ -275,11 +350,13 @@ class ContinuousBatchingServer:
                 if (pre_ids.shape[0] == T
                         and np.array_equal(pre_ids, ids)):
                     return T
-            if self._prefill_chunk:
+            if self._prefill_chunk and not self._ragged:
                 # a queued request was bound-checked at submit against
                 # the prefixes registered THEN; refuse a new prefix
                 # whose remainder-chunk pad would overflow its rows
-                # mid-admission (ADVICE r5 #2)
+                # mid-admission (ADVICE r5 #2). Ragged admission never
+                # pads a remainder (chunking is the per-tick token
+                # budget, cut at any position), so no such hazard.
                 for item in self._queue:
                     q_ids = item.ids
                     Tq = q_ids.shape[0]
@@ -302,7 +379,15 @@ class ContinuousBatchingServer:
             self.stats["prefill_tokens"] += T
             if self._tele is not None:
                 self._tele.add_prefill_tokens(T)
-            rows = jax.tree_util.tree_map(lambda c: c[:, :, :T], caches1)
+            # dense prefill mode seeds admissions from these retained
+            # rows/logits; ragged mode matches through the pinned tree
+            # pages alone and never reads them — retaining a full
+            # per-layer dense KV copy of the prefix for the server's
+            # lifetime would be pure HBM waste there
+            rows = None if self._ragged else jax.tree_util.tree_map(
+                lambda c: c[:, :, :T], caches1)
+            if self._ragged:
+                logits = None
             pages, run, own, pin_delta = [], [], [], 0
             if self._kv is not None:
                 # store the prefix's FULL pages once in the pool; every
@@ -407,15 +492,19 @@ class ContinuousBatchingServer:
             if deadline_s is not None and deadline_s <= 0:
                 raise DeadlineExceeded(
                     f"deadline_s={deadline_s} is already expired")
-            hit = self._match_prefix(ids)
+            hit = None if self._ragged else self._match_prefix(ids)
             pad = 0
-            if self._prefill_chunk:
+            if self._prefill_chunk and not self._ragged:
                 # a registered-prefix hit prefills only the REMAINDER at
                 # t0=n, whose own chunk pad can exceed the full-prompt
                 # pad (ADVICE r5 #2). Longest match wins at admission,
                 # prefixes are never removed, and register_prefix
                 # refuses new ones that would strand a queued request —
-                # so the CURRENT longest match decides the bound.
+                # so the CURRENT longest match decides the bound. The
+                # RAGGED path never pads: prompts are chunked by the
+                # per-tick token budget at arbitrary cut points, so the
+                # only bound is prompt + budget (prefill_chunk is
+                # ignored at ragged admission).
                 pad = self._chunk_pad(T - hit[0].shape[0]) \
                     if hit is not None else self._chunk_pad(T)
             if max(T + max_new_tokens, T + pad) > self.max_cache_len:
@@ -429,8 +518,16 @@ class ContinuousBatchingServer:
                 # full-extent reservation (prompt + budget): a request
                 # that can never fit must fail HERE, not stall the FIFO
                 # forever — pool minus prefix-pinned pages, minus the
-                # pinned pages this request would itself share
-                need = self._request_pages(ids, int(max_new_tokens), hit)
+                # pinned pages this request would itself share. Ragged
+                # mode matches through the tree: only the PINNED run is
+                # stable enough to count at submit time (donated pages
+                # can be evicted before admission).
+                if self._ragged:
+                    need = self._npages_for(T + int(max_new_tokens)) \
+                        - self._pinned_run_pages(ids)
+                else:
+                    need = self._request_pages(ids, int(max_new_tokens),
+                                               hit)
                 usable = self._kv.num_pages - 1 \
                     - self._prefix.pinned_pages
                 if need > usable:
@@ -495,7 +592,10 @@ class ContinuousBatchingServer:
                 return True
         for slot in range(self.max_slots):
             st = self._slots[slot]
-            if self._active[slot] and st.rid == rid:
+            if st is not None and st.rid == rid:
+                # covers decoding AND mid-ragged-prefill slots (the
+                # latter record an empty partial; their filled prefix
+                # pages are still donated)
                 self._finish_partial_locked(slot)
                 if self._tele is not None:
                     self._tele.on_cancel(rid)
@@ -519,6 +619,8 @@ class ContinuousBatchingServer:
         st = self._slots[slot]
         self._active[slot] = False
         self._slots[slot] = None
+        if slot in self._prefill_fifo:
+            self._prefill_fifo.remove(slot)
         if self._kv is None:
             return
         pages = self._kv.detach_slot(slot)
@@ -526,7 +628,11 @@ class ContinuousBatchingServer:
             return
         if self._auto_prefix and st is not None:
             try:
-                new = self._prefix.donate(st.ids, pages, st.prompt_len)
+                # only prompt rows actually WRITTEN are donated: a slot
+                # torn down mid-ragged-prefill (deadline, cancel, fault)
+                # caches its filled prefix, never unwritten pages
+                n_known = min(st.prompt_len, st.filled)
+                new = self._prefix.donate(st.ids, pages, n_known)
             except Exception:
                 self._kv.release(pages)
             else:
@@ -571,7 +677,12 @@ class ContinuousBatchingServer:
         into a dense batch-1 cache covering [0, len(pages) *
         page_size) — the auto-hit remainder prefill attends to these
         rows. The decode program reads the SAME pages through the block
-        table, so the pool copy stays the single source of truth."""
+        table, so the pool copy stays the single source of truth.
+        DENSE prefill mode only: the ragged path attends over cached
+        pages through the block table directly, so an auto hit costs
+        zero extra dispatches (BENCHNOTES Round 7 measured this
+        gather→dense→scatter round-trip exceeding the saved FLOPs on
+        small models)."""
         pg = self._kv.page_size
         n = len(pages) * pg
         idx = jnp.asarray(np.asarray(pages, np.int32))
@@ -646,7 +757,18 @@ class ContinuousBatchingServer:
         ``max_cache_len`` (submit() bound-checked the pad against the
         hits known THEN; the tree moves underneath queued requests),
         and capped one token short of the prompt — the remainder
-        prefill must emit the first-token logits."""
+        prefill must emit the first-token logits.
+
+        RAGGED mode matches through the tree alone: register_prefix
+        entries already live in it as pinned nodes, so a registered hit
+        reuses its page-aligned run (the sub-page tail re-prefills with
+        the remainder — recomputation is deterministic, tokens are
+        unchanged) and the stored dense rows are never touched. No
+        chunk-pad trim either: ragged remainders never pad."""
+        if self._ragged:
+            T = int(ids.shape[0])
+            tree = self._prefix.lookup(ids, T - 1)
+            return None if tree is None else ("tree", tree)
         reg = self._match_prefix(ids)
         best = None if reg is None else ("reg", reg)
         if self._auto_prefix:
@@ -660,6 +782,20 @@ class ContinuousBatchingServer:
             if tree is not None and tree.tokens > reg_n:
                 best = ("tree", tree)
         return best
+
+    def _pinned_run_pages(self, ids):
+        """Pages of the PINNED (register_prefix) tree run this prompt
+        would share — the stable floor on page reuse a ragged-mode
+        submit may count (capped at T-1 like ``_best_hit``'s lookup, so
+        the remainder prefill keeps its first-token row)."""
+        T = int(ids.shape[0])
+        aligned = (T - 1) // self._kv.page_size * self._kv.page_size
+        n = 0
+        for nd in self._prefix.node_run(ids[:aligned]):
+            if not nd.pinned:
+                break
+            n += 1
+        return n
 
     def _request_pages(self, ids, budget, hit):
         """Fresh pages a request needs for its FULL extent (prompt +
@@ -694,14 +830,26 @@ class ContinuousBatchingServer:
         return -(-int(n_tokens) // self._kv.page_size)
 
     # ------------------------------------------------------- scheduling
-    def _admit(self):
-        """Fill free slots from the queue (one prefill program each).
-        A request whose admission raises is recorded in ``_failures``
-        (its waiters get the error) instead of killing the serve thread
-        or losing the rest of the queue (ADVICE r5 #2)."""
+    def _admit(self, run_prefill=True):
+        """Fill free slots from the queue. Dense prefill mode: one
+        dense batch-1 prefill program per admission (the PR-5 path).
+        Ragged mode: admissions only RESERVE their slot + full page
+        extent here (cheap, host-side); the actual prompt chunks run
+        batched in ``_prefill_tick`` — several admissions, one launch,
+        straight into pool pages — interleaved with decode under the
+        per-tick token budget. A request whose admission raises is
+        recorded in ``_failures`` (its waiters get the error) instead
+        of killing the serve thread or losing the rest of the queue
+        (ADVICE r5 #2)."""
+        if self._ragged:
+            self._admit_ragged(run_prefill)
+            return
+        admitted = 0
         for slot in range(self.max_slots):
-            if self._active[slot] or not self._queue:
+            if self._slots[slot] is not None or not self._queue:
                 continue
+            if self._admit_cap is not None and admitted >= self._admit_cap:
+                break
             # one _best_hit per admission attempt: the radix walk (and
             # registered-prefix scan) feeds the fits check AND the
             # admission itself — same lock, same tick, the tree cannot
@@ -741,8 +889,240 @@ class ContinuousBatchingServer:
                 if self._tele is not None:
                     self._tele.on_admission_failure(rid, e)
                 self._done_cv.notify_all()
+            else:
+                admitted += 1
         if self._tele is not None:
             self._pool_gauges()
+
+    def _admit_ragged(self, run_prefill=True):
+        """Ragged-mode scheduling pass: pop queued requests into free
+        slots (reservation only — ``admit_slot`` takes the full
+        prompt + budget extent, shared cache-hit pages by reference),
+        then run one batched ragged prefill launch over every slot with
+        prompt rows still to write. OutOfPages DEFERS the head request
+        exactly like the dense path; nothing is prefilled for a
+        deferred reservation, so counters see each admission once."""
+        admitted = 0
+        for slot in range(self.max_slots):
+            if not self._queue:
+                break
+            if self._admit_cap is not None and admitted >= self._admit_cap:
+                break
+            if self._slots[slot] is not None:
+                continue
+            best = self._best_hit(self._queue[0].ids)
+            if not self._head_fits_pool(best):
+                break
+            req = self._queue.pop(0)
+            if self._tele is not None:
+                self._tele.on_admit(req.rid, len(self._queue))
+            try:
+                self._reserve_one(slot, req, best)
+            except OutOfPages:
+                # eviction could not free enough right now (an injected
+                # ``prefix.evict`` fault aborted the sweep): the request
+                # returns to the head of the queue (FIFO preserved) and
+                # is retried next tick — admit_slot rolled its own
+                # shared-page refs back, nothing was prefilled
+                self._queue.insert(0, req)
+                if self._tele is not None:
+                    self._tele.on_admission_deferred(req.rid,
+                                                     len(self._queue))
+                break
+            except Exception as e:
+                if self._kv.slot_pages(slot):
+                    self._kv.free_slot(slot)     # roll back a part-admit
+                self._active[slot] = False
+                self._slots[slot] = None
+                if slot in self._prefill_fifo:
+                    self._prefill_fifo.remove(slot)
+                self._failures[req.rid] = e
+                if self._tele is not None:
+                    self._tele.on_admission_failure(req.rid, e)
+                self._done_cv.notify_all()
+            else:
+                admitted += 1
+        if run_prefill:
+            self._prefill_tick()
+        if self._tele is not None:
+            self._pool_gauges()
+
+    def _reserve_one(self, slot, req, best):
+        """Reserve ``slot`` for ``req``: full-extent page reservation
+        (prompt + budget, cache-hit pages joined by reference) and a
+        prefill-phase slot record. No device work happens here — the
+        prompt's chunks run in ``_prefill_tick`` launches."""
+        if self._faults is not None:
+            # chaos failure point: an admission that dies is a
+            # PER-REQUEST failure (_admit_ragged records it), never a
+            # server one — and it fires BEFORE the reservation, so no
+            # pages need rolling back
+            self._faults.check(faults.PREFILL, rid=req.rid)
+        ids = req.ids
+        T = ids.shape[0]
+        if best is not None:
+            m = best[1]
+            n_pre, pre_pages = m.tokens, m.pages
+        else:
+            m, n_pre, pre_pages = None, 0, []
+        self._kv.admit_slot(slot, T + req.budget, pre_pages)
+        if m is not None:
+            self._prefix.use(m)               # LRU: reuse is recency
+            # attribution: pinned nodes are register_prefix state (the
+            # run's head — extend_pinned pins whole root paths), the
+            # unpinned tail is the automatic cache's
+            n_auto = n_pre - sum(1 for nd in m.nodes if nd.pinned) \
+                * self._kv.page_size
+        else:
+            n_auto = 0
+        self.stats["prefix_hit_tokens"] += n_pre
+        if n_auto:
+            self.stats["prefix_auto_hits"] += 1
+            self.stats["prefix_auto_hit_tokens"] += n_auto
+        if self._tele is not None and self._auto_prefix:
+            self._tele.on_prefix_auto(n_auto > 0, n_auto)
+        st = _Slot(req.rid, ids, T, req.budget, req.on_token,
+                   req.deadline)
+        st.phase = "prefill"
+        st.fill_pos = st.filled = n_pre
+        st.n_pre = n_pre
+        st.seed = req.seed
+        self._slots[slot] = st
+        self._prefill_fifo.append(slot)
+        # park the slot's decode write position past the block table:
+        # until activation, its wasted decode-step writes null-redirect
+        # (zeroed) instead of corrupting the pages being prefilled
+        self._pending_t[slot] = self.max_cache_len
+
+    def _prefill_tick(self):
+        """Run one batched ragged prefill launch: the next chunk of
+        every mid-prefill slot (head-of-FIFO first — Sarathi-style, the
+        oldest admission completes soonest), bounded by the per-tick
+        token budget so a long prompt cannot stall in-flight decode
+        ticks. Chunk width C is padded up a power-of-two ladder (min 2:
+        single-row matmuls take XLA's fused-reduce path and break
+        bit-parity with the dense prefill) so compiles stay
+        O(log max_cache_len)."""
+        budget = self._prefill_budget - self._prefill_used
+        if not self._prefill_fifo or budget <= 0:
+            return
+        plan = []                        # (slot, start, take)
+        used = 0
+        for slot in self._prefill_fifo:
+            if used >= budget:
+                break
+            st = self._slots[slot]
+            take = min(st.prompt_len - st.fill_pos, budget - used)
+            plan.append((slot, st.fill_pos, take))
+            used += take
+        if not plan:
+            return
+        self._prefill_used += used
+        C = max(2, 1 << (max(t for _, _, t in plan) - 1).bit_length())
+        S = self.max_slots
+        toks = np.zeros((S, C), np.int32)
+        t0 = np.full((S,), self.max_cache_len, np.int32)  # idle sentinel
+        out_idx = np.zeros((S,), np.int32)
+        done = []
+        for slot, start, take in plan:
+            st = self._slots[slot]
+            toks[slot, :take] = st.ids[start:start + take]
+            t0[slot] = start
+            if start + take == st.prompt_len:
+                out_idx[slot] = take - 1
+                done.append(slot)
+        self._sync_block_table()
+        tele = self._tele
+        t_started = tele.prefill_started() if tele is not None else None
+        wall0 = _time_mod.perf_counter()
+        logits, self._caches = self._ragged_fn(
+            jnp.asarray(toks), jnp.asarray(t0), self._caches,
+            jnp.asarray(out_idx))
+        self._count_dispatches(1)
+        for slot, start, take in plan:
+            st = self._slots[slot]
+            st.fill_pos = st.filled = start + take
+            self.stats["prefill_tokens"] += take
+        for slot in done:
+            self._activate(slot, logits[slot:slot + 1])
+        self.stats["prefill_wall_s"] += _time_mod.perf_counter() - wall0
+        if tele is not None:
+            tele.on_prefill_batch(t_started, used)
+
+    def _activate(self, slot, logits):
+        """A slot's prompt is fully written: draw its first token from
+        the ragged launch's logits row (same PRNG chain and logit ops
+        as the dense path — bit-identical draws) and flip it into the
+        decode phase."""
+        st = self._slots[slot]
+        key = jax.random.PRNGKey(st.seed)
+        if self.do_sample:
+            # same split pattern as sample_generate.run: one split,
+            # sample tok0 from the [1, V] prefill logits row
+            key, sub = jax.random.split(key)
+            from .decode_loop import process_logits
+            first = int(jax.random.categorical(
+                sub, process_logits(logits, self._temperature,
+                                    self._top_k, self._top_p),
+                axis=-1)[0])
+        else:
+            first = int(jnp.argmax(logits, -1)[0])
+        self._pending_key[slot] = key
+        self._pending_tok[slot] = first
+        self._pending_t[slot] = st.prompt_len
+        st.phase = "decode"
+        self._active[slot] = True
+        self._prefill_fifo.remove(slot)
+        st.emitted.append(first)
+        st.stream(self._deferred_cbs)
+        self.stats["admissions"] += 1
+        if self._tele is not None:
+            self._tele.on_first_token(st.rid, st.prompt_len - st.n_pre,
+                                      st.n_pre)
+
+    def _flush_slot_state(self):
+        """Push pending per-slot decode state (first token, write
+        position, PRNG key) to the device arrays the decode program
+        consumes — ONE batched update per array per tick instead of
+        three dispatches per admission."""
+        if self._pending_tok:
+            idx = jnp.asarray(list(self._pending_tok), jnp.int32)
+            vals = jnp.asarray(list(self._pending_tok.values()),
+                               jnp.int32)
+            self._tok = self._tok.at[idx].set(vals)
+            self._pending_tok.clear()
+            self._count_dispatches(1)
+        if self._pending_t:
+            idx = jnp.asarray(list(self._pending_t), jnp.int32)
+            vals = jnp.asarray(list(self._pending_t.values()), jnp.int32)
+            self._t = self._t.at[idx].set(vals)
+            self._pending_t.clear()
+            self._count_dispatches(1)
+        if self._pending_key:
+            idx = jnp.asarray(list(self._pending_key), jnp.int32)
+            vals = jnp.stack(list(self._pending_key.values()))
+            self._keys = self._keys.at[idx].set(vals)
+            self._pending_key.clear()
+            self._count_dispatches(1)
+
+    def _count_dispatches(self, n=1):
+        """Account ``n`` host->device dispatches on the admission/
+        prefill path (prefill program launches, page gathers/scatters,
+        slot-state pushes) — the counter-asserted signal that the
+        ragged path eliminated the per-admission detour."""
+        self.stats["prefill_dispatches"] += n
+        if self._tele is not None:
+            self._tele.add_prefill_dispatches(n)
+
+    def _n_prefill_calls(self, seg_len):
+        """Dense-prefill program launches ``_run_prefill`` makes for a
+        ``seg_len``-token segment (1 unchunked, else one per chunk)."""
+        if seg_len <= 0:
+            return 0
+        c = self._prefill_chunk
+        if not c or seg_len <= c:
+            return 1
+        return (seg_len + self._chunk_pad(seg_len)) // c
 
     def _admit_one(self, slot, rid, ids, budget, req_seed, on_token,
                    deadline=None, best=None):
@@ -778,10 +1158,14 @@ class ContinuousBatchingServer:
             # reclaim sweep can never evict them; mid-decode growth can
             # never exhaust the pool.
             own = self._kv.admit_slot(slot, T + budget, pre_pages)
+        tele = self._tele
+        t_started = tele.prefill_started() if tele is not None else None
+        wall0 = _time_mod.perf_counter()
         if best is not None and best[0] == "tree":
             m = best[1]
             self._prefix.use(m)               # LRU: reuse is recency
             caches1 = self._seed_from_pages(m.pages)
+            self._count_dispatches(1)         # page gather (the detour)
             rest = ids[n_pre:]                # never empty (lookup cap)
             self.stats["prefix_hit_tokens"] += n_pre
             self.stats["prefix_auto_hits"] += 1
@@ -789,31 +1173,36 @@ class ContinuousBatchingServer:
             logits, caches1 = self.model._run_prefill(
                 self._bundle, rest[None], chunk=self._prefill_chunk,
                 caches=caches1, t0=n_pre)
+            self._count_dispatches(self._n_prefill_calls(rest.shape[0]))
             self.stats["prefill_tokens"] += rest.shape[0]
-            if self._tele is not None:
-                self._tele.on_prefix_auto(True, n_pre)
+            if tele is not None:
+                tele.on_prefix_auto(True, n_pre)
         elif best is not None:
             rows, pre_logits = best[1][1], best[1][2]
             caches1 = jax.tree_util.tree_map(
                 lambda full, r: full.at[:, :, :r.shape[2]].set(r),
                 self._init_caches(1), rows)
+            self._count_dispatches(1)         # dense-row seed
             rest = ids[n_pre:]
             self.stats["prefix_hit_tokens"] += n_pre
             if rest.shape[0]:
                 logits, caches1 = self.model._run_prefill(
                     self._bundle, rest[None],
                     chunk=self._prefill_chunk, caches=caches1, t0=n_pre)
+                self._count_dispatches(
+                    self._n_prefill_calls(rest.shape[0]))
                 self.stats["prefill_tokens"] += rest.shape[0]
             else:
                 logits = pre_logits
-            if self._tele is not None and self._auto_prefix:
-                self._tele.on_prefix_auto(False, 0)
+            if tele is not None and self._auto_prefix:
+                tele.on_prefix_auto(False, 0)
         else:
             logits, caches1 = self.model._run_prefill(
                 self._bundle, ids[None], chunk=self._prefill_chunk)
+            self._count_dispatches(self._n_prefill_calls(T))
             self.stats["prefill_tokens"] += T
-            if self._tele is not None and self._auto_prefix:
-                self._tele.on_prefix_auto(False, 0)
+            if tele is not None and self._auto_prefix:
+                tele.on_prefix_auto(False, 0)
         key = jax.random.PRNGKey(req_seed)
         if self.do_sample:
             # same split pattern as sample_generate.run: one split,
@@ -832,21 +1221,30 @@ class ContinuousBatchingServer:
             # shared prefix pages ahead of them are already filled
             pg = self._kv.page_size
             n_prompt = -(-T // pg) - len(pre_pages)
+            if own[:n_prompt]:
+                self._count_dispatches(1)     # remainder page scatter
             self._fill_pages(caches1, own[:n_prompt],
                              len(pre_pages) * pg)
         else:
             self._caches = jax.tree_util.tree_map(
                 lambda pool, one: pool.at[:, slot].set(one[:, 0]),
                 self._caches, caches1)
+            self._count_dispatches(1)         # dense cache row copy
         self._tok = self._tok.at[slot].set(first)
         self._t = self._t.at[slot].set(T)
+        self._count_dispatches(3)             # per-slot tok/t/key pushes
         self._active[slot] = True
         st = _Slot(rid, ids, T, budget, on_token, deadline)
+        st.n_pre = n_pre
+        st.seed = req_seed
         st.emitted.append(int(first))
         st.stream(self._deferred_cbs)
         self._slots[slot] = st
-        if self._tele is not None:
-            self._tele.on_first_token(rid, T - n_pre, n_pre)
+        self.stats["admissions"] += 1
+        self.stats["prefill_wall_s"] += _time_mod.perf_counter() - wall0
+        if tele is not None:
+            tele.on_prefill_batch(t_started, T - n_pre)
+            tele.on_first_token(rid, T - n_pre, n_pre)
 
     # ------------------------------------------------------------ steps
     def _build_decode_step(self):
@@ -928,6 +1326,7 @@ class ContinuousBatchingServer:
             raise CallbackError(errors, what="on_token callback")
 
     def _step_locked(self):
+        self._prefill_used = 0       # per-tick prefill token budget
         self._expire_locked()
         self._admit()
         if not self._active.any():
@@ -947,6 +1346,11 @@ class ContinuousBatchingServer:
             # a slot's table (wasted block steps of finished/inactive
             # rows) are redirected to the null page and need no coverage
             self._sync_block_table()
+        # ragged mode: activations batched their tok/t/key updates —
+        # push them (and the parked write positions of slots still
+        # prefilling: their wasted decode writes must null-redirect,
+        # not land in the pages being filled) before the decode program
+        self._flush_slot_state()
         if self._decode_jit is None:
             self._decode_jit = self._build_decode_step()
         if self._faults is not None:
@@ -985,11 +1389,21 @@ class ContinuousBatchingServer:
                 tele.add_null_writes(
                     (self.max_slots - n_active) * toks.shape[1])
         self._harvest()
-        self._admit()
+        # end-of-tick admissions reserve only (ragged: their prefill
+        # chunks run at the NEXT tick's single batched launch — the
+        # token budget is per tick); the dense path prefills inline
+        self._admit(run_prefill=False)
         n = int(self._active.sum())
         if tele is not None:
             tele.set_active_slots(n)
         return n
+
+    def _busy_locked(self):
+        """Work pending: queued requests, decoding slots, or slots
+        still mid-ragged-prefill (not yet _active but holding pages
+        and owed their remaining prompt chunks)."""
+        return bool(self._queue or self._active.any()
+                    or self._prefill_fifo)
 
     def _finished(self, st):
         if len(st.emitted) >= st.budget:
@@ -1042,11 +1456,13 @@ class ContinuousBatchingServer:
                     self._tele.set_queue_depth(len(self._queue))
         for slot in range(self.max_slots):
             st = self._slots[slot]
-            if not self._active[slot] or st.deadline is None:
+            if st is None or st.deadline is None:
                 continue
             if now is None:
                 now = self._clock.now()
             if now >= st.deadline:
+                # decoding (partial tokens kept) or mid-ragged-prefill
+                # (empty partial) — either way the slot frees now
                 self._finish_partial_locked(slot)
                 notify = True
                 if self._tele is not None:
@@ -1074,7 +1490,7 @@ class ContinuousBatchingServer:
         if not found:
             for slot in range(self.max_slots):
                 st = self._slots[slot]
-                if self._active[slot] and st.rid == rid:
+                if st is not None and st.rid == rid:
                     self._release_slot(slot)
                     if self._tele is not None:
                         self._pool_gauges()
@@ -1099,7 +1515,7 @@ class ContinuousBatchingServer:
         rids = [item.rid for item in self._queue]
         self._queue.clear()
         for slot in range(self.max_slots):
-            if self._active[slot]:
+            if self._slots[slot] is not None:
                 rids.append(self._slots[slot].rid)
                 self._release_slot(slot)
         # chunks queued by the failed tick belong to rids that now have
@@ -1141,7 +1557,7 @@ class ContinuousBatchingServer:
         ticks = 0
         while ticks < max_ticks:
             with self._lock:
-                if not (self._queue or self._active.any()):
+                if not self._busy_locked():
                     break
                 self._step_locked()
             self._fire_callbacks()
@@ -1184,7 +1600,7 @@ class ContinuousBatchingServer:
             try:
                 while True:
                     with self._lock:
-                        busy = bool(self._queue or self._active.any())
+                        busy = self._busy_locked()
                     if self._stop.is_set():
                         if not (self._draining and busy):
                             break
@@ -1208,7 +1624,7 @@ class ContinuousBatchingServer:
                         continue
                     try:
                         with self._lock:
-                            if self._queue or self._active.any():
+                            if self._busy_locked():
                                 self._step_locked()
                         self._fire_callbacks()
                     except CallbackError as ce:
@@ -1282,10 +1698,11 @@ class ContinuousBatchingServer:
         with self._lock:
             self._draining = False
             if not drain:
-                # hard stop: flush partials for in-flight slots, fail
-                # what never ran — every waiter unblocks
+                # hard stop: flush partials for in-flight slots (mid-
+                # prefill ones record an empty partial), fail what
+                # never ran — every waiter unblocks
                 for slot in range(self.max_slots):
-                    if self._active[slot]:
+                    if self._slots[slot] is not None:
                         self._finish_partial_locked(slot)
                 for item in self._queue:
                     self._failures[item.rid] = ServerClosed(

@@ -213,11 +213,13 @@ def _check_paged_config(max_cache_len, page_size, num_pages, cache_dtype,
     paged decode path bit-identical to the dense one."""
     if cache_dtype == "int8":
         raise NotImplementedError(
-            "cache_dtype='int8' is not wired for the paged backend yet; "
-            "use cache_backend='dense' with int8 caches")
+            "cache_dtype='int8' is not wired for the paged backend yet "
+            "(ROADMAP item 3: quantized paged KV pool); use "
+            "cache_backend='dense' with int8 caches")
     if mesh is not None:
         raise NotImplementedError(
-            "mesh sharding is not wired for the paged backend yet")
+            "mesh sharding is not wired for the paged backend yet "
+            "(ROADMAP item 1: sharded paged serving)")
     if not page_size or int(page_size) < 1:
         raise ValueError("paged backend needs page_size >= 1")
     if not num_pages or int(num_pages) < 2:
@@ -276,13 +278,67 @@ def _paged_attend(q, k_pool, v_pool, bt, t, scale):
     return paged_attention(q[:, 0], k_pool, v_pool, bt, t + 1, scale)[:, None]
 
 
+def _page_write_seq(pool, kv, bt, t):
+    """Ragged-prefill page write: pool [P, pg, h, hd] <- kv
+    [B, s, h, hd] at per-slot position runs [t_b, t_b + s). The
+    multi-token analogue of ``_page_write`` with the same null-page
+    discipline: any position past the block-table width is redirected
+    to page 0 with a ZEROED payload (padded chunk rows of idle slots
+    carry rope's out-of-range NaN fill — a stored NaN in the null page
+    would poison every slot's attention through 0-weight reads).
+    Positions inside the table but past a slot's allocation land in its
+    NULL_PAGE tail entries — finite garbage the length masks hide,
+    exactly like a wasted decode step."""
+    pg = pool.shape[1]
+    b, s = kv.shape[0], kv.shape[1]
+    maxp = bt.shape[1]
+    if jnp.ndim(t) == 0:
+        t = jnp.full((b,), t, jnp.int32)
+    P = _positions(t, b, s)                              # [B, s]
+    pidx = P // pg
+    oob = pidx >= maxp
+    page = jnp.where(
+        oob, jnp.int32(0),
+        jnp.take_along_axis(bt, jnp.minimum(pidx, maxp - 1), axis=1))
+    vals = kv.astype(pool.dtype)
+    vals = jnp.where(oob[..., None, None], jnp.zeros_like(vals), vals)
+    n = b * s
+    return pool.at[page.reshape(n), (P % pg).reshape(n)].set(
+        vals.reshape((n,) + vals.shape[2:]))
+
+
+def _paged_prefill_attend(q, k_pool, v_pool, bt, t, scale):
+    """Ragged packed-prefill attention through the block table: q
+    [B, s, nh, hd] chunk rows starting at per-slot offsets ``t``, pools
+    [P, pg, kvh, hd]; row j of slot b attends to positions <= t_b + j
+    (cache already written through the chunk). Pallas kernel on TPU,
+    bit-exact dense-mirroring gather composition elsewhere. A slot
+    carrying the scheduler's idle sentinel (``t`` past the block-table
+    extent) is handed ``last = -1`` so the kernel skips its every page
+    instead of sweeping NaN garbage; live slots scan at most one chunk
+    width past their real frontier (the chunk's own padding rows)."""
+    from ..ops.pallas.ragged_prefill import ragged_prefill_attention
+    b, s = q.shape[0], q.shape[1]
+    if jnp.ndim(t) == 0:
+        t = jnp.full((b,), t, jnp.int32)
+    limit = bt.shape[1] * k_pool.shape[1]          # tokens a table spans
+    last = jnp.where(t >= limit, jnp.int32(-1), t + s - 1)
+    return ragged_prefill_attention(q, k_pool, v_pool, bt, t, last=last,
+                                    sm_scale=scale)
+
+
 def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
     """Shared llama-family attention sublayer for the decode scan:
     pre-RMSNorm, rope at absolute positions, GQA cache write + masked
     cached attention, output projection + residual. ``lc`` is this
     layer's cache dict (fp or int8 codec) — or, when ``bt`` (a per-slot
     block table) is given, this layer's K/V page pools, written and
-    attended through the table (paged backend, decode steps only).
+    attended through the table (paged backend). Paged with s == 1 is a
+    decode step (ragged paged-attention kernel); s > 1 is a RAGGED
+    PREFILL chunk — K/V written straight into pool pages at per-slot
+    offsets ``t`` and attended causally through the block table, which
+    is what lets the server prefill several admissions as one launch
+    with no dense-cache detour.
     Returns (xx, lc, h2) with h2 = the post-attention norm for the FFN."""
     b, s, nh, kvh, hd, scale = dims
     cos, sin = tables
@@ -293,7 +349,11 @@ def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
     v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
     q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
     k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
-    if bt is not None:
+    if bt is not None and s > 1:
+        lc = {"k": _page_write_seq(lc["k"], k, bt, t),
+              "v": _page_write_seq(lc["v"], v, bt, t)}
+        att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t, scale)
+    elif bt is not None:
         lc = {"k": _page_write(lc["k"], k, bt, t),
               "v": _page_write(lc["v"], v, bt, t)}
         att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale)
@@ -309,6 +369,35 @@ def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
     xx = xx + _mm(att.reshape(b, s, nh * hd), blk["wo"])
     h2 = _rms(xx, blk["ln2"], eps)
     return xx, lc, h2
+
+
+def _make_ragged_prefill_fn(step_fn, head_fn, embed_tokens):
+    """Build the paged bundle's ragged-prefill entry point: several
+    variable-length prompt chunks — one per serving slot — run as ONE
+    program, K/V written straight into pool pages through the block
+    table (no dense batch-1 cache detour) and attended causally at
+    per-slot prefix offsets, so an auto-prefix-cache hit resumes over
+    its already-cached pages exactly like decode does.
+
+    Signature: ``(tokens [S, C], t0 [S], caches, out_idx [S]) ->
+    (logits [S, V], caches)``. ``tokens`` holds one right-padded chunk
+    per slot, ``t0`` the chunk's absolute start position (a slot with
+    no prefill work this launch carries t0 = max_cache_len: every one
+    of its writes null-redirects and its rows are garbage nobody
+    reads), ``out_idx`` the row of each slot's LAST prompt token —
+    ``logits[s]`` is that row's next-token distribution, valid only for
+    slots whose prompt completes in this launch. All chunk geometry is
+    static per (S, C): the server pads C up a power-of-two ladder so
+    compiles stay O(log max_cache_len), not O(distinct prompt lengths).
+    """
+    def ragged_prefill(tokens, t0, caches, out_idx):
+        S = tokens.shape[0]
+        x = embed_tokens(tokens, t0)
+        out, caches = step_fn(x, caches, t0)
+        rows = out[jnp.arange(S), out_idx][:, None]        # [S, 1, H]
+        return head_fn(rows)[:, -1], caches
+
+    return ragged_prefill
 
 
 def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
@@ -396,6 +485,10 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
                 ).astype(jnp.float32)
 
+    if paged:
+        ragged = _make_ragged_prefill_fn(
+            step_fn, head_fn, lambda tokens, t0: p["table"][tokens])
+        return init_caches, embed_fn, step_fn, head_fn, ragged
     return init_caches, embed_fn, step_fn, head_fn
 
 
@@ -510,6 +603,10 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
                 ).astype(jnp.float32)
 
+    if paged:
+        ragged = _make_ragged_prefill_fn(
+            step_fn, head_fn, lambda tokens, t0: p["table"][tokens])
+        return init_caches, embed_fn, step_fn, head_fn, ragged
     return init_caches, embed_fn, step_fn, head_fn
 
 
@@ -582,7 +679,12 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
             qkv = (_mm(h, blk["attn.qkv.weight"]) + blk["attn.qkv.bias"]
                    ).reshape(b, s, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            if paged:
+            if paged and s > 1:              # ragged prefill chunk
+                lc = {"k": _page_write_seq(lc["k"], k, bt, t),
+                      "v": _page_write_seq(lc["v"], v, bt, t)}
+                att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t,
+                                            scale)
+            elif paged:
                 lc = {"k": _page_write(lc["k"], k, bt, t),
                       "v": _page_write(lc["v"], v, bt, t)}
                 att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale)
@@ -613,6 +715,17 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         h = _ln(unwrap(out), p["lnf_w"], p["lnf_b"], eps)
         return (h @ p["table"].T).astype(jnp.float32)
 
+    if paged:
+        def gpt_embed_tokens(tokens, t0):
+            # learned positions: per-slot offsets, [S, C] gather (an
+            # idle slot's out-of-range rows pick up jnp's NaN fill —
+            # zeroed on the null-page write, discarded on the output)
+            pos = _positions(t0, tokens.shape[0], tokens.shape[1])
+            return p["table"][tokens] + p["wpe"][pos]
+
+        ragged = _make_ragged_prefill_fn(step_fn, head_fn,
+                                         gpt_embed_tokens)
+        return init_caches, embed_fn, step_fn, head_fn, ragged
     return init_caches, embed_fn, step_fn, head_fn
 
 
@@ -650,11 +763,19 @@ class GenerationMixin:
                                           weight_dtype, mesh,
                                           cache_dtype, **kw)
         else:
+            # no-roadmap: model-family dispatch, not a scope cut
             raise NotImplementedError(
                 f"generate() not wired for {type(self).__name__}")
         # one prefill program per (bundle, prompt-shape): jit here, not
-        # inside generate(), so repeated calls reuse the compile
-        bundle = bundle + (jax.jit(bundle[2], donate_argnums=(1,)),)
+        # inside generate(), so repeated calls reuse the compile. Paged
+        # bundles carry a SIXTH element: the jitted ragged-prefill
+        # entry point (packed multi-slot prompt chunks straight into
+        # pool pages; see _make_ragged_prefill_fn) — dense bundles stay
+        # 5-tuples for existing consumers (deploy_decode, speculative).
+        ragged = bundle[4:5]
+        bundle = bundle[:4] + (jax.jit(bundle[2], donate_argnums=(1,)),)
+        if ragged:
+            bundle = bundle + (jax.jit(ragged[0], donate_argnums=(2,)),)
         cached[key] = bundle
         # each bundle closes over a full stacked weight copy: cap the
         # cache (LRU) so varied generate() shapes can't accumulate

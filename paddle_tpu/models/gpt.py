@@ -163,6 +163,7 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         from ..core.tensor import unwrap
         from ..jit import functional_call
         if self.cfg.tensor_parallel:
+            # no-roadmap: API redirect to the hybrid factories, not a cut
             raise NotImplementedError(
                 "pipeline_decompose targets the non-TP module; for mp×pp "
                 "use parallel.hybrid factories")

@@ -17,6 +17,11 @@ Per-tick engine signals:
                                   wall, includes device sync)
 - ``serving_tick_occupancy``      active slots entering the tick
 - ``serving_active_slots`` / ``serving_queue_depth`` gauges
+- ``serving_prefill_seconds``     one prefill batch (a ragged packed
+                                  launch, or one dense admission)
+- ``server_prefill_dispatches_total``  host dispatches on the
+  admission/prefill path — the ragged prefill path's counter-asserted
+  win is this dropping per admission vs the dense baseline
 
 Cache signals:
 - ``serving_tokens_total{kind=prefill|prefix_hit|decode}``
@@ -164,6 +169,20 @@ class ServerTelemetry:
             "serving_wasted_block_tokens_total",
             "Block-decode steps run past a slot's finish (tick_block "
             "amortization cost)")
+        # admission/prefill dispatch accounting: the ragged prefill
+        # path's counter-asserted win is this DROPPING per admission
+        # (one batched launch per tick vs per-request prefill programs
+        # + the auto-hit page-gather/scatter detour + 3 slot-state
+        # pushes each)
+        self._c_prefill_disp = r.counter(
+            "server_prefill_dispatches_total",
+            "Host->device dispatches on the admission/prefill path "
+            "(prefill program launches, page gathers/scatters, "
+            "slot-state pushes)")
+        self._h_prefill = r.histogram(
+            "serving_prefill_seconds",
+            "One prefill batch: a ragged packed launch, or one "
+            "admission's dense prefill", buckets=TICK_BUCKETS)
         # reliability signals (paddle_tpu.reliability): admission
         # control, supervised-loop retries, breaker, health
         shed = r.counter("server_shed_total",
@@ -366,6 +385,26 @@ class ServerTelemetry:
         """Out-of-band prefill work (register_prefix)."""
         if self.enabled and n:
             self._c_tok_prefill.inc(n)
+
+    def add_prefill_dispatches(self, n):
+        """``n`` host->device dispatches on the admission/prefill path."""
+        if self.enabled and n:
+            self._c_prefill_disp.inc(n)
+
+    def prefill_started(self):
+        """Timestamp handle for on_prefill_batch (one clock read)."""
+        if not self.enabled:
+            return None
+        return self.clock.now()
+
+    def on_prefill_batch(self, t_started, tokens):
+        """One prefill batch finished: a ragged packed launch covering
+        ``tokens`` prompt rows across its slots, or one admission's
+        dense prefill. (Token counters are driven by on_first_token;
+        this only times the batch.)"""
+        if not self.enabled:
+            return
+        self._h_prefill.observe(self.clock.now() - t_started)
 
     # ------------------------------------------------------- reliability
     def on_shed(self, policy):

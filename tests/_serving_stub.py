@@ -13,7 +13,11 @@ exactly the decode-bundle contract the server consumes
 ``stub_tokens(prompt, n)`` is the oracle. Prefill writes token values
 into the cache rows it covers, so page fills / prefix sharing move real
 data; decode steps pass caches through untouched (logits depend only on
-(token, position), which is what makes the oracle exact).
+(token, position), which is what makes the oracle exact). The paged
+bundle carries the ragged-prefill entry point (element 5, ISSUE 6)
+with the same write-token-values semantics, so the ragged scheduler's
+chunk packing, null-redirects and prefix-offset resumes are exercised
+against the oracle too.
 """
 import numpy as np
 
@@ -46,12 +50,14 @@ class StubModel:
         C = int(max_cache_len)
 
         if cache_backend == "paged":
+            pg = int(page_size)
+            maxp = C // pg
+
             def init_caches(batch):
-                shape = (L, int(num_pages), int(page_size), h, hd)
+                shape = (L, int(num_pages), pg, h, hd)
                 return {"pool": {"k": jnp.zeros(shape, jnp.float32),
                                  "v": jnp.zeros(shape, jnp.float32)},
-                        "bt": jnp.zeros((batch, C // int(page_size)),
-                                        jnp.int32)}
+                        "bt": jnp.zeros((batch, maxp), jnp.int32)}
         else:
             def init_caches(batch):
                 shape = (L, batch, C, h, hd)
@@ -71,6 +77,40 @@ class StubModel:
             nxt = (7 * tok + t + 1) % vocab
             return jax.nn.one_hot(nxt, vocab, dtype=jnp.float32) * 10.0
 
+        if cache_backend == "paged":
+            def ragged_prefill(tokens, t0, caches, out_idx):
+                """Ragged-prefill contract (paged bundle element 5):
+                tokens [S, C] packed chunks, t0 [S] start positions
+                (idle slots carry t0 = max_cache_len — every write
+                null-redirects zeroed), out_idx [S] row of each slot's
+                last prompt token. Writes token VALUES into pool pages
+                (page fills move real data, like _run_prefill) and
+                returns the oracle's next-token logits per slot."""
+                pool, bt = caches["pool"], caches["bt"]
+                S, Cc = tokens.shape
+                pos = t0[:, None] + jnp.arange(Cc, dtype=jnp.int32)[None]
+                pidx = pos // pg
+                oob = pidx >= maxp
+                page = jnp.where(
+                    oob, 0, jnp.take_along_axis(
+                        bt, jnp.minimum(pidx, maxp - 1), axis=1))
+                vals = jnp.where(oob, 0.0, tokens.astype(jnp.float32))
+                n = S * Cc
+                flat = jnp.broadcast_to(
+                    vals.reshape(n)[:, None, None], (n, h, hd))
+                fp, fo = page.reshape(n), (pos % pg).reshape(n)
+                pool = {"k": pool["k"].at[:, fp, fo].set(flat[None]),
+                        "v": pool["v"].at[:, fp, fo].set(flat[None])}
+                last_tok = jnp.take_along_axis(
+                    tokens, out_idx[:, None], axis=1)[:, 0]
+                last_pos = t0 + out_idx
+                nxt = (7 * last_tok + last_pos + 1) % vocab
+                logits = jax.nn.one_hot(nxt, vocab,
+                                        dtype=jnp.float32) * 10.0
+                return logits, dict(caches, pool=pool)
+
+            return (init_caches, embed_fn, step_fn, head_fn, None,
+                    jax.jit(ragged_prefill, donate_argnums=(2,)))
         return init_caches, embed_fn, step_fn, head_fn, None
 
     def _run_prefill(self, bundle, ids_np, chunk=None, caches=None, t0=0):

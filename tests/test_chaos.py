@@ -57,7 +57,7 @@ def _drive(srv, max_ticks=5000):
     ticks = 0
     while True:
         with srv._lock:
-            busy = bool(srv._queue or srv._active.any())
+            busy = srv._busy_locked()       # incl. mid-prefill slots
         if not busy:
             return
         try:

@@ -383,12 +383,12 @@ class TestAutoPrefixServer:
         assert srv.stats["prefix_hit_tokens"] == 0
 
     def test_chunked_prefill_pad_guard_trims_unsafe_match(self):
-        """A tree hit whose remainder would chunk-pad past
-        max_cache_len is trimmed (here: to nothing) instead of
-        overflowing the cache rows — the submit-time bound only knew
-        the hits registered THEN (ADVICE r5 #2 lineage)."""
+        """DENSE prefill mode: a tree hit whose remainder would
+        chunk-pad past max_cache_len is trimmed (here: to nothing)
+        instead of overflowing the cache rows — the submit-time bound
+        only knew the hits registered THEN (ADVICE r5 #2 lineage)."""
         rng = np.random.default_rng(3)
-        srv = _srv(max_slots=1, prefill_chunk=8)
+        srv = _srv(max_slots=1, prefill_chunk=8, prefill_mode="dense")
         donor = rng.integers(0, 16, (12,)).astype(np.int32)
         srv.submit(donor, max_new_tokens=4)
         srv.run()
@@ -399,6 +399,24 @@ class TestAutoPrefixServer:
         rid = srv.submit(p, max_new_tokens=3)
         np.testing.assert_array_equal(srv.run()[rid], stub_tokens(p, 3))
         assert srv.stats["prefix_auto_hits"] == 0
+
+    def test_ragged_mode_never_pads_so_match_survives(self):
+        """RAGGED prefill mode (ISSUE 6 satellite): the same workload
+        KEEPS the hit — ragged remainders are chunked by the per-tick
+        token budget at arbitrary cut points, never padded, so the
+        chunk-pad trim (and the submit-time pad bound) do not apply."""
+        rng = np.random.default_rng(3)
+        srv = _srv(max_slots=1, prefill_chunk=8)     # ragged default
+        assert srv.prefill_mode == "ragged"
+        donor = rng.integers(0, 16, (12,)).astype(np.int32)
+        srv.submit(donor, max_new_tokens=4)
+        srv.run()
+        p = np.concatenate([donor[:4],
+                            rng.integers(0, 16, (25,)).astype(np.int32)])
+        rid = srv.submit(p, max_new_tokens=3)        # 29 + 3 fits 32
+        np.testing.assert_array_equal(srv.run()[rid], stub_tokens(p, 3))
+        assert srv.stats["prefix_auto_hits"] == 1
+        assert srv.stats["prefix_auto_hit_tokens"] == 4
 
     def test_llama_auto_hit_matches_solo_generate(self):
         """Real-model acceptance: the auto hit's gather-seeded remainder
@@ -456,7 +474,7 @@ class TestEvictionChaos:
         ticks = 0
         while True:
             with srv._lock:
-                busy = bool(srv._queue or srv._active.any())
+                busy = srv._busy_locked()   # incl. mid-prefill slots
             if not busy:
                 return
             try:

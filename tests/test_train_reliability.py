@@ -800,6 +800,38 @@ class TestNoBareExcept:
         for d in mod.DEFAULT_DIRS:
             hits = mod.bare_excepts(os.path.join(REPO, d))
             assert hits == [], f"bare excepts found in {d}: {hits}"
+        # ISSUE 6 satellite: every messageful NotImplementedError in
+        # the serving stack points at its ROADMAP item (or carries an
+        # explicit no-roadmap opt-out) — scope cuts stay discoverable
+        for d in mod.DEFAULT_DIRS:
+            _, cuts = mod.scan(os.path.join(REPO, d), REPO)
+            assert cuts == [], f"unpointered scope cuts in {d}: {cuts}"
+
+    def test_lint_flags_unpointered_scope_cut(self, tmp_path):
+        """A new NotImplementedError in a serving-stack dir must name a
+        ROADMAP item; 'ROADMAP' in the message or a '# no-roadmap:'
+        comment passes, a silent cut fails."""
+        from importlib import util
+        spec = util.spec_from_file_location(
+            "check_no_bare_except",
+            os.path.join(REPO, "scripts", "check_no_bare_except.py"))
+        mod = util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        d = tmp_path / "paddle_tpu" / "inference"
+        d.mkdir(parents=True)
+        (d / "x.py").write_text(
+            "def a():\n"
+            "    raise NotImplementedError('quantized pool later')\n"
+            "def b():\n"
+            "    raise NotImplementedError('see ROADMAP item 3')\n"
+            "def c():\n"
+            "    # no-roadmap: abstract refusal\n"
+            "    raise NotImplementedError('not a cut')\n"
+            "def d():\n"
+            "    raise NotImplementedError\n")
+        _, cuts = mod.scan(str(tmp_path / "paddle_tpu"),
+                           str(tmp_path))
+        assert [line for _, line in cuts] == [2]
 
     def test_lint_flags_a_bare_except(self, tmp_path):
         from importlib import util
