@@ -1,0 +1,227 @@
+"""Multi-replica router bench (ISSUE 7): cache-aware routing vs
+round-robin, failover requeue latency, and rolling-restart drain wall.
+
+Drives a shared-prefix workload — G system prompts, each followed by a
+short random tail — through ``ReplicaRouter`` in three configurations:
+
+- ``1-replica``   the single-server baseline (every request lands on
+  the only pool, so its prefix cache sees everything),
+- ``rr-N``        N replicas, ``policy="round_robin"`` — the
+  affinity-blind baseline: same-prefix traffic sprays across pools and
+  each replica must cache every group separately,
+- ``affinity-N``  N replicas, ``policy="affinity"`` — sketch-routed:
+  same-prefix traffic sticks to the replica already holding its pages,
+
+and reports per mode:
+
+- RAW prefix hit rate (replica ``prefix_auto_hits`` counters over all
+  requests) next to the COLD-MISS COUNT — the structural misses each
+  policy pays: 1-replica/affinity miss once per group, round-robin
+  once per (replica, group) pair its rotation touches; the cold column
+  IS the affinity story at a glance,
+- prefill tokens actually computed (the counter that generalizes:
+  affinity should approach the 1-replica number at N-replica
+  throughput),
+- drain wall for the whole workload (submitted round-by-round —
+  steady traffic, not one burst; StubModel replicas, so this is
+  HOST-side routing + serving cost, not model FLOPs).
+
+Then two robustness numbers on the affinity fleet:
+
+- failover requeue latency: K requests queued on a victim replica,
+  ``kill()``, one supervisor ``poll()`` — the wall covers harvest +
+  re-dispatch of all K (per-request latency printed), results verified
+  bit-exact on the siblings,
+- rolling-restart drain wall: ``rolling_restart()`` across the fleet
+  mid-workload, asserted zero failed requests.
+
+StubModel replicas (tests/_serving_stub.py) keep the bench about the
+ROUTER: no transformer compiles, closed-form token oracle, tier-1-fast.
+Counters are the signal; walls on shared CI are noise-prone.
+
+    python benchmarks/router_bench.py [--requests-per-group N]
+        [--groups N] [--replicas N] [--system-tokens N]
+        [--tail-tokens N] [--new-tokens N] [--slots N] [--failover-k N]
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+
+def _build(args, n, policy):
+    from _serving_stub import StubModel
+    from paddle_tpu.inference.continuous_batching import \
+        ContinuousBatchingServer
+    from paddle_tpu.inference.router import ReplicaRouter
+    reps = [ContinuousBatchingServer(
+        StubModel(), max_slots=args.slots,
+        max_cache_len=args.max_cache_len, cache_backend="paged",
+        page_size=args.page_size) for _ in range(n)]
+    return ReplicaRouter(reps, policy=policy), reps
+
+
+def _workload(args):
+    rng = np.random.default_rng(0)
+    groups = [rng.integers(0, 16, (args.system_tokens,)).astype(np.int32)
+              for _ in range(args.groups)]
+    rounds = []
+    for _ in range(args.requests_per_group):
+        # shuffled group order per round: real traffic does not arrive
+        # in a fixed rotation (a fixed order congruent with the replica
+        # count would hand round-robin accidental perfect affinity)
+        order = rng.permutation(args.groups)
+        rounds.append([np.concatenate(
+            [groups[g], rng.integers(0, 16, (args.tail_tokens,))
+             .astype(np.int32)]) for g in order])
+    return rounds
+
+
+def _run_mode(args, rounds, n, policy):
+    from _serving_stub import stub_tokens
+    router, reps = _build(args, n, policy)
+    router.start(poll_interval=0.005)
+    n_req = sum(len(r) for r in rounds)
+    t0 = time.perf_counter()
+    for rnd in rounds:                      # steady traffic: one round
+        rids = [(router.submit(p, max_new_tokens=args.new_tokens), p)
+                for p in rnd]               # in flight at a time
+        for rid, p in rids:
+            got = router.wait(rid, timeout=120)
+            np.testing.assert_array_equal(
+                got, stub_tokens(p, args.new_tokens))
+    wall = time.perf_counter() - t0
+    hits = sum(r.stats["prefix_auto_hits"] for r in reps)
+    prefill = sum(r.stats["prefill_tokens"] for r in reps)
+    router.stop()
+    # cold misses = admissions that found no cached prefix anywhere in
+    # the fleet: 1-replica/affinity pay one per GROUP, round-robin one
+    # per (replica, group) pair its rotation touches — the spread is
+    # exactly the locality the affinity policy exists to keep
+    return {"mode": f"{policy}-{n}" if n > 1 else "1-replica",
+            "hit_rate": hits / n_req, "cold_misses": n_req - hits,
+            "hits": hits, "prefill_tokens": prefill,
+            "affinity_hits": router.stats["affinity_hits"],
+            "wall_s": wall}
+
+
+def _bench_failover(args):
+    """K requests queued on a victim replica; kill it; ONE poll
+    harvests + re-dispatches all K. Deterministic single-threaded."""
+    from _serving_stub import stub_tokens
+    router, reps = _build(args, args.replicas, "affinity")
+    rng = np.random.default_rng(7)
+    shared = rng.integers(0, 16, (args.system_tokens,)).astype(np.int32)
+    seed_p = np.concatenate([shared, np.asarray([1], np.int32)])
+    rid = router.submit(seed_p, max_new_tokens=2)
+    _drain_single(router, reps)
+    router.wait(rid, timeout=5)
+    victim = int(np.argmax(router.stats["routed"]))
+    qs = []
+    for i in range(args.failover_k):
+        p = np.concatenate([shared, np.asarray([2, i % 16], np.int32)])
+        qs.append((router.submit(p, max_new_tokens=args.new_tokens), p))
+    assert reps[victim].queue_depth() == args.failover_k
+    reps[victim].kill()
+    t0 = time.perf_counter()
+    router.poll()                           # harvest + requeue them all
+    requeue_wall = time.perf_counter() - t0
+    assert router.stats["requeued"] == args.failover_k
+    _drain_single(router, reps)
+    for r, p in qs:
+        np.testing.assert_array_equal(
+            router.wait(r, timeout=5),
+            stub_tokens(p, args.new_tokens))
+    return {"k": args.failover_k, "requeue_wall_s": requeue_wall,
+            "per_request_ms": requeue_wall / args.failover_k * 1e3}
+
+
+def _drain_single(router, reps, max_iters=5000):
+    idle = 0
+    for _ in range(max_iters):
+        router.poll()
+        busy = False
+        for rep in reps:
+            if rep.health == "dead":
+                continue
+            if rep.queue_depth() or rep.in_flight():
+                rep.step()
+                busy = True
+        idle = 0 if busy else idle + 1
+        if idle >= 2:
+            return
+    raise AssertionError("bench drive did not converge")
+
+
+def _bench_rolling_restart(args, rounds):
+    from _serving_stub import stub_tokens
+    router, _ = _build(args, args.replicas, "affinity")
+    router.start(poll_interval=0.005)
+    rids = [(router.submit(p, max_new_tokens=args.new_tokens), p)
+            for rnd in rounds for p in rnd]
+    t0 = time.perf_counter()
+    router.rolling_restart(drain_timeout=120.0)
+    wall = time.perf_counter() - t0
+    failed = 0
+    for rid, p in rids:
+        try:
+            np.testing.assert_array_equal(
+                router.wait(rid, timeout=120),
+                stub_tokens(p, args.new_tokens))
+        except Exception:
+            failed += 1
+    router.stop()
+    return {"drain_wall_s": wall, "failed": failed,
+            "restarts": router.stats["restarts"],
+            "requeued": router.stats["requeued"]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests-per-group", type=int, default=12)
+    ap.add_argument("--groups", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--system-tokens", type=int, default=48)
+    ap.add_argument("--tail-tokens", type=int, default=6)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-cache-len", type=int, default=128)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--failover-k", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    rounds = _workload(args)
+    n_req = sum(len(r) for r in rounds)
+    print(f"router bench: {n_req} requests "
+          f"({args.groups} groups x {args.requests_per_group}), "
+          f"{args.replicas} replicas, system={args.system_tokens} "
+          f"tail={args.tail_tokens} new={args.new_tokens}")
+    modes = [_run_mode(args, rounds, 1, "affinity"),
+             _run_mode(args, rounds, args.replicas, "round_robin"),
+             _run_mode(args, rounds, args.replicas, "affinity")]
+    print(f"\n  {'mode':<14} {'hit_rate':>8} {'cold':>5} "
+          f"{'prefill_tok':>11} {'wall_ms':>8}")
+    for m in modes:
+        print(f"  {m['mode']:<14} {m['hit_rate']:>8.2f} "
+              f"{m['cold_misses']:>5} {m['prefill_tokens']:>11} "
+              f"{m['wall_s'] * 1e3:>8.1f}")
+    fo = _bench_failover(args)
+    print(f"\n  failover: {fo['k']} queued requests requeued in "
+          f"{fo['requeue_wall_s'] * 1e3:.2f} ms "
+          f"({fo['per_request_ms']:.3f} ms/req), siblings bit-exact")
+    rr = _bench_rolling_restart(args, rounds)
+    print(f"  rolling restart: {rr['restarts']} replicas bounced in "
+          f"{rr['drain_wall_s'] * 1e3:.1f} ms under load, "
+          f"{rr['failed']} failed requests, "
+          f"{rr['requeued']} requeued")
+    return {"modes": modes, "failover": fo, "rolling_restart": rr}
+
+
+if __name__ == "__main__":
+    main()

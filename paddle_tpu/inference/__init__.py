@@ -19,7 +19,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "export_program", "export_layer", "load_exported",
            "convert_to_mixed_precision", "get_version",
            # serving stack (beyond the reference surface)
-           "BatchScheduler", "ContinuousBatchingServer", "scan_decode",
+           "BatchScheduler", "ContinuousBatchingServer", "ReplicaRouter",
+           "RouterSupervisor", "scan_decode",
            "greedy_generate", "sample_generate", "beam_generate",
            "fsm_generate", "phrases_to_fsm", "process_logits",
            "speculative_generate", "export_decode", "load_decode",
@@ -256,6 +257,7 @@ from .decode_loop import (scan_decode, greedy_generate,  # noqa: E402,F401
                           sample_generate, beam_generate, fsm_generate,
                           phrases_to_fsm, process_logits)
 from .continuous_batching import ContinuousBatchingServer  # noqa: E402,F401
+from .router import ReplicaRouter, RouterSupervisor  # noqa: E402,F401
 from .speculative import speculative_generate  # noqa: E402,F401
 from .deploy_decode import (export_decode, load_decode,  # noqa: E402,F401
                             DeployedGenerator)

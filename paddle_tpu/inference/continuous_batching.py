@@ -203,6 +203,7 @@ class ContinuousBatchingServer:
             pages_per_slot = self.max_cache_len // page_size
             if num_pages is None:     # worst case: every slot maxed out
                 num_pages = self.max_slots * pages_per_slot + 1
+            self.page_size = page_size
             self._paged_bundle = model._decode_bundle(
                 max_cache_len, weight_dtype, mesh, cache_dtype,
                 cache_backend="paged", page_size=page_size,
@@ -226,6 +227,7 @@ class ContinuousBatchingServer:
             self._ragged_fn = (self._paged_bundle[5]
                                if len(self._paged_bundle) > 5 else None)
         else:
+            self.page_size = None
             self._caches = self._init_caches(self.max_slots)
             self._prefix = None
             self._auto_prefix = False
@@ -440,6 +442,7 @@ class ContinuousBatchingServer:
                                      len(run) * self._kv.page_size)
                 self._prefix.extend_pinned(
                     ids[:len(pages) * self._kv.page_size], run, own)
+                self._prefix.flush_sketch()
             self._pool_gauges()
         return T
 
@@ -1301,6 +1304,8 @@ class ContinuousBatchingServer:
         Returns the number of active slots after the tick."""
         with self._lock:
             n = self._step_locked()
+            if self._prefix is not None:
+                self._prefix.flush_sketch()   # one publish per tick
         self._fire_callbacks()
         return n
 
@@ -1560,6 +1565,8 @@ class ContinuousBatchingServer:
                 if not self._busy_locked():
                     break
                 self._step_locked()
+                if self._prefix is not None:
+                    self._prefix.flush_sketch()
             self._fire_callbacks()
             ticks += 1
         with self._lock:
@@ -1626,6 +1633,8 @@ class ContinuousBatchingServer:
                         with self._lock:
                             if self._busy_locked():
                                 self._step_locked()
+                            if self._prefix is not None:
+                                self._prefix.flush_sketch()
                         self._fire_callbacks()
                     except CallbackError as ce:
                         # the ENGINE is fine — fail exactly the
@@ -1711,6 +1720,102 @@ class ContinuousBatchingServer:
                 self._queue.clear()
                 self._deferred_cbs.clear()   # nobody will fire them
             self._health.to(DEAD)
+            self._done_cv.notify_all()
+
+    # ---------------------- multi-replica front door (inference/router.py)
+    def queue_depth(self):
+        """Requests waiting for a slot — the router's least-loaded
+        signal (with ``in_flight`` and ``pool_balance``). LOCK-FREE
+        read of a point-in-time length: a serve thread holds the lock
+        for whole ticks, and a router picking a destination must not
+        queue behind one — a slightly stale load reading only costs
+        placement quality, never correctness."""
+        return len(self._queue)
+
+    def in_flight(self):
+        """Slots holding a live request (decoding or mid-ragged-
+        prefill). Lock-free, same contract as ``queue_depth``."""
+        return sum(1 for st in self._slots if st is not None)
+
+    def prefix_sketch(self):
+        """Fingerprint set of this replica's radix-tree contents
+        (``PrefixCache.sketch()``) — the router's prefix-affinity
+        signal. Host-side only, no device reads, and LOCK-FREE: the
+        cache maintains the sketch incrementally and publishes an
+        immutable snapshot. Empty for the dense backend (no page cache
+        to be affine to)."""
+        prefix = self._prefix
+        return frozenset() if prefix is None else prefix.sketch()
+
+    def evacuate(self, flush_partials=False):
+        """Harvest every QUEUED request off this replica and hand it to
+        the caller (a router requeues them on sibling replicas). The
+        harvested entries carry everything a resubmit needs — prompt,
+        budget, the resolved sampling seed (so a sibling draws the
+        identical chain), callback, and the ABSOLUTE deadline (time
+        already spent queued here keeps counting against it). Nothing
+        is recorded in ``failures`` for harvested rids: the caller owns
+        them now.
+
+        ``flush_partials=True`` (a DEAD replica being evacuated)
+        additionally flushes every in-flight slot's partial tokens to
+        its waiter exactly as ``stop(drain=False)`` does — mid-decode
+        work is not replayable (the sibling would re-decode from
+        scratch and double-stream), so the partial is the result. With
+        the default False (e.g. a DRAINING replica) in-flight slots
+        keep decoding to completion."""
+        with self._lock:
+            harvested = list(self._queue)
+            self._queue.clear()
+            if self._tele is not None:
+                # the harvested rids leave THIS replica for good: close
+                # their lifecycle spans here (the router re-counts them
+                # on whatever sibling they land on)
+                for item in harvested:
+                    self._tele.on_cancel(item.rid)
+            if flush_partials:
+                for slot in range(self.max_slots):
+                    if self._slots[slot] is not None:
+                        st = self._finish_partial_locked(slot)
+                        if self._tele is not None:
+                            self._tele.on_cancel(st.rid)
+                # nobody will fire chunks on a dead replica, and every
+                # live rid was just flushed
+                self._deferred_cbs.clear()
+                if self._tele is not None:
+                    # every slot was just torn down — a dead replica
+                    # must not report phantom load
+                    self._tele.set_active_slots(0)
+            if self._prefix is not None:
+                self._prefix.flush_sketch()   # flushed slots donated
+            if self._tele is not None:
+                self._tele.set_queue_depth(0)
+                self._pool_gauges()
+            self._done_cv.notify_all()
+        return harvested
+
+    def kill(self, timeout=60.0):
+        """Simulate a replica crash (failover drills, chaos suites):
+        stop the serve thread NOW and mark the server ``dead``, but —
+        unlike ``stop()`` — leave the queue and in-flight slots exactly
+        as they are: no failures recorded, no partials flushed. That is
+        the state a router finds after a real crash and harvests with
+        ``evacuate(flush_partials=True)``. ``start()`` restarts as
+        usual."""
+        with self._lock:
+            self._accepting = False
+            self._draining = False
+            self._health.to(DEAD)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"serve thread did not stop within {timeout}s (a "
+                    f"tick/compile may still be running); call kill() "
+                    f"again to re-join")
+            self._thread = None
+        with self._lock:
             self._done_cv.notify_all()
 
     def wait(self, rid, timeout=120.0):

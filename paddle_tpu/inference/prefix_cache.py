@@ -54,18 +54,44 @@ import numpy as np
 
 from ..reliability.faults import PREFIX_DONATE, PREFIX_EVICT
 
-__all__ = ["PrefixCache", "PrefixMatch"]
+__all__ = ["PrefixCache", "PrefixMatch", "prefix_fingerprints"]
+
+# root value of the rolling fingerprint chain (sketch()/
+# prefix_fingerprints must agree on it for membership tests to work)
+_SKETCH_ROOT = 0
+
+
+def prefix_fingerprints(ids, page_size, max_tokens=None):
+    """Rolling fingerprints of the page-aligned prefixes of ``ids``:
+    entry ``k`` identifies the first ``(k + 1) * page_size`` tokens.
+    Built with the same chain as ``PrefixCache.sketch()``, so
+    ``fps[k] in sketch`` answers "does that replica's radix tree hold
+    this exact page-aligned prefix?" with no tree (or device) access —
+    the router's affinity signal. ``max_tokens`` caps the covered
+    prefix (the server matches at most ``T - 1`` tokens so the
+    remainder prefill still emits first-token logits). Int-tuple
+    hashing is unsalted in CPython, so fingerprints are stable across
+    processes with the same token stream."""
+    ids = np.asarray(ids).reshape(-1)
+    n = len(ids) if max_tokens is None else min(len(ids), int(max_tokens))
+    pg = int(page_size)
+    out, fp = [], _SKETCH_ROOT
+    for i in range(n // pg):
+        fp = hash((fp, tuple(int(x) for x in ids[i * pg:(i + 1) * pg])))
+        out.append(fp)
+    return out
 
 
 class _Node:
     """One cached page: ``key`` is the page's token tuple, ``page`` its
     pool id. ``last_used``/``seq`` order eviction (LRU, then insertion
-    order); ``pinned`` marks register_prefix entries."""
+    order); ``pinned`` marks register_prefix entries; ``fp`` is the
+    node's rolling path fingerprint (see ``sketch()``)."""
 
     __slots__ = ("key", "page", "parent", "children", "pinned",
-                 "last_used", "seq")
+                 "last_used", "seq", "fp")
 
-    def __init__(self, key, page, parent):
+    def __init__(self, key, page, parent, fp=0):
         self.key = key
         self.page = page
         self.parent = parent
@@ -73,6 +99,7 @@ class _Node:
         self.pinned = False
         self.last_used = 0
         self.seq = 0
+        self.fp = fp
 
 
 class PrefixMatch:
@@ -112,7 +139,17 @@ class PrefixCache:
     def __init__(self, kv, fault_injector=None):
         self.kv = kv
         self.page_size = kv.page_size
-        self._root = _Node(None, None, None)
+        self._root = _Node(None, None, None, fp=_SKETCH_ROOT)
+        # fingerprint index maintained INCREMENTALLY alongside the tree
+        # (one rolling hash per node) and published as an immutable
+        # snapshot, so a router can read sketch() without the server
+        # lock — a serve thread holds that lock for whole ticks. The
+        # snapshot is republished in BATCHES (flush_sketch, once per
+        # server tick / pin / evacuation), not per mutation: a
+        # multi-slot harvest pays one O(tree) copy, not one per slot.
+        self._sketch = set()
+        self._sketch_dirty = False
+        self.sketch_snapshot = frozenset()
         self._tick = 0          # logical LRU clock (bumped per touch)
         self._seq = 0           # insertion order, the deterministic tie-break
         self._protected = frozenset()   # node ids shielded from eviction
@@ -203,16 +240,19 @@ class PrefixCache:
                 self.kv.release([page])
                 self.dedup_pages_total += 1
             else:
-                child = _Node(key, page, node)
+                child = _Node(key, page, node, fp=hash((node.fp, key)))
                 self._seq += 1
                 child.seq = self._seq
                 node.children[key] = child
+                self._sketch.add(child.fp)
                 self.cached_pages += 1
                 new += 1
             self._touch(child)
             node = child
         self.kv.release(pages[nf:])
         self.donated_pages_total += new
+        if new:
+            self._sketch_dirty = True
         return new
 
     # ---------------------------------------------------------- eviction
@@ -267,10 +307,13 @@ class PrefixCache:
             victim = min(leaves, key=lambda n: (n.last_used, n.seq))
             del victim.parent.children[victim.key]
             safe.discard(victim)
+            self._sketch.discard(victim.fp)
             self.kv.release([victim.page])
             self.cached_pages -= 1
             self.evicted_pages_total += 1
             freed += 1
+        if freed:
+            self._sketch_dirty = True
         return freed
 
     # ----------------------------------------------------------- pinning
@@ -288,16 +331,47 @@ class PrefixCache:
         node = run[-1] if run else self._root
         ids = np.asarray(ids).reshape(-1)
         keys = self._page_keys(ids, len(ids) // self.page_size)
+        added = False
         for key, page in zip(keys[len(run):], own_pages):
-            child = _Node(key, page, node)
+            child = _Node(key, page, node, fp=hash((node.fp, key)))
             child.pinned = True
             self._seq += 1
             child.seq = self._seq
             self._touch(child)
             node.children[key] = child
+            self._sketch.add(child.fp)
+            added = True
             node = child
             self.pinned_pages += 1
+        if added:
+            self._sketch_dirty = True
         return [n.page for n in run] + list(own_pages)
+
+    # ---------------------------------------------------------- sketching
+    def flush_sketch(self):
+        """Republish the lock-free snapshot if the tree changed since
+        the last flush. The server calls this once per tick (plus at
+        register_prefix and evacuation boundaries) — off-tick
+        mutations (e.g. a client-thread cancel's donation) surface at
+        the next tick, which only staleness-bounds a routing HINT."""
+        if self._sketch_dirty:
+            self._sketch_dirty = False
+            self.sketch_snapshot = frozenset(self._sketch)
+
+    def sketch(self):
+        """Host-side fingerprint set of every page-aligned prefix the
+        tree currently caches (pinned and unpinned alike) — one rolling
+        hash per node, O(nodes) ints, zero device reads. A router keeps
+        one sketch per replica and routes a prompt to the replica whose
+        sketch covers its longest ``prefix_fingerprints`` run.
+
+        Returns the maintained IMMUTABLE snapshot, so it is safe to
+        call WITHOUT the server lock (a serve thread holds that lock
+        for whole decode ticks — the router must not queue behind it
+        just to pick a destination). A sketch is a ROUTING HINT, not a
+        contract: pages may be evicted right after it is read, which
+        costs the chosen replica a cache miss, never correctness."""
+        return self.sketch_snapshot
 
     # -------------------------------------------------------- accounting
     def stats(self):

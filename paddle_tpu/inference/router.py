@@ -1,0 +1,739 @@
+"""Multi-replica front door: cache-aware routing, failover, rolling
+restarts (ROADMAP item 5).
+
+One ``ContinuousBatchingServer`` is a survivable process (PR 3's
+supervision, PR 5's prefix cache, PR 6's ragged prefill) — but a single
+process per chip group is where "millions of users" actually breaks: a
+replica dying loses every queued request it holds, and a fleet without
+prefix-aware placement re-prefills the same system prompts on every
+replica. ``ReplicaRouter`` is the layer above N replicas that fixes
+both:
+
+Routing. Each replica exports a cheap host-side SKETCH of its
+radix-tree contents (``PrefixCache.sketch()`` — rolling page-key
+fingerprints, no device reads). ``submit()`` routes a prompt to the
+replica whose sketch covers its longest page-aligned prefix
+(``prefix_fingerprints``) — the same locality insight that motivates
+Ragged Paged Attention's page reuse (PAPERS.md), applied one level up:
+KV reuse only helps if same-prefix traffic lands on the same pool.
+Ties (and sketch misses) fall back to least-loaded by the replicas'
+already-exported queue-depth / in-flight / health signals.
+``policy="round_robin"`` is the affinity-blind baseline the router
+bench compares against.
+
+Robustness. A ``RouterSupervisor`` (per-replica ``CircuitBreaker`` +
+``RetryPolicy`` backoff + the shared ``is_serving_state`` verdict)
+watches each replica's health: when one goes ``draining`` or ``dead``
+its queued requests are harvested via
+``ContinuousBatchingServer.evacuate()`` and requeued onto siblings —
+bit-exact, because the harvested entries carry their RESOLVED sampling
+seeds — while a dead replica's mid-decode slots flush their partial
+tokens to waiters exactly as ``stop(drain=False)`` does (mid-decode
+work is not replayable without double-streaming). A harvested request
+no sibling can take RIGHT NOW (backpressure, every candidate
+transiently down) is HELD at the router — the ``router_queue_depth``
+backlog, retried every poll — and fails with typed
+``ReplicaLostError`` only when the whole fleet is down. Per-replica
+circuit breakers divert traffic from a flapping replica after
+consecutive dispatch failures, and ``rolling_restart()`` bounces the
+fleet one replica at a time with zero failed requests. Request-level
+outcomes (deadline expiry, cancellation, a poisoned stream, a
+replica's own breaker opening) pass through to the client unchanged —
+the router makes replica LOSS transparent, not request failure.
+
+Deadlines hold end to end: ``submit(deadline_s=...)`` fixes an ABSOLUTE
+deadline at the router; every (re)dispatch passes the REMAINING budget
+to the replica, so time spent queued at the router — or stranded on a
+dead replica — is charged against it.
+
+Chaos: ``fault_injector`` arms ``router.dispatch`` (one replica submit
+attempt; fires fall through to the next candidate and feed that
+replica's breaker) and ``router.evacuate`` (a harvest sweep; fires
+abort the sweep — requests stay put and the next supervisor poll
+retries).
+
+Everything here is host-side and replica-agnostic: the router only
+touches the public server surface (``submit`` / ``wait`` / ``cancel`` /
+``evacuate`` / ``health`` / ``queue_depth`` / ``in_flight`` /
+``prefix_sketch`` / ``stop`` / ``start``).
+"""
+import threading
+
+import numpy as np
+
+from ..core.tensor import unwrap
+from ..reliability import (CircuitBreaker, DEAD, DEGRADED, DeadlineExceeded,
+                           HEALTHY, QueueFullError, ReliabilityError,
+                           ReplicaLostError, RequestCancelled, RetryPolicy,
+                           ServerClosed, faults, is_serving_state)
+from ..telemetry.clock import MonotonicClock
+from .prefix_cache import prefix_fingerprints
+
+__all__ = ["ReplicaRouter", "RouterSupervisor"]
+
+
+class _RouterRequest:
+    """Everything needed to (re)dispatch one request to any replica."""
+
+    __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
+                 "cancelled")
+
+    def __init__(self, rid, ids, budget, seed, on_token, deadline):
+        self.rid = rid
+        self.ids = ids
+        self.budget = budget
+        self.seed = seed              # RESOLVED at router submit: a
+        self.on_token = on_token      # requeued sibling draws the
+        self.deadline = deadline      # identical sampling chain
+        self.cancelled = False
+
+
+class _Route:
+    """Where a router rid currently lives. ``gen`` bumps on every
+    requeue so a ``wait()`` blocked on the OLD replica can tell a stale
+    error from a real one."""
+
+    __slots__ = ("idx", "rrid", "gen", "item")
+
+    def __init__(self, idx, rrid, gen, item):
+        self.idx = idx
+        self.rrid = rrid
+        self.gen = gen
+        self.item = item
+
+
+class RouterSupervisor:
+    """Health watcher + failover driver for one ``ReplicaRouter``.
+
+    Built from the existing reliability primitives: the shared
+    ``is_serving_state`` verdict decides who takes traffic, per-replica
+    ``CircuitBreaker``s (owned by the router) divert flapping replicas,
+    and a ``RetryPolicy`` backs off the supervisor thread after a
+    failed failover sweep (an injected ``router.evacuate`` fault keeps
+    the requests ON the replica; a sibling fleet too full to absorb
+    the harvest keeps them in the ROUTER's backlog — both retry here).
+
+    ``poll()`` is ONE deterministic sweep — evacuations first, then a
+    retry pass over the router-held backlog. Single-threaded tests
+    call it directly; ``ReplicaRouter.start()`` runs it on a
+    background thread. It never raises: per-replica failover errors
+    are counted (``failed_sweeps``, ``last_error``) and retried on the
+    next poll.
+    """
+
+    def __init__(self, router, retry=None):
+        self._router = router
+        self.retry = retry if retry is not None else RetryPolicy()
+        n = len(router.replicas)
+        self.last_states = [None] * n   # last health seen per replica
+        self.failed_sweeps = 0
+        self.last_error = None
+
+    def poll(self):
+        """One watch sweep: evacuate + requeue every non-serving
+        replica that still holds work. Returns the number of failover
+        attempts that FAILED this sweep (0 = converged)."""
+        r = self._router
+        errors = 0
+        for idx, rep in enumerate(r.replicas):
+            state = rep.health
+            self.last_states[idx] = state
+            if is_serving_state(state):
+                continue
+            dead = state == DEAD
+            # cheap pre-check so an idle dead/draining replica costs
+            # two lock hops per poll, not an evacuation sweep
+            if rep.queue_depth() == 0 \
+                    and not (dead and rep.in_flight() > 0):
+                continue
+            try:
+                r._failover(idx, flush_partials=dead)
+            except Exception as e:    # injected router.evacuate fault:
+                errors += 1           # the requests stay put on the
+                self.last_error = e   # replica; retry next poll
+        r._drain_backlog()            # router-held requests (sibling
+        if errors:                    # backpressure) retry every sweep
+            self.failed_sweeps += 1
+        r._publish_health()
+        return errors
+
+
+class ReplicaRouter:
+    """Cache-aware, failure-tolerant front door over N
+    ``ContinuousBatchingServer`` replicas.
+
+    >>> reps = [ContinuousBatchingServer(model, cache_backend="paged",
+    ...                                  ...) for _ in range(3)]
+    >>> router = ReplicaRouter(reps).start()       # starts replicas +
+    >>> rid = router.submit(prompt, max_new_tokens=32)   # supervisor
+    >>> tokens = router.wait(rid)
+    >>> router.rolling_restart()                   # zero failed requests
+    >>> router.stop()
+
+    ``policy``: ``"affinity"`` (default — longest cached prefix wins,
+    least-loaded fallback), ``"least_loaded"``, or ``"round_robin"``
+    (the affinity-blind bench baseline).
+
+    ``telemetry`` (``telemetry.RouterTelemetry``, or ``True`` for a
+    default one) publishes per-replica routed/affinity/requeue
+    counters, the router backlog gauge, and the aggregate
+    ``router_health`` gauge; ``serving.serve_metrics(router)`` fronts
+    the fleet with one ``/healthz`` (200 iff >= 1 replica is serving).
+
+    Clocks: deadline math spans router and replicas, so construct the
+    replicas with the SAME clock as the router when injecting a
+    ``FakeClock`` (real ``MonotonicClock``s already share a time base).
+
+    All traffic must flow through the router: it requeues only requests
+    it routed itself (foreign rids found in an evacuated queue are
+    dropped back to their own waiters' timeout).
+    """
+
+    def __init__(self, replicas, policy="affinity", seed=0,
+                 telemetry=None, clock=None, fault_injector=None,
+                 breakers=None, retry_policy=None, wait_slice=0.05):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        if policy not in ("affinity", "least_loaded", "round_robin"):
+            raise ValueError(f"policy must be 'affinity', 'least_loaded'"
+                             f" or 'round_robin', got {policy!r}")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self._seed = int(seed)
+        if telemetry is True:
+            from ..telemetry import RouterTelemetry
+            telemetry = RouterTelemetry(clock=clock)
+        self.telemetry = telemetry
+        self._tele = telemetry if (telemetry is not None
+                                   and telemetry.enabled) else None
+        self._clock = clock if clock is not None else (
+            telemetry.clock if self._tele is not None else MonotonicClock())
+        self._faults = fault_injector
+        n = len(self.replicas)
+        if breakers is None:
+            breakers = [CircuitBreaker(failure_threshold=3,
+                                       reset_after_s=5.0,
+                                       clock=self._clock)
+                        for _ in range(n)]
+        if len(breakers) != n:
+            raise ValueError(f"need one breaker per replica "
+                             f"({n}), got {len(breakers)}")
+        self._breakers = list(breakers)
+        self._wait_slice = float(wait_slice)
+        self._lock = threading.RLock()
+        self._routes = {}                    # rid -> _Route
+        self._by_replica = [dict() for _ in range(n)]   # rrid -> rid
+        self._failures = {}                  # rid -> ReliabilityError
+        self._backlog = []                   # rids held at the router:
+        #   harvested requests no sibling could take YET (backpressure,
+        #   or every candidate transiently down) — retried every poll
+        self._orphans = {}                   # (idx, rrid) -> ttl: rids
+        #   harvested from a replica BEFORE the dispatching thread
+        #   could record the route (the replica died inside that gap);
+        #   the recorder claims the entry and re-places instead of
+        #   routing to a corpse. Unclaimed entries (true foreign
+        #   traffic) age out after a few polls.
+        self._next_rid = 0
+        self._rr = 0                         # round-robin cursor
+        self._stats = {"routed": [0] * n, "affinity_hits": 0,
+                       "fallbacks": 0, "dispatch_retries": 0,
+                       "evacuations": 0, "requeued": 0,
+                       "replica_lost": 0, "restarts": 0}
+        self.supervisor = RouterSupervisor(self, retry=retry_policy)
+        self._stop_evt = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------ client
+    def submit(self, input_ids, max_new_tokens=32, seed=None,
+               on_token=None, deadline_s=None):
+        """Route one prompt to the best replica; returns a ROUTER
+        request id (collect with ``wait``). ``deadline_s`` fixes an
+        absolute deadline NOW — any time the request later spends
+        queued at the router (failover requeue) or on a replica is
+        charged against it. Raises ``QueueFullError`` when every
+        serving replica shed it (resubmit with backoff) and
+        ``ReplicaLostError`` when no replica is serving at all."""
+        ids = np.asarray(unwrap(input_ids)).astype(np.int32)
+        if ids.ndim == 2:
+            if ids.shape[0] != 1:
+                raise ValueError("submit() takes one request; batch by "
+                                 "calling submit() per row")
+            ids = ids[0]
+        if deadline_s is not None and deadline_s <= 0:
+            raise DeadlineExceeded(
+                f"deadline_s={deadline_s} is already expired")
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            if seed is None:
+                # resolve NOW: a replica-assigned default seed would
+                # change on requeue and break sampled-token parity
+                seed = self._seed + rid
+        deadline = None if deadline_s is None \
+            else self._clock.now() + float(deadline_s)
+        item = _RouterRequest(rid, ids, int(max_new_tokens), int(seed),
+                              on_token, deadline)
+        self._place(item, exclude=())
+        return rid
+
+    def wait(self, rid, timeout=120.0):
+        """Block until ``rid`` finishes ANYWHERE in the fleet; returns
+        its new tokens (possibly a partial, if its replica died
+        mid-decode). Follows the request across failover requeues;
+        typed ``ReliabilityError``s are raised directly."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if rid in self._failures:
+                    self._routes.pop(rid, None)
+                    raise self._failures.pop(rid)
+                route = self._routes.get(rid)
+                if route is None:
+                    raise KeyError(f"unknown request id {rid} (never "
+                                   f"submitted, or already collected)")
+                idx, rrid, gen = route.idx, route.rrid, route.gen
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"request {rid} not finished in {timeout}s")
+            try:
+                out = self.replicas[idx].wait(
+                    rrid, timeout=min(remaining, self._wait_slice))
+            except TimeoutError:
+                continue              # re-read the route: it may have
+            except ReliabilityError:  # moved to a sibling meanwhile
+                with self._lock:
+                    cur = self._routes.get(rid)
+                    if cur is not None and cur.gen != gen:
+                        continue      # requeued mid-wait; stale error
+                    if rid in self._failures:
+                        self._routes.pop(rid, None)
+                        raise self._failures.pop(rid)
+                    self._routes.pop(rid, None)
+                    self._by_replica[idx].pop(rrid, None)
+                raise
+            except RuntimeError as e:
+                # a DEAD SERVE THREAD raises a generic RuntimeError for
+                # every waiter WITHOUT consuming any per-rid state —
+                # the request is still queued/in-flight on the corpse
+                # and the supervisor's next poll will harvest it; keep
+                # waiting instead of leaking a raw thread death to the
+                # client. (ReliabilityError subclasses RuntimeError, so
+                # typed per-rid failures were already handled above.)
+                # Identified by __cause__ IDENTITY with the replica's
+                # recorded thread error: a wrapped per-request
+                # admission failure also arrives as RuntimeError but
+                # DID consume the rid's state — that one must re-raise,
+                # even when the thread has also died.
+                with self._lock:
+                    cur = self._routes.get(rid)
+                    if cur is not None and cur.gen != gen:
+                        continue
+                    if rid in self._failures:
+                        self._routes.pop(rid, None)
+                        raise self._failures.pop(rid)
+                rep = self.replicas[idx]
+                if rep._thread_error is not None \
+                        and e.__cause__ is rep._thread_error \
+                        and not is_serving_state(rep.health):
+                    # failover pending; stay blocked (the corpse's
+                    # wait() raises instantly, so pace the loop)
+                    _time.sleep(min(self._wait_slice, 0.01))
+                    continue
+                with self._lock:
+                    self._routes.pop(rid, None)
+                    self._by_replica[idx].pop(rrid, None)
+                raise
+            else:
+                with self._lock:
+                    self._routes.pop(rid, None)
+                    self._by_replica[idx].pop(rrid, None)
+                return out
+
+    def cancel(self, rid):
+        """Best-effort cancel wherever the request currently lives.
+        A request mid-failover (harvested, not yet requeued) is failed
+        with ``RequestCancelled`` instead of being requeued."""
+        with self._lock:
+            route = self._routes.get(rid)
+            if route is None:
+                return False
+            route.item.cancelled = True
+            idx, rrid = route.idx, route.rrid
+        return self.replicas[idx].cancel(rrid)
+
+    # ----------------------------------------------------------- routing
+    def _candidates(self, ids, exclude=()):
+        """(ordered replica indices to try, {idx: affinity tokens}).
+        Serving replicas only (health + closed breaker), best first."""
+        if self._tele is not None:
+            # gauge from the UNFILTERED health scan (matches .health):
+            # a requeue's source exclusion must not read as a capacity
+            # dip on dashboards
+            self._tele.set_serving(sum(
+                1 for rep in self.replicas
+                if is_serving_state(rep.health)))
+        serving = [idx for idx, rep in enumerate(self.replicas)
+                   if idx not in exclude
+                   and is_serving_state(rep.health)
+                   and self._breakers[idx].would_allow()]
+        aff = {idx: 0 for idx in serving}
+        if not serving:
+            return [], aff
+        if self.policy == "round_robin":
+            with self._lock:
+                k = self._rr % len(serving)
+                self._rr += 1
+            return serving[k:] + serving[:k], aff
+        load = {idx: (self.replicas[idx].queue_depth()
+                      + self.replicas[idx].in_flight())
+                for idx in serving}
+        if self.policy == "affinity":
+            fps_by_pg = {}
+            for idx in serving:
+                pg = self.replicas[idx].page_size
+                if not pg:
+                    continue          # dense backend: nothing to be
+                if pg not in fps_by_pg:                 # affine to
+                    fps_by_pg[pg] = prefix_fingerprints(
+                        ids, pg, max_tokens=ids.shape[0] - 1)
+                sketch = self.replicas[idx].prefix_sketch()
+                k = 0
+                for fp in fps_by_pg[pg]:
+                    if fp not in sketch:
+                        break
+                    k += 1
+                aff[idx] = k * pg
+            order = sorted(serving,
+                           key=lambda i: (-aff[i], load[i], i))
+        else:                         # least_loaded
+            order = sorted(serving, key=lambda i: (load[i], i))
+        return order, aff
+
+    def _dispatch(self, idx, item):
+        """One replica submit attempt (the ``router.dispatch`` chaos
+        point); returns the REPLICA rid. Charges elapsed time against
+        the request's absolute deadline."""
+        if self._faults is not None:
+            self._faults.check(faults.ROUTER_DISPATCH, rid=item.rid,
+                               replica=idx)
+        deadline_s = None
+        if item.deadline is not None:
+            deadline_s = item.deadline - self._clock.now()
+            if deadline_s <= 0:
+                raise DeadlineExceeded(
+                    f"request {item.rid} expired before it could be "
+                    f"dispatched to a replica")
+        return self.replicas[idx].submit(
+            item.ids, max_new_tokens=item.budget, seed=item.seed,
+            on_token=item.on_token, deadline_s=deadline_s)
+
+    def _place(self, item, exclude=()):
+        """Dispatch ``item`` to the best willing replica; record the
+        route. Raises typed when nobody takes it: ``QueueFullError``
+        if every serving replica shed, ``DeadlineExceeded`` if the
+        deadline ran out first, else ``ReplicaLostError``."""
+        for _rescan in range(4):      # orphan claims force a fresh
+            order, aff = self._candidates(item.ids, exclude)   # scan
+            last_err = None
+            rescan = False
+            for idx in order:
+                if not self._breakers[idx].allow():
+                    continue   # opened since the candidate scan; the
+                try:           # mutating open->half_open probe gate
+                    rrid = self._dispatch(idx, item)   # happens HERE
+                except DeadlineExceeded:
+                    raise             # total expiry: siblings can't help
+                except (QueueFullError, ServerClosed) as e:
+                    # replica-level shed / drain race: divert, don't
+                    # trip the breaker — healthy, just unwilling
+                    last_err = e
+                    self._note_retry(idx)
+                    continue
+                except Exception as e:
+                    # dispatch fault / unexpected submit error: this is
+                    # what "flapping" looks like from the router — feed
+                    # the replica's breaker
+                    last_err = e
+                    self._breakers[idx].record_failure()
+                    self._note_retry(idx)
+                    continue
+                self._breakers[idx].record_success()
+                hit = aff.get(idx, 0) > 0
+                with self._lock:
+                    if self._orphans.pop((idx, rrid), None) is not None:
+                        # the replica accepted this request and died —
+                        # and the supervisor already harvested it —
+                        # before we could record the route. The request
+                        # exists NOWHERE now; recording would point a
+                        # waiter at a corpse forever. Start over with a
+                        # FRESH candidate scan (the fleet just changed
+                        # under us — the stale tail of this order is
+                        # not the full picture).
+                        rescan = True
+                    else:
+                        prev = self._routes.get(item.rid)
+                        gen = 0 if prev is None else prev.gen + 1
+                        self._routes[item.rid] = _Route(idx, rrid, gen,
+                                                        item)
+                        self._by_replica[idx][rrid] = item.rid
+                        self._stats["routed"][idx] += 1
+                        if hit:
+                            self._stats["affinity_hits"] += 1
+                        else:
+                            self._stats["fallbacks"] += 1
+                if rescan:
+                    break
+                if self._tele is not None:
+                    self._tele.on_routed(idx, hit)
+                return idx
+            if rescan:
+                continue              # re-scan (bounded: each retry
+            break                     # needs ANOTHER mid-gap death)
+        if isinstance(last_err, QueueFullError):
+            raise last_err            # backpressure, not loss: resubmit
+        err = ReplicaLostError(
+            f"request {item.rid}: no serving replica could take it "
+            f"({len(self.replicas)} replicas total)")
+        err.__cause__ = last_err
+        raise err
+
+    def _note_retry(self, idx):
+        with self._lock:
+            self._stats["dispatch_retries"] += 1
+        if self._tele is not None:
+            self._tele.on_dispatch_retry(idx)
+
+    # ---------------------------------------------------------- failover
+    def _failover(self, idx, flush_partials):
+        """Harvest replica ``idx``'s queue (the ``router.evacuate``
+        chaos point — an injected fault aborts BEFORE any state moves)
+        and requeue everything onto siblings."""
+        if self._faults is not None:
+            self._faults.check(faults.ROUTER_EVACUATE, replica=idx)
+        harvested = self.replicas[idx].evacuate(
+            flush_partials=flush_partials)
+        with self._lock:
+            self._stats["evacuations"] += 1
+        if self._tele is not None:
+            self._tele.on_evacuation(idx)
+        self._requeue(idx, harvested)
+
+    def _requeue(self, src, harvested):
+        """Re-place harvested requests on siblings, oldest first. A
+        request nobody can take RIGHT NOW is held at the router (the
+        ``router_queue_depth`` backlog, retried every poll) as long as
+        the condition looks transient — sibling backpressure, or every
+        candidate momentarily down; it fails typed only when the whole
+        fleet is dead (``ReplicaLostError``), its deadline ran out
+        while stranded (``DeadlineExceeded``), or it was cancelled."""
+        for pending in harvested:
+            with self._lock:
+                rid = self._by_replica[src].pop(pending.rid, None)
+                route = self._routes.get(rid) if rid is not None else None
+                if route is None:
+                    # either true foreign traffic, or a router dispatch
+                    # whose route is not recorded YET (the replica died
+                    # between accepting the submit and the dispatching
+                    # thread re-taking the router lock): park it so the
+                    # recorder can claim-and-replace instead of routing
+                    # the waiter to a corpse
+                    self._orphans[(src, pending.rid)] = 3   # polls to live
+                    continue
+            self._try_place(rid, route.item, exclude=(src,))
+        self._publish_backlog()
+
+    def _try_place(self, rid, item, exclude=()):
+        """One requeue attempt for a router-held request; places it,
+        holds it in the backlog, or fails it typed (see ``_requeue``)."""
+        if item.cancelled:
+            self._record_failure(rid, RequestCancelled(
+                f"request {rid} cancelled during failover"))
+            return
+        if item.deadline is not None \
+                and self._clock.now() >= item.deadline:
+            self._record_failure(rid, DeadlineExceeded(
+                f"request {rid} expired while awaiting requeue"))
+            return
+        try:
+            dst = self._place(item, exclude=exclude)
+        except (DeadlineExceeded, RequestCancelled) as e:
+            self._record_failure(rid, e)
+        except QueueFullError:
+            # sibling backpressure is TRANSIENT: hold the request at
+            # the router and retry next poll — failing it here would
+            # turn a seconds-long full queue into a lost request
+            with self._lock:
+                self._backlog.append(rid)
+        except ReliabilityError as e:
+            if any(is_serving_state(rep.health)
+                   for rep in self.replicas):
+                # someone is alive but could not take it this sweep
+                # (excluded source, drain race, injected dispatch
+                # faults on every candidate): transient — hold it
+                with self._lock:
+                    self._backlog.append(rid)
+                return
+            err = e if isinstance(e, ReplicaLostError) else \
+                ReplicaLostError(
+                    f"request {rid}: its replica was lost and no "
+                    f"sibling could take the requeue")
+            if err is not e:
+                err.__cause__ = e
+            with self._lock:
+                self._stats["replica_lost"] += 1
+            if self._tele is not None:
+                self._tele.on_replica_lost()
+            self._record_failure(rid, err)
+        else:
+            with self._lock:
+                self._stats["requeued"] += 1
+            if self._tele is not None:
+                self._tele.on_requeued(dst)
+
+    def _drain_backlog(self):
+        """Retry every router-held request (called once per supervisor
+        poll). No source exclusion here: a restarted replica may take
+        its old work back."""
+        with self._lock:
+            backlog, self._backlog = self._backlog, []
+            # age out unclaimed orphan entries (true foreign traffic)
+            self._orphans = {k: ttl - 1
+                             for k, ttl in self._orphans.items()
+                             if ttl > 1}
+        for rid in backlog:
+            with self._lock:
+                route = self._routes.get(rid)
+            if route is None:
+                continue              # settled/cancelled meanwhile
+            self._try_place(rid, route.item)
+        self._publish_backlog()
+
+    def _publish_backlog(self):
+        if self._tele is not None:
+            with self._lock:
+                n = len(self._backlog)
+            self._tele.set_backlog(n)
+
+    @property
+    def backlog(self):
+        """Requests currently held at the router awaiting a sibling
+        that can take them (the ``router_queue_depth`` gauge)."""
+        with self._lock:
+            return len(self._backlog)
+
+    def _record_failure(self, rid, err):
+        # wait() notices within one poll slice; no condition variable
+        # needed (waiters block on the REPLICA's cv, not the router's)
+        with self._lock:
+            self._routes.pop(rid, None)
+            self._failures[rid] = err
+
+    # ------------------------------------------------------------ health
+    @property
+    def health(self):
+        """Aggregate fleet health: ``healthy`` (all replicas serving),
+        ``degraded`` (some down, still taking traffic), ``dead`` (none
+        serving). ``/healthz`` via ``serve_metrics(router)`` answers
+        200 iff this is a serving state — i.e. >= 1 replica up."""
+        n_serving = sum(1 for rep in self.replicas
+                        if is_serving_state(rep.health))
+        if n_serving == len(self.replicas):
+            return HEALTHY
+        return DEGRADED if n_serving else DEAD
+
+    def _publish_health(self):
+        if self._tele is not None:
+            self._tele.set_health(self.health)
+
+    @property
+    def stats(self):
+        """Copy of the router counters: per-replica ``routed``,
+        ``affinity_hits`` / ``fallbacks``, ``dispatch_retries``,
+        ``evacuations`` / ``requeued`` / ``replica_lost``,
+        ``restarts``."""
+        with self._lock:
+            out = dict(self._stats)
+            out["routed"] = list(out["routed"])
+            return out
+
+    @property
+    def failures(self):
+        """{rid: exception} for requests the router itself failed
+        (``wait(rid)`` pops and raises each)."""
+        with self._lock:
+            return dict(self._failures)
+
+    def poll(self):
+        """One supervisor sweep (see ``RouterSupervisor.poll``) —
+        single-threaded/deterministic drives call this instead of
+        ``start()``."""
+        return self.supervisor.poll()
+
+    # --------------------------------------------------------- lifecycle
+    def start(self, poll_interval=0.01, start_replicas=True):
+        """Start the supervisor thread (and, by default, any replica
+        serve thread not already running). The supervisor polls health
+        every ``poll_interval`` seconds, backing off by the retry
+        policy after a failed failover sweep."""
+        if self._thread is not None:
+            raise RuntimeError("router already started")
+        if start_replicas:
+            for rep in self.replicas:
+                if rep._thread is None:
+                    rep.start()
+        self._stop_evt.clear()
+
+        def loop():
+            attempt = 0
+            delay = poll_interval
+            while not self._stop_evt.wait(delay):
+                errors = self.supervisor.poll()
+                if errors:
+                    delay = poll_interval \
+                        + self.supervisor.retry.delay(attempt)
+                    attempt += 1
+                else:
+                    delay = poll_interval
+                    attempt = 0
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True, timeout=60.0, stop_replicas=True):
+        """Stop the supervisor thread, then (by default) every replica
+        — gracefully with ``drain=True``."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        if stop_replicas:
+            for rep in self.replicas:
+                rep.stop(timeout=timeout, drain=drain)
+        self._publish_health()
+
+    def rolling_restart(self, drain_timeout=120.0):
+        """Bounce every replica one at a time with ZERO failed
+        requests: its queued work is evacuated to siblings first (they
+        also absorb all new traffic once health goes ``draining``),
+        in-flight requests finish during the graceful drain, then the
+        replica restarts and rejoins the rotation before the next one
+        goes down."""
+        for idx, rep in enumerate(self.replicas):
+            harvested = rep.evacuate()      # queued -> siblings now,
+            with self._lock:                # instead of riding out the
+                self._stats["evacuations"] += 1   # drain wall
+            if self._tele is not None:
+                self._tele.on_evacuation(idx)
+            self._requeue(idx, harvested)
+            rep.stop(drain=True, timeout=drain_timeout)
+            rep.start()
+            # requests the requeue parked under sibling backpressure
+            # must not wait for a supervisor thread that may not be
+            # running — the restarted replica can take them now
+            self._drain_backlog()
+            with self._lock:
+                self._stats["restarts"] += 1
+            self._publish_health()

@@ -247,12 +247,14 @@ class BatchScheduler:
 def serve_metrics(target, host="127.0.0.1", port=0):
     """Expose a serving stack's telemetry over HTTP: ``/metrics``
     (Prometheus text), ``/stats`` (JSON snapshot + process stats), and
-    — when ``target`` reports health (``ContinuousBatchingServer``) —
-    ``/healthz`` (200 healthy/degraded, 503 draining/dead: the
-    load-balancer readiness contract).
+    — when ``target`` reports health — ``/healthz`` (200 while serving,
+    503 otherwise: the load-balancer readiness contract).
 
     ``target`` is a ``ContinuousBatchingServer`` (uses its attached
-    ``telemetry``), a ``ServerTelemetry``, or a bare ``MetricRegistry``.
+    ``telemetry``), a ``router.ReplicaRouter`` (its ``/healthz``
+    AGGREGATES the fleet: 200 iff >= 1 replica is serving, and
+    ``/stats`` carries per-replica health/queue/stats), a
+    ``ServerTelemetry``, or a bare ``MetricRegistry``.
     Returns a started ``telemetry.MetricsServer`` (``.url``, ``.port``,
     ``.close()``). ``port=0`` binds an ephemeral port.
     """
@@ -265,7 +267,18 @@ def serve_metrics(target, host="127.0.0.1", port=0):
             "server has no telemetry attached — construct it with "
             "telemetry=True (or a ServerTelemetry) to expose metrics")
     registry = getattr(tele, "registry", tele)
-    if hasattr(target, "stats"):          # ContinuousBatchingServer
+    if hasattr(target, "replicas"):       # ReplicaRouter front door
+
+        def extra():
+            stats = dict(target.stats)
+            stats["replicas"] = [
+                {"health": rep.health,
+                 "queue_depth": rep.queue_depth(),
+                 "in_flight": rep.in_flight(),
+                 "stats": dict(rep.stats)}
+                for rep in target.replicas]
+            return stats
+    elif hasattr(target, "stats"):        # ContinuousBatchingServer
         kv = getattr(target, "_kv", None)
 
         def extra():

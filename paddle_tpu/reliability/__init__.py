@@ -30,12 +30,13 @@ clock protocol), and deterministic under test.
 """
 from .errors import (CallbackError, CheckpointCorruptError,  # noqa: F401
                      CircuitOpenError, DeadlineExceeded, InjectedFault,
-                     QueueFullError, ReliabilityError, RequestCancelled,
-                     SchedulerClosed, ServerClosed, StepFailedError,
-                     TrainAnomalyError)
+                     QueueFullError, ReliabilityError, ReplicaLostError,
+                     RequestCancelled, SchedulerClosed, ServerClosed,
+                     StepFailedError, TrainAnomalyError)
 from .faults import (CKPT_RENAME, CKPT_SWAP, CKPT_WRITE,  # noqa: F401
                      DATA_NEXT, DECODE_TICK, FaultInjector, ON_TOKEN,
-                     PAGE_ALLOC, PREFILL, TRAIN_STEP)
+                     PAGE_ALLOC, PREFILL, ROUTER_DISPATCH,
+                     ROUTER_EVACUATE, TRAIN_STEP)
 from .health import (DEAD, DEGRADED, DRAINING, HEALTH_CODES,  # noqa: F401
                      HEALTHY, HealthMonitor, is_serving_state)
 from .retry import CircuitBreaker, RetryPolicy  # noqa: F401
@@ -49,14 +50,15 @@ from .training import (AnomalyPolicy, ResumableLoader,  # noqa: F401
 
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
-           "CircuitOpenError", "InjectedFault", "CallbackError",
-           "CheckpointCorruptError", "TrainAnomalyError",
+           "CircuitOpenError", "ReplicaLostError", "InjectedFault",
+           "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError",
            "RetryPolicy", "CircuitBreaker", "ServeSupervisor",
            "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "DEAD",
            "HEALTH_CODES", "is_serving_state",
            "FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
-           "ON_TOKEN", "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
+           "ON_TOKEN", "ROUTER_DISPATCH", "ROUTER_EVACUATE",
+           "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
            "TRAIN_STEP", "DATA_NEXT",
            "write_checkpoint", "read_checkpoint", "verify_checkpoint",
            "checkpoint_meta", "recover_interrupted_swaps",

@@ -10,8 +10,9 @@ these DIRECTLY (no RuntimeError wrapping) — a client distinguishing
 
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
-           "CircuitOpenError", "InjectedFault", "CallbackError",
-           "CheckpointCorruptError", "TrainAnomalyError", "StepFailedError"]
+           "CircuitOpenError", "ReplicaLostError", "InjectedFault",
+           "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
+           "StepFailedError"]
 
 
 class ReliabilityError(RuntimeError):
@@ -53,6 +54,19 @@ class CircuitOpenError(ReliabilityError):
     failures): in-flight and queued requests are failed with this so no
     waiter wedges, and the server goes ``degraded`` until a half-open
     probe tick succeeds. ``__cause__`` is the last tick error."""
+
+
+class ReplicaLostError(ReliabilityError):
+    """The multi-replica router could not place (or re-place) this
+    request on ANY replica: no replica was serving at submit, or the
+    replica holding it died and the requeue found the whole fleet
+    down (while any sibling is alive the router HOLDS the request and
+    keeps retrying instead). ``__cause__`` is the last per-replica
+    error. Request-level outcomes pass through the router unchanged —
+    ``DeadlineExceeded``, ``RequestCancelled``, ``CallbackError``, and
+    a replica's breaker opening (``CircuitOpenError``, deliberately
+    fail-fast: its in-flight work may already have streamed tokens, so
+    transparent re-execution would double-stream)."""
 
 
 class InjectedFault(ReliabilityError):

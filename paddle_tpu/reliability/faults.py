@@ -27,7 +27,8 @@ import threading
 from .errors import InjectedFault
 
 __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
-           "ON_TOKEN", "PREFIX_EVICT", "PREFIX_DONATE", "CKPT_WRITE",
+           "ON_TOKEN", "PREFIX_EVICT", "PREFIX_DONATE",
+           "ROUTER_DISPATCH", "ROUTER_EVACUATE", "CKPT_WRITE",
            "CKPT_RENAME", "CKPT_SWAP", "TRAIN_STEP", "DATA_NEXT"]
 
 # failure points wired into the serving stack (callers may add their own)
@@ -38,6 +39,11 @@ ON_TOKEN = "server.on_token"        # streamed-token callback delivery
 PREFIX_EVICT = "prefix.evict"       # PrefixCache.evict: LRU reclaim sweep
 PREFIX_DONATE = "prefix.donate"     # PrefixCache.donate: harvest-time
 #                                     adoption of a slot's prompt pages
+
+# failure points wired into the multi-replica router (inference/router.py)
+ROUTER_DISPATCH = "router.dispatch"  # ReplicaRouter: one replica submit
+ROUTER_EVACUATE = "router.evacuate"  # RouterSupervisor: harvesting a
+#                                      lost replica's queued requests
 
 # failure points wired into the training / checkpoint stack
 CKPT_WRITE = "ckpt.write"           # durable save: per-file payload write

@@ -42,11 +42,30 @@ class HealthMonitor:
     the server uses it to publish the ``server_health`` gauge. The
     caller provides its own locking (the server mutates health under
     its serve lock).
+
+    A RAISING observer never blocks the transition: by the time
+    ``on_change`` runs the state is already committed, and health
+    transitions happen on failure paths (breaker opens, drains, thread
+    death) where an exception would wedge the very machinery doing the
+    failing. Observer errors are swallowed and kept in
+    ``observer_errors`` (bounded) for inspection instead.
     """
+
+    MAX_OBSERVER_ERRORS = 16
 
     def __init__(self, on_change=None):
         self.state = HEALTHY
         self._on_change = on_change
+        self.observer_errors = []   # [(state, exception)], newest last
+
+    def _notify(self, state):
+        if self._on_change is None:
+            return
+        try:
+            self._on_change(state, HEALTH_CODES[state])
+        except Exception as e:      # isolate: telemetry must never
+            self.observer_errors.append((state, e))   # block health
+            del self.observer_errors[:-self.MAX_OBSERVER_ERRORS]
 
     @property
     def code(self):
@@ -70,8 +89,7 @@ class HealthMonitor:
         if self.state == DRAINING and state != DEAD:
             return self.state
         self.state = state
-        if self._on_change is not None:
-            self._on_change(state, HEALTH_CODES[state])
+        self._notify(state)
         return self.state
 
     def reset(self):
@@ -79,6 +97,6 @@ class HealthMonitor:
         restart (``start()`` after ``stop()``), never mid-flight."""
         changed = self.state != HEALTHY
         self.state = HEALTHY
-        if changed and self._on_change is not None:
-            self._on_change(HEALTHY, HEALTH_CODES[HEALTHY])
+        if changed:
+            self._notify(HEALTHY)
         return self.state

@@ -93,6 +93,17 @@ class CircuitBreaker:
             return False
         return True
 
+    def would_allow(self):
+        """``allow()`` WITHOUT the open->half_open side effect: a pure
+        read for candidate FILTERING (the router scans every replica's
+        breaker per routing decision — flipping one half-open from a
+        scan that then routes elsewhere would leave its gate open with
+        no probe outcome ever recorded). Call ``allow()`` only at the
+        point of actually dispatching."""
+        if self.state == self.OPEN:
+            return self._clock.now() - self.opened_at >= self.reset_after_s
+        return True
+
     def record_success(self):
         self.state = self.CLOSED
         self.consecutive_failures = 0

@@ -32,7 +32,7 @@ from .metrics import (DEFAULT_BUCKETS, Counter, Gauge,  # noqa: F401
 from .tracing import NULL_SPAN, NullSpan, Span, Tracer  # noqa: F401
 from .exposition import (MetricsServer, parse_prometheus,  # noqa: F401
                          render_prometheus)
-from .serving import ServerTelemetry  # noqa: F401
+from .serving import RouterTelemetry, ServerTelemetry  # noqa: F401
 from .training import TelemetryCallback  # noqa: F401
 
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
@@ -40,7 +40,7 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "Tracer", "Span", "NullSpan", "NULL_SPAN",
            "MonotonicClock", "FakeClock",
            "MetricsServer", "render_prometheus", "parse_prometheus",
-           "ServerTelemetry", "TelemetryCallback",
+           "ServerTelemetry", "RouterTelemetry", "TelemetryCallback",
            "default_registry"]
 
 _default_registry = None

@@ -58,8 +58,8 @@ from .clock import MonotonicClock
 from .metrics import DEFAULT_BUCKETS, MetricRegistry
 from .tracing import Tracer
 
-__all__ = ["ServerTelemetry", "TPOT_BUCKETS", "TICK_BUCKETS",
-           "OCCUPANCY_BUCKETS"]
+__all__ = ["ServerTelemetry", "RouterTelemetry", "TPOT_BUCKETS",
+           "TICK_BUCKETS", "OCCUPANCY_BUCKETS"]
 
 # per-token / per-tick scales are finer than request-level latencies
 TPOT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -430,6 +430,118 @@ class ServerTelemetry:
     def set_health(self, state):
         """Publish the health gauge; ``state`` is the reliability
         health-state name (healthy/degraded/draining/dead)."""
+        if not self.enabled:
+            return
+        from ..reliability.health import HEALTH_CODES
+        self._g_health.set(HEALTH_CODES[state])
+
+
+class RouterTelemetry:
+    """Instrumentation for the multi-replica front door
+    (``inference.router.ReplicaRouter``):
+
+    - ``router_routed_total{replica}``      requests dispatched, by
+                                            destination
+    - ``router_affinity_hits_total``        dispatches won by prefix
+      affinity (the chosen replica's sketch covered >= 1 prompt page)
+    - ``router_fallback_total``             dispatches that fell back
+      to least-loaded (no replica held any prefix)
+    - ``router_dispatch_retries_total{replica}``  dispatch attempts
+      that failed and moved on to the next candidate
+    - ``router_evacuations_total{replica}`` harvest sweeps, by SOURCE
+    - ``router_requeued_total{replica}``    failover requeues, by
+                                            DESTINATION
+    - ``router_replica_lost_total``         requests failed with
+      ``ReplicaLostError`` (no sibling could take them)
+    - ``router_queue_depth``                harvested requests awaiting
+                                            redispatch
+    - ``router_replicas_serving``           replicas currently taking
+                                            traffic
+    - ``router_health``                     aggregate: 0 all serving /
+      1 some down / 3 none serving (same coding as ``server_health``)
+
+    Same conventions as ``ServerTelemetry``: every method no-ops when
+    the registry is disabled, calls happen under the router's lock (or
+    from its single supervisor thread), host-side only.
+    """
+
+    def __init__(self, registry=None, clock=None):
+        self.clock = clock if clock is not None else MonotonicClock()
+        self.registry = registry if registry is not None \
+            else MetricRegistry()
+        self.enabled = self.registry.enabled
+        r = self.registry
+        self._c_routed = r.counter(
+            "router_routed_total",
+            "Requests dispatched to a replica (by destination)",
+            labelnames=("replica",))
+        self._c_affinity = r.counter(
+            "router_affinity_hits_total",
+            "Dispatches routed by prefix affinity (sketch hit)")
+        self._c_fallback = r.counter(
+            "router_fallback_total",
+            "Dispatches that fell back to least-loaded routing")
+        self._c_retry = r.counter(
+            "router_dispatch_retries_total",
+            "Dispatch attempts that failed over to the next candidate",
+            labelnames=("replica",))
+        self._c_evac = r.counter(
+            "router_evacuations_total",
+            "Harvest sweeps over a lost replica's queue (by source)",
+            labelnames=("replica",))
+        self._c_requeued = r.counter(
+            "router_requeued_total",
+            "Requests requeued onto a sibling after failover "
+            "(by destination)", labelnames=("replica",))
+        self._c_lost = r.counter(
+            "router_replica_lost_total",
+            "Requests failed typed because no sibling could take them")
+        self._g_backlog = r.gauge(
+            "router_queue_depth",
+            "Harvested requests held by the router awaiting redispatch")
+        self._g_serving = r.gauge(
+            "router_replicas_serving",
+            "Replicas currently taking traffic (serving health, "
+            "breaker closed)")
+        self._g_health = r.gauge(
+            "router_health",
+            "Aggregate router health code: 0 all replicas serving / "
+            "1 some down / 3 none (alert on >= 1)")
+
+    def on_routed(self, replica, affinity_hit):
+        if not self.enabled:
+            return
+        self._c_routed.labels(replica=str(replica)).inc()
+        if affinity_hit:
+            self._c_affinity.inc()
+        else:
+            self._c_fallback.inc()
+
+    def on_dispatch_retry(self, replica):
+        if self.enabled:
+            self._c_retry.labels(replica=str(replica)).inc()
+
+    def on_evacuation(self, replica):
+        if self.enabled:
+            self._c_evac.labels(replica=str(replica)).inc()
+
+    def on_requeued(self, replica):
+        if self.enabled:
+            self._c_requeued.labels(replica=str(replica)).inc()
+
+    def on_replica_lost(self):
+        if self.enabled:
+            self._c_lost.inc()
+
+    def set_backlog(self, n):
+        if self.enabled:
+            self._g_backlog.set(n)
+
+    def set_serving(self, n):
+        if self.enabled:
+            self._g_serving.set(n)
+
+    def set_health(self, state):
         if not self.enabled:
             return
         from ..reliability.health import HEALTH_CODES

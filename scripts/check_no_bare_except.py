@@ -9,7 +9,8 @@ suites assert on them). ``except Exception`` is the floor. Benchmarks
 and tooling are covered too: a bench that swallows its own failure
 reports numbers for work that never ran.
 
-Scope-cut rule (ISSUE 6): under the serving/kernel dirs
+Scope-cut rule (ISSUE 6, dirs extended to reliability/ + telemetry/ by
+ISSUE 7): under the serving/kernel/reliability dirs
 (``SCOPE_CUT_DIRS``), every ``raise NotImplementedError("...")`` WITH a
 message must point at the ROADMAP item that will lift it (the string
 contains "ROADMAP") — that is what kept the paged+mesh and paged+int8
@@ -30,11 +31,16 @@ import sys
 DEFAULT_DIRS = ("paddle_tpu", "benchmarks", "scripts")
 
 # serving/kernel surfaces where a NotImplementedError is (almost
-# always) a recorded scope cut — the ROADMAP is its tracking issue
+# always) a recorded scope cut — the ROADMAP is its tracking issue.
+# reliability/ and telemetry/ joined with the multi-replica router
+# (ISSUE 7): scope cuts in the supervisor/failover machinery are
+# exactly the kind that silently bite during an incident.
 SCOPE_CUT_DIRS = (
     os.path.join("paddle_tpu", "inference"),
     os.path.join("paddle_tpu", "models"),
     os.path.join("paddle_tpu", "ops", "pallas"),
+    os.path.join("paddle_tpu", "reliability"),
+    os.path.join("paddle_tpu", "telemetry"),
 )
 OPT_OUT = "no-roadmap:"
 

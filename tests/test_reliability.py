@@ -119,6 +119,55 @@ class TestHealthMonitor:
         with pytest.raises(ValueError, match="unknown"):
             hm.to("sideways")
 
+    def test_raising_observer_never_blocks_transition(self):
+        """ISSUE 7 satellite regression: a raising on_change observer
+        used to propagate out of to() and wedge the state transition
+        mid-flight — health moves happen on FAILURE paths (breaker
+        opens, drains, thread death), exactly where an extra exception
+        does the most damage. Observers are now isolated: the
+        transition commits, nothing raises, the error is kept."""
+        boom = RuntimeError("telemetry sink is down")
+
+        def observer(state, code):
+            raise boom
+
+        hm = HealthMonitor(on_change=observer)
+        assert hm.to(DEGRADED) == DEGRADED      # committed, no raise
+        assert hm.state == DEGRADED
+        assert hm.to(DRAINING) == DRAINING
+        assert hm.to(DEAD) == DEAD
+        assert hm.reset() == HEALTHY            # reset path isolated too
+        assert [s for s, _ in hm.observer_errors] == \
+            [DEGRADED, DRAINING, DEAD, HEALTHY]
+        assert all(e is boom for _, e in hm.observer_errors)
+
+    def test_observer_errors_bounded(self):
+        hm = HealthMonitor(on_change=lambda s, c: 1 / 0)
+        for _ in range(3 * HealthMonitor.MAX_OBSERVER_ERRORS):
+            hm.to(DEGRADED)
+            hm.to(HEALTHY)
+        assert len(hm.observer_errors) == HealthMonitor.MAX_OBSERVER_ERRORS
+
+
+class TestWouldAllow:
+    def test_would_allow_is_a_pure_read(self):
+        """ISSUE 7: the router filters candidates with would_allow()
+        (pure) and gates the actual dispatch with allow() (mutating) —
+        a scan that routes elsewhere must not flip a breaker half-open
+        with no probe outcome ever recorded."""
+        fc = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_after_s=5.0,
+                           clock=fc)
+        b.record_failure()
+        assert b.state == b.OPEN
+        assert not b.would_allow()
+        fc.advance(6.0)
+        assert b.would_allow()
+        assert b.state == b.OPEN          # unchanged: no side effect
+        assert b.would_allow()            # idempotent
+        assert b.allow()                  # the dispatch gate mutates
+        assert b.state == b.HALF_OPEN
+
 
 # -------------------------------------------------------- supervisor
 

@@ -1,0 +1,766 @@
+"""Multi-replica front door (ISSUE 7): ReplicaRouter + RouterSupervisor
+over N StubModel ContinuousBatchingServer replicas — prefix-affinity
+routing on PrefixCache sketches, deadline charging across the router,
+replica failover via evacuate(), per-replica circuit breakers, rolling
+restarts, and the router chaos suite.
+
+Everything runs on the StubModel double (tests/_serving_stub.py): no
+transformer compiles, closed-form expected tokens, deterministic
+single-threaded drives (step() + poll()) wherever the assertion needs
+an exact trace, threaded start()/wait() where the contract under test
+is concurrent."""
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from _serving_stub import StubModel, stub_tokens
+from paddle_tpu.inference.continuous_batching import ContinuousBatchingServer
+from paddle_tpu.inference.prefix_cache import prefix_fingerprints
+from paddle_tpu.inference.router import ReplicaRouter
+from paddle_tpu.inference.serving import serve_metrics
+from paddle_tpu.reliability import (CircuitBreaker, DeadlineExceeded,
+                                    FaultInjector, QueueFullError,
+                                    ReliabilityError, ReplicaLostError,
+                                    RequestCancelled, RetryPolicy,
+                                    faults)
+from paddle_tpu.telemetry import FakeClock
+
+
+def _prompt(*toks):
+    return np.asarray(toks, np.int32)
+
+
+def _rep(**kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_cache_len", 64)
+    kw.setdefault("cache_backend", "paged")
+    kw.setdefault("page_size", 8)
+    return ContinuousBatchingServer(StubModel(), **kw)
+
+
+def _router(n=3, rep_kw=None, **kw):
+    reps = [_rep(**(rep_kw or {})) for _ in range(n)]
+    return ReplicaRouter(reps, **kw), reps
+
+
+def _drive(router, reps, max_iters=3000):
+    """Deterministic single-threaded drive: poll the supervisor and
+    step every serving replica until the whole fleet is idle (dead
+    replicas are never stepped — that is the crash being simulated)."""
+    idle = 0
+    for _ in range(max_iters):
+        router.poll()
+        busy = False
+        for rep in reps:
+            if rep.health == "dead":
+                continue
+            if rep.queue_depth() or rep.in_flight():
+                rep.step()
+                busy = True
+        if busy:
+            idle = 0
+        else:
+            idle += 1
+            if idle >= 2:        # one extra pass: poll may requeue
+                return
+    raise AssertionError("router drive did not converge")
+
+
+def _balanced(rep):
+    """Assert this replica's pool leaked nothing (live == 0 once idle)
+    and return the balance tuple."""
+    free, live, pinned, cached = rep.pool_balance()
+    assert live == 0, f"leaked {live} pages"
+    assert free + pinned + cached == rep._kv.num_pages - 1
+    return free, live, pinned, cached
+
+
+# ------------------------------------------------------------- routing
+
+class TestRouting:
+    def test_affinity_routes_shared_prefix_to_same_replica(self):
+        router, reps = _router()
+        shared = np.arange(16, dtype=np.int32) % 16       # 2 full pages
+        for i in range(5):
+            p = np.concatenate([shared, _prompt(i + 1)])
+            rid = router.submit(p, max_new_tokens=3)
+            _drive(router, reps)
+            np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                          stub_tokens(p, 3))
+        # first request was a sketch miss (fallback), every follow-up
+        # found the donated pages on the same replica
+        assert router.stats["affinity_hits"] == 4
+        assert router.stats["fallbacks"] == 1
+        routed = router.stats["routed"]
+        assert max(routed) == 5 and sum(routed) == 5
+        winner = reps[int(np.argmax(routed))]
+        assert winner.stats["prefix_auto_hits"] == 4
+        assert winner.stats["prefix_auto_hit_tokens"] == 4 * 16
+
+    def test_round_robin_cycles_serving_replicas(self):
+        router, reps = _router(policy="round_robin")
+        for i in range(6):
+            rid = router.submit(_prompt(1, 2, i + 1), max_new_tokens=2)
+            _drive(router, reps)
+            router.wait(rid, timeout=5)
+        assert router.stats["routed"] == [2, 2, 2]
+        assert router.stats["affinity_hits"] == 0
+
+    def test_fallback_is_least_loaded(self):
+        router, reps = _router()
+        # no prefixes cached anywhere: affinity 0 for everyone, so the
+        # queue-depth/in-flight load signal decides. Nothing is stepped
+        # between submits, so each lands on the emptiest replica.
+        rids = [router.submit(_prompt(7, i + 1), max_new_tokens=2)
+                for i in range(3)]
+        assert router.stats["routed"] == [1, 1, 1]
+        _drive(router, reps)
+        for rid in rids:
+            router.wait(rid, timeout=5)
+
+    def test_dense_replicas_route_by_load(self):
+        router, reps = _router(rep_kw={"cache_backend": "dense"})
+        rids = [router.submit(_prompt(3, i + 1), max_new_tokens=2)
+                for i in range(3)]
+        assert router.stats["routed"] == [1, 1, 1]
+        assert router.stats["affinity_hits"] == 0   # nothing to be
+        _drive(router, reps)                        # affine to
+        for rid in rids:
+            router.wait(rid, timeout=5)
+
+    def test_sketch_and_fingerprints_agree(self):
+        router, reps = _router(n=1)
+        p = np.arange(20, dtype=np.int32) % 16
+        rid = router.submit(p, max_new_tokens=3)
+        _drive(router, reps)
+        router.wait(rid, timeout=5)
+        sketch = reps[0].prefix_sketch()
+        fps = prefix_fingerprints(p, 8)            # 2 full pages cached
+        assert fps[0] in sketch and fps[1] in sketch
+        cold = prefix_fingerprints(_prompt(*([9] * 8)), 8)
+        assert cold[0] not in sketch
+
+    def test_no_replica_serving_raises_replica_lost(self):
+        router, reps = _router(n=2)
+        for rep in reps:
+            rep.kill()
+        with pytest.raises(ReplicaLostError):
+            router.submit(_prompt(1, 2), max_new_tokens=2)
+
+    def test_every_replica_shedding_raises_queue_full(self):
+        router, reps = _router(n=2, rep_kw={"max_queue": 0})
+        with pytest.raises(QueueFullError):
+            router.submit(_prompt(1, 2), max_new_tokens=2)
+        assert router.stats["dispatch_retries"] == 2   # both tried
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            _router(policy="sideways")
+
+    def test_spent_deadline_rejected_at_router(self):
+        router, _ = _router(n=1)
+        with pytest.raises(DeadlineExceeded):
+            router.submit(_prompt(1), max_new_tokens=2, deadline_s=0.0)
+
+
+# ------------------------------------------------------------ deadlines
+
+class TestRouterDeadlines:
+    def test_requeue_charges_time_spent_on_lost_replica(self):
+        """The absolute deadline is fixed at router submit: a request
+        stranded on a dead replica past its deadline fails typed at
+        requeue — no sibling time is wasted on it."""
+        fc = FakeClock()
+        router, reps = _router(n=2, clock=fc,
+                               rep_kw={"clock": fc})
+        rid = router.submit(_prompt(1, 2, 3), max_new_tokens=4,
+                            deadline_s=5.0)
+        victim = int(np.argmax(router.stats["routed"]))
+        reps[victim].kill()
+        fc.advance(10.0)                  # expires while stranded
+        router.poll()                     # harvest + requeue attempt
+        with pytest.raises(DeadlineExceeded):
+            router.wait(rid, timeout=5)
+
+    def test_requeue_passes_remaining_deadline_to_sibling(self):
+        fc = FakeClock()
+        router, reps = _router(n=2, clock=fc, rep_kw={"clock": fc})
+        t0 = fc.now()
+        rid = router.submit(_prompt(1, 2, 3), max_new_tokens=4,
+                            deadline_s=5.0)
+        victim = int(np.argmax(router.stats["routed"]))
+        fc.advance(2.0)                   # time spent queued pre-crash
+        reps[victim].kill()
+        router.poll()
+        sibling = reps[1 - victim]
+        assert sibling.queue_depth() == 1
+        # the sibling sees the ORIGINAL absolute deadline, not a fresh
+        # 5 s budget
+        assert sibling._queue[0].deadline == pytest.approx(t0 + 5.0)
+        assert rid not in router.failures
+        _drive(router, reps)
+        np.testing.assert_array_equal(
+            router.wait(rid, timeout=5),
+            stub_tokens(_prompt(1, 2, 3), 4))
+
+
+# ------------------------------------------------------------- failover
+
+class TestFailover:
+    def test_kill_mid_decode_queued_complete_on_siblings(self):
+        """ISSUE 7 acceptance: killing a replica mid-decode completes
+        every QUEUED request on siblings with bit-exact greedy tokens,
+        flushes mid-decode partials to their waiters, and leaks zero
+        pages anywhere — all counter-asserted."""
+        router, reps = _router()
+        shared = np.arange(16, dtype=np.int32) % 16
+        # seed the prefix on one replica so affinity concentrates the
+        # whole workload there
+        p0 = np.concatenate([shared, _prompt(1)])
+        rid = router.submit(p0, max_new_tokens=3)
+        _drive(router, reps)
+        router.wait(rid, timeout=5)
+        victim_idx = int(np.argmax(router.stats["routed"]))
+        victim = reps[victim_idx]
+        # two blockers occupy the victim's slots mid-decode...
+        blk_p = np.concatenate([shared, _prompt(9)])
+        blockers = [router.submit(blk_p, max_new_tokens=30)
+                    for _ in range(2)]
+        for _ in range(3):                # admit + a few decode ticks
+            victim.step()
+        assert victim.in_flight() == 2
+        # ...and three more wait in its queue
+        q_p = [np.concatenate([shared, _prompt(7, i)]) for i in range(3)]
+        queued = [router.submit(p, max_new_tokens=4) for p in q_p]
+        assert victim.queue_depth() == 3
+        assert router.stats["routed"][victim_idx] == 6
+        victim.kill()
+        assert victim.health == "dead"
+        _drive(router, reps)              # poll harvests + siblings run
+        # queued requests completed on siblings, bit-exact
+        for rid, p in zip(queued, q_p):
+            np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                          stub_tokens(p, 4))
+        st = router.stats
+        assert st["evacuations"] >= 1
+        assert st["requeued"] == 3
+        assert st["replica_lost"] == 0
+        # mid-decode blockers flushed their partials (bit-exact prefix)
+        for rid in blockers:
+            out = router.wait(rid, timeout=5)
+            assert 1 <= len(out) < 30
+            np.testing.assert_array_equal(
+                out, stub_tokens(blk_p, 30)[:len(out)])
+        for rep in reps:                  # zero leaks, even the corpse
+            _balanced(rep)
+
+    def test_failover_sampled_tokens_bit_exact(self):
+        """The harvested entries carry their RESOLVED seeds, so a
+        sibling draws the identical sampling chain the lost replica
+        would have."""
+        router, reps = _router(rep_kw={"do_sample": True,
+                                       "temperature": 0.8, "top_k": 8,
+                                       "seed": 123})
+        p = _prompt(5, 11, 2)
+        # oracle: the same request served by a healthy fleet
+        ref_router, ref_reps = _router(
+            n=1, rep_kw={"do_sample": True, "temperature": 0.8,
+                         "top_k": 8, "seed": 123})
+        ref = ref_router.submit(p, max_new_tokens=6, seed=77)
+        _drive(ref_router, ref_reps)
+        expect = ref_router.wait(ref, timeout=5)
+        # lose the replica before the request is ever admitted
+        rid = router.submit(p, max_new_tokens=6, seed=77)
+        victim = int(np.argmax(router.stats["routed"]))
+        reps[victim].kill()
+        _drive(router, reps)
+        np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                      expect)
+        assert router.stats["requeued"] == 1
+
+    def test_no_sibling_raises_replica_lost_typed(self):
+        router, reps = _router(n=2)
+        rid = router.submit(_prompt(1, 2), max_new_tokens=2)
+        victim = int(np.argmax(router.stats["routed"]))
+        reps[victim].kill()
+        reps[1 - victim].kill()           # nobody left to requeue onto
+        router.poll()
+        assert router.stats["replica_lost"] == 1
+        with pytest.raises(ReplicaLostError):
+            router.wait(rid, timeout=5)
+
+    def test_cancel_during_failover_fails_typed(self):
+        router, reps = _router(n=2)
+        rid = router.submit(_prompt(1, 2, 3), max_new_tokens=4)
+        victim_idx = int(np.argmax(router.stats["routed"]))
+        victim = reps[victim_idx]
+        victim.kill()
+        # harvest manually (as the supervisor would), THEN cancel while
+        # the request sits in the router's hands, then requeue
+        harvested = victim.evacuate(flush_partials=True)
+        assert len(harvested) == 1
+        assert router.cancel(rid) is False   # not live anywhere now
+        router._requeue(victim_idx, harvested)
+        assert router.stats["requeued"] == 0
+        with pytest.raises(RequestCancelled):
+            router.wait(rid, timeout=5)
+
+    def test_backpressure_holds_at_router_until_a_sibling_can_take(self):
+        """Review regression: a harvested request whose siblings are
+        all FULL must be held at the router and retried (transient
+        backpressure), not failed with a permanent ReplicaLostError —
+        the sibling drains seconds later."""
+        reps = [_rep(max_slots=1),
+                _rep(max_slots=1, max_queue=0)]   # sibling: always full
+        router = ReplicaRouter(reps)
+        p = _prompt(1, 2, 3)
+        rid = router.submit(p, max_new_tokens=4)
+        assert router.stats["routed"] == [1, 0]
+        reps[0].kill()
+        router.poll()                     # harvest; sibling sheds
+        assert router.backlog == 1        # held, NOT failed
+        assert rid not in router.failures
+        router.poll()                     # still nowhere to go
+        assert router.backlog == 1
+        reps[0].start()                   # the "sibling" recovers (the
+        router.poll()                     # restarted source may take
+        assert router.backlog == 0        # its old work back)
+        assert router.stats["requeued"] == 1
+        _drive(router, reps)
+        np.testing.assert_array_equal(router.wait(rid, timeout=60),
+                                      stub_tokens(p, 4))
+        reps[0].stop()
+
+    def test_wait_survives_replica_thread_death_until_failover(self):
+        """Review regression: a dead serve THREAD raises a generic
+        RuntimeError for every waiter without consuming per-rid state;
+        router.wait must keep waiting for the supervisor's failover
+        instead of leaking the raw thread death to the client."""
+        router, reps = _router(n=2)
+        reps[0].start()
+        reps[1].start()
+        p = _prompt(1, 2, 3)
+        rid = router.submit(p, max_new_tokens=4)
+        victim = int(np.argmax(router.stats["routed"]))
+        # crash the victim's serve loop with a non-Exception (the
+        # BaseException path: _thread_error set, health dead, queue
+        # and slots left intact)
+        reps[victim]._sup.allow = lambda: (_ for _ in ()).throw(
+            SystemExit("crashed"))
+        deadline = time.monotonic() + 10
+        while reps[victim]._thread_error is None:
+            assert time.monotonic() < deadline, "loop never crashed"
+            time.sleep(0.005)
+        assert reps[victim].health == "dead"
+        # BEFORE any failover poll: wait must not surface the thread
+        # death — it times out instead (the request is still pending)
+        with pytest.raises(TimeoutError):
+            router.wait(rid, timeout=0.3)
+        router.poll()                     # failover to the sibling
+        out = router.wait(rid, timeout=60)
+        np.testing.assert_array_equal(out, stub_tokens(p, 4))
+        assert router.stats["requeued"] >= 1
+        reps[1 - victim].stop()
+
+    def test_breaker_diverts_flapping_replica(self):
+        fc = FakeClock()
+        breakers = [CircuitBreaker(failure_threshold=2,
+                                   reset_after_s=10.0, clock=fc)
+                    for _ in range(2)]
+        router, reps = _router(n=2, policy="least_loaded",
+                               breakers=breakers, clock=fc)
+        calls = {"n": 0}
+        real_submit = reps[0].submit
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            raise RuntimeError("replica wedged")
+
+        reps[0].submit = flaky
+        p = _prompt(1, 2, 3)
+        rids = [router.submit(p, max_new_tokens=2) for _ in range(2)]
+        # both submits tried rep0 first (lowest load), failed, and
+        # landed on rep1 — two consecutive failures open the breaker
+        assert calls["n"] == 2
+        assert breakers[0].state == CircuitBreaker.OPEN
+        rids.append(router.submit(p, max_new_tokens=2))
+        assert calls["n"] == 2            # open breaker: never dialed
+        assert router.stats["routed"] == [0, 3]
+        # cooldown elapses, the replica recovers: half-open probe
+        # dispatch succeeds and closes the breaker
+        reps[0].submit = real_submit
+        fc.advance(11.0)
+        rids.append(router.submit(p, max_new_tokens=2))
+        assert router.stats["routed"] == [1, 3]
+        assert breakers[0].state == CircuitBreaker.CLOSED
+        _drive(router, reps)
+        for rid in rids:
+            np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                          stub_tokens(p, 2))
+
+    def test_rolling_restart_zero_failed_requests(self):
+        """ISSUE 7 acceptance: rolling_restart() over 3 StubModel
+        replicas finishes with zero failed requests."""
+        router, reps = _router()
+        router.start()
+        try:
+            prompts = [_prompt(1, 2, 3, i + 1) for i in range(9)]
+            rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+            router.rolling_restart(drain_timeout=60.0)
+            for rid, p in zip(rids, prompts):
+                np.testing.assert_array_equal(
+                    router.wait(rid, timeout=60), stub_tokens(p, 6))
+            assert router.stats["restarts"] == 3
+            assert router.stats["replica_lost"] == 0
+            assert router.failures == {}
+            assert router.health == "healthy"
+            # the fleet still serves after the bounce
+            p = _prompt(9, 9)
+            rid = router.submit(p, max_new_tokens=3)
+            np.testing.assert_array_equal(router.wait(rid, timeout=60),
+                                          stub_tokens(p, 3))
+        finally:
+            router.stop()
+        for rep in reps:
+            _balanced(rep)
+
+    def test_orphaned_dispatch_replaces_instead_of_routing_to_corpse(self):
+        """Review regression (dispatch-vs-evacuate race): a request a
+        replica accepted but the supervisor harvested BEFORE the
+        dispatching thread recorded the route must be placed again —
+        not recorded as a route to a corpse the waiter polls forever.
+        The race window is synthesized by pre-parking the orphan entry
+        the harvest side would leave."""
+        router, reps = _router(n=2)
+        rrid_next = reps[0]._next_rid
+        with router._lock:
+            router._orphans[(0, rrid_next)] = 3
+        p = _prompt(1, 2)
+        rid = router.submit(p, max_new_tokens=2)
+        # rep0's acceptance was claimed as orphaned: the request was
+        # re-placed on rep1 and only THAT dispatch recorded
+        assert router.stats["routed"] == [0, 1]
+        with router._lock:
+            assert router._routes[rid].idx == 1
+            assert not router._orphans          # claimed
+        reps[0].evacuate()        # drop the synthetic duplicate copy
+        _drive(router, reps)
+        np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                      stub_tokens(p, 2))
+
+    def test_rolling_restart_drains_backlog_without_supervisor(self):
+        """Review regression: requests parked by sibling backpressure
+        DURING a rolling restart must be drained by rolling_restart
+        itself — a supervisor thread may not be running."""
+        reps = [_rep(max_slots=1),
+                _rep(max_slots=1, max_queue=0)]   # sibling: always full
+        router = ReplicaRouter(reps)
+        reps[0].start()
+        reps[1].start()
+        p = _prompt(1, 2, 3)
+        rids = [router.submit(p, max_new_tokens=4) for _ in range(3)]
+        router.rolling_restart(drain_timeout=60)
+        for rid in rids:
+            np.testing.assert_array_equal(
+                router.wait(rid, timeout=60), stub_tokens(p, 4))
+        assert router.failures == {}
+        assert router.backlog == 0
+        reps[0].stop()
+        reps[1].stop()
+
+    def test_threaded_kill_failover(self):
+        """The supervisor THREAD (not a manual poll) notices a crash
+        and requeues; waiters blocked across the failover follow the
+        request to its new replica."""
+        router, reps = _router(rep_kw={"max_cache_len": 8192})
+        router.start(poll_interval=0.005)
+        try:
+            # park long requests on every replica so the next submits
+            # stay queued on their replica
+            blockers = [router.submit(_prompt(9, i), max_new_tokens=5000)
+                        for i in range(6)]
+            deadline = time.monotonic() + 10
+            while any(r.queue_depth() for r in reps):
+                if time.monotonic() > deadline:
+                    raise AssertionError("blockers never admitted")
+                time.sleep(0.005)
+            q_p = [_prompt(1, 2, i + 1) for i in range(3)]
+            queued = [router.submit(p, max_new_tokens=4) for p in q_p]
+            victim = max(range(3), key=lambda i: reps[i].queue_depth())
+            reps[victim].kill()
+            for rid, p in zip(queued, q_p):
+                np.testing.assert_array_equal(
+                    router.wait(rid, timeout=60), stub_tokens(p, 4))
+            assert router.stats["requeued"] >= 1
+            for rid in blockers:
+                router.cancel(rid)
+        finally:
+            router.stop(drain=False)
+
+
+# ---------------------------------------------------------------- chaos
+
+@pytest.mark.chaos
+class TestRouterChaos:
+    def test_dispatch_fault_storm_recovers_no_leaks(self):
+        """30% router.dispatch faults: failed dispatches fall through
+        to siblings, every submit either routes or fails typed, no
+        wedged waiters, no page leaks, and the fleet serves cleanly
+        once the storm passes."""
+        fi = FaultInjector(seed=42).on(faults.ROUTER_DISPATCH,
+                                       probability=0.3)
+        router, reps = _router(fault_injector=fi,
+                               breakers=[CircuitBreaker(
+                                   failure_threshold=10_000)
+                                   for _ in range(3)])
+        ok, failed = {}, {}
+        prompts = [_prompt(2, 5, (i % 13) + 1) for i in range(20)]
+        for i, p in enumerate(prompts):
+            try:
+                rid = router.submit(p, max_new_tokens=4)
+            except ReliabilityError as e:
+                failed[i] = e
+                continue
+            _drive(router, reps)
+            ok[i] = router.wait(rid, timeout=5)
+        assert len(ok) + len(failed) == len(prompts)
+        for i, out in ok.items():
+            np.testing.assert_array_equal(out,
+                                          stub_tokens(prompts[i], 4))
+        assert fi.fired() > 0, "storm never fired; raise the rate"
+        assert router.stats["dispatch_retries"] >= fi.fired()
+        fi.disarm()                       # recovery
+        rid = router.submit(_prompt(8, 8), max_new_tokens=3)
+        _drive(router, reps)
+        np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                      stub_tokens(_prompt(8, 8), 3))
+        for rep in reps:
+            _balanced(rep)
+
+    def test_evacuate_fault_aborts_then_retries(self):
+        """An injected router.evacuate fault aborts the harvest sweep
+        BEFORE any state moves: the requests stay queued on the corpse
+        and the next poll retries — recovery, not loss."""
+        fi = FaultInjector(seed=0).on(faults.ROUTER_EVACUATE,
+                                      schedule=[0])
+        router, reps = _router(n=2, fault_injector=fi)
+        rid = router.submit(_prompt(1, 2, 3), max_new_tokens=4)
+        victim_idx = int(np.argmax(router.stats["routed"]))
+        reps[victim_idx].kill()
+        assert router.poll() == 1         # first sweep dies injected
+        assert reps[victim_idx].queue_depth() == 1   # nothing moved
+        assert router.supervisor.failed_sweeps == 1
+        assert router.poll() == 0         # retry harvests
+        assert router.stats["requeued"] == 1
+        _drive(router, reps)
+        np.testing.assert_array_equal(
+            router.wait(rid, timeout=5),
+            stub_tokens(_prompt(1, 2, 3), 4))
+
+    def test_same_seed_identical_trace_and_state(self):
+        """Same injector seed + same scripted drive => identical
+        injection trace, results, failure types, and counters."""
+
+        def script(seed):
+            fi = FaultInjector(seed=seed).on(faults.ROUTER_DISPATCH,
+                                             probability=0.25)
+            router, reps = _router(
+                fault_injector=fi, seed=9,
+                breakers=[CircuitBreaker(failure_threshold=10_000)
+                          for _ in range(3)])
+            results, fails = {}, {}
+            # phase 1: sequential traffic under dispatch faults
+            for i in range(6):
+                p = _prompt(3, 1, i + 1)
+                try:
+                    rid = router.submit(p, max_new_tokens=3)
+                except ReliabilityError as e:
+                    fails[i] = type(e).__name__
+                    continue
+                _drive(router, reps)
+                results[i] = tuple(int(x)
+                                   for x in router.wait(rid, timeout=5))
+            # phase 2: queue a burst, kill the busiest, fail over
+            rids = {}
+            for i in range(6, 12):
+                p = _prompt(3, 1, i + 1)
+                try:
+                    rids[i] = (router.submit(p, max_new_tokens=3), p)
+                except ReliabilityError as e:
+                    fails[i] = type(e).__name__
+            victim = int(np.argmax([r.queue_depth() for r in reps]))
+            reps[victim].kill()
+            _drive(router, reps)
+            for i, (rid, p) in rids.items():
+                try:
+                    results[i] = tuple(int(x)
+                                       for x in router.wait(rid,
+                                                            timeout=5))
+                except ReliabilityError as e:
+                    fails[i] = type(e).__name__
+            return (fi.trace, results, fails, router.stats,
+                    [r.pool_balance() for r in reps])
+
+        a, b = script(777), script(777)
+        assert a == b
+        assert a[0], "deterministic run injected nothing"
+
+
+# ------------------------------------------------- aggregated telemetry
+
+class TestRouterTelemetry:
+    def test_aggregated_healthz_and_stats(self):
+        """serve_metrics(router): /healthz answers 200 iff >= 1 replica
+        is serving; /stats carries router counters + per-replica
+        health."""
+        router, reps = _router(telemetry=True)
+        ms = serve_metrics(router)
+        try:
+            with urllib.request.urlopen(ms.url + "/healthz") as r:
+                assert r.status == 200
+            reps[0].kill()
+            assert router.health == "degraded"
+            with urllib.request.urlopen(ms.url + "/healthz") as r:
+                assert r.status == 200    # 2 of 3 still serving
+            reps[1].kill()
+            reps[2].kill()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(ms.url + "/healthz")
+            assert ei.value.code == 503
+            assert b'"dead"' in ei.value.read()
+            with urllib.request.urlopen(ms.url + "/stats") as r:
+                body = r.read().decode()
+            assert '"replicas"' in body and '"routed"' in body
+        finally:
+            ms.close()
+
+    def test_router_counters_exposed(self):
+        router, reps = _router(n=2, telemetry=True)
+        rid = router.submit(_prompt(1, 2, 3), max_new_tokens=3)
+        victim = int(np.argmax(router.stats["routed"]))
+        reps[victim].kill()
+        _drive(router, reps)
+        router.wait(rid, timeout=5)
+        text = router.telemetry.registry.render()
+        for name in ("router_routed_total", "router_requeued_total",
+                     "router_evacuations_total", "router_queue_depth",
+                     "router_replicas_serving", "router_health"):
+            assert name in text, name
+
+    def test_affinity_beats_round_robin_counters(self):
+        """ISSUE 7 acceptance (counter form of the router bench): on a
+        shared-prefix workload over 3 replicas, affinity routing's
+        replica-level prefix-hit counters beat round-robin's."""
+
+        def run(policy):
+            router, reps = _router(policy=policy)
+            rng = np.random.default_rng(0)
+            groups = [rng.integers(0, 16, (16,)).astype(np.int32)
+                      for _ in range(2)]
+            for rnd in range(6):
+                for g in groups:
+                    p = np.concatenate([g, _prompt(rnd + 1)])
+                    rid = router.submit(p, max_new_tokens=2)
+                    _drive(router, reps)
+                    np.testing.assert_array_equal(
+                        router.wait(rid, timeout=5), stub_tokens(p, 2))
+            hits = sum(r.stats["prefix_auto_hits"] for r in reps)
+            return hits, router
+
+        aff_hits, aff_router = run("affinity")
+        rr_hits, _ = run("round_robin")
+        # affinity: each group misses once then always hits (5 + 5);
+        # round-robin spreads each group over all 3 replicas
+        assert aff_hits == 10
+        assert aff_router.stats["affinity_hits"] == 10
+        assert rr_hits < aff_hits
+
+
+# ----------------------------------------------------------------- bench
+
+
+@pytest.mark.slow
+@pytest.mark.bench
+class TestRouterBenchSmoke:
+    def test_router_bench_runs_and_orders_modes(self):
+        """Smoke-run benchmarks/router_bench.py at toy scale: it must
+        complete (walls included), affinity must beat round-robin on
+        the fleet-wide hit counters, and the robustness legs must
+        report zero failed requests."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks"))
+        import router_bench
+        out = router_bench.main(["--requests-per-group", "4",
+                                 "--groups", "2", "--replicas", "3",
+                                 "--system-tokens", "16",
+                                 "--tail-tokens", "3",
+                                 "--new-tokens", "3",
+                                 "--failover-k", "4"])
+        by_mode = {m["mode"]: m for m in out["modes"]}
+        aff, rr = by_mode["affinity-3"], by_mode["round_robin-3"]
+        assert aff["hits"] > rr["hits"]
+        assert aff["prefill_tokens"] < rr["prefill_tokens"]
+        assert aff["affinity_hits"] > 0
+        assert out["failover"]["k"] == 4
+        assert out["rolling_restart"]["failed"] == 0
+
+
+# ----------------------------------------------------- evacuate() unit
+
+class TestEvacuateHook:
+    def test_evacuate_harvests_queued_keeps_inflight(self):
+        srv = _rep(max_slots=1)
+        ra = srv.submit(_prompt(1, 2), max_new_tokens=6)
+        srv.step()                        # admit ra mid-decode
+        rb = srv.submit(_prompt(3, 4), max_new_tokens=2)
+        harvested = srv.evacuate()        # default: queued only
+        assert [h.rid for h in harvested] == [rb]
+        assert srv.queue_depth() == 0
+        assert srv.in_flight() == 1       # ra keeps decoding
+        out = srv.run()
+        np.testing.assert_array_equal(out[ra],
+                                      stub_tokens(_prompt(1, 2), 6))
+        assert rb not in out and rb not in srv.failures
+
+    def test_evacuate_flush_partials_matches_hard_stop(self):
+        srv = _rep(max_slots=1)
+        ra = srv.submit(_prompt(1, 2), max_new_tokens=10)
+        srv.step()
+        srv.step()
+        harvested = srv.evacuate(flush_partials=True)
+        assert harvested == []
+        out = srv._results[ra]            # partial recorded, bit-exact
+        np.testing.assert_array_equal(
+            out, stub_tokens(_prompt(1, 2), 10)[:len(out)])
+        _balanced(srv)                    # pages donated/freed, no leak
+
+    def test_kill_preserves_state_for_harvest_then_restarts(self):
+        srv = _rep(max_slots=1, max_cache_len=8192).start()
+        ra = srv.submit(_prompt(1, 2), max_new_tokens=5000)
+        deadline = time.monotonic() + 10
+        while srv.queue_depth():          # wait for admission
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        rb = srv.submit(_prompt(5, 6), max_new_tokens=4)
+        srv.kill()
+        assert srv.health == "dead"
+        assert srv.queue_depth() == 1     # rb still harvestable
+        assert srv.in_flight() == 1       # ra still holds its slot
+        assert rb not in srv.failures     # nothing failed behind our back
+        harvested = srv.evacuate(flush_partials=True)
+        assert [h.rid for h in harvested] == [rb]
+        part = srv.wait(ra, timeout=5)    # flushed partial
+        np.testing.assert_array_equal(
+            part, stub_tokens(_prompt(1, 2), 5000)[:len(part)])
+        srv.start()                       # crash drill over: restart
+        rc = srv.submit(_prompt(7), max_new_tokens=3)
+        np.testing.assert_array_equal(srv.wait(rc, timeout=60),
+                                      stub_tokens(_prompt(7), 3))
+        srv.stop()

@@ -331,6 +331,87 @@ class TestHealthAndDrain:
         with pytest.raises(ServerClosed):
             srv.wait(rb, timeout=5)
 
+    def test_submit_racing_drain_rejected_typed_healthz_503(self):
+        """ISSUE 7 satellite: a submit() racing stop(drain=True) must
+        be rejected TYPED (ServerClosed — never silently dropped, never
+        admitted into a dying server), and /healthz must answer 503 for
+        the whole drain window (draining) and after it (dead). The
+        in-flight request pins the drain open via a gated on_token
+        callback, so the window is deterministic, not a sleep race."""
+        srv = _srv(max_slots=1, telemetry=True).start()
+        ms = serve_metrics(srv)
+        entered, release = threading.Event(), threading.Event()
+
+        def gate(rid, toks):
+            entered.set()
+            assert release.wait(timeout=30)
+
+        p = _prompt(1, 2, 3)
+        rid = srv.submit(p, max_new_tokens=8, on_token=gate)
+        assert entered.wait(timeout=30)     # mid-decode, stream gated
+        t = threading.Thread(target=lambda: srv.stop(drain=True,
+                                                     timeout=60))
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            while srv.health != "draining":
+                assert time.monotonic() < deadline, "never saw draining"
+                time.sleep(0.002)
+            # the drain window is OPEN (in-flight request gated):
+            # admission must refuse typed...
+            with pytest.raises(ServerClosed):
+                srv.submit(_prompt(9), max_new_tokens=2)
+            # ...and the readiness probe must already say 503
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(ms.url + "/healthz")
+            assert ei.value.code == 503
+            assert b'"draining"' in ei.value.read()
+        finally:
+            release.set()
+            t.join(timeout=60)
+        assert not t.is_alive()
+        # drained, not dropped: the in-flight request completed in full
+        np.testing.assert_array_equal(srv.wait(rid, timeout=5),
+                                      stub_tokens(p, 8))
+        # after the drain the server is dead — still 503, same verdict
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(ms.url + "/healthz")
+        assert ei.value.code == 503
+        ms.close()
+
+    def test_queued_requests_complete_during_drain_race(self):
+        """Admissions QUEUED before the drain began are not shed by it:
+        stop(drain=True) completes them; only post-drain submits see
+        ServerClosed."""
+        srv = _srv(max_slots=1).start()
+        entered, release = threading.Event(), threading.Event()
+
+        def gate(rid, toks):
+            entered.set()
+            assert release.wait(timeout=30)
+
+        a, b = _prompt(1, 2), _prompt(3, 4)
+        ra = srv.submit(a, max_new_tokens=4, on_token=gate)
+        assert entered.wait(timeout=30)
+        rb = srv.submit(b, max_new_tokens=4)    # queued behind ra
+        stopper = threading.Thread(
+            target=lambda: srv.stop(drain=True, timeout=60))
+        stopper.start()
+        deadline = time.monotonic() + 30
+        while srv.health != "draining":
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        with pytest.raises(ServerClosed):
+            srv.submit(_prompt(5), max_new_tokens=1)
+        release.set()
+        stopper.join(timeout=60)
+        assert not stopper.is_alive()
+        np.testing.assert_array_equal(srv.wait(ra, timeout=5),
+                                      stub_tokens(a, 4))
+        np.testing.assert_array_equal(srv.wait(rb, timeout=5),
+                                      stub_tokens(b, 4))
+        assert not srv.failures
+
     def test_restart_after_stop_resets_health(self):
         srv = _srv().start()
         srv.stop()
