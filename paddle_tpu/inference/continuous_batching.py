@@ -25,32 +25,36 @@ from ..core.tensor import unwrap
 from .kv_cache import OutOfPages
 from ..reliability import (CallbackError, CircuitOpenError, DEAD,
                            DEGRADED, DRAINING, DeadlineExceeded, HEALTHY,
-                           HealthMonitor, QueueFullError, ReliabilityError,
-                           RequestCancelled, ServeSupervisor, ServerClosed,
-                           faults)
+                           HealthMonitor, PreemptedError, QueueFullError,
+                           ReliabilityError, RequestCancelled,
+                           ServeSupervisor, ServerClosed, faults)
 from ..telemetry.clock import MonotonicClock
 
-__all__ = ["ContinuousBatchingServer"]
+__all__ = ["ContinuousBatchingServer", "PreemptionPolicy", "PoolBalance"]
 
 
 class _Pending:
     """A queued request awaiting a slot."""
 
-    __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline")
+    __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
+                 "priority")
 
-    def __init__(self, rid, ids, budget, seed, on_token, deadline):
+    def __init__(self, rid, ids, budget, seed, on_token, deadline,
+                 priority=0):
         self.rid = rid
         self.ids = ids
         self.budget = budget
         self.seed = seed
         self.on_token = on_token
         self.deadline = deadline      # absolute clock time, or None
+        self.priority = priority      # higher = preempted later
 
 
 class _Slot:
     __slots__ = ("rid", "ids", "prompt_len", "budget", "emitted",
                  "on_token", "streamed", "deadline", "phase", "fill_pos",
-                 "filled", "n_pre", "seed")
+                 "filled", "n_pre", "seed", "priority", "preempts",
+                 "replayed")
 
     def __init__(self, rid, ids, prompt_len, budget, on_token=None,
                  deadline=None):
@@ -70,11 +74,28 @@ class _Slot:
         self.filled = prompt_len      # prompt rows actually written
         self.n_pre = 0                # prefix-cache tokens reused
         self.seed = 0                 # sampling chain seed
+        self.priority = 0             # preemption class (higher = safer)
+        self.preempts = 0             # times this request was preempted
+        # the partial recorded BEFORE a preemption: a resumed slot
+        # replays the identical chain, so the longer of (replayed,
+        # emitted) is always the request's true partial — a deadline/
+        # cancel/hard-stop mid-replay must not hand the waiter fewer
+        # tokens than its on_token stream already delivered
+        self.replayed = ()
+
+    def partial(self):
+        """The request's current partial output: replayed tokens from
+        before a preemption, or the live emitted list — whichever is
+        longer (they agree on the common prefix by bit-exact replay)."""
+        return self.emitted if len(self.emitted) >= len(self.replayed) \
+            else list(self.replayed)
 
     def stream(self, sink):
         """Queue this slot's unstreamed chunk on ``sink``; the server
         fires callbacks AFTER releasing its lock (a slow or blocking
-        callback must not stall decode/submit/cancel)."""
+        callback must not stall decode/submit/cancel). A RESUMED slot
+        starts with ``streamed`` at its pre-preemption offset, so the
+        replayed (bit-identical) tokens below it are never re-sent."""
         if self.on_token is None:
             return
         upto = min(len(self.emitted), self.budget)
@@ -83,6 +104,85 @@ class _Slot:
                          np.asarray(self.emitted[self.streamed:upto],
                                     np.int32)))
             self.streamed = upto
+
+
+class _Preempted:
+    """A request parked off its slot under pool pressure, awaiting
+    re-admission (``admission="optimistic"``). Carries everything a
+    bit-exact replay needs: the RESOLVED sampling seed (the replayed
+    chain draws identically), the ABSOLUTE deadline (time spent parked
+    keeps counting), ``streamed`` (on_token never re-sends delivered
+    chunks), and ``emitted`` — the longest partial so far, flushed as
+    the result if the request must leave early (deadline, cancel, hard
+    stop, dead-replica evacuation) before decode resumes."""
+
+    __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
+                 "priority", "emitted", "streamed", "preempts")
+
+    def __init__(self, st):
+        self.rid = st.rid
+        self.ids = st.ids
+        self.budget = st.budget
+        self.seed = st.seed
+        self.on_token = st.on_token
+        self.deadline = st.deadline
+        self.priority = st.priority
+        self.emitted = list(st.partial())
+        self.streamed = st.streamed
+        self.preempts = st.preempts + 1
+
+
+class PreemptionPolicy:
+    """Victim selection for ``admission="optimistic"``: when a
+    mid-decode page grow hits an exhausted pool, ``pick`` names the
+    slot whose pages are freed. The default order sacrifices the LEAST
+    valuable work first — lowest ``priority`` class, then fewest
+    tokens generated (least recompute thrown away), then the youngest
+    request (highest rid) so ties are deterministic and two same-seed
+    runs preempt identically.
+
+    The growing slot is itself a candidate: when it ranks last it
+    parks ITSELF instead of evicting more valuable work. That makes
+    the ranking a strict total order over live slots, so the top
+    request is never preempted, only gains tokens, and finishes —
+    global progress follows by induction no matter how hard the pool
+    thrashes (recompute-preemption as in paged-attention serving
+    stacks, PAPERS.md)."""
+
+    def key(self, slot, st):
+        """Sort key over live slots; the MINIMUM is preempted first.
+        Work is the request's TRUE partial (``st.partial()`` — the
+        longer of the pre-preemption tokens and the live replay), not
+        the raw replay progress: a resumed victim early in its replay
+        must keep the seniority of the work it already did once, or
+        every squeeze would re-pick the same just-resumed request and
+        throw its replay away again (thrash/starvation of exactly the
+        requests that already lost the gamble)."""
+        return (st.priority, len(st.partial()), -st.rid)
+
+    def pick(self, grower, candidates):
+        """``candidates`` is ``[(slot, _Slot)]`` for every live slot,
+        the grower included. Returns the victim slot id (possibly
+        ``grower`` itself), or None when there is nothing to free."""
+        if not candidates:
+            return None
+        return min(candidates, key=lambda c: self.key(*c))[0]
+
+
+class PoolBalance(tuple):
+    """``pool_balance()``'s result: a plain ``(free, live, pinned,
+    cached)`` 4-tuple (existing unpacks keep working), with optimistic-
+    admission state riding as ATTRIBUTES: ``preempted`` — requests
+    currently parked on the preempted queue (their pages are already
+    donated or freed, so they contribute nothing to ``live``) — and
+    ``preemptions`` — cumulative victims preempted so far."""
+
+    def __new__(cls, free, live, pinned, cached, preempted=0,
+                preemptions=0):
+        self = super().__new__(cls, (free, live, pinned, cached))
+        self.preempted = preempted
+        self.preemptions = preemptions
+        return self
 
 
 class ContinuousBatchingServer:
@@ -134,6 +234,23 @@ class ContinuousBatchingServer:
     Tokens are bit-identical across all three of dense backend, paged+
     dense prefill, and paged+ragged prefill.
 
+    ``admission="optimistic"`` (paged backend only; default
+    ``"reserve"``) lifts the full-extent admission pessimism: a
+    request is admitted with only its PROMPT pages plus
+    ``headroom_pages``, decode grows its block table page-by-page on
+    demand, and when a grow finds the pool empty the
+    ``preemption_policy`` picks victims — lowest priority class first,
+    then fewest tokens generated, deterministic ties — frees their
+    pages (written prompt prefixes are donated into the prefix cache
+    first), and parks them on a preempted queue. Re-admission REPLAYS
+    the victim bit-exactly: the resolved seed restarts the identical
+    sampling chain, the donated pages usually auto-hit so the prompt
+    is not re-prefilled, and streamed callbacks resume at their old
+    offset — under pressure the server degrades throughput, never
+    correctness, and no request ever fails because the gamble lost.
+    ``submit(priority=...)`` sets the preemption class (higher = safer,
+    admitted first); admission order becomes priority-aware FIFO.
+
     ``telemetry`` (``paddle_tpu.telemetry.ServerTelemetry``, or ``True``
     for a default one) turns on SLO instrumentation: per-request
     lifecycle spans and TTFT/TPOT/queue-wait histograms, per-tick
@@ -161,6 +278,8 @@ class ContinuousBatchingServer:
                  prefill_chunk=None, mesh=None, tick_block=1,
                  cache_dtype=None, cache_backend="dense", page_size=16,
                  num_pages=None, auto_prefix_cache=True,
+                 admission="reserve", headroom_pages=1,
+                 preemption_policy=None,
                  prefill_mode=None, prefill_tokens_per_tick=None,
                  max_admissions_per_tick=None, telemetry=None,
                  max_queue=None, shed_policy="reject", retry_policy=None,
@@ -268,6 +387,39 @@ class ContinuousBatchingServer:
         if self._admit_cap is not None and self._admit_cap < 1:
             raise ValueError("max_admissions_per_tick must be >= 1 "
                              "(0 would admit nothing, forever)")
+        # ------------------------------------------------ admission mode
+        # "reserve" (default): admission takes a request's FULL extent
+        # (prompt + budget) up front — decode can never hit an empty
+        # pool, but concurrency is capped by the WORST-case decode
+        # length even though most requests finish far earlier.
+        # "optimistic": admission reserves only the prompt pages plus
+        # ``headroom_pages``; decode grows each slot page-by-page on
+        # demand, and when the pool runs dry mid-tick the
+        # ``preemption_policy`` frees victims — parked on a preempted
+        # queue and re-admitted with a BIT-EXACT replay (resolved seed
+        # + prefix-cache-assisted recompute), so pressure degrades
+        # throughput, never correctness.
+        if admission not in ("reserve", "optimistic"):
+            raise ValueError(f"admission must be 'reserve' or "
+                             f"'optimistic', got {admission!r}")
+        if admission == "optimistic" and cache_backend != "paged":
+            raise NotImplementedError(
+                "admission='optimistic' needs cache_backend='paged': "
+                "the dense backend allocates every slot's full "
+                "[max_cache_len] KV rows up front, so there is no pool "
+                "to admit optimistically against — virtualizing dense "
+                "slot buffers is the same page-pool work as the paged "
+                "serving items in ROADMAP (items 1/3); use "
+                "cache_backend='paged'")
+        self.admission = admission
+        self._optimistic = admission == "optimistic"
+        self._headroom_pages = int(headroom_pages)
+        if self._headroom_pages < 0:
+            raise ValueError("headroom_pages must be >= 0")
+        self._preempt_policy = preemption_policy \
+            if preemption_policy is not None else PreemptionPolicy()
+        self._preempted = []      # _Preempted records awaiting re-admission
+        self._priority_seen = False   # sticky: any submit(priority != 0)
         self._prefill_fifo = []   # slot ids mid-prefill, admission order
         self._prefill_used = 0    # tokens prefilled this tick
         # slot-state updates batched into one device push per array per
@@ -287,7 +439,10 @@ class ContinuousBatchingServer:
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0,
                       "prefix_auto_hits": 0, "prefix_auto_hit_tokens": 0,
                       "admissions": 0, "prefill_dispatches": 0,
-                      "prefill_wall_s": 0.0}
+                      "prefill_wall_s": 0.0,
+                      # admission="optimistic" accounting
+                      "preemptions": 0, "preempt_resumed": 0,
+                      "grow_pages": 0, "headroom_pages": 0}
         # telemetry (paddle_tpu.telemetry.ServerTelemetry): True builds
         # a default-enabled one; None (default) keeps the hot path at
         # a single attribute check — no locks, no clock reads
@@ -421,7 +576,10 @@ class ContinuousBatchingServer:
                 # starve the FIFO — refuse the registration instead
                 usable = self._kv.num_pages - 1 \
                     - (self._prefix.pinned_pages + pin_delta)
-                for item in self._queue:
+                for item in list(self._queue) + list(self._preempted):
+                    # parked preempted requests must stay re-admittable
+                    # too: their FULL extent is the binding bound (the
+                    # top-ranked one must be able to run to completion)
                     q_ids = item.ids
                     q_need = self._request_pages(
                         q_ids, item.budget, self._match_prefix(q_ids))
@@ -465,12 +623,21 @@ class ContinuousBatchingServer:
 
     # ------------------------------------------------------------ queue
     def submit(self, input_ids, max_new_tokens=32, seed=None,
-               on_token=None, deadline_s=None):
+               on_token=None, deadline_s=None, priority=0):
         """Queue a prompt; returns a request id. The FIRST generated
         token is produced by the prefill (same contract as generate()).
         ``seed`` drives this request's sampling chain (default: the
         server seed + request id). ``on_token(rid, tokens)`` streams
         each harvested chunk (1..tick_block tokens) as it lands.
+
+        ``priority`` (``admission="optimistic"`` only; ignored under
+        ``"reserve"``) is the request's preemption class: under pool
+        pressure victims are taken from the LOWEST class first, and
+        admission prefers higher classes (priority-aware FIFO — same
+        class keeps submit order). Whatever the pressure, every
+        request's full extent must still fit the pool on its own
+        (checked here), so the top-ranked request can always run to
+        completion.
 
         ``deadline_s`` bounds the request's TOTAL time from submit: a
         request still queued when it expires fails with
@@ -566,8 +733,11 @@ class ContinuousBatchingServer:
                 seed = self._seed + rid
             deadline = None if deadline_s is None \
                 else self._clock.now() + float(deadline_s)
+            if priority:
+                self._priority_seen = True
             self._queue.append(_Pending(rid, ids, int(max_new_tokens),
-                                        int(seed), on_token, deadline))
+                                        int(seed), on_token, deadline,
+                                        int(priority)))
             if self._tele is not None:
                 self._tele.on_submit(rid, T, len(self._queue))
         return rid
@@ -607,9 +777,21 @@ class ContinuousBatchingServer:
                 # only notices the recorded partial at its next 1 s poll
                 self._done_cv.notify_all()
                 return True
+        for i, rec in enumerate(self._preempted):
+            if rec.rid == rid:
+                # parked under pool pressure: mid-flight cancel
+                # semantics — the pre-preemption partial is the result
+                # (its pages were already donated/freed at preemption)
+                del self._preempted[i]
+                self._flush_parked_locked(rec)
+                if self._tele is not None:
+                    self._tele.on_cancel(rid)
+                    self._preempt_gauge()
+                self._done_cv.notify_all()
+                return True
         return False
 
-    def _release_slot(self, slot):
+    def _release_slot(self, slot, cold=False):
         """Tear down a slot's host + page state (no result recording).
         Paged backend with auto prefix caching: the request's full
         prompt pages are DONATED into the radix tree (future prompts
@@ -618,7 +800,9 @@ class ContinuousBatchingServer:
         prompt tail, decode budget — returns to the free list. An
         injected ``prefix.donate`` fault abandons the insert and the
         pages are simply freed: donation is best-effort cache
-        maintenance, never a correctness or leak risk."""
+        maintenance, never a correctness or leak risk. ``cold=True``
+        (preemption teardown) donates at the cold end of the LRU so
+        the grow that displaced this slot reclaims its pages first."""
         st = self._slots[slot]
         self._active[slot] = False
         self._slots[slot] = None
@@ -635,7 +819,8 @@ class ContinuousBatchingServer:
                 # torn down mid-ragged-prefill (deadline, cancel, fault)
                 # caches its filled prefix, never unwritten pages
                 n_known = min(st.prompt_len, st.filled)
-                new = self._prefix.donate(st.ids, pages, n_known)
+                new = self._prefix.donate(st.ids, pages, n_known,
+                                          cold=cold)
             except Exception:
                 self._kv.release(pages)
             else:
@@ -647,9 +832,11 @@ class ContinuousBatchingServer:
     def _finish_partial_locked(self, slot):
         """Record the slot's partial tokens as its rid's RESULT and tear
         the slot down — the one way a live request leaves early with its
-        output kept (cancel, deadline expiry, hard stop)."""
+        output kept (cancel, deadline expiry, hard stop). A resumed
+        slot's partial is the LONGER of its pre-preemption tokens and
+        the replay so far (never fewer tokens than already streamed)."""
         st = self._slots[slot]
-        self._results[st.rid] = np.asarray(st.emitted[:st.budget],
+        self._results[st.rid] = np.asarray(st.partial()[:st.budget],
                                            np.int32)
         self._release_slot(slot)
         return st
@@ -719,13 +906,16 @@ class ContinuousBatchingServer:
                                 used - pinned - cached, pinned, cached)
 
     def pool_balance(self):
-        """(free, live, pinned, cached) page counts summing to the
-        usable pool (``num_pages - 1``; page 0 is the null page):
-        ``live`` pages belong to decoding slots, ``pinned`` to
-        registered prefixes (never evicted), ``cached`` to the auto
-        prefix cache (evictable LRU). Chaos suites assert ``live == 0``
-        once drained — free + pinned + cached then covers the whole
-        pool and no injected failure leaked a page. Dense backend
+        """``PoolBalance`` — a ``(free, live, pinned, cached)`` tuple
+        of page counts summing to the usable pool (``num_pages - 1``;
+        page 0 is the null page): ``live`` pages belong to decoding
+        slots, ``pinned`` to registered prefixes (never evicted),
+        ``cached`` to the auto prefix cache (evictable LRU). Chaos
+        suites assert ``live == 0`` once drained — free + pinned +
+        cached then covers the whole pool and no injected failure
+        leaked a page. Optimistic-admission state rides as ATTRIBUTES
+        (``.preempted`` parked requests, ``.preemptions`` cumulative
+        victims) so existing 4-way unpacks keep working. Dense backend
         returns None."""
         if self._kv is None:
             return None
@@ -734,7 +924,9 @@ class ContinuousBatchingServer:
             pinned = self._prefix.pinned_pages
             cached = self._prefix.cached_pages
             live = self._kv.used_pages() - pinned - cached
-            return free, live, pinned, cached
+            return PoolBalance(free, live, pinned, cached,
+                               preempted=len(self._preempted),
+                               preemptions=self.stats["preemptions"])
 
     def _reclaim_pages(self, shortfall):
         """``PagedKVCache.alloc``'s reclaimer: evict LRU cached prefix
@@ -808,29 +1000,99 @@ class ContinuousBatchingServer:
         shared = len(hit[3]) if hit is not None else 0
         return self._npages_for(ids.shape[0] + budget) - shared
 
-    def _head_fits_pool(self, best):
-        """Can the pool admit the request at the head of the queue right
-        now? If not it (and everything behind it — FIFO) waits for a
-        harvest to free pages. Evictable prefix-cache pages count as
-        available headroom (alloc reclaims them on demand) — minus the
-        nodes the head's own cache hit (``best``, computed once per
-        admission attempt and shared with ``_admit_one``) is about to
-        take by reference, which obviously cannot be evicted to make
-        room for it."""
-        head = self._queue[0]
+    def _extent_tokens(self, T, budget):
+        """Tokens' worth of pages admission reserves for a request.
+        ``admission="reserve"``: the FULL extent (prompt + budget), so
+        decode can never hit an empty pool mid-flight.
+        ``"optimistic"``: the prompt plus ``headroom_pages`` worth —
+        decode grows page-by-page on demand (``_grow_locked``) and the
+        preemption policy settles the bill when the gamble loses."""
+        if self._optimistic:
+            return min(T + self._headroom_pages * self.page_size,
+                       T + budget)
+        return T + budget
+
+    def _head_fits_pool(self, head, best):
+        """Can the pool admit ``head`` (the chosen admission candidate)
+        right now? If not it (and everything behind it in admission
+        order) waits for a harvest to free pages. Evictable
+        prefix-cache pages count as available headroom (alloc reclaims
+        them on demand) — minus the nodes the head's own cache hit
+        (``best``, computed once per admission attempt and shared with
+        the admit) is about to take by reference, which obviously
+        cannot be evicted to make room for it. Optimistic admission
+        only asks for the prompt + headroom reservation here."""
         if best is None:
             shared, nodes = 0, ()
         elif best[0] == "reg":
             shared, nodes = len(best[1][3]), ()
         else:
             shared, nodes = len(best[1].pages), best[1].nodes
-        need = self._npages_for(head.ids.shape[0] + head.budget) - shared
+        need = self._npages_for(
+            self._extent_tokens(head.ids.shape[0], head.budget)) - shared
         avail = self._kv.free_pages() \
             + self._prefix.evictable_pages(exclude=nodes)
         return avail >= need
 
     def _npages_for(self, n_tokens):
         return -(-int(n_tokens) // self._kv.page_size)
+
+    # -------------------------------------------- admission scheduling
+    def _next_admission_locked(self):
+        """``(item, source)`` of the next admission candidate, or
+        ``(None, None)``. Reserve mode: strict FIFO — the queue head.
+        Optimistic mode: PRIORITY-AWARE FIFO — highest priority class
+        first, then original submit order (rid), in one order across
+        the preempted queue and the main queue; a preempted request
+        keeps its original rid, so at equal priority it re-enters
+        ahead of later arrivals. ``source`` is the pop/defer handle."""
+        if not self._optimistic \
+                or (not self._priority_seen and not self._preempted):
+            # reserve mode, or optimistic with every priority at the
+            # default and nothing parked: the priority-aware order IS
+            # rid order, so skip the O(queue) scan per admission (the
+            # common case keeps the reserve path's O(1) head peek)
+            if not self._queue:
+                return None, None
+            return self._queue[0], ("queue", 0)
+        best, src = None, None
+        for where, items in (("queue", self._queue),
+                             ("preempted", self._preempted)):
+            for i, item in enumerate(items):
+                if best is None or (-item.priority, item.rid) \
+                        < (-best.priority, best.rid):
+                    best, src = item, (where, i)
+        return best, src
+
+    def _pop_admission_locked(self, src):
+        where, i = src
+        items = self._queue if where == "queue" else self._preempted
+        item = items.pop(i)
+        if where == "preempted":
+            self._preempt_gauge()
+        return item
+
+    def _defer_admission_locked(self, src, item):
+        """Put a popped candidate back where it came from (an admission
+        attempt rolled back — OutOfPages defer)."""
+        where, i = src
+        (self._queue if where == "queue"
+         else self._preempted).insert(i, item)
+        if where == "preempted":
+            self._preempt_gauge()
+
+    def _preempt_gauge(self):
+        if self._tele is not None:
+            self._tele.set_preempted_depth(len(self._preempted))
+
+    def _flush_parked_locked(self, rec):
+        """Record a parked record's pre-preemption partial as its
+        rid's RESULT — the one way a preempted request leaves the
+        parked queue without decode resuming (cancel, deadline expiry,
+        hard stop, dead-replica evacuation). The caller removes the
+        record from ``_preempted`` and handles telemetry/notify."""
+        self._results[rec.rid] = np.asarray(rec.emitted[:rec.budget],
+                                            np.int32)
 
     # ------------------------------------------------------- scheduling
     def _admit(self, run_prefill=True):
@@ -849,24 +1111,27 @@ class ContinuousBatchingServer:
             return
         admitted = 0
         for slot in range(self.max_slots):
-            if self._slots[slot] is not None or not self._queue:
+            if self._slots[slot] is not None:
                 continue
             if self._admit_cap is not None and admitted >= self._admit_cap:
+                break
+            item, src = self._next_admission_locked()
+            if item is None:
                 break
             # one _best_hit per admission attempt: the radix walk (and
             # registered-prefix scan) feeds the fits check AND the
             # admission itself — same lock, same tick, the tree cannot
             # move between the two
-            best = self._best_hit(self._queue[0].ids)
-            if self._kv is not None and not self._head_fits_pool(best):
+            best = self._best_hit(item.ids)
+            if self._kv is not None \
+                    and not self._head_fits_pool(item, best):
                 break
-            req = self._queue.pop(0)
+            req = self._pop_admission_locked(src)
             rid = req.rid
             if self._tele is not None:
                 self._tele.on_admit(rid, len(self._queue))
             try:
-                self._admit_one(slot, rid, req.ids, req.budget, req.seed,
-                                req.on_token, req.deadline, best)
+                self._admit_one(slot, req, best)
             except OutOfPages:
                 # eviction could not free enough right now (an injected
                 # ``prefix.evict`` fault aborted the sweep, or a cache
@@ -878,7 +1143,7 @@ class ContinuousBatchingServer:
                     self._kv.free_slot(slot)
                 self._active[slot] = False
                 self._slots[slot] = None
-                self._queue.insert(0, req)
+                self._defer_admission_locked(src, req)
                 if self._tele is not None:
                     self._tele.on_admission_deferred(rid,
                                                      len(self._queue))
@@ -907,16 +1172,17 @@ class ContinuousBatchingServer:
         deferred reservation, so counters see each admission once."""
         admitted = 0
         for slot in range(self.max_slots):
-            if not self._queue:
-                break
             if self._admit_cap is not None and admitted >= self._admit_cap:
                 break
             if self._slots[slot] is not None:
                 continue
-            best = self._best_hit(self._queue[0].ids)
-            if not self._head_fits_pool(best):
+            item, src = self._next_admission_locked()
+            if item is None:
                 break
-            req = self._queue.pop(0)
+            best = self._best_hit(item.ids)
+            if not self._head_fits_pool(item, best):
+                break
+            req = self._pop_admission_locked(src)
             if self._tele is not None:
                 self._tele.on_admit(req.rid, len(self._queue))
             try:
@@ -927,7 +1193,7 @@ class ContinuousBatchingServer:
                 # returns to the head of the queue (FIFO preserved) and
                 # is retried next tick — admit_slot rolled its own
                 # shared-page refs back, nothing was prefilled
-                self._queue.insert(0, req)
+                self._defer_admission_locked(src, req)
                 if self._tele is not None:
                     self._tele.on_admission_deferred(req.rid,
                                                      len(self._queue))
@@ -968,7 +1234,9 @@ class ContinuousBatchingServer:
             n_pre, pre_pages = m.tokens, m.pages
         else:
             m, n_pre, pre_pages = None, 0, []
-        self._kv.admit_slot(slot, T + req.budget, pre_pages)
+        self._kv.admit_slot(slot, self._extent_tokens(T, req.budget),
+                            pre_pages)
+        self._count_headroom(slot, T)
         if m is not None:
             self._prefix.use(m)               # LRU: reuse is recency
             # attribution: pinned nodes are register_prefix state (the
@@ -990,12 +1258,39 @@ class ContinuousBatchingServer:
         st.fill_pos = st.filled = n_pre
         st.n_pre = n_pre
         st.seed = req.seed
+        self._bind_request(st, req)
         self._slots[slot] = st
         self._prefill_fifo.append(slot)
         # park the slot's decode write position past the block table:
         # until activation, its wasted decode-step writes null-redirect
         # (zeroed) instead of corrupting the pages being prefilled
         self._pending_t[slot] = self.max_cache_len
+
+    def _bind_request(self, st, req):
+        """Carry the request's scheduling state onto its slot. A
+        RESUMED (previously preempted) request keeps its stream offset
+        (on_token never re-sends delivered chunks — the replay is
+        bit-identical below it), its pre-preemption partial (flushed if
+        it must leave early again), and its preemption count."""
+        st.priority = req.priority
+        if isinstance(req, _Preempted):
+            st.streamed = req.streamed
+            st.replayed = tuple(req.emitted)
+            st.preempts = req.preempts
+            self.stats["preempt_resumed"] += 1
+            if self._tele is not None:
+                self._tele.on_preempt_resumed()
+
+    def _count_headroom(self, slot, T):
+        """Account the pages an optimistic admission reserved BEYOND
+        the prompt (its pre-paid growth headroom)."""
+        if not self._optimistic:
+            return
+        hr = len(self._kv.slot_pages(slot)) - self._npages_for(T)
+        if hr > 0:
+            self.stats["headroom_pages"] += hr
+            if self._tele is not None:
+                self._tele.add_headroom_pages(hr)
 
     def _prefill_tick(self):
         """Run one batched ragged prefill launch: the next chunk of
@@ -1127,8 +1422,9 @@ class ContinuousBatchingServer:
             return 1
         return (seg_len + self._chunk_pad(seg_len)) // c
 
-    def _admit_one(self, slot, rid, ids, budget, req_seed, on_token,
-                   deadline=None, best=None):
+    def _admit_one(self, slot, req, best=None):
+        rid, ids, budget = req.rid, req.ids, req.budget
+        req_seed, on_token, deadline = req.seed, req.on_token, req.deadline
         if self._faults is not None:
             # chaos failure point: an admission prefill that dies is a
             # PER-REQUEST failure (_admit records it), never a server one
@@ -1159,8 +1455,12 @@ class ContinuousBatchingServer:
             # ONCE. Shared cache-hit pages join the slot's table by
             # reference and are referenced before the alloc, so its
             # reclaim sweep can never evict them; mid-decode growth can
-            # never exhaust the pool.
-            own = self._kv.admit_slot(slot, T + budget, pre_pages)
+            # never exhaust the pool. (Optimistic admission reserves
+            # only prompt + headroom here; _grow_locked pays as it goes.)
+            own = self._kv.admit_slot(slot,
+                                      self._extent_tokens(T, budget),
+                                      pre_pages)
+            self._count_headroom(slot, T)
         tele = self._tele
         t_started = tele.prefill_started() if tele is not None else None
         wall0 = _time_mod.perf_counter()
@@ -1240,6 +1540,7 @@ class ContinuousBatchingServer:
         st = _Slot(rid, ids, T, budget, on_token, deadline)
         st.n_pre = n_pre
         st.seed = req_seed
+        self._bind_request(st, req)
         st.emitted.append(int(first))
         st.stream(self._deferred_cbs)
         self._slots[slot] = st
@@ -1248,6 +1549,104 @@ class ContinuousBatchingServer:
         if tele is not None:
             tele.on_prefill_batch(t_started, T - n_pre)
             tele.on_first_token(rid, T - n_pre, n_pre)
+
+    # ------------------------------------- optimistic growth / preemption
+    def _grow_locked(self):
+        """Optimistic admission's per-tick growth pass: every active
+        slot whose next ``tick_block`` decode writes would cross its
+        block-table coverage gets pages appended ON DEMAND
+        (``PagedKVCache.grow_slot``); when the pool cannot supply them
+        the preemption policy frees victims (``_grow_one_locked``).
+        Runs under the server lock BEFORE the decode dispatch, so the
+        device program always sees tables covering every row it will
+        genuinely need — rows past a request's total extent
+        null-redirect harmlessly, exactly like reserve mode's wasted
+        block steps."""
+        n = self.tick_block
+        for slot in range(self.max_slots):
+            if not self._active[slot]:
+                continue              # empty, mid-prefill, or just parked
+            st = self._slots[slot]
+            # next tick writes rows [t, t + n), t = prompt_len +
+            # emitted - 1; rows at or past prompt + budget are never
+            # read back (harvest stops the slot first)
+            needed = min(st.prompt_len + len(st.emitted) - 1 + n,
+                         st.prompt_len + st.budget)
+            try:
+                self._grow_one_locked(slot, st, needed)
+            except PreemptedError:
+                # the grower itself ranked last and was parked — typed,
+                # internal, and caught HERE: it never reaches a waiter
+                continue
+
+    def _grow_one_locked(self, slot, st, needed_tokens):
+        """Grow one slot to cover ``needed_tokens``, preempting victims
+        if the pool is genuinely exhausted. Loop invariant: every
+        iteration either succeeds, raises (transient tick failure —
+        retried by the supervisor with all state consistent), or
+        removes one live slot from the candidate set, so it terminates;
+        when the grower itself is the least valuable live work it parks
+        itself (``PreemptedError``, caught by ``_grow_locked``) rather
+        than evict anyone ranked above it."""
+        kv = self._kv
+        need = self._npages_for(needed_tokens) - len(kv.slot_pages(slot))
+        if need <= 0:
+            return
+        while True:
+            try:
+                kv.grow_slot(slot, need)
+            except OutOfPages:
+                if kv.free_pages() \
+                        + self._prefix.evictable_pages() >= need:
+                    # pages exist but this reclaim sweep died (injected
+                    # ``prefix.evict`` fault): a TRANSIENT tick failure
+                    # — the supervisor retries; preempting here would
+                    # burn a victim for pages already reclaimable
+                    raise
+                cands = [(s, self._slots[s])
+                         for s in range(self.max_slots)
+                         if self._slots[s] is not None]
+                victim = self._preempt_policy.pick(slot, cands)
+                if victim is None:
+                    raise      # no live work to free: genuine exhaustion
+                if self._faults is not None:
+                    # chaos point: an aborted victim teardown leaves the
+                    # victim decoding and fails the TICK (supervised
+                    # retry); victims already parked this sweep stay
+                    # safely parked — nothing leaks either way
+                    self._faults.check(faults.SERVER_PREEMPT,
+                                       slot=victim, grower=slot,
+                                       rid=self._slots[victim].rid)
+                if victim == slot:
+                    self._preempt_slot_locked(slot)
+                    raise PreemptedError(
+                        f"request {st.rid} parked by its own page "
+                        f"growth (least valuable live work)")
+                self._preempt_slot_locked(victim)
+            else:
+                self.stats["grow_pages"] += need
+                if self._tele is not None:
+                    self._tele.add_grow_pages(need)
+                return
+
+    def _preempt_slot_locked(self, slot):
+        """Tear a victim down BIT-EXACTLY resumable: park its replay
+        record (resolved seed, absolute deadline, stream offset, the
+        partial so far) on the preempted queue, donate its written
+        prompt prefix pages into the radix tree COLD (the triggering
+        grow reclaims them first; a quick re-admission still auto-hits
+        whatever survives), and free the rest. The waiter keeps
+        blocking: re-admission replays the identical token chain —
+        greedy trivially, sampled because the chain restarts from the
+        same resolved seed through the same programs."""
+        st = self._slots[slot]
+        rec = _Preempted(st)
+        self._release_slot(slot, cold=True)
+        self._preempted.append(rec)
+        self.stats["preemptions"] += 1
+        if self._tele is not None:
+            self._tele.on_preempt(st.rid, len(self._preempted))
+            self._pool_gauges()
 
     # ------------------------------------------------------------ steps
     def _build_decode_step(self):
@@ -1346,10 +1745,23 @@ class ContinuousBatchingServer:
                 self._tele.set_active_slots(0)
             return 0
         if self._kv is not None:
-            # admission reserved each slot's FULL extent (prompt +
-            # budget), so no page growth happens mid-flight; writes past
-            # a slot's table (wasted block steps of finished/inactive
-            # rows) are redirected to the null page and need no coverage
+            # reserve mode: admission took each slot's FULL extent
+            # (prompt + budget), so no page growth happens mid-flight.
+            # optimistic mode: grow every slot about to cross its
+            # coverage NOW, preempting victims if the pool is dry —
+            # the dispatch below must never write a needed row through
+            # a missing page. Writes past a slot's table (wasted block
+            # steps of finished/inactive rows) are redirected to the
+            # null page and need no coverage in either mode.
+            if self._optimistic:
+                self._grow_locked()
+                if not self._active.any():
+                    # extreme pressure: growth parked every decoding
+                    # slot — nothing to dispatch this tick (re-admission
+                    # restarts them next tick)
+                    if self._tele is not None:
+                        self._tele.set_active_slots(0)
+                    return 0
             self._sync_block_table()
         # ragged mode: activations batched their tok/t/key updates —
         # push them (and the parked write positions of slots still
@@ -1404,11 +1816,13 @@ class ContinuousBatchingServer:
         return n
 
     def _busy_locked(self):
-        """Work pending: queued requests, decoding slots, or slots
-        still mid-ragged-prefill (not yet _active but holding pages
-        and owed their remaining prompt chunks)."""
+        """Work pending: queued requests, decoding slots, slots still
+        mid-ragged-prefill (not yet _active but holding pages and owed
+        their remaining prompt chunks), or preempted requests parked
+        for re-admission (``stop(drain=True)`` keeps ticking until
+        they finish too)."""
         return bool(self._queue or self._active.any()
-                    or self._prefill_fifo)
+                    or self._prefill_fifo or self._preempted)
 
     def _finished(self, st):
         if len(st.emitted) >= st.budget:
@@ -1474,6 +1888,29 @@ class ContinuousBatchingServer:
                     self._tele.on_deadline_expired("decoding")
                     self._tele.on_cancel(st.rid)
                     self._pool_gauges()
+        if self._preempted:
+            keep_p = []
+            for rec in self._preempted:
+                if rec.deadline is not None:
+                    if now is None:
+                        now = self._clock.now()
+                    if now >= rec.deadline:
+                        # deadline accounting holds ACROSS preemption:
+                        # time parked counted against the same absolute
+                        # deadline. Same promise as mid-decode expiry —
+                        # the pre-preemption partial is the result, no
+                        # decode is resumed, and its pages were already
+                        # donated/freed at preemption
+                        self._flush_parked_locked(rec)
+                        notify = True
+                        if self._tele is not None:
+                            self._tele.on_deadline_expired("preempted")
+                            self._tele.on_cancel(rec.rid)
+                        continue
+                keep_p.append(rec)
+            if len(keep_p) != len(self._preempted):
+                self._preempted[:] = keep_p
+                self._preempt_gauge()
         if notify:
             self._done_cv.notify_all()
 
@@ -1502,6 +1939,13 @@ class ContinuousBatchingServer:
                     found = True
                     break
         if not found:
+            for i, rec in enumerate(self._preempted):
+                if rec.rid == rid:
+                    del self._preempted[i]
+                    self._preempt_gauge()
+                    found = True
+                    break
+        if not found:
             return
         # a failed request has no result: its undelivered stream chunks
         # must not fire later as if it were still live
@@ -1519,6 +1963,9 @@ class ContinuousBatchingServer:
         thresh = self._sup.breaker.failure_threshold
         rids = [item.rid for item in self._queue]
         self._queue.clear()
+        rids += [rec.rid for rec in self._preempted]
+        self._preempted.clear()
+        self._preempt_gauge()
         for slot in range(self.max_slots):
             if self._slots[slot] is not None:
                 rids.append(self._slots[slot].rid)
@@ -1708,11 +2155,17 @@ class ContinuousBatchingServer:
             self._draining = False
             if not drain:
                 # hard stop: flush partials for in-flight slots (mid-
-                # prefill ones record an empty partial), fail what
-                # never ran — every waiter unblocks
+                # prefill ones record an empty partial) AND for parked
+                # preempted requests (their pre-preemption partial is
+                # the result), fail what never ran — every waiter
+                # unblocks
                 for slot in range(self.max_slots):
                     if self._slots[slot] is not None:
                         self._finish_partial_locked(slot)
+                for rec in self._preempted:
+                    self._flush_parked_locked(rec)
+                self._preempted.clear()
+                self._preempt_gauge()
                 for item in self._queue:
                     self._failures[item.rid] = ServerClosed(
                         f"request {item.rid} was still queued when the "
@@ -1736,6 +2189,31 @@ class ContinuousBatchingServer:
         """Slots holding a live request (decoding or mid-ragged-
         prefill). Lock-free, same contract as ``queue_depth``."""
         return sum(1 for st in self._slots if st is not None)
+
+    def preempt_pressure(self):
+        """Requests parked on the preempted queue — displaced in-flight
+        work this replica must REPLAY before it makes progress on new
+        traffic. The router folds it into its load score (weighted
+        above plain queue depth: a thrashing pool costs every resident
+        request, not just the parked ones) so the fleet sheds load away
+        from replicas losing the optimistic-admission gamble. Always 0
+        under ``admission="reserve"``. Lock-free, same contract as
+        ``queue_depth``."""
+        return len(self._preempted)
+
+    def abandon(self, rid, err):
+        """Record a typed failure for ``rid`` on behalf of a caller
+        that HOLDS the request outside this server (the multi-replica
+        router: a foreign rid harvested off this replica's queue that
+        no route ever claimed) — its waiter's ``wait(rid)`` raises
+        ``err`` promptly instead of running out its timeout. No-op
+        (returns False) when the rid already settled here."""
+        with self._lock:
+            if rid in self._results or rid in self._failures:
+                return False
+            self._failures[rid] = err
+            self._done_cv.notify_all()
+        return True
 
     def prefix_sketch(self):
         """Fingerprint set of this replica's radix-tree contents
@@ -1779,6 +2257,15 @@ class ContinuousBatchingServer:
                         st = self._finish_partial_locked(slot)
                         if self._tele is not None:
                             self._tele.on_cancel(st.rid)
+                # a dead replica's parked preempted requests are
+                # mid-decode work too: not replayable elsewhere without
+                # double-streaming, so their partials flush to waiters
+                for rec in self._preempted:
+                    self._flush_parked_locked(rec)
+                    if self._tele is not None:
+                        self._tele.on_cancel(rec.rid)
+                self._preempted.clear()
+                self._preempt_gauge()
                 # nobody will fire chunks on a dead replica, and every
                 # live rid was just flushed
                 self._deferred_cbs.clear()

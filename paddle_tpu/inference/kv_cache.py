@@ -21,7 +21,7 @@ corrupt a live slot's pages.
 """
 import numpy as np
 
-from ..reliability.faults import PAGE_ALLOC
+from ..reliability.faults import KV_GROW, PAGE_ALLOC
 
 __all__ = ["PagedKVCache", "OutOfPages", "NULL_PAGE"]
 
@@ -76,6 +76,7 @@ class PagedKVCache:
         self.alloc_total = 0       # pages taken off the free list
         self.freed_total = 0       # pages returned (refcount hit 0)
         self.shared_ref_total = 0  # extra refs taken on shared pages
+        self.grown_total = 0       # pages appended mid-decode (grow_slot)
 
     # ------------------------------------------------------- allocation
     def _npages(self, n_tokens):
@@ -167,6 +168,33 @@ class PagedKVCache:
         self.dirty = True
         return own
 
+    def grow_slot(self, slot, n):
+        """Append ``n`` fresh pages to a live slot's block table —
+        optimistic admission grows a slot page-by-page as decode
+        crosses page boundaries instead of reserving its full extent
+        up front. The ``kv.grow`` chaos point fires BEFORE the free
+        list is touched, so an injected grow failure is a clean
+        transient (nothing to roll back). Raises ``OutOfPages`` when
+        the pool (plus whatever the reclaimer can evict) cannot supply
+        the pages — the server's preemption policy then frees a
+        victim's pages and retries. Returns the new page ids."""
+        if self._faults is not None:
+            self._faults.check(KV_GROW, slot=slot, need=n)
+        pages = self._slot_pages[slot]
+        if not pages:
+            raise RuntimeError(f"slot {slot} holds no pages to grow")
+        if len(pages) + n > self.pages_per_slot:
+            raise ValueError(
+                f"growing slot {slot} by {n} pages exceeds "
+                f"pages_per_slot ({self.pages_per_slot})")
+        own = self.alloc(n)
+        row = self.block_table[slot]
+        row[len(pages):len(pages) + n] = own
+        pages.extend(own)
+        self.dirty = True
+        self.grown_total += n
+        return own
+
     def free_slot(self, slot):
         """Release the slot's pages (shared pages just drop a ref) and
         null its block-table row so stale decode writes are redirected
@@ -194,7 +222,8 @@ class PagedKVCache:
                 "used_pages": self.used_pages(),
                 "alloc_total": self.alloc_total,
                 "freed_total": self.freed_total,
-                "shared_ref_total": self.shared_ref_total}
+                "shared_ref_total": self.shared_ref_total,
+                "grown_total": self.grown_total}
 
     @staticmethod
     def paged_hbm_bytes(num_pages, page_size, layers, kv_heads, head_dim,

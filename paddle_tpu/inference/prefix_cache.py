@@ -217,13 +217,26 @@ class PrefixCache:
             self._touch(node)
 
     # ---------------------------------------------------------- donation
-    def donate(self, ids, pages, prompt_len):
+    def donate(self, ids, pages, prompt_len, cold=False):
         """Adopt a released slot's page list: full prompt pages become
         (or refresh) tree nodes, everything else — the partial prompt
         tail and the decode budget — is released. Takes ownership of
         EVERY reference the caller held on ``pages``: existing nodes
         absorb the duplicate (released), new nodes keep theirs. Returns
         the number of newly cached pages.
+
+        ``cold=True`` is the PREEMPTION donation path: the donated run
+        enters at the COLD end of the LRU instead of as most-recent —
+        new nodes keep ``last_used=0`` (insertion ``seq`` still breaks
+        ties deterministically) and existing nodes keep their real
+        recency untouched. A preemption victim was chosen as the least
+        valuable work in flight, and the very grow that displaced it is
+        about to reclaim pages — cold insertion lets that reclaim take
+        the victim's pages FIRST while a genuinely hot shared prefix
+        among them (an existing, recently-used node) survives. The
+        pages stay lookup-able until evicted, so a quickly re-admitted
+        victim still auto-hits its own prompt (prefix-cache-assisted
+        recompute).
 
         Raises (``prefix.donate`` fault) strictly BEFORE any state
         changes — on failure the caller still owns all ``pages`` and
@@ -247,7 +260,8 @@ class PrefixCache:
                 self._sketch.add(child.fp)
                 self.cached_pages += 1
                 new += 1
-            self._touch(child)
+            if not cold:
+                self._touch(child)
             node = child
         self.kv.release(pages[nf:])
         self.donated_pages_total += new

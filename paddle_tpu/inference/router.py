@@ -76,16 +76,18 @@ class _RouterRequest:
     """Everything needed to (re)dispatch one request to any replica."""
 
     __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
-                 "cancelled")
+                 "priority", "cancelled")
 
-    def __init__(self, rid, ids, budget, seed, on_token, deadline):
+    def __init__(self, rid, ids, budget, seed, on_token, deadline,
+                 priority=0):
         self.rid = rid
         self.ids = ids
         self.budget = budget
         self.seed = seed              # RESOLVED at router submit: a
         self.on_token = on_token      # requeued sibling draws the
         self.deadline = deadline      # identical sampling chain
-        self.cancelled = False
+        self.priority = priority      # preemption class (optimistic
+        self.cancelled = False        # admission), travels on requeue
 
 
 class _Route:
@@ -141,10 +143,14 @@ class RouterSupervisor:
             if is_serving_state(state):
                 continue
             dead = state == DEAD
-            # cheap pre-check so an idle dead/draining replica costs
-            # two lock hops per poll, not an evacuation sweep
+            # cheap pre-check so an idle dead/draining replica costs a
+            # few lock-free reads per poll, not an evacuation sweep. A
+            # dead replica still holding in-flight slots OR parked
+            # preempted requests must be swept: both carry partials
+            # their waiters are owed (flush_partials covers them)
             if rep.queue_depth() == 0 \
-                    and not (dead and rep.in_flight() > 0):
+                    and not (dead and (rep.in_flight() > 0
+                                       or rep.preempt_pressure() > 0)):
                 continue
             try:
                 r._failover(idx, flush_partials=dead)
@@ -238,21 +244,24 @@ class ReplicaRouter:
         self._stats = {"routed": [0] * n, "affinity_hits": 0,
                        "fallbacks": 0, "dispatch_retries": 0,
                        "evacuations": 0, "requeued": 0,
-                       "replica_lost": 0, "restarts": 0}
+                       "replica_lost": 0, "orphaned": 0, "restarts": 0}
         self.supervisor = RouterSupervisor(self, retry=retry_policy)
         self._stop_evt = threading.Event()
         self._thread = None
 
     # ------------------------------------------------------------ client
     def submit(self, input_ids, max_new_tokens=32, seed=None,
-               on_token=None, deadline_s=None):
+               on_token=None, deadline_s=None, priority=0):
         """Route one prompt to the best replica; returns a ROUTER
         request id (collect with ``wait``). ``deadline_s`` fixes an
         absolute deadline NOW — any time the request later spends
         queued at the router (failover requeue) or on a replica is
-        charged against it. Raises ``QueueFullError`` when every
-        serving replica shed it (resubmit with backoff) and
-        ``ReplicaLostError`` when no replica is serving at all."""
+        charged against it. ``priority`` is the preemption class
+        (replicas running ``admission="optimistic"``); it travels with
+        the request across failover requeues. Raises
+        ``QueueFullError`` when every serving replica shed it
+        (resubmit with backoff) and ``ReplicaLostError`` when no
+        replica is serving at all."""
         ids = np.asarray(unwrap(input_ids)).astype(np.int32)
         if ids.ndim == 2:
             if ids.shape[0] != 1:
@@ -272,7 +281,7 @@ class ReplicaRouter:
         deadline = None if deadline_s is None \
             else self._clock.now() + float(deadline_s)
         item = _RouterRequest(rid, ids, int(max_new_tokens), int(seed),
-                              on_token, deadline)
+                              on_token, deadline, int(priority))
         self._place(item, exclude=())
         return rid
 
@@ -386,8 +395,14 @@ class ReplicaRouter:
                 k = self._rr % len(serving)
                 self._rr += 1
             return serving[k:] + serving[:k], aff
+        # preemption pressure joins the load score, weighted ABOVE
+        # plain queue depth: a replica thrashing its KV pool (parked
+        # preempted requests it must replay) is slower for EVERY
+        # resident request, so the fleet sheds new load away from it
+        # until the backlog drains. Lock-free reads, like the rest.
         load = {idx: (self.replicas[idx].queue_depth()
-                      + self.replicas[idx].in_flight())
+                      + self.replicas[idx].in_flight()
+                      + 2 * self.replicas[idx].preempt_pressure())
                 for idx in serving}
         if self.policy == "affinity":
             fps_by_pg = {}
@@ -427,7 +442,8 @@ class ReplicaRouter:
                     f"dispatched to a replica")
         return self.replicas[idx].submit(
             item.ids, max_new_tokens=item.budget, seed=item.seed,
-            on_token=item.on_token, deadline_s=deadline_s)
+            on_token=item.on_token, deadline_s=deadline_s,
+            priority=item.priority)
 
     def _place(self, item, exclude=()):
         """Dispatch ``item`` to the best willing replica; record the
@@ -444,10 +460,19 @@ class ReplicaRouter:
                 try:           # mutating open->half_open probe gate
                     rrid = self._dispatch(idx, item)   # happens HERE
                 except DeadlineExceeded:
-                    raise             # total expiry: siblings can't help
+                    # total expiry: siblings can't help. If allow()
+                    # handed us a half-open probe token, return it
+                    # UNRESOLVED — the replica was never touched, and
+                    # keeping the token would wedge the breaker
+                    # half-open with no probe outcome ever recorded
+                    self._breakers[idx].release_probe()
+                    raise
                 except (QueueFullError, ServerClosed) as e:
                     # replica-level shed / drain race: divert, don't
-                    # trip the breaker — healthy, just unwilling
+                    # trip the breaker — healthy, just unwilling (and a
+                    # shed is no probe VERDICT either: hand a half-open
+                    # token back so another attempt may probe)
+                    self._breakers[idx].release_probe()
                     last_err = e
                     self._note_retry(idx)
                     continue
@@ -595,13 +620,29 @@ class ReplicaRouter:
     def _drain_backlog(self):
         """Retry every router-held request (called once per supervisor
         poll). No source exclusion here: a restarted replica may take
-        its old work back."""
+        its old work back. Orphan entries that aged out without a
+        route claiming them are TRUE FOREIGN traffic (submitted
+        straight to the replica, not through this router): their
+        waiters block on the source replica, so fail them THERE, typed
+        and promptly, instead of letting them run out their own
+        timeouts (the PR-7 known cut this closes)."""
         with self._lock:
             backlog, self._backlog = self._backlog, []
-            # age out unclaimed orphan entries (true foreign traffic)
+            expired = [k for k, ttl in self._orphans.items() if ttl <= 1]
             self._orphans = {k: ttl - 1
                              for k, ttl in self._orphans.items()
                              if ttl > 1}
+        for src, rrid in expired:
+            err = ReplicaLostError(
+                f"request {rrid} was evacuated off replica {src} but "
+                f"belongs to no route of this router (foreign traffic "
+                f"submitted directly to the replica?) — it cannot be "
+                f"requeued, submit through the router instead")
+            if self.replicas[src].abandon(rrid, err):
+                with self._lock:
+                    self._stats["orphaned"] += 1
+                if self._tele is not None:
+                    self._tele.on_orphaned()
         for rid in backlog:
             with self._lock:
                 route = self._routes.get(rid)

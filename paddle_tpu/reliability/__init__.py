@@ -30,13 +30,13 @@ clock protocol), and deterministic under test.
 """
 from .errors import (CallbackError, CheckpointCorruptError,  # noqa: F401
                      CircuitOpenError, DeadlineExceeded, InjectedFault,
-                     QueueFullError, ReliabilityError, ReplicaLostError,
-                     RequestCancelled, SchedulerClosed, ServerClosed,
-                     StepFailedError, TrainAnomalyError)
+                     PreemptedError, QueueFullError, ReliabilityError,
+                     ReplicaLostError, RequestCancelled, SchedulerClosed,
+                     ServerClosed, StepFailedError, TrainAnomalyError)
 from .faults import (CKPT_RENAME, CKPT_SWAP, CKPT_WRITE,  # noqa: F401
-                     DATA_NEXT, DECODE_TICK, FaultInjector, ON_TOKEN,
-                     PAGE_ALLOC, PREFILL, ROUTER_DISPATCH,
-                     ROUTER_EVACUATE, TRAIN_STEP)
+                     DATA_NEXT, DECODE_TICK, FaultInjector, KV_GROW,
+                     ON_TOKEN, PAGE_ALLOC, PREFILL, ROUTER_DISPATCH,
+                     ROUTER_EVACUATE, SERVER_PREEMPT, TRAIN_STEP)
 from .health import (DEAD, DEGRADED, DRAINING, HEALTH_CODES,  # noqa: F401
                      HEALTHY, HealthMonitor, is_serving_state)
 from .retry import CircuitBreaker, RetryPolicy  # noqa: F401
@@ -50,13 +50,15 @@ from .training import (AnomalyPolicy, ResumableLoader,  # noqa: F401
 
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
-           "CircuitOpenError", "ReplicaLostError", "InjectedFault",
+           "CircuitOpenError", "ReplicaLostError", "PreemptedError",
+           "InjectedFault",
            "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError",
            "RetryPolicy", "CircuitBreaker", "ServeSupervisor",
            "HealthMonitor", "HEALTHY", "DEGRADED", "DRAINING", "DEAD",
            "HEALTH_CODES", "is_serving_state",
            "FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
+           "KV_GROW", "SERVER_PREEMPT",
            "ON_TOKEN", "ROUTER_DISPATCH", "ROUTER_EVACUATE",
            "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
            "TRAIN_STEP", "DATA_NEXT",

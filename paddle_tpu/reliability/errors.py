@@ -10,7 +10,8 @@ these DIRECTLY (no RuntimeError wrapping) — a client distinguishing
 
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
-           "CircuitOpenError", "ReplicaLostError", "InjectedFault",
+           "CircuitOpenError", "ReplicaLostError", "PreemptedError",
+           "InjectedFault",
            "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError"]
 
@@ -67,6 +68,20 @@ class ReplicaLostError(ReliabilityError):
     a replica's breaker opening (``CircuitOpenError``, deliberately
     fail-fast: its in-flight work may already have streamed tokens, so
     transparent re-execution would double-stream)."""
+
+
+class PreemptedError(ReliabilityError):
+    """INTERNAL scheduling signal of ``admission="optimistic"``: the
+    server preempted this request's slot under KV-pool pressure (its
+    pages were freed, its written prompt prefix donated to the prefix
+    cache) and parked it on the preempted queue for bit-exact
+    re-admission. It is typed so the scheduler's own control flow and
+    the chaos suites can match it precisely — but it is NOT a request
+    outcome: a preempted request is still live, its waiter keeps
+    blocking, and ``wait()`` NEVER raises this (the chaos suite asserts
+    zero escapes). A preempted request ultimately resolves like any
+    other: result, partial (deadline/cancel/hard stop), or a different
+    typed failure."""
 
 
 class InjectedFault(ReliabilityError):

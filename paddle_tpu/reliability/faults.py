@@ -27,6 +27,7 @@ import threading
 from .errors import InjectedFault
 
 __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
+           "KV_GROW", "SERVER_PREEMPT",
            "ON_TOKEN", "PREFIX_EVICT", "PREFIX_DONATE",
            "ROUTER_DISPATCH", "ROUTER_EVACUATE", "CKPT_WRITE",
            "CKPT_RENAME", "CKPT_SWAP", "TRAIN_STEP", "DATA_NEXT"]
@@ -35,6 +36,16 @@ __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
 PREFILL = "server.prefill"          # _admit_one: admission prefill
 DECODE_TICK = "server.decode_tick"  # _step_locked: batched decode dispatch
 PAGE_ALLOC = "kv.alloc"             # PagedKVCache.alloc
+KV_GROW = "kv.grow"                 # PagedKVCache.grow_slot: optimistic
+#                                     mid-decode page growth (fires BEFORE
+#                                     the free list is touched — a faulted
+#                                     grow is a transient tick failure,
+#                                     never a leak)
+SERVER_PREEMPT = "server.preempt"   # _grow_one_locked: one victim
+#                                     teardown (fires BEFORE the victim
+#                                     is touched — an aborted sweep
+#                                     leaves it decoding; the tick
+#                                     retries)
 ON_TOKEN = "server.on_token"        # streamed-token callback delivery
 PREFIX_EVICT = "prefix.evict"       # PrefixCache.evict: LRU reclaim sweep
 PREFIX_DONATE = "prefix.donate"     # PrefixCache.donate: harvest-time

@@ -361,6 +361,12 @@ class TrainSupervisor:
                     self.injector.check(point)
                 out = fn(*args)
             except StopIteration:       # exhausted data is not a fault
+                if self.breaker is not None:
+                    # no verdict either: a half-open probe token taken
+                    # by allow() above must be returned, or end-of-data
+                    # coinciding with a recovering breaker wedges it
+                    # half-open (denying every later step) forever
+                    self.breaker.release_probe()
                 raise
             except Exception as e:
                 opened = (self.breaker.record_failure()

@@ -193,8 +193,29 @@ class ServerTelemetry:
         exp = r.counter("server_deadline_expired_total",
                         "Requests that outran their deadline",
                         labelnames=("where",))
-        self._c_exp_queued = exp.labels(where="queued")
-        self._c_exp_decoding = exp.labels(where="decoding")
+        self._c_exp = {"queued": exp.labels(where="queued"),
+                       "decoding": exp.labels(where="decoding"),
+                       "preempted": exp.labels(where="preempted")}
+        # admission="optimistic" signals: how often the gamble loses
+        # (preemptions), what growth-on-demand actually allocated, the
+        # headroom admissions pre-paid, and the parked-replay backlog
+        self._c_preempt = r.counter(
+            "server_preemptions_total",
+            "Slots preempted under KV-pool pressure (victim parked for "
+            "bit-exact re-admission)")
+        self._c_preempt_resumed = r.counter(
+            "server_preempt_resumed_total",
+            "Preempted requests re-admitted (replay started)")
+        self._c_grow_pages = r.counter(
+            "kv_grow_pages_total",
+            "Pages grown on demand mid-decode (optimistic admission)")
+        self._c_headroom = r.counter(
+            "server_headroom_pages_total",
+            "Pages reserved beyond the prompt at optimistic admission "
+            "(pre-paid growth headroom)")
+        self._g_preempted = r.gauge(
+            "server_preempted_queue_depth",
+            "Preempted requests parked awaiting re-admission")
         self._c_tick_retries = r.counter(
             "server_tick_retries_total",
             "Supervised serve-loop tick failures retried")
@@ -254,23 +275,29 @@ class ServerTelemetry:
                 "request.queued", rid=rid, requeued=True)
 
     def on_first_token(self, rid, prefill_tokens, prefix_hit_tokens):
-        """Admission prefill produced the request's first token."""
+        """Admission prefill produced the request's first token. A
+        PREEMPTED request re-emits its first token at re-admission:
+        the waiter saw it long ago, so TTFT/queue-wait observe only the
+        ORIGINAL emission (``t_first`` stays put for TPOT); the token
+        counters still count the replay's real prefill work."""
         if not self.enabled:
             return
         st = self._req.get(rid)
         if st is None:
             return
         t = self.clock.now()
-        st.t_first = t
-        if st.t_admit is not None:
-            # the wait that ended at the SUCCESSFUL admission (deferred
-            # attempts updated t_admit and observed nothing)
-            self._h_wait.observe(st.t_admit - st.t_submit)
+        if st.t_first is None:
+            if st.t_admit is not None:
+                # the wait that ended at the SUCCESSFUL admission
+                # (deferred attempts updated t_admit and observed
+                # nothing)
+                self._h_wait.observe(st.t_admit - st.t_submit)
+            self._h_ttft.observe(t - st.t_submit)
+            st.t_first = t
         if st.prefill_span is not None:
             st.prefill_span.end(prefill_tokens=prefill_tokens,
                                 prefix_hit_tokens=prefix_hit_tokens)
             st.prefill_span = None
-        self._h_ttft.observe(t - st.t_submit)
         if prefill_tokens:
             self._c_tok_prefill.inc(prefill_tokens)
         if prefix_hit_tokens:
@@ -414,10 +441,50 @@ class ServerTelemetry:
          else self._c_shed_evict).inc()
 
     def on_deadline_expired(self, where):
+        """``where``: ``queued`` / ``decoding`` / ``preempted`` (the
+        request expired while parked on the preempted queue)."""
         if not self.enabled:
             return
-        (self._c_exp_queued if where == "queued"
-         else self._c_exp_decoding).inc()
+        self._c_exp.get(where, self._c_exp["decoding"]).inc()
+
+    # ------------------------------------------- optimistic admission
+    def on_preempt(self, rid, depth):
+        """A live slot was preempted under pool pressure and parked
+        (``depth`` = preempted-queue depth after parking). The request
+        is back to waiting: its open prefill/decode spans close and a
+        fresh queued span opens, like a deferred admission."""
+        if not self.enabled:
+            return
+        self._c_preempt.inc()
+        self._g_preempted.set(depth)
+        st = self._req.get(rid)
+        if st is None:
+            return
+        if st.decode_span is not None:
+            st.decode_span.end(preempted=True)
+            st.decode_span = None
+        if st.prefill_span is not None:
+            st.prefill_span.end(preempted=True)
+            st.prefill_span = None
+        if st.queued_span is None:
+            st.queued_span = self.tracer.begin_span(
+                "request.queued", rid=rid, preempted=True)
+
+    def on_preempt_resumed(self):
+        if self.enabled:
+            self._c_preempt_resumed.inc()
+
+    def add_grow_pages(self, n):
+        if self.enabled and n:
+            self._c_grow_pages.inc(n)
+
+    def add_headroom_pages(self, n):
+        if self.enabled and n:
+            self._c_headroom.inc(n)
+
+    def set_preempted_depth(self, n):
+        if self.enabled:
+            self._g_preempted.set(n)
 
     def on_tick_retry(self):
         if self.enabled:
@@ -453,6 +520,9 @@ class RouterTelemetry:
                                             DESTINATION
     - ``router_replica_lost_total``         requests failed with
       ``ReplicaLostError`` (no sibling could take them)
+    - ``router_orphaned_total``             foreign rids harvested from
+      an evacuated replica that no route ever claimed, failed typed at
+      their source replica once the orphan TTL expired
     - ``router_queue_depth``                harvested requests awaiting
                                             redispatch
     - ``router_replicas_serving``           replicas currently taking
@@ -496,6 +566,10 @@ class RouterTelemetry:
         self._c_lost = r.counter(
             "router_replica_lost_total",
             "Requests failed typed because no sibling could take them")
+        self._c_orphaned = r.counter(
+            "router_orphaned_total",
+            "Foreign evacuated requests failed typed at their source "
+            "replica after the orphan TTL expired")
         self._g_backlog = r.gauge(
             "router_queue_depth",
             "Harvested requests held by the router awaiting redispatch")
@@ -532,6 +606,10 @@ class RouterTelemetry:
     def on_replica_lost(self):
         if self.enabled:
             self._c_lost.inc()
+
+    def on_orphaned(self):
+        if self.enabled:
+            self._c_orphaned.inc()
 
     def set_backlog(self, n):
         if self.enabled:

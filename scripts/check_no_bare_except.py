@@ -18,6 +18,15 @@ cuts discoverable instead of buried. Deliberate non-cuts (abstract
 methods raise bare; API refusals) opt out with a ``# no-roadmap:
 <reason>`` comment on the raise line, which is itself grep-able.
 
+Required-cut rule (ISSUE 8): some dispatch sites must KEEP a
+ROADMAP-pointered refusal — ``REQUIRED_CUTS`` lists (file, keyword)
+pairs, and the lint fails if the file no longer contains a pointered
+``NotImplementedError`` mentioning the keyword. The first entry is the
+admission-mode dispatch: ``admission="optimistic"`` on the dense cache
+backend must refuse with a pointer (silently "supporting" the combo —
+or deleting the refusal wholesale — is exactly the kind of quiet
+contract change this lint exists to surface).
+
 Usage: python scripts/check_no_bare_except.py [root ...]
 Exit status 1 lists every offending file:line. Wired into the test
 suite (tests/test_train_reliability.py) so a regression fails tier-1.
@@ -43,6 +52,16 @@ SCOPE_CUT_DIRS = (
     os.path.join("paddle_tpu", "telemetry"),
 )
 OPT_OUT = "no-roadmap:"
+
+# dispatch sites that must KEEP a ROADMAP-pointered
+# NotImplementedError: (repo-relative file, keyword its message must
+# mention). ISSUE 8: the optimistic-admission mode dispatch — the
+# optimistic+dense combo must refuse with a pointer, not silently
+# half-work or lose its annotation.
+REQUIRED_CUTS = (
+    (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
+     "optimistic"),
+)
 
 
 def _raise_strings(node):
@@ -117,6 +136,38 @@ def bare_excepts(root):
     return scan(root, repo)[0]
 
 
+def missing_required_cuts(repo):
+    """[(relpath, keyword), ...] of ``REQUIRED_CUTS`` entries whose
+    file no longer holds a ROADMAP-pointered ``NotImplementedError``
+    mentioning the keyword (or cannot be parsed)."""
+    missing = []
+    for rel, keyword in REQUIRED_CUTS:
+        path = os.path.join(repo, rel)
+        try:
+            with open(path, "rb") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            missing.append((rel, keyword))
+            continue
+        found = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            if not (isinstance(exc, ast.Call)
+                    and isinstance(exc.func, ast.Name)
+                    and exc.func.id == "NotImplementedError"):
+                continue
+            strings = _raise_strings(exc)
+            if any("ROADMAP" in s for s in strings) \
+                    and any(keyword in s for s in strings):
+                found = True
+                break
+        if not found:
+            missing.append((rel, keyword))
+    return missing
+
+
 def main(argv):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     roots = argv[1:] or [os.path.join(repo, d) for d in DEFAULT_DIRS]
@@ -125,6 +176,9 @@ def main(argv):
         b, c = scan(root, repo)
         bare += b
         cuts += c
+    # positive obligations are repo-level, independent of which roots
+    # were passed (a partial run must not skip them)
+    required = missing_required_cuts(repo)
     for path, line in bare:
         print(f"{path}:{line}: bare 'except:' — name the exception type "
               "(at least 'except Exception')")
@@ -132,10 +186,15 @@ def main(argv):
         print(f"{path}:{line}: NotImplementedError without a ROADMAP "
               "pointer — name the ROADMAP item that lifts this scope "
               f"cut, or opt out with '# {OPT_OUT} <reason>'")
-    if bare or cuts:
+    for rel, keyword in required:
+        print(f"{rel}: required scope cut missing — expected a "
+              f"ROADMAP-pointered NotImplementedError mentioning "
+              f"{keyword!r} (see REQUIRED_CUTS)")
+    if bare or cuts or required:
         return 1
     print(f"OK: no bare excepts / unpointered scope cuts under "
-          f"{', '.join(roots)}")
+          f"{', '.join(roots)}; {len(REQUIRED_CUTS)} required cut(s) "
+          f"present")
     return 0
 
 

@@ -92,6 +92,82 @@ class TestCircuitBreaker:
         br.record_success()
         assert br.record_failure() is False        # streak restarted
 
+    def test_half_open_admits_exactly_one_probe(self):
+        """ISSUE 8 satellite (PR-7 known cut): racing submits at the
+        cooldown edge must not all probe at once — the first allow()
+        takes the single probe token, every racer is denied until the
+        probe resolves."""
+        fc = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, reset_after_s=5.0,
+                            clock=fc)
+        br.record_failure()
+        fc.advance(6.0)
+        assert br.allow()                       # probe taken
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert not br.allow()                   # racer denied
+        assert not br.allow()                   # and again
+        assert not br.would_allow()             # filter agrees
+        assert br.record_failure() is True      # probe fails: re-open
+        assert not br.allow()                   # cooldown restarts
+        fc.advance(6.0)
+        assert br.allow()                       # next single probe
+        assert not br.allow()
+        br.record_success()                     # probe succeeds
+        assert br.state == CircuitBreaker.CLOSED
+        assert br.allow() and br.allow()        # closed: no gating
+
+    def test_probe_token_is_atomic_under_racing_threads(self):
+        """The race the token exists to gate IS concurrent: many
+        threads calling allow() at the cooldown edge must yield exactly
+        ONE True, and a thread that never took the token must not be
+        able to release another thread's probe."""
+        import threading
+        fc = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, reset_after_s=5.0,
+                            clock=fc)
+        br.record_failure()
+        fc.advance(6.0)
+        got, start = [], threading.Barrier(16)
+
+        def racer():
+            start.wait()
+            if br.allow():
+                got.append(threading.get_ident())
+            else:
+                # non-owners abandoning must NOT free the real probe
+                br.release_probe()
+
+        ts = [threading.Thread(target=racer) for _ in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(got) == 1, f"{len(got)} concurrent probes admitted"
+        assert not br.allow()              # token still held
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_release_probe_unwedges_abandoned_attempt(self):
+        """A caller that took the probe token but never touched the
+        guarded resource (request expired, replica shed) hands it back
+        — otherwise the breaker stays half-open denying everyone
+        forever, with no probe outcome ever possible."""
+        fc = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, reset_after_s=5.0,
+                            clock=fc)
+        br.record_failure()
+        fc.advance(6.0)
+        assert br.allow()
+        assert not br.allow()                   # token held
+        br.release_probe()                      # attempt abandoned
+        assert br.would_allow()
+        assert br.allow()                       # someone else probes
+        assert not br.allow()
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED
+        br.release_probe()                      # closed: harmless no-op
+        assert br.allow()
+
 
 # ------------------------------------------------------------ health
 

@@ -764,3 +764,103 @@ class TestEvacuateHook:
         np.testing.assert_array_equal(srv.wait(rc, timeout=60),
                                       stub_tokens(_prompt(7), 3))
         srv.stop()
+
+
+# ------------------------------------------- ISSUE 8 satellites
+
+class TestOrphanTTL:
+    def test_foreign_rid_fails_typed_at_source_after_ttl(self):
+        """ISSUE 8 satellite (PR-7 known cut): a FOREIGN request
+        (submitted straight to a replica, not through the router)
+        harvested off an evacuated queue used to age out of
+        ``_orphans`` silently, leaving its waiter to its own timeout.
+        Now TTL expiry fails it promptly at the SOURCE replica with a
+        typed ``ReplicaLostError``, and the router counts it."""
+        router, reps = _router(2)
+        foreign = reps[0].submit(_prompt(1, 2, 3), max_new_tokens=4)
+        reps[0].kill()                    # dies with the queue intact
+        router.poll()                     # evacuate: rid has no route
+        assert router.stats["orphaned"] == 0     # parked, not failed
+        router.poll()                     # TTL ticking...
+        router.poll()                     # ...expired: abandoned typed
+        assert router.stats["orphaned"] == 1
+        with pytest.raises(ReplicaLostError, match="foreign"):
+            reps[0].wait(foreign, timeout=1.0)
+
+    def test_orphaned_counted_in_router_telemetry(self):
+        router, reps = _router(2, telemetry=True)
+        reps[0].submit(_prompt(9, 9), max_new_tokens=3)
+        reps[0].kill()
+        for _ in range(3):
+            router.poll()
+        reg = router.telemetry.registry
+        assert reg.get("router_orphaned_total").value == 1.0
+
+    def test_router_owned_rids_are_never_orphan_failed(self):
+        """Router-routed traffic keeps its PR-7 claim-and-requeue path:
+        an evacuation of router-owned rids produces no orphan
+        failures."""
+        router, reps = _router(2, rep_kw={"max_slots": 1})
+        rid = router.submit(_prompt(4, 5), max_new_tokens=4)
+        src = next(i for i, r in enumerate(reps)
+                   if r.queue_depth() or r.in_flight())
+        reps[src].kill()
+        for _ in range(4):
+            router.poll()
+        assert router.stats["orphaned"] == 0
+        _drive(router, reps)
+        out = router.wait(rid, timeout=10)
+        np.testing.assert_array_equal(
+            out, stub_tokens(_prompt(4, 5), 4)[:len(out)])
+
+
+class TestPreemptPressureRouting:
+    def test_pressure_diverts_load(self):
+        """ISSUE 8: parked preempted requests weigh on the routing
+        score (heavier than plain queue depth), so new traffic sheds
+        away from a replica thrashing its KV pool."""
+        router, reps = _router(2, policy="least_loaded")
+        assert reps[0].preempt_pressure() == 0
+        reps[0]._preempted.extend(object() for _ in range(3))
+        for _ in range(3):
+            router.submit(_prompt(1, 2), max_new_tokens=2)
+        assert router.stats["routed"] == [0, 3]   # all shed to rep1
+        reps[0]._preempted.clear()
+        # queue depth 3 on rep1 now outweighs rep0's zero pressure
+        router.submit(_prompt(1, 2), max_new_tokens=2)
+        assert router.stats["routed"] == [1, 3]
+
+    def test_priority_travels_through_dispatch(self):
+        router, reps = _router(2)
+        router.submit(_prompt(7, 7), max_new_tokens=2, priority=2)
+        pending = next(r._queue[0] for r in reps if r.queue_depth())
+        assert pending.priority == 2
+
+
+class TestDeadReplicaParkedFlush:
+    def test_poll_flushes_parked_preempted_on_dead_replica(self):
+        """A dead replica whose only remaining work is PARKED preempted
+        requests (queue 0, in-flight 0) must still be swept: the poll
+        pre-check counts preempt_pressure, and flush_partials hands the
+        parked partials to their waiters."""
+        router, reps = _router(
+            2, rep_kw={"max_slots": 2, "admission": "optimistic",
+                       "num_pages": 17})
+        rid = router.submit(_prompt(1, 2, 3, 4), max_new_tokens=12)
+        route = router._routes[rid]
+        rep = reps[route.idx]
+        for _ in range(4):
+            rep.step()                       # decode a real partial
+        with rep._lock:                      # park it (production path)
+            slot = next(s for s in range(rep.max_slots)
+                        if rep._slots[s] is not None)
+            rep._preempt_slot_locked(slot)
+        assert rep.in_flight() == 0 and rep.queue_depth() == 0
+        assert rep.preempt_pressure() == 1
+        rep.kill()
+        router.poll()                        # must not skip the corpse
+        out = router.wait(rid, timeout=5)
+        np.testing.assert_array_equal(
+            out, stub_tokens(_prompt(1, 2, 3, 4), 12)[:len(out)])
+        assert len(out) > 0
+        _balanced(rep)

@@ -142,6 +142,27 @@ class TestSupervisorLoop:
         assert sup.run_with_retries(lambda: 1, faults.TRAIN_STEP) == 1
         assert cb.state == cb.CLOSED            # probe success closed it
 
+    def test_stop_iteration_returns_the_probe_token(self, tmp_path):
+        """ISSUE 8 regression: StopIteration (normal end-of-data) is
+        neither success nor failure — the half-open single-probe token
+        allow() took must be RELEASED, or the breaker wedges half-open
+        and every later step fails 'cooling down' forever."""
+        def exhausted():
+            raise StopIteration
+
+        clk = FakeClock()
+        cb = CircuitBreaker(failure_threshold=1, reset_after_s=60,
+                            clock=clk)
+        cb.record_failure()                     # pre-opened
+        sup = TrainSupervisor(str(tmp_path), breaker=cb)
+        clk.advance(61)                         # cooldown elapsed
+        with pytest.raises(StopIteration):
+            sup.run_with_retries(exhausted, faults.DATA_NEXT)
+        assert cb.state == cb.HALF_OPEN
+        # the probe must be available again, and succeed
+        assert sup.run_with_retries(lambda: 1, faults.TRAIN_STEP) == 1
+        assert cb.state == cb.CLOSED
+
     def test_breaker_open_aborts_typed(self, tmp_path):
         fi = FaultInjector(seed=0).on(faults.TRAIN_STEP, probability=1.0)
         sup = TrainSupervisor(
