@@ -1,18 +1,23 @@
-"""Telemetry overhead on the continuous-batching decode path (ISSUE 2).
+"""Telemetry overhead on the continuous-batching decode path (ISSUE 2;
+recorder + journey paths added by ISSUE 10).
 
 Drives the same request workload through ``ContinuousBatchingServer``
 with telemetry DISABLED (``telemetry=None`` — one attribute check per
 hook site) and ENABLED (full ``ServerTelemetry``: histograms, gauges,
-spans) and reports:
+spans), then again with a ``FlightRecorder`` attached DISABLED
+(``enabled=False`` — must be structurally free: the server treats it
+as None) and ENABLED (event ring + per-tick dispatch profiles), and
+reports:
 
 - drain wall time per mode (best of N reps, compile warmed first),
 - per-tick decode latency from the enabled run's own
   ``serving_tick_seconds`` histogram (telemetry measuring itself),
 - instrument microbenchmarks (counter.inc / histogram.observe /
-  null-instrument call, ns/op),
-- the enabled-vs-disabled overhead %% — target: <2%% on the CPU decode
-  bench (the real tick is milliseconds of XLA work; the instruments
-  add microseconds of host work).
+  null-instrument call / recorder.record / disabled record / journey
+  event, ns/op),
+- the enabled-vs-disabled overhead %% per layer — GUARDS: telemetry
+  <2%%, disabled-recorder <2%% (the disabled-is-structurally-zero-cost
+  contract, measured end to end rather than assumed).
 
     python benchmarks/telemetry_overhead_bench.py [--slots N]
         [--requests N] [--new-tokens N] [--reps N]
@@ -37,7 +42,8 @@ def _build_model():
     return m
 
 
-def _drain(model, telemetry, slots, requests, new_tokens, reps):
+def _drain(model, telemetry, slots, requests, new_tokens, reps,
+           recorder=None):
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
     rng = np.random.default_rng(0)
@@ -45,7 +51,8 @@ def _drain(model, telemetry, slots, requests, new_tokens, reps):
                .astype(np.int32) for _ in range(requests)]
     srv = ContinuousBatchingServer(model, max_slots=slots,
                                    max_cache_len=128,
-                                   telemetry=telemetry)
+                                   telemetry=telemetry,
+                                   recorder=recorder)
     for p in prompts[:slots]:                       # warm the compiles
         srv.submit(p, max_new_tokens=4)
     srv.run()
@@ -74,7 +81,8 @@ def main():
     ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args()
 
-    from paddle_tpu.telemetry import MetricRegistry, ServerTelemetry
+    from paddle_tpu.telemetry import (FlightRecorder, JourneyRecorder,
+                                      MetricRegistry, ServerTelemetry)
 
     model = _build_model()
     t_off, _ = _drain(model, None, args.slots, args.requests,
@@ -82,9 +90,19 @@ def main():
     tele = ServerTelemetry()
     t_on, srv = _drain(model, tele, args.slots, args.requests,
                        args.new_tokens, args.reps)
+    # recorder paths ride on the DISABLED-telemetry baseline so each
+    # layer's cost is isolated
+    t_rec_off, _ = _drain(model, None, args.slots, args.requests,
+                          args.new_tokens, args.reps,
+                          recorder=FlightRecorder(enabled=False))
+    rec = FlightRecorder()
+    t_rec_on, srv_rec = _drain(model, None, args.slots, args.requests,
+                               args.new_tokens, args.reps, recorder=rec)
 
     tick = tele.registry.get("serving_tick_seconds")
     overhead = (t_on - t_off) / t_off * 100.0
+    rec_off_overhead = (t_rec_off - t_off) / t_off * 100.0
+    rec_on_overhead = (t_rec_on - t_off) / t_off * 100.0
 
     reg = MetricRegistry()
     c = reg.counter("bench_total")
@@ -93,19 +111,37 @@ def main():
     ns_inc = _micro(c.inc)
     ns_obs = _micro(lambda: h.observe(0.003))
     ns_null = _micro(null.inc)
+    mrec = FlightRecorder(capacity=4096)
+    ns_rec = _micro(lambda: mrec.record("bench", rid=1))
+    drec = FlightRecorder(enabled=False)
+    ns_rec_off = _micro(lambda: drec.record("bench", rid=1))
+    jr = JourneyRecorder()
+    jh = jr.begin("bench")
+    ns_jev = _micro(lambda: jh.event("phase", rid=1))
 
     print(f"workload: {args.requests} requests x {args.new_tokens} new "
           f"tokens, {args.slots} slots, best of {args.reps}")
-    print(f"drain disabled : {t_off * 1e3:9.1f} ms")
-    print(f"drain enabled  : {t_on * 1e3:9.1f} ms   "
+    print(f"drain disabled      : {t_off * 1e3:9.1f} ms")
+    print(f"drain telemetry     : {t_on * 1e3:9.1f} ms   "
           f"({tick.count} ticks, "
           f"{tick.sum / max(tick.count, 1) * 1e3:.3f} ms/tick measured "
           f"by serving_tick_seconds)")
-    print(f"overhead       : {overhead:9.2f} %   (target < 2%)")
-    print(f"counter.inc    : {ns_inc:9.0f} ns/op")
-    print(f"hist.observe   : {ns_obs:9.0f} ns/op")
-    print(f"null inc       : {ns_null:9.0f} ns/op (disabled registry)")
-    return 0 if overhead < 2.0 else 1
+    print(f"drain rec disabled  : {t_rec_off * 1e3:9.1f} ms   "
+          f"({rec_off_overhead:+.2f}% — structurally-zero guard)")
+    print(f"drain rec enabled   : {t_rec_on * 1e3:9.1f} ms   "
+          f"({rec_on_overhead:+.2f}%, {rec.total} events, "
+          f"{len(rec.events(kind='tick'))} tick profiles)")
+    print(f"telemetry overhead  : {overhead:9.2f} %   (target < 2%)")
+    print(f"counter.inc         : {ns_inc:9.0f} ns/op")
+    print(f"hist.observe        : {ns_obs:9.0f} ns/op")
+    print(f"null inc            : {ns_null:9.0f} ns/op (disabled registry)")
+    print(f"recorder.record     : {ns_rec:9.0f} ns/op")
+    print(f"record (disabled)   : {ns_rec_off:9.0f} ns/op")
+    print(f"journey.event       : {ns_jev:9.0f} ns/op")
+    # guards: full telemetry <2%, DISABLED recorder <2% (its events/
+    # clock reads are asserted zero in tests; wall clock is the
+    # end-to-end check that "treated as None" really holds)
+    return 0 if overhead < 2.0 and rec_off_overhead < 2.0 else 1
 
 
 if __name__ == "__main__":

@@ -37,10 +37,10 @@ class _Pending:
     """A queued request awaiting a slot."""
 
     __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
-                 "priority")
+                 "priority", "journey")
 
     def __init__(self, rid, ids, budget, seed, on_token, deadline,
-                 priority=0):
+                 priority=0, journey=None):
         self.rid = rid
         self.ids = ids
         self.budget = budget
@@ -48,13 +48,17 @@ class _Pending:
         self.on_token = on_token
         self.deadline = deadline      # absolute clock time, or None
         self.priority = priority      # higher = preempted later
+        self.journey = journey        # fleet trace handle (router), or
+        #                               None — every emission site is
+        #                               guarded, so no-journey costs
+        #                               one attribute check
 
 
 class _Slot:
     __slots__ = ("rid", "ids", "prompt_len", "budget", "emitted",
                  "on_token", "streamed", "deadline", "phase", "fill_pos",
                  "filled", "n_pre", "seed", "priority", "preempts",
-                 "replayed")
+                 "replayed", "journey")
 
     def __init__(self, rid, ids, prompt_len, budget, on_token=None,
                  deadline=None):
@@ -76,6 +80,7 @@ class _Slot:
         self.seed = 0                 # sampling chain seed
         self.priority = 0             # preemption class (higher = safer)
         self.preempts = 0             # times this request was preempted
+        self.journey = None           # fleet trace handle, or None
         # the partial recorded BEFORE a preemption: a resumed slot
         # replays the identical chain, so the longer of (replayed,
         # emitted) is always the request's true partial — a deadline/
@@ -117,7 +122,7 @@ class _Preempted:
     stop, dead-replica evacuation) before decode resumes."""
 
     __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
-                 "priority", "emitted", "streamed", "preempts")
+                 "priority", "emitted", "streamed", "preempts", "journey")
 
     def __init__(self, st):
         self.rid = st.rid
@@ -130,6 +135,7 @@ class _Preempted:
         self.emitted = list(st.partial())
         self.streamed = st.streamed
         self.preempts = st.preempts + 1
+        self.journey = st.journey
 
 
 class PreemptionPolicy:
@@ -259,6 +265,15 @@ class ContinuousBatchingServer:
     Host-side only; with the default ``telemetry=None`` the hot path
     pays a single attribute check, no locks and no clock reads.
 
+    ``recorder`` (``telemetry.FlightRecorder``, or ``True``) adds the
+    flight-recorder layer: a bounded ring of structured events
+    (admissions, grows, preemptions/replays, evictions, per-tick
+    dispatch profiles, health/breaker flips) and postmortem bundles
+    captured on breaker open, request failure, and ``kill()`` —
+    ``srv.postmortems()``, or ``/debug/postmortem`` via
+    ``serve_metrics``. A disabled recorder is treated exactly like
+    the default None (same zero-cost contract as telemetry).
+
     Reliability (paddle_tpu.reliability): ``submit(deadline_s=...)``
     bounds waiting, ``max_queue`` + ``shed_policy`` bound the queue,
     the ``start()`` serve thread is SUPERVISED (``retry_policy`` /
@@ -282,8 +297,9 @@ class ContinuousBatchingServer:
                  preemption_policy=None,
                  prefill_mode=None, prefill_tokens_per_tick=None,
                  max_admissions_per_tick=None, telemetry=None,
-                 max_queue=None, shed_policy="reject", retry_policy=None,
-                 breaker=None, fault_injector=None, clock=None):
+                 recorder=None, max_queue=None, shed_policy="reject",
+                 retry_policy=None, breaker=None, fault_injector=None,
+                 clock=None):
         self.model = model
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
@@ -439,7 +455,7 @@ class ContinuousBatchingServer:
         self.stats = {"prefill_tokens": 0, "prefix_hit_tokens": 0,
                       "prefix_auto_hits": 0, "prefix_auto_hit_tokens": 0,
                       "admissions": 0, "prefill_dispatches": 0,
-                      "prefill_wall_s": 0.0,
+                      "prefill_wall_s": 0.0, "tick_dispatches": 0,
                       # admission="optimistic" accounting
                       "preemptions": 0, "preempt_resumed": 0,
                       "grow_pages": 0, "headroom_pages": 0}
@@ -452,6 +468,39 @@ class ContinuousBatchingServer:
         self.telemetry = telemetry
         self._tele = telemetry if (telemetry is not None
                                    and telemetry.enabled) else None
+        # one time base for everything (events must correlate with
+        # spans/deadlines in a postmortem, and FakeClock tests need
+        # determinism): explicit clock > telemetry's > monotonic
+        self._clock = clock if clock is not None else (
+            telemetry.clock if self._tele is not None else MonotonicClock())
+        # flight recorder (telemetry.FlightRecorder): structured event
+        # ring + postmortem bundles. True builds a default one on the
+        # server's clock; a DISABLED recorder is treated exactly like
+        # None, so the hot path pays one `is None` check — no locks,
+        # no clock reads
+        if recorder is True:
+            from ..telemetry import FlightRecorder
+            recorder = FlightRecorder(clock=self._clock)
+        self.recorder = recorder
+        self._rec = recorder if (recorder is not None
+                                 and recorder.enabled) else None
+        # per-tick host->device dispatch profile {op: count} — the
+        # dispatches-per-decode-tick baseline ROADMAP item 4 is
+        # measured against; reset at each tick, published to telemetry
+        # + recorder when nonempty (plain dict ops: always maintained,
+        # costs no locks/clock)
+        self._tick_disp = {}
+        if fault_injector is not None:
+            # chaos storms become VISIBLE: fires publish to this
+            # server's registry and land in its flight recorder (an
+            # injector shared across servers keeps the first recorder
+            # it was given)
+            if self._tele is not None \
+                    and hasattr(fault_injector, "publish_to"):
+                fault_injector.publish_to(self._tele.registry)
+            if self._rec is not None \
+                    and getattr(fault_injector, "recorder", None) is None:
+                fault_injector.recorder = self._rec
         self._failures = {}   # rid -> admission exception (ADVICE r5 #2)
         self._run_failures = {}   # last run()'s drained failures
         # submit()/cancel() may come from request threads while a serve
@@ -471,8 +520,6 @@ class ContinuousBatchingServer:
                              f"'evict_oldest', got {shed_policy!r}")
         self._max_queue = None if max_queue is None else int(max_queue)
         self._shed_policy = shed_policy
-        self._clock = clock if clock is not None else (
-            telemetry.clock if self._tele is not None else MonotonicClock())
         self._faults = fault_injector
         self._sup = ServeSupervisor(retry=retry_policy, breaker=breaker)
         self._health = HealthMonitor(on_change=self._publish_health)
@@ -623,7 +670,8 @@ class ContinuousBatchingServer:
 
     # ------------------------------------------------------------ queue
     def submit(self, input_ids, max_new_tokens=32, seed=None,
-               on_token=None, deadline_s=None, priority=0):
+               on_token=None, deadline_s=None, priority=0,
+               journey=None):
         """Queue a prompt; returns a request id. The FIRST generated
         token is produced by the prefill (same contract as generate()).
         ``seed`` drives this request's sampling chain (default: the
@@ -646,7 +694,13 @@ class ContinuousBatchingServer:
         the result. With ``max_queue`` set, a full queue sheds per
         ``shed_policy`` — ``"reject"`` raises ``QueueFullError`` here,
         ``"evict_oldest"`` fails the oldest queued request instead and
-        accepts this one."""
+        accepts this one.
+
+        ``journey`` (a ``telemetry.Journey`` handle, normally minted by
+        the router and rebound per dispatch) threads this request's
+        fleet timeline through admission, prefill chunks, grow/preempt/
+        replay and completion; the default None costs one attribute
+        check per lifecycle site."""
         ids = np.asarray(unwrap(input_ids)).astype(np.int32)
         if ids.ndim == 2:
             if ids.shape[0] != 1:
@@ -726,6 +780,9 @@ class ContinuousBatchingServer:
                 if self._tele is not None:
                     self._tele.on_shed("evict_oldest")
                     self._tele.on_admission_failure(old.rid, err)
+                self._note_request_failure_locked(old.rid, err,
+                                                  old.journey,
+                                                  bundle=False)
                 self._done_cv.notify_all()
             rid = self._next_rid
             self._next_rid += 1
@@ -737,9 +794,11 @@ class ContinuousBatchingServer:
                 self._priority_seen = True
             self._queue.append(_Pending(rid, ids, int(max_new_tokens),
                                         int(seed), on_token, deadline,
-                                        int(priority)))
+                                        int(priority), journey))
             if self._tele is not None:
                 self._tele.on_submit(rid, T, len(self._queue))
+            if journey is not None:
+                journey.event("queued", rid=rid, prompt_tokens=int(T))
         return rid
 
     def cancel(self, rid):
@@ -761,6 +820,10 @@ class ContinuousBatchingServer:
                 if self._tele is not None:
                     self._tele.on_cancel(rid)
                     self._tele.set_queue_depth(len(self._queue))
+                if self._rec is not None:
+                    self._rec.record("cancel", rid=rid, where="queued")
+                if item.journey is not None:
+                    item.journey.event("cancelled")
                 self._done_cv.notify_all()
                 return True
         for slot in range(self.max_slots):
@@ -769,6 +832,11 @@ class ContinuousBatchingServer:
                 # covers decoding AND mid-ragged-prefill slots (the
                 # latter record an empty partial; their filled prefix
                 # pages are still donated)
+                if self._rec is not None:
+                    self._rec.record("cancel", rid=rid,
+                                     where="in_flight")
+                if st.journey is not None:
+                    st.journey.event("cancelled")
                 self._finish_partial_locked(slot)
                 if self._tele is not None:
                     self._tele.on_cancel(rid)
@@ -783,10 +851,15 @@ class ContinuousBatchingServer:
                 # semantics — the pre-preemption partial is the result
                 # (its pages were already donated/freed at preemption)
                 del self._preempted[i]
+                if self._rec is not None:
+                    self._rec.record("cancel", rid=rid,
+                                     where="preempted")
                 self._flush_parked_locked(rec)
                 if self._tele is not None:
                     self._tele.on_cancel(rid)
                     self._preempt_gauge()
+                if rec.journey is not None:
+                    rec.journey.event("cancelled")
                 self._done_cv.notify_all()
                 return True
         return False
@@ -826,6 +899,9 @@ class ContinuousBatchingServer:
             else:
                 if new and self._tele is not None:
                     self._tele.on_prefix_donate(new)
+                if new and self._rec is not None:
+                    self._rec.record("donate", rid=st.rid, pages=new,
+                                     cold=cold)
         else:
             self._kv.release(pages)
 
@@ -838,6 +914,12 @@ class ContinuousBatchingServer:
         st = self._slots[slot]
         self._results[st.rid] = np.asarray(st.partial()[:st.budget],
                                            np.int32)
+        if self._rec is not None:
+            self._rec.record("flush", rid=st.rid,
+                             tokens=len(self._results[st.rid]))
+        if st.journey is not None:
+            st.journey.event("flushed",
+                             tokens=len(self._results[st.rid]))
         self._release_slot(slot)
         return st
 
@@ -895,6 +977,7 @@ class ContinuousBatchingServer:
             self._caches = dict(self._caches,
                                 bt=jnp.asarray(self._kv.block_table))
             self._kv.dirty = False
+            self._tick_dispatch("block_table")
 
     def _pool_gauges(self):
         """Refresh the page-pool occupancy gauges (paged backend)."""
@@ -940,6 +1023,8 @@ class ContinuousBatchingServer:
             return 0
         if freed and self._tele is not None:
             self._tele.on_prefix_evict(freed)
+        if freed and self._rec is not None:
+            self._rec.record("evict", pages=freed)
         return freed
 
     def _best_hit(self, ids):
@@ -1093,6 +1178,14 @@ class ContinuousBatchingServer:
         record from ``_preempted`` and handles telemetry/notify."""
         self._results[rec.rid] = np.asarray(rec.emitted[:rec.budget],
                                             np.int32)
+        if self._rec is not None:
+            self._rec.record("flush", rid=rec.rid,
+                             tokens=len(self._results[rec.rid]),
+                             parked=True)
+        if rec.journey is not None:
+            rec.journey.event("flushed",
+                              tokens=len(self._results[rec.rid]),
+                              parked=True)
 
     # ------------------------------------------------------- scheduling
     def _admit(self, run_prefill=True):
@@ -1147,6 +1240,10 @@ class ContinuousBatchingServer:
                 if self._tele is not None:
                     self._tele.on_admission_deferred(rid,
                                                      len(self._queue))
+                if self._rec is not None:
+                    self._rec.record("defer", rid=rid)
+                if req.journey is not None:
+                    req.journey.event("deferred")
                 break
             except Exception as e:
                 if self._kv is not None and self._kv.slot_pages(slot):
@@ -1156,6 +1253,7 @@ class ContinuousBatchingServer:
                 self._failures[rid] = e
                 if self._tele is not None:
                     self._tele.on_admission_failure(rid, e)
+                self._note_request_failure_locked(rid, e, req.journey)
                 self._done_cv.notify_all()
             else:
                 admitted += 1
@@ -1197,6 +1295,10 @@ class ContinuousBatchingServer:
                 if self._tele is not None:
                     self._tele.on_admission_deferred(req.rid,
                                                      len(self._queue))
+                if self._rec is not None:
+                    self._rec.record("defer", rid=req.rid)
+                if req.journey is not None:
+                    req.journey.event("deferred")
                 break
             except Exception as e:
                 if self._kv.slot_pages(slot):
@@ -1208,6 +1310,8 @@ class ContinuousBatchingServer:
                 self._failures[req.rid] = e
                 if self._tele is not None:
                     self._tele.on_admission_failure(req.rid, e)
+                self._note_request_failure_locked(req.rid, e,
+                                                  req.journey)
                 self._done_cv.notify_all()
             else:
                 admitted += 1
@@ -1258,7 +1362,7 @@ class ContinuousBatchingServer:
         st.fill_pos = st.filled = n_pre
         st.n_pre = n_pre
         st.seed = req.seed
-        self._bind_request(st, req)
+        self._bind_request(st, req, slot)
         self._slots[slot] = st
         self._prefill_fifo.append(slot)
         # park the slot's decode write position past the block table:
@@ -1266,20 +1370,32 @@ class ContinuousBatchingServer:
         # (zeroed) instead of corrupting the pages being prefilled
         self._pending_t[slot] = self.max_cache_len
 
-    def _bind_request(self, st, req):
+    def _bind_request(self, st, req, slot):
         """Carry the request's scheduling state onto its slot. A
         RESUMED (previously preempted) request keeps its stream offset
         (on_token never re-sends delivered chunks — the replay is
         bit-identical below it), its pre-preemption partial (flushed if
-        it must leave early again), and its preemption count."""
+        it must leave early again), and its preemption count. Also the
+        observability funnel for admissions: one flight-recorder event
+        and one journey phase per (re)admission, ``replay`` when the
+        request came off the preempted queue."""
         st.priority = req.priority
-        if isinstance(req, _Preempted):
+        st.journey = req.journey
+        resumed = isinstance(req, _Preempted)
+        if resumed:
             st.streamed = req.streamed
             st.replayed = tuple(req.emitted)
             st.preempts = req.preempts
             self.stats["preempt_resumed"] += 1
             if self._tele is not None:
                 self._tele.on_preempt_resumed()
+        if self._rec is not None:
+            self._rec.record("replay" if resumed else "admit",
+                             rid=st.rid, slot=slot,
+                             prompt=st.prompt_len, prefix_hit=st.n_pre)
+        if st.journey is not None:
+            st.journey.event("replay" if resumed else "admitted",
+                             slot=slot, prefix_hit=st.n_pre)
 
     def _count_headroom(self, slot, T):
         """Account the pages an optimistic admission reserved BEYOND
@@ -1336,11 +1452,14 @@ class ContinuousBatchingServer:
         logits, self._caches = self._ragged_fn(
             jnp.asarray(toks), jnp.asarray(t0), self._caches,
             jnp.asarray(out_idx))
-        self._count_dispatches(1)
+        self._count_dispatches(1, op="prefill")
         for slot, start, take in plan:
             st = self._slots[slot]
             st.fill_pos = st.filled = start + take
             self.stats["prefill_tokens"] += take
+            if st.journey is not None:
+                st.journey.event("prefill_chunk", start=start,
+                                 take=take)
         for slot in done:
             self._activate(slot, logits[slot:slot + 1])
         self.stats["prefill_wall_s"] += _time_mod.perf_counter() - wall0
@@ -1372,6 +1491,8 @@ class ContinuousBatchingServer:
         self._active[slot] = True
         self._prefill_fifo.remove(slot)
         st.emitted.append(first)
+        if st.journey is not None:
+            st.journey.event("first_token")
         st.stream(self._deferred_cbs)
         self.stats["admissions"] += 1
         if self._tele is not None:
@@ -1389,28 +1510,36 @@ class ContinuousBatchingServer:
                                jnp.int32)
             self._tok = self._tok.at[idx].set(vals)
             self._pending_tok.clear()
-            self._count_dispatches(1)
+            self._count_dispatches(1, op="state_push")
         if self._pending_t:
             idx = jnp.asarray(list(self._pending_t), jnp.int32)
             vals = jnp.asarray(list(self._pending_t.values()), jnp.int32)
             self._t = self._t.at[idx].set(vals)
             self._pending_t.clear()
-            self._count_dispatches(1)
+            self._count_dispatches(1, op="state_push")
         if self._pending_key:
             idx = jnp.asarray(list(self._pending_key), jnp.int32)
             vals = jnp.stack(list(self._pending_key.values()))
             self._keys = self._keys.at[idx].set(vals)
             self._pending_key.clear()
-            self._count_dispatches(1)
+            self._count_dispatches(1, op="state_push")
 
-    def _count_dispatches(self, n=1):
+    def _count_dispatches(self, n=1, op="prefill"):
         """Account ``n`` host->device dispatches on the admission/
         prefill path (prefill program launches, page gathers/scatters,
         slot-state pushes) — the counter-asserted signal that the
-        ragged path eliminated the per-admission detour."""
+        ragged path eliminated the per-admission detour. ``op`` labels
+        the dispatch in this tick's profile (the item-4 baseline)."""
         self.stats["prefill_dispatches"] += n
+        self._tick_disp[op] = self._tick_disp.get(op, 0) + n
         if self._tele is not None:
             self._tele.add_prefill_dispatches(n)
+
+    def _tick_dispatch(self, op, n=1):
+        """Account ``n`` dispatches that are NOT admission/prefill work
+        (the decode program itself, block-table syncs) in this tick's
+        per-op profile only."""
+        self._tick_disp[op] = self._tick_disp.get(op, 0) + n
 
     def _n_prefill_calls(self, seg_len):
         """Dense-prefill program launches ``_run_prefill`` makes for a
@@ -1468,7 +1597,7 @@ class ContinuousBatchingServer:
             m = best[1]
             self._prefix.use(m)               # LRU: reuse is recency
             caches1 = self._seed_from_pages(m.pages)
-            self._count_dispatches(1)         # page gather (the detour)
+            self._count_dispatches(1, op="page_gather")   # the detour
             rest = ids[n_pre:]                # never empty (lookup cap)
             self.stats["prefix_hit_tokens"] += n_pre
             self.stats["prefix_auto_hits"] += 1
@@ -1485,7 +1614,7 @@ class ContinuousBatchingServer:
             caches1 = jax.tree_util.tree_map(
                 lambda full, r: full.at[:, :, :r.shape[2]].set(r),
                 self._init_caches(1), rows)
-            self._count_dispatches(1)         # dense-row seed
+            self._count_dispatches(1, op="page_scatter")  # dense-row seed
             rest = ids[n_pre:]
             self.stats["prefix_hit_tokens"] += n_pre
             if rest.shape[0]:
@@ -1525,23 +1654,25 @@ class ContinuousBatchingServer:
             pg = self._kv.page_size
             n_prompt = -(-T // pg) - len(pre_pages)
             if own[:n_prompt]:
-                self._count_dispatches(1)     # remainder page scatter
+                self._count_dispatches(1, op="page_scatter")  # remainder pages
             self._fill_pages(caches1, own[:n_prompt],
                              len(pre_pages) * pg)
         else:
             self._caches = jax.tree_util.tree_map(
                 lambda pool, one: pool.at[:, slot].set(one[:, 0]),
                 self._caches, caches1)
-            self._count_dispatches(1)         # dense cache row copy
+            self._count_dispatches(1, op="page_scatter")  # dense row copy
         self._tok = self._tok.at[slot].set(first)
         self._t = self._t.at[slot].set(T)
-        self._count_dispatches(3)             # per-slot tok/t/key pushes
+        self._count_dispatches(3, op="state_push")    # tok/t/key pushes
         self._active[slot] = True
         st = _Slot(rid, ids, T, budget, on_token, deadline)
         st.n_pre = n_pre
         st.seed = req_seed
-        self._bind_request(st, req)
+        self._bind_request(st, req, slot)
         st.emitted.append(int(first))
+        if st.journey is not None:
+            st.journey.event("first_token")
         st.stream(self._deferred_cbs)
         self._slots[slot] = st
         self.stats["admissions"] += 1
@@ -1627,6 +1758,11 @@ class ContinuousBatchingServer:
                 self.stats["grow_pages"] += need
                 if self._tele is not None:
                     self._tele.add_grow_pages(need)
+                if self._rec is not None:
+                    self._rec.record("grow", rid=st.rid, slot=slot,
+                                     pages=need)
+                if st.journey is not None:
+                    st.journey.event("grow", pages=need)
                 return
 
     def _preempt_slot_locked(self, slot):
@@ -1641,6 +1777,13 @@ class ContinuousBatchingServer:
         same resolved seed through the same programs."""
         st = self._slots[slot]
         rec = _Preempted(st)
+        if self._rec is not None:
+            self._rec.record("preempt", rid=st.rid, slot=slot,
+                             tokens=len(rec.emitted),
+                             preempts=rec.preempts)
+        if st.journey is not None:
+            st.journey.event("preempted", slot=slot,
+                             tokens=len(rec.emitted))
         self._release_slot(slot, cold=True)
         self._preempted.append(rec)
         self.stats["preemptions"] += 1
@@ -1730,6 +1873,27 @@ class ContinuousBatchingServer:
             raise CallbackError(errors, what="on_token callback")
 
     def _step_locked(self):
+        """One tick under the lock. Wraps the real work so the tick's
+        host->device dispatch profile is published however the tick
+        exits (normal, drained early-return, or a raising fault — a
+        partial profile in the recorder is exactly what a postmortem
+        wants to see)."""
+        self._tick_disp = {}
+        try:
+            return self._step_inner()
+        finally:
+            prof = self._tick_disp
+            if prof:
+                total = sum(prof.values())
+                self.stats["tick_dispatches"] += total
+                if self._tele is not None:
+                    self._tele.on_tick_dispatches(prof)
+                if self._rec is not None:
+                    self._rec.record("tick", dispatches=dict(prof),
+                                     total=total,
+                                     active=int(self._active.sum()))
+
+    def _step_inner(self):
         self._prefill_used = 0       # per-tick prefill token budget
         self._expire_locked()
         self._admit()
@@ -1781,6 +1945,7 @@ class ContinuousBatchingServer:
         (self._tok, self._caches, self._t, self._keys,
          toks) = self._decode_jit(self._tok, self._caches, self._t,
                                   self._keys)
+        self._tick_dispatch("decode")
         toks = np.asarray(toks)                    # [slots, tick_block]
         decoded = wasted = 0
         for slot in range(self.max_slots):
@@ -1840,6 +2005,11 @@ class ContinuousBatchingServer:
                 self._release_slot(slot)   # paged: donates prompt pages
                 if self._tele is not None:
                     self._tele.on_finish(st.rid, len(out))
+                if self._rec is not None:
+                    self._rec.record("finish", rid=st.rid,
+                                     tokens=len(out))
+                if st.journey is not None:
+                    st.journey.event("finished", tokens=len(out))
                 finished = True
         if finished:
             if self._tele is not None:
@@ -1867,6 +2037,13 @@ class ContinuousBatchingServer:
                     if self._tele is not None:
                         self._tele.on_deadline_expired("queued")
                         self._tele.on_admission_failure(item.rid, err)
+                    if self._rec is not None:
+                        self._rec.record("deadline", rid=item.rid,
+                                         where="queued")
+                    if item.journey is not None:
+                        # NB "where" is a Journey reserved key (the
+                        # hop label) — the expiry location is "at"
+                        item.journey.event("expired", at="queued")
                 else:
                     keep.append(item)
             if len(keep) != len(self._queue):
@@ -1882,6 +2059,11 @@ class ContinuousBatchingServer:
             if now >= st.deadline:
                 # decoding (partial tokens kept) or mid-ragged-prefill
                 # (empty partial) — either way the slot frees now
+                if self._rec is not None:
+                    self._rec.record("deadline", rid=st.rid,
+                                     where="decoding")
+                if st.journey is not None:
+                    st.journey.event("expired", at="decoding")
                 self._finish_partial_locked(slot)
                 notify = True
                 if self._tele is not None:
@@ -1895,6 +2077,12 @@ class ContinuousBatchingServer:
                     if now is None:
                         now = self._clock.now()
                     if now >= rec.deadline:
+                        if self._rec is not None:
+                            self._rec.record("deadline", rid=rec.rid,
+                                             where="preempted")
+                        if rec.journey is not None:
+                            rec.journey.event("expired",
+                                              at="preempted")
                         # deadline accounting holds ACROSS preemption:
                         # time parked counted against the same absolute
                         # deadline. Same promise as mid-decode expiry —
@@ -1923,11 +2111,11 @@ class ContinuousBatchingServer:
         e.g. the FINAL stream chunk's callback raised after harvest.
         Recording a failure then would leave a phantom ``failures``
         entry no wait() ever pops, so it is skipped."""
-        found = False
+        found, journey = False, None
         for i, item in enumerate(self._queue):
             if item.rid == rid:
                 del self._queue[i]
-                found = True
+                found, journey = True, item.journey
                 break
         if not found:
             for slot in range(self.max_slots):
@@ -1936,14 +2124,14 @@ class ContinuousBatchingServer:
                     self._release_slot(slot)
                     if self._tele is not None:
                         self._pool_gauges()
-                    found = True
+                    found, journey = True, st.journey
                     break
         if not found:
             for i, rec in enumerate(self._preempted):
                 if rec.rid == rid:
                     del self._preempted[i]
                     self._preempt_gauge()
-                    found = True
+                    found, journey = True, rec.journey
                     break
         if not found:
             return
@@ -1954,13 +2142,85 @@ class ContinuousBatchingServer:
         self._failures[rid] = err
         if self._tele is not None:
             self._tele.on_admission_failure(rid, err)
+        self._note_request_failure_locked(rid, err, journey)
         self._done_cv.notify_all()
+
+    def _note_request_failure_locked(self, rid, err, journey=None,
+                                     bundle=True):
+        """Observability funnel for one request FAILING (as opposed to
+        finishing with a partial): journey phase, recorder event, and a
+        postmortem bundle — "a request just died" is exactly the moment
+        an operator wants the last N events and the pool state frozen.
+        ``bundle=False`` skips the capture for EXPECTED sheds (the
+        evict_oldest path runs on every overloaded submit(): paying a
+        state snapshot there would tax the hot path and flood the
+        bounded bundle store out of its genuinely interesting
+        captures). The caller owns the actual failure bookkeeping."""
+        if journey is not None:
+            journey.event("failed", error=type(err).__name__)
+        if self._rec is not None:
+            self._rec.record("fail", rid=rid,
+                             error=type(err).__name__)
+            if bundle:
+                self._postmortem_locked("request_failed", rid=rid,
+                                        error=repr(err))
+
+    def _postmortem_locked(self, reason, **extra):
+        """Capture a postmortem bundle into the flight recorder: recent
+        events plus the serving state an incident review needs — pool
+        balance, block-table occupancy, radix-tree stats, the parked
+        queue, live slots, queue depth, health, stats. Called under the
+        server lock; returns the bundle (or None without a recorder)."""
+        if self._rec is None:
+            return None
+        sections = {
+            "health": self._health.state,
+            "stats": dict(self.stats),
+            "queue": [item.rid for item in self._queue],
+            "slots": [{"slot": s, "rid": st.rid, "phase": st.phase,
+                       "emitted": len(st.emitted),
+                       "priority": st.priority}
+                      for s, st in enumerate(self._slots)
+                      if st is not None],
+            "parked": [{"rid": rec.rid, "priority": rec.priority,
+                        "preempts": rec.preempts,
+                        "emitted": len(rec.emitted)}
+                       for rec in self._preempted],
+        }
+        if self._kv is not None:
+            # pool_balance() is the ONE definition of the balance the
+            # chaos suites assert on (re-entrant lock: safe here) —
+            # the bundle must never drift from it
+            bal = self.pool_balance()
+            sections["pool_balance"] = {
+                "free": bal[0], "live": bal[1], "pinned": bal[2],
+                "cached": bal[3], "preempted": bal.preempted,
+                "preemptions": bal.preemptions}
+            sections["block_table"] = self._kv.occupancy()
+            sections["prefix_cache"] = self._prefix.stats()
+        sections.update(extra)
+        return self._rec.postmortem(reason, **sections)
+
+    def postmortems(self):
+        """Captured postmortem bundles, oldest first (empty without a
+        recorder) — served over ``/debug/postmortem`` via
+        ``serving.serve_metrics``."""
+        return [] if self._rec is None else self._rec.postmortems()
 
     def _fail_all_locked(self, cause):
         """Breaker-open path: fail EVERY queued and in-flight request
         with a ``CircuitOpenError`` so no waiter wedges on a server
         that cannot currently tick."""
         thresh = self._sup.breaker.failure_threshold
+        for item in self._queue:
+            if item.journey is not None:
+                item.journey.event("failed", error="CircuitOpenError")
+        for rec in self._preempted:
+            if rec.journey is not None:
+                rec.journey.event("failed", error="CircuitOpenError")
+        for st in self._slots:
+            if st is not None and st.journey is not None:
+                st.journey.event("failed", error="CircuitOpenError")
         rids = [item.rid for item in self._queue]
         self._queue.clear()
         rids += [rec.rid for rec in self._preempted]
@@ -2000,6 +2260,8 @@ class ContinuousBatchingServer:
     def _publish_health(self, state, code):
         if self._tele is not None:
             self._tele.set_health(state)
+        if self._rec is not None:
+            self._rec.record("health", state=state)
 
     def run(self, max_ticks=100000):
         """Drive until queue and slots drain; returns {rid: new_tokens}.
@@ -2115,9 +2377,19 @@ class ContinuousBatchingServer:
         retry backoff sleeps here)."""
         if self._tele is not None:
             self._tele.on_tick_retry()
+        if self._rec is not None:
+            self._rec.record("tick_retry", error=type(e).__name__)
         if self._sup.failure(e) == "open":
             with self._lock:
                 self._health.to(DEGRADED)
+                if self._rec is not None:
+                    self._rec.record("breaker", state="open",
+                                     error=type(e).__name__)
+                    # capture BEFORE the teardown: the bundle freezes
+                    # the parked queue / pool balance / slots as they
+                    # were at the moment retries ran out
+                    self._postmortem_locked("breaker_open",
+                                            error=repr(e))
                 self._fail_all_locked(e)
             if self._tele is not None:
                 self._tele.on_breaker_open()
@@ -2245,6 +2517,9 @@ class ContinuousBatchingServer:
         with self._lock:
             harvested = list(self._queue)
             self._queue.clear()
+            if self._rec is not None:
+                self._rec.record("evacuate", harvested=len(harvested),
+                                 flush_partials=bool(flush_partials))
             if self._tele is not None:
                 # the harvested rids leave THIS replica for good: close
                 # their lifecycle spans here (the router re-counts them
@@ -2292,6 +2567,12 @@ class ContinuousBatchingServer:
         with self._lock:
             self._accepting = False
             self._draining = False
+            if self._rec is not None:
+                self._rec.record("killed")
+                # the crash-scene snapshot the router's harvest will
+                # tear apart: queue + slots exactly as the "crash" left
+                # them
+                self._postmortem_locked("killed")
             self._health.to(DEAD)
         self._stop.set()
         if self._thread is not None:

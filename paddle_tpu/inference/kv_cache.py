@@ -213,6 +213,17 @@ class PagedKVCache:
         return pages
 
     # ------------------------------------------------------- accounting
+    def occupancy(self):
+        """Per-slot block-table occupancy, plain data — the postmortem
+        bundle's "who holds which pages" section: pages held and
+        shared-prefix pages per occupied slot, plus the pool totals."""
+        return {"free_pages": self.free_pages(),
+                "used_pages": self.used_pages(),
+                "pages_per_slot": self.pages_per_slot,
+                "slots": {s: {"pages": len(p),
+                              "shared": self._slot_shared[s]}
+                          for s, p in enumerate(self._slot_pages) if p}}
+
     def telemetry_stats(self):
         """Point-in-time pool state + cumulative churn, plain data —
         the ``/stats`` payload and the page-pool gauges source."""

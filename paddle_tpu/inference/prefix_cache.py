@@ -389,9 +389,15 @@ class PrefixCache:
 
     # -------------------------------------------------------- accounting
     def stats(self):
-        """Point-in-time tree state + cumulative churn, plain data."""
+        """Point-in-time tree state + cumulative churn, plain data
+        (``/stats`` and postmortem bundles). ``sketch_size`` is the
+        live fingerprint count — the size of the affinity signal the
+        router reads, which a postmortem wants next to the page
+        counts (a dead replica with a big sketch is lost locality the
+        fleet will re-prefill)."""
         return {"cached_pages": self.cached_pages,
                 "pinned_pages": self.pinned_pages,
+                "sketch_size": len(self._sketch),
                 "donated_pages_total": self.donated_pages_total,
                 "dedup_pages_total": self.dedup_pages_total,
                 "evicted_pages_total": self.evicted_pages_total}

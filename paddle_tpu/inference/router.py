@@ -76,10 +76,10 @@ class _RouterRequest:
     """Everything needed to (re)dispatch one request to any replica."""
 
     __slots__ = ("rid", "ids", "budget", "seed", "on_token", "deadline",
-                 "priority", "cancelled")
+                 "priority", "cancelled", "journey")
 
     def __init__(self, rid, ids, budget, seed, on_token, deadline,
-                 priority=0):
+                 priority=0, journey=None):
         self.rid = rid
         self.ids = ids
         self.budget = budget
@@ -88,6 +88,10 @@ class _RouterRequest:
         self.deadline = deadline      # identical sampling chain
         self.priority = priority      # preemption class (optimistic
         self.cancelled = False        # admission), travels on requeue
+        self.journey = journey        # fleet trace handle ("router"
+        #                               hop); rebound per dispatch so
+        #                               replica events carry their own
+        #                               location label
 
 
 class _Route:
@@ -139,7 +143,16 @@ class RouterSupervisor:
         errors = 0
         for idx, rep in enumerate(r.replicas):
             state = rep.health
-            self.last_states[idx] = state
+            prev, self.last_states[idx] = self.last_states[idx], state
+            if state != prev and r._rec is not None:
+                r._rec.record("replica_health", replica=idx,
+                              state=state)
+                if state == DEAD and prev != DEAD:
+                    # a replica just died under the router: capture the
+                    # fleet-level postmortem BEFORE the evacuation
+                    # sweep tears its queue apart
+                    r._capture_postmortem(f"replica {idx} dead",
+                                          replica=idx)
             if is_serving_state(state):
                 continue
             dead = state == DEAD
@@ -186,6 +199,18 @@ class ReplicaRouter:
     ``router_health`` gauge; ``serving.serve_metrics(router)`` fronts
     the fleet with one ``/healthz`` (200 iff >= 1 replica is serving).
 
+    ``journeys`` (``telemetry.JourneyRecorder``, or ``True``) turns on
+    request-journey tracing: ``submit()`` mints a fleet trace id,
+    every hop appends phase events, ``journey(rid)`` returns the
+    cross-replica timeline (also ``/debug/journey/<rid>``), and
+    ``export_fleet_trace(path)`` writes one merged Perfetto trace with
+    flow events connecting a request's hops. ``recorder``
+    (``telemetry.FlightRecorder``, or ``True``) records router-level
+    events (evacuations, requeues, replica health flips) and captures
+    fleet postmortems on replica death; ``postmortems()`` merges them
+    with every replica's bundles (``/debug/postmortem``). Disabled
+    recorders are treated exactly like None — zero cost.
+
     Clocks: deadline math spans router and replicas, so construct the
     replicas with the SAME clock as the router when injecting a
     ``FakeClock`` (real ``MonotonicClock``s already share a time base).
@@ -196,7 +221,8 @@ class ReplicaRouter:
     """
 
     def __init__(self, replicas, policy="affinity", seed=0,
-                 telemetry=None, clock=None, fault_injector=None,
+                 telemetry=None, journeys=None, recorder=None,
+                 clock=None, fault_injector=None,
                  breakers=None, retry_policy=None, wait_slice=0.05):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -214,7 +240,33 @@ class ReplicaRouter:
                                    and telemetry.enabled) else None
         self._clock = clock if clock is not None else (
             telemetry.clock if self._tele is not None else MonotonicClock())
+        # request-journey tracing (telemetry.JourneyRecorder): the
+        # router MINTS the fleet trace id at submit and rebinds the
+        # handle per dispatch; a disabled recorder is treated exactly
+        # like None (requests carry no handle — zero cost)
+        if journeys is True:
+            from ..telemetry import JourneyRecorder
+            journeys = JourneyRecorder(clock=self._clock)
+        self.journeys = journeys
+        self._jrec = journeys if (journeys is not None
+                                  and journeys.enabled) else None
+        # flight recorder for ROUTER-level events (evacuations,
+        # requeues, replica health flips, fleet postmortems); replicas
+        # each carry their own
+        if recorder is True:
+            from ..telemetry import FlightRecorder
+            recorder = FlightRecorder(clock=self._clock)
+        self.recorder = recorder
+        self._rec = recorder if (recorder is not None
+                                 and recorder.enabled) else None
         self._faults = fault_injector
+        if self._faults is not None:
+            if self._tele is not None \
+                    and hasattr(self._faults, "publish_to"):
+                self._faults.publish_to(self._tele.registry)
+            if self._rec is not None \
+                    and getattr(self._faults, "recorder", None) is None:
+                self._faults.recorder = self._rec
         n = len(self.replicas)
         if breakers is None:
             breakers = [CircuitBreaker(failure_threshold=3,
@@ -280,8 +332,17 @@ class ReplicaRouter:
                 seed = self._seed + rid
         deadline = None if deadline_s is None \
             else self._clock.now() + float(deadline_s)
+        journey = None
+        if self._jrec is not None:
+            # the fleet trace id: one per ROUTER rid, minted here —
+            # every later hop (dispatch, admission, preempt/replay,
+            # evacuation, requeue, completion) appends to this timeline
+            journey = self._jrec.begin(f"r{rid}", where="router")
+            journey.event("submitted", rid=rid,
+                          prompt_tokens=int(ids.shape[0]),
+                          priority=int(priority))
         item = _RouterRequest(rid, ids, int(max_new_tokens), int(seed),
-                              on_token, deadline, int(priority))
+                              on_token, deadline, int(priority), journey)
         self._place(item, exclude=())
         return rid
 
@@ -356,8 +417,11 @@ class ReplicaRouter:
                 raise
             else:
                 with self._lock:
-                    self._routes.pop(rid, None)
+                    route = self._routes.pop(rid, None)
                     self._by_replica[idx].pop(rrid, None)
+                if route is not None and route.item.journey is not None:
+                    route.item.journey.event("collected",
+                                             tokens=len(out))
                 return out
 
     def cancel(self, rid):
@@ -430,6 +494,12 @@ class ReplicaRouter:
         """One replica submit attempt (the ``router.dispatch`` chaos
         point); returns the REPLICA rid. Charges elapsed time against
         the request's absolute deadline."""
+        if item.journey is not None:
+            # every ATTEMPT is a journey phase (where="router"): a
+            # chaos-failed dispatch shows as this event followed by the
+            # next candidate's, so flapping reads straight off the
+            # timeline
+            item.journey.event("dispatched", replica=idx)
         if self._faults is not None:
             self._faults.check(faults.ROUTER_DISPATCH, rid=item.rid,
                                replica=idx)
@@ -440,10 +510,12 @@ class ReplicaRouter:
                 raise DeadlineExceeded(
                     f"request {item.rid} expired before it could be "
                     f"dispatched to a replica")
+        journey = None if item.journey is None \
+            else item.journey.at(f"replica{idx}")
         return self.replicas[idx].submit(
             item.ids, max_new_tokens=item.budget, seed=item.seed,
             on_token=item.on_token, deadline_s=deadline_s,
-            priority=item.priority)
+            priority=item.priority, journey=journey)
 
     def _place(self, item, exclude=()):
         """Dispatch ``item`` to the best willing replica; record the
@@ -543,6 +615,10 @@ class ReplicaRouter:
             self._stats["evacuations"] += 1
         if self._tele is not None:
             self._tele.on_evacuation(idx)
+        if self._rec is not None:
+            self._rec.record("evacuation", replica=idx,
+                             harvested=len(harvested),
+                             flush_partials=bool(flush_partials))
         self._requeue(idx, harvested)
 
     def _requeue(self, src, harvested):
@@ -566,6 +642,8 @@ class ReplicaRouter:
                     # the waiter to a corpse
                     self._orphans[(src, pending.rid)] = 3   # polls to live
                     continue
+            if route.item.journey is not None:
+                route.item.journey.event("evacuated", source=src)
             self._try_place(rid, route.item, exclude=(src,))
         self._publish_backlog()
 
@@ -591,6 +669,8 @@ class ReplicaRouter:
             # turn a seconds-long full queue into a lost request
             with self._lock:
                 self._backlog.append(rid)
+            if item.journey is not None:
+                item.journey.event("held", why="backpressure")
         except ReliabilityError as e:
             if any(is_serving_state(rep.health)
                    for rep in self.replicas):
@@ -599,6 +679,8 @@ class ReplicaRouter:
                 # faults on every candidate): transient — hold it
                 with self._lock:
                     self._backlog.append(rid)
+                if item.journey is not None:
+                    item.journey.event("held", why="no_candidate")
                 return
             err = e if isinstance(e, ReplicaLostError) else \
                 ReplicaLostError(
@@ -610,12 +692,19 @@ class ReplicaRouter:
                 self._stats["replica_lost"] += 1
             if self._tele is not None:
                 self._tele.on_replica_lost()
+            if self._rec is not None:
+                self._rec.record("replica_lost", rid=rid)
+                # the whole fleet is down and a request just died with
+                # it: freeze the routing state for the incident review
+                self._capture_postmortem("replica_lost", rid=rid)
             self._record_failure(rid, err)
         else:
             with self._lock:
                 self._stats["requeued"] += 1
             if self._tele is not None:
                 self._tele.on_requeued(dst)
+            if self._rec is not None:
+                self._rec.record("requeued", rid=rid, replica=dst)
 
     def _drain_backlog(self):
         """Retry every router-held request (called once per supervisor
@@ -668,8 +757,132 @@ class ReplicaRouter:
         # wait() notices within one poll slice; no condition variable
         # needed (waiters block on the REPLICA's cv, not the router's)
         with self._lock:
-            self._routes.pop(rid, None)
+            route = self._routes.pop(rid, None)
             self._failures[rid] = err
+        if route is not None and route.item.journey is not None:
+            route.item.journey.event("failed",
+                                     error=type(err).__name__)
+
+    # ----------------------------------------------- journeys/postmortem
+    def journey(self, rid):
+        """The fleet-wide timeline for router request ``rid`` — every
+        hop's phase events (submitted, dispatched, queued, admitted,
+        prefill chunks, grow/preempted/replay, evacuated, requeued,
+        finished/failed/collected) in arrival order, each stamped with
+        ``where`` ("router" / "replicaN"). None without a journey
+        recorder or for an unknown/evicted rid. Served over
+        ``/debug/journey/<rid>`` by ``serve_metrics(router)``."""
+        if self._jrec is None:
+            return None
+        return self._jrec.journey(f"r{int(rid)}")
+
+    def _capture_postmortem(self, reason, **extra):
+        """Freeze the router's view of the fleet into a postmortem
+        bundle: routing table, backlog, orphan count, per-replica
+        breaker + health/load snapshots, router stats — alongside the
+        recorder's recent events."""
+        if self._rec is None:
+            return None
+        with self._lock:
+            routing = {
+                "routes": {rid: {"replica": rt.idx, "rrid": rt.rrid,
+                                 "gen": rt.gen}
+                           for rid, rt in self._routes.items()},
+                "backlog": list(self._backlog),
+                "orphans": len(self._orphans),
+                "stats": {**self._stats,
+                          "routed": list(self._stats["routed"])},
+            }
+        return self._rec.postmortem(
+            reason, routing=routing,
+            breakers=[b.state for b in self._breakers],
+            replicas=[{"health": rep.health,
+                       "queue_depth": rep.queue_depth(),
+                       "in_flight": rep.in_flight(),
+                       "preempt_pressure": rep.preempt_pressure()}
+                      for rep in self.replicas],
+            **extra)
+
+    def postmortems(self):
+        """Every captured bundle across the fleet, oldest first: the
+        router's own (tagged ``source="router"``) merged with each
+        replica's (``source="replicaN"``) — one artifact stream for
+        ``/debug/postmortem``."""
+        out = []
+        if self._rec is not None:
+            for b in self._rec.postmortems():
+                out.append({"source": "router", **b})
+        for idx, rep in enumerate(self.replicas):
+            for b in rep.postmortems():
+                out.append({"source": f"replica{idx}", **b})
+        out.sort(key=lambda b: b.get("t", 0.0))
+        return out
+
+    def export_fleet_trace(self, file):
+        """Write ONE merged Chrome/Perfetto trace for the whole fleet:
+        each replica's tracer spans on its own pid (pid 0 = router,
+        pid i+1 = replica i), every journey's phase events as instant
+        markers at the pid of the hop that emitted them, and flow
+        events (``ph: s/t/f``, one shared id per journey) connecting a
+        request's hops — a failover renders as a connected arrow from
+        the dead replica through the router to the sibling. ``file``
+        is a path or file object; returns the event count."""
+        import json
+
+        events = [{"ph": "M", "name": "process_name", "pid": 0,
+                   "tid": 0, "args": {"name": "router"}}]
+        for idx, rep in enumerate(self.replicas):
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": idx + 1, "tid": 0,
+                           "args": {"name": f"replica{idx}"}})
+            tele = getattr(rep, "telemetry", None)
+            if tele is not None and getattr(tele, "enabled", False):
+                for ev in tele.tracer.events():
+                    ev = dict(ev)
+                    ev["pid"] = idx + 1
+                    events.append(ev)
+
+        def pid_of(where):
+            if isinstance(where, str) and where.startswith("replica"):
+                return int(where[len("replica"):]) + 1
+            return 0
+
+        if self._jrec is not None:
+            for tid in self._jrec.ids():
+                timeline = self._jrec.journey(tid) or []
+                for ev in timeline:
+                    args = {k: v for k, v in ev.items()
+                            if k not in ("t", "phase", "where")}
+                    args["journey"] = tid
+                    events.append({"name": f"journey.{ev['phase']}",
+                                   "ph": "i", "s": "p",
+                                   "pid": pid_of(ev["where"]), "tid": 0,
+                                   "ts": ev["t"] * 1e6, "args": args})
+                # one flow per journey, stepping at each location
+                # change — the cross-replica connection Perfetto draws
+                hops, last = [], None
+                for ev in timeline:
+                    if ev["where"] != last:
+                        hops.append(ev)
+                        last = ev["where"]
+                if len(hops) >= 2:
+                    for i, ev in enumerate(hops):
+                        ph = "s" if i == 0 else \
+                            ("f" if i == len(hops) - 1 else "t")
+                        fe = {"name": "journey", "cat": "journey",
+                              "ph": ph, "id": tid,
+                              "pid": pid_of(ev["where"]), "tid": 0,
+                              "ts": ev["t"] * 1e6}
+                        if ph == "f":
+                            fe["bt"] = "e"
+                        events.append(fe)
+        payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if hasattr(file, "write"):
+            json.dump(payload, file)
+        else:
+            with open(file, "w") as f:
+                json.dump(payload, f)
+        return len(events)
 
     # ------------------------------------------------------------ health
     @property
@@ -771,6 +984,8 @@ class ReplicaRouter:
             self._requeue(idx, harvested)
             rep.stop(drain=True, timeout=drain_timeout)
             rep.start()
+            if self._rec is not None:
+                self._rec.record("restart", replica=idx)
             # requests the requeue parked under sibling backpressure
             # must not wait for a supervisor thread that may not be
             # running — the restarted replica can take them now

@@ -257,6 +257,13 @@ def serve_metrics(target, host="127.0.0.1", port=0):
     ``ServerTelemetry``, or a bare ``MetricRegistry``.
     Returns a started ``telemetry.MetricsServer`` (``.url``, ``.port``,
     ``.close()``). ``port=0`` binds an ephemeral port.
+
+    Debug surfaces (ISSUE 10): server/router targets serve their
+    captured bundles on ``/debug/postmortem`` (the router aggregates
+    its own plus every replica's; an empty list without a
+    ``FlightRecorder``) and routers serve per-request fleet timelines
+    on ``/debug/journey/<rid>`` (404 for unknown rids — every rid,
+    without a ``JourneyRecorder``).
     """
     from ..telemetry.exposition import MetricsServer
 
@@ -292,5 +299,17 @@ def serve_metrics(target, host="127.0.0.1", port=0):
 
         def health():
             return target.health
+    journey = None
+    if callable(getattr(target, "journey", None)):
+        def journey(rid_s, _fn=target.journey):
+            try:
+                rid = int(rid_s)
+            except (TypeError, ValueError):
+                return None
+            return _fn(rid)
+    postmortem = getattr(target, "postmortems", None)
+    if not callable(postmortem):
+        postmortem = None
     return MetricsServer(registry, host=host, port=port,
-                         extra_stats=extra, health=health).start()
+                         extra_stats=extra, health=health,
+                         journey=journey, postmortem=postmortem).start()

@@ -91,9 +91,19 @@ class FaultInjector:
     ``enabled=False`` (or ``disarm()``) turns every ``check`` into a
     counter-only visit, so one test can run the same script with and
     without chaos.
+
+    Observability (ISSUE 10): fires were test-only state (``trace``);
+    ``publish_to(registry)`` mints ``fault_fires_total{point}`` so a
+    chaos storm is VISIBLE on ``/metrics`` (a server with telemetry
+    attached wires this automatically), and ``recorder`` (a
+    ``telemetry.FlightRecorder``; the server wires its own at
+    construction) records each fire as a ``fault`` event, so injected
+    failures land in postmortem bundles next to the grows/preemptions
+    they caused. Neither hook consumes the per-point PRNG streams —
+    same-seed injection traces stay identical.
     """
 
-    def __init__(self, seed=0, enabled=True):
+    def __init__(self, seed=0, enabled=True, registry=None):
         self.seed = int(seed)
         self.enabled = bool(enabled)
         self._rules = {}
@@ -101,6 +111,13 @@ class FaultInjector:
         self._visits = {}
         self.trace = []               # (point, visit_index) of FIRES
         self._lock = threading.Lock()
+        self._fires = []              # fault_fires_total counters, one
+        #                               per ATTACHED registry: a fleet-
+        #                               shared injector increments all
+        #                               of them, so every replica's
+        #                               /metrics sees the same storm
+        self.recorder = None          # FlightRecorder (fires -> events)
+        self.publish_to(registry)
 
     # ------------------------------------------------------ registration
     def on(self, point, probability=0.0, schedule=(), error=None,
@@ -118,6 +135,23 @@ class FaultInjector:
                                        start, stop, max_fires)
             self._rngs[point] = random.Random(f"{self.seed}:{point}")
             self._visits.setdefault(point, 0)
+        return self
+
+    def publish_to(self, registry):
+        """Publish ``fault_fires_total{point}`` to ``registry``
+        (``telemetry.MetricRegistry``; None or disabled no-ops).
+        Idempotent per registry, CUMULATIVE across registries: an
+        injector shared by several components (N replicas + a router)
+        counts every fire in every attached registry. A server/router
+        constructed with both ``telemetry`` and ``fault_injector``
+        calls this for you."""
+        if registry is not None and registry.enabled:
+            c = registry.counter(
+                "fault_fires_total",
+                "Injected chaos faults fired, by failure point",
+                labelnames=("point",))
+            if all(c is not prev for prev in self._fires):
+                self._fires.append(c)
         return self
 
     def arm(self):
@@ -165,6 +199,13 @@ class FaultInjector:
                 return
             rule.fired += 1
             self.trace.append((point, n))
+        # observability hooks OUTSIDE the injector lock (each has its
+        # own short lock): the fire is visible on /metrics and in the
+        # flight recorder before the error even propagates
+        for fires in self._fires:
+            fires.labels(point=point).inc()
+        if self.recorder is not None:
+            self.recorder.record("fault", point=point, visit=n)
         if rule.error is None:
             err = InjectedFault(point, n)
         else:

@@ -13,7 +13,14 @@ observability rather than one-off profiling sessions):
   injectable clock, Chrome-trace JSON export, optional mirroring into
   ``profiler.RecordEvent`` so spans land inside jax device traces.
 - ``MetricsServer`` (exposition.py): ``/metrics`` (Prometheus text) +
-  ``/stats`` (JSON) scrape endpoint.
+  ``/stats`` (JSON) scrape endpoint, plus ``/debug/journey/<rid>`` and
+  ``/debug/postmortem`` when the owner wires them.
+- ``FlightRecorder`` (flight.py): bounded ring of structured server
+  events + postmortem bundles — the "what just happened" companion to
+  the aggregate metrics.
+- ``JourneyRecorder`` / ``Journey`` (journey.py): per-request fleet
+  timelines (trace id minted at the router, handles rebound per hop)
+  merged into one Perfetto trace with cross-replica flow events.
 - ``ServerTelemetry`` (serving.py): the continuous-batching server's
   SLO instrumentation — TTFT/TPOT/queue-wait, tick occupancy, page-pool
   gauges, prefix-cache counters, per-request lifecycle spans.
@@ -32,6 +39,8 @@ from .metrics import (DEFAULT_BUCKETS, Counter, Gauge,  # noqa: F401
 from .tracing import NULL_SPAN, NullSpan, Span, Tracer  # noqa: F401
 from .exposition import (MetricsServer, parse_prometheus,  # noqa: F401
                          render_prometheus)
+from .flight import FlightRecorder  # noqa: F401
+from .journey import Journey, JourneyRecorder  # noqa: F401
 from .serving import RouterTelemetry, ServerTelemetry  # noqa: F401
 from .training import TelemetryCallback  # noqa: F401
 
@@ -40,6 +49,7 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "Tracer", "Span", "NullSpan", "NULL_SPAN",
            "MonotonicClock", "FakeClock",
            "MetricsServer", "render_prometheus", "parse_prometheus",
+           "FlightRecorder", "Journey", "JourneyRecorder",
            "ServerTelemetry", "RouterTelemetry", "TelemetryCallback",
            "default_registry"]
 

@@ -14,7 +14,13 @@ values), not for scraping production endpoints.
 - ``/healthz`` — when a ``health`` callback is wired (see
   ``inference.serving.serve_metrics``): 200 with ``{"state": ...}``
   while the server is healthy or degraded, 503 while draining or dead
-  — the load-balancer / readiness contract.
+  — the load-balancer / readiness contract,
+- ``/debug/journey/<rid>`` — when a ``journey`` callback is wired (a
+  router with a ``JourneyRecorder``): the request's fleet-wide phase
+  timeline as JSON; 404 for an unknown/evicted rid,
+- ``/debug/postmortem`` — when a ``postmortem`` callback is wired (a
+  server/router with a ``FlightRecorder``): the captured incident
+  bundles as JSON.
 """
 import json
 import threading
@@ -156,7 +162,8 @@ class _Handler:
     """Request handler factory bound to a registry (built lazily so the
     http.server import stays off the non-serving path)."""
 
-    def __new__(cls, registry, extra_stats, health=None):
+    def __new__(cls, registry, extra_stats, health=None, journey=None,
+                postmortem=None):
         from http.server import BaseHTTPRequestHandler
 
         class Handler(BaseHTTPRequestHandler):
@@ -171,6 +178,22 @@ class _Handler:
                     if extra_stats is not None:
                         stats["stats"] = extra_stats()
                     body = json.dumps(stats, default=str).encode()
+                    ctype = "application/json"
+                elif path == "/debug/postmortem" and postmortem is not None:
+                    # the captured incident bundles (recent recorder
+                    # events + frozen pool/routing state), newest last
+                    body = json.dumps({"postmortems": postmortem()},
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path.startswith("/debug/journey/") \
+                        and journey is not None:
+                    rid = path[len("/debug/journey/"):]
+                    timeline = journey(rid)
+                    if timeline is None:
+                        self.send_error(404, "unknown journey")
+                        return
+                    body = json.dumps({"rid": rid, "journey": timeline},
+                                      default=str).encode()
                     ctype = "application/json"
                 elif path == "/healthz" and health is not None:
                     # the serving verdict lives in ONE place
@@ -206,12 +229,17 @@ class MetricsServer:
     """
 
     def __init__(self, registry, host="127.0.0.1", port=0,
-                 extra_stats=None, health=None):
+                 extra_stats=None, health=None, journey=None,
+                 postmortem=None):
         self.registry = registry
         self._host = host
         self._port = int(port)
         self._extra = extra_stats
         self._health = health      # () -> health-state name, for /healthz
+        self._journey = journey    # (rid str) -> timeline | None, for
+        #                            /debug/journey/<rid>
+        self._postmortem = postmortem   # () -> [bundle, ...], for
+        #                                 /debug/postmortem
         self._httpd = None
         self._thread = None
 
@@ -229,7 +257,8 @@ class MetricsServer:
         from http.server import ThreadingHTTPServer
         self._httpd = ThreadingHTTPServer(
             (self._host, self._port),
-            _Handler(self.registry, self._extra, self._health))
+            _Handler(self.registry, self._extra, self._health,
+                     self._journey, self._postmortem))
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
             daemon=True)

@@ -22,6 +22,10 @@ Per-tick engine signals:
 - ``server_prefill_dispatches_total``  host dispatches on the
   admission/prefill path — the ragged prefill path's counter-asserted
   win is this dropping per admission vs the dense baseline
+- ``serving_tick_dispatches``     host->device dispatches per server
+  tick (histogram) — the ROADMAP item-4 fused-megakernel baseline
+- ``server_dispatches_total{op}`` the same dispatches by op: decode /
+  prefill / state_push / block_table / page_gather / page_scatter
 
 Cache signals:
 - ``serving_tokens_total{kind=prefill|prefix_hit|decode}``
@@ -70,7 +74,7 @@ OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
 class _ReqState:
     __slots__ = ("t_submit", "t_admit", "t_first", "queued_span",
-                 "prefill_span", "decode_span")
+                 "prefill_span", "decode_span", "preempted")
 
     def __init__(self, t_submit, queued_span):
         self.t_submit = t_submit
@@ -79,6 +83,11 @@ class _ReqState:
         self.queued_span = queued_span
         self.prefill_span = None
         self.decode_span = None
+        # parked under pool pressure: the next wait span is
+        # ``request.parked`` and the next admission's prefill span is
+        # ``request.replay`` — PR-8 preemption is VISIBLE in the
+        # per-request span timeline, not disguised as a re-queue
+        self.preempted = False
 
 
 class ServerTelemetry:
@@ -183,6 +192,23 @@ class ServerTelemetry:
             "serving_prefill_seconds",
             "One prefill batch: a ragged packed launch, or one "
             "admission's dense prefill", buckets=TICK_BUCKETS)
+        # dispatches-per-decode-tick: THE success metric for the fused
+        # decode megakernel (ROADMAP item 4) — today a tick costs one
+        # decode program plus state pushes / block-table syncs /
+        # prefill launches; the megakernel's win is this histogram's
+        # mass moving toward 1. The per-op counter names where the
+        # remaining dispatches go.
+        self._h_tick_disp = r.histogram(
+            "serving_tick_dispatches",
+            "Host->device dispatches per server tick (ROADMAP item-4 "
+            "megakernel baseline)",
+            buckets=(1, 2, 3, 5, 8, 13, 21, 34, 55))
+        self._c_disp = r.counter(
+            "server_dispatches_total",
+            "Host->device dispatches on the serving hot path, by op "
+            "(decode / prefill / state_push / block_table / "
+            "page_gather / page_scatter)", labelnames=("op",))
+        self._disp_children = {}
         # reliability signals (paddle_tpu.reliability): admission
         # control, supervised-loop retries, breaker, health
         shed = r.counter("server_shed_total",
@@ -254,8 +280,12 @@ class ServerTelemetry:
         if st.queued_span is not None:   # None after a deferred admit
             st.queued_span.end()
             st.queued_span = None
-        st.prefill_span = self.tracer.begin_span("request.prefill",
-                                                 rid=rid)
+        # a resumed (previously preempted) request's admission is a
+        # REPLAY, not a first prefill — name the span so the parked ->
+        # replay detour reads directly off the timeline
+        st.prefill_span = self.tracer.begin_span(
+            "request.replay" if st.preempted else "request.prefill",
+            rid=rid)
 
     def on_admission_deferred(self, rid, queue_depth):
         """Admission rolled back (the pool could not be made to fit —
@@ -272,7 +302,8 @@ class ServerTelemetry:
             st.prefill_span = None
         if st.queued_span is None:
             st.queued_span = self.tracer.begin_span(
-                "request.queued", rid=rid, requeued=True)
+                "request.parked" if st.preempted else "request.queued",
+                rid=rid, requeued=True)
 
     def on_first_token(self, rid, prefill_tokens, prefix_hit_tokens):
         """Admission prefill produced the request's first token. A
@@ -294,6 +325,7 @@ class ServerTelemetry:
                 self._h_wait.observe(st.t_admit - st.t_submit)
             self._h_ttft.observe(t - st.t_submit)
             st.t_first = t
+        st.preempted = False     # the replay caught up; spans normalize
         if st.prefill_span is not None:
             st.prefill_span.end(prefill_tokens=prefill_tokens,
                                 prefix_hit_tokens=prefix_hit_tokens)
@@ -418,6 +450,22 @@ class ServerTelemetry:
         if self.enabled and n:
             self._c_prefill_disp.inc(n)
 
+    def on_tick_dispatches(self, profile):
+        """Publish one tick's host->device dispatch profile:
+        ``profile`` maps op name -> dispatch count for the tick that
+        just ran (the server accumulates it; empty ticks publish
+        nothing). Observes the per-tick total and feeds the per-op
+        counter."""
+        if not self.enabled or not profile:
+            return
+        self._h_tick_disp.observe(sum(profile.values()))
+        for op, n in profile.items():
+            child = self._disp_children.get(op)
+            if child is None:
+                child = self._disp_children[op] = \
+                    self._c_disp.labels(op=op)
+            child.inc(n)
+
     def prefill_started(self):
         """Timestamp handle for on_prefill_batch (one clock read)."""
         if not self.enabled:
@@ -452,7 +500,9 @@ class ServerTelemetry:
         """A live slot was preempted under pool pressure and parked
         (``depth`` = preempted-queue depth after parking). The request
         is back to waiting: its open prefill/decode spans close and a
-        fresh queued span opens, like a deferred admission."""
+        ``request.parked`` span opens — the parked/replay detour is a
+        distinct phase in the span timeline, and the NEXT admission's
+        prefill span is named ``request.replay``."""
         if not self.enabled:
             return
         self._c_preempt.inc()
@@ -460,6 +510,7 @@ class ServerTelemetry:
         st = self._req.get(rid)
         if st is None:
             return
+        st.preempted = True
         if st.decode_span is not None:
             st.decode_span.end(preempted=True)
             st.decode_span = None
@@ -468,7 +519,7 @@ class ServerTelemetry:
             st.prefill_span = None
         if st.queued_span is None:
             st.queued_span = self.tracer.begin_span(
-                "request.queued", rid=rid, preempted=True)
+                "request.parked", rid=rid)
 
     def on_preempt_resumed(self):
         if self.enabled:

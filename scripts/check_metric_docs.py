@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Lint: every metric registered in code is documented in README.md.
+
+Metric names are an OPERATOR interface — dashboards, alerts, and the
+capacity-planning runbook key on them — but they are registered as
+string literals scattered through the codebase, so nothing used to
+stop a PR from adding ``server_foo_total`` while the README metric
+table quietly went stale (ISSUE 10: PR 7's ``router_orphaned_total``
+and the whole ``scheduler_*`` family had already drifted). This lint
+closes the loop: it extracts every name passed to
+``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` under
+``paddle_tpu/`` and fails unless each appears verbatim somewhere in
+README.md.
+
+The check is direction-sensitive on purpose: code -> README only.
+(README may legitimately mention historical or planned names; a
+registered-but-undocumented metric is the drift that bites during an
+incident.) Dynamic names (a variable instead of a literal) are
+invisible to the scan — keep metric names literal, which the registry
+API already encourages.
+
+Usage: python scripts/check_metric_docs.py [--list]
+Exit status 1 lists every undocumented metric. Wired into the test
+suite (tests/test_flight_recorder.py) alongside check_no_bare_except,
+so drift fails tier-1.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# .counter( / .gauge( / .histogram( with a literal first argument,
+# newline-tolerant (registrations routinely wrap the name)
+_REG = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']",
+    re.S)
+
+# metric names that are registered by BENCH/test scaffolding living
+# inside the scanned tree, not part of the operator interface
+IGNORED = frozenset()
+
+
+def registered_metrics(root):
+    """{name: [relpath, ...]} of literal metric registrations under
+    ``root``."""
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                src = f.read()
+            for m in _REG.finditer(src):
+                name = m.group(1)
+                if name not in IGNORED:
+                    out.setdefault(name, []).append(
+                        os.path.relpath(path, os.path.dirname(root)))
+    return out
+
+
+def undocumented(metrics, readme_text):
+    """[(name, [paths])] of registered metrics README never mentions."""
+    return sorted((name, paths) for name, paths in metrics.items()
+                  if name not in readme_text)
+
+
+def main(argv=None):
+    argv = sys.argv if argv is None else argv
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    metrics = registered_metrics(os.path.join(repo, "paddle_tpu"))
+    with open(os.path.join(repo, "README.md"), "r",
+              encoding="utf-8") as f:
+        readme = f.read()
+    if "--list" in argv[1:]:
+        for name in sorted(metrics):
+            print(name)
+        return 0
+    missing = undocumented(metrics, readme)
+    for name, paths in missing:
+        print(f"{name}: registered in {', '.join(sorted(set(paths)))} "
+              f"but never mentioned in README.md — add it to the "
+              f"metric table (or rename the metric back)")
+    if missing:
+        return 1
+    print(f"OK: all {len(metrics)} registered metric names are "
+          f"documented in README.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
