@@ -12,7 +12,12 @@ Drives the same mixed-length workload — request budgets spanning
   footprint tracks actual tokens,
 - decode-program compile count across slot churn (the block table is a
   runtime argument — it must stay at 1),
-- token parity (the paged backend is bit-identical on the XLA path).
+- token parity (the paged backend is bit-identical on the XLA path),
+- steady-state GOODPUT ratio per mode (ISSUE 11: the goodput ledger's
+  useful / total device tokens — the paged backend trades dense HBM
+  for masked page DMAs the ledger makes visible, and the fused-
+  megakernel / speculative-decode PRs will be judged on moving this
+  number).
 
     python benchmarks/paged_decode_bench.py [--model tiny|350m]
         [--slots N] [--cache-len N] [--page-size N]
@@ -61,6 +66,7 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     from paddle_tpu.inference.kv_cache import PagedKVCache
     from paddle_tpu.models.llama import (LlamaForCausalLM, llama_350m,
                                          llama_tiny)
+    from paddle_tpu.telemetry import GoodputLedger
 
     pt.seed(7)
     cfg = (llama_tiny if model_name == "tiny" else llama_350m)(
@@ -80,28 +86,39 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     print(f"workload: {n_requests} requests, extents 32..{cache_len} "
           f"(peak concurrent {work_tokens} tokens), {slots} slots")
 
+    led_d = GoodputLedger()
     dense = ContinuousBatchingServer(model, max_slots=slots,
-                                     max_cache_len=cache_len)
+                                     max_cache_len=cache_len,
+                                     ledger=led_d)
     outs_d, toks_d, dt_d = _drain(dense, reqs)
     hbm_d = PagedKVCache.dense_hbm_bytes(slots, cache_len, L, kvh, hd,
                                          itemsize)
+    good_d = led_d.snapshot()
     print(f"dense: {toks_d / dt_d:8,.0f} tok/s   "
           f"cache HBM {hbm_d / 2**20:8.2f} MiB "
-          f"({slots} slots x {cache_len} rows)")
+          f"({slots} slots x {cache_len} rows)   "
+          f"goodput {good_d['goodput_ratio']:.3f}")
 
+    led_p = GoodputLedger()
     paged = ContinuousBatchingServer(model, max_slots=slots,
                                      max_cache_len=cache_len,
                                      cache_backend="paged",
                                      page_size=page_size,
-                                     num_pages=num_pages)
+                                     num_pages=num_pages,
+                                     ledger=led_p)
     outs_p, toks_p, dt_p = _drain(paged, reqs)
     hbm_p = PagedKVCache.paged_hbm_bytes(num_pages, page_size, L, kvh,
                                          hd, itemsize)
     compiles = getattr(paged._decode_jit, "_cache_size", lambda: -1)()
+    good_p = led_p.snapshot()
     print(f"paged: {toks_p / dt_p:8,.0f} tok/s   "
           f"cache HBM {hbm_p / 2**20:8.2f} MiB "
           f"({num_pages} pages x {page_size} rows, "
-          f"{hbm_d / hbm_p:.1f}x smaller)")
+          f"{hbm_d / hbm_p:.1f}x smaller)   "
+          f"goodput {good_p['goodput_ratio']:.3f}")
+    waste_p = {k: v for k, v in sorted(good_p["tokens"].items())
+               if k != "goodput"}
+    print(f"paged waste breakdown (tokens): {waste_p}")
     print(f"decode compiles across slot churn: {compiles} (want 1)")
     parity = all(np.array_equal(a, b) for a, b in zip(outs_d, outs_p))
     print(f"token parity dense vs paged: {parity}")

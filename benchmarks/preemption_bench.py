@@ -17,6 +17,10 @@ reports:
 - drain wall (StubModel replicas: host scheduling cost, not FLOPs),
 - the optimistic counters: preemptions, preempt resumes, pages grown
   on demand, headroom reserved,
+- the GOODPUT ratio per mode (ISSUE 11 ledger: useful / total device
+  tokens) with the replay-waste column — the tokens preemption burns
+  re-decoding from token 0 (the PR-8 known cut) are now a measured
+  number instead of a footnote,
 - the post-drain pool balance (leak check: live == 0 both modes).
 
 The acceptance assert (ISSUE 8) is ``effective_concurrency(optimistic)
@@ -62,12 +66,14 @@ def _run_mode(args, admission, prompts):
     from _serving_stub import StubModel
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
+    from paddle_tpu.telemetry import GoodputLedger
+    led = GoodputLedger()
     srv = ContinuousBatchingServer(
         StubModel(), max_slots=args.slots,
         max_cache_len=args.max_cache_len, cache_backend="paged",
         page_size=args.page_size, num_pages=args.pool_pages + 1,
         eos_token_id=args.eos, admission=admission,
-        headroom_pages=args.headroom)
+        headroom_pages=args.headroom, ledger=led)
     rids = [srv.submit(p, max_new_tokens=args.new_tokens)
             for p in prompts]
     t0 = time.perf_counter()
@@ -89,6 +95,7 @@ def _run_mode(args, admission, prompts):
         total_tokens += len(want)
     bal = srv.pool_balance()
     assert bal[1] == 0, f"{admission}: leaked {bal[1]} live pages"
+    good = led.snapshot()
     return {"mode": admission,
             "requests": len(prompts),
             "tokens": int(total_tokens),
@@ -100,6 +107,8 @@ def _run_mode(args, admission, prompts):
             "preempt_resumed": srv.stats["preempt_resumed"],
             "grow_pages": srv.stats["grow_pages"],
             "headroom_pages": srv.stats["headroom_pages"],
+            "goodput_ratio": good["goodput_ratio"],
+            "replay_tokens": good["tokens"].get("replay", 0),
             "pool": tuple(bal)}
 
 
@@ -135,14 +144,16 @@ def main(argv=None):
           f"{args.slots} slots")
     hdr = (f"{'mode':<11} {'tok/tick':>9} {'active/tick':>12} "
            f"{'ticks':>6} {'wall ms':>8} {'preempt':>8} "
-           f"{'grow pg':>8} {'headroom':>9}")
+           f"{'grow pg':>8} {'headroom':>9} {'goodput':>8} "
+           f"{'replay tok':>11}")
     print(hdr)
     print("-" * len(hdr))
     for m in modes:
         print(f"{m['mode']:<11} {m['effective_concurrency']:>9.2f} "
               f"{m['mean_active']:>12.2f} {m['ticks']:>6} "
               f"{m['wall_s'] * 1e3:>8.1f} {m['preemptions']:>8} "
-              f"{m['grow_pages']:>8} {m['headroom_pages']:>9}")
+              f"{m['grow_pages']:>8} {m['headroom_pages']:>9} "
+              f"{m['goodput_ratio']:>8.3f} {m['replay_tokens']:>11}")
     print(f"effective-concurrency ratio (optimistic / reserve): "
           f"{ratio:.2f}x")
 

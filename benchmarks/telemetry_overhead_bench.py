@@ -1,23 +1,27 @@
 """Telemetry overhead on the continuous-batching decode path (ISSUE 2;
-recorder + journey paths added by ISSUE 10).
+recorder + journey paths added by ISSUE 10, goodput ledger by
+ISSUE 11).
 
 Drives the same request workload through ``ContinuousBatchingServer``
 with telemetry DISABLED (``telemetry=None`` — one attribute check per
 hook site) and ENABLED (full ``ServerTelemetry``: histograms, gauges,
 spans), then again with a ``FlightRecorder`` attached DISABLED
 (``enabled=False`` — must be structurally free: the server treats it
-as None) and ENABLED (event ring + per-tick dispatch profiles), and
-reports:
+as None) and ENABLED (event ring + per-tick dispatch profiles), then
+the same pair for the ``GoodputLedger`` (disabled = treated as None;
+enabled = per-token attribution + per-tick flush), and reports:
 
 - drain wall time per mode (best of N reps, compile warmed first),
 - per-tick decode latency from the enabled run's own
   ``serving_tick_seconds`` histogram (telemetry measuring itself),
+- the enabled ledger run's steady-state goodput ratio,
 - instrument microbenchmarks (counter.inc / histogram.observe /
   null-instrument call / recorder.record / disabled record / journey
-  event, ns/op),
+  event / ledger add+flush, ns/op),
 - the enabled-vs-disabled overhead %% per layer — GUARDS: telemetry
-  <2%%, disabled-recorder <2%% (the disabled-is-structurally-zero-cost
-  contract, measured end to end rather than assumed).
+  <2%%, disabled-recorder <2%%, disabled-ledger <2%% (the
+  disabled-is-structurally-zero-cost contract, measured end to end
+  rather than assumed).
 
     python benchmarks/telemetry_overhead_bench.py [--slots N]
         [--requests N] [--new-tokens N] [--reps N]
@@ -43,7 +47,7 @@ def _build_model():
 
 
 def _drain(model, telemetry, slots, requests, new_tokens, reps,
-           recorder=None):
+           recorder=None, ledger=None):
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
     rng = np.random.default_rng(0)
@@ -52,7 +56,7 @@ def _drain(model, telemetry, slots, requests, new_tokens, reps,
     srv = ContinuousBatchingServer(model, max_slots=slots,
                                    max_cache_len=128,
                                    telemetry=telemetry,
-                                   recorder=recorder)
+                                   recorder=recorder, ledger=ledger)
     for p in prompts[:slots]:                       # warm the compiles
         srv.submit(p, max_new_tokens=4)
     srv.run()
@@ -81,8 +85,9 @@ def main():
     ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args()
 
-    from paddle_tpu.telemetry import (FlightRecorder, JourneyRecorder,
-                                      MetricRegistry, ServerTelemetry)
+    from paddle_tpu.telemetry import (FlightRecorder, GoodputLedger,
+                                      JourneyRecorder, MetricRegistry,
+                                      ServerTelemetry)
 
     model = _build_model()
     t_off, _ = _drain(model, None, args.slots, args.requests,
@@ -90,19 +95,28 @@ def main():
     tele = ServerTelemetry()
     t_on, srv = _drain(model, tele, args.slots, args.requests,
                        args.new_tokens, args.reps)
-    # recorder paths ride on the DISABLED-telemetry baseline so each
-    # layer's cost is isolated
+    # recorder/ledger paths ride on the DISABLED-telemetry baseline so
+    # each layer's cost is isolated
     t_rec_off, _ = _drain(model, None, args.slots, args.requests,
                           args.new_tokens, args.reps,
                           recorder=FlightRecorder(enabled=False))
     rec = FlightRecorder()
     t_rec_on, srv_rec = _drain(model, None, args.slots, args.requests,
                                args.new_tokens, args.reps, recorder=rec)
+    t_led_off, _ = _drain(model, None, args.slots, args.requests,
+                          args.new_tokens, args.reps,
+                          ledger=GoodputLedger(enabled=False))
+    led = GoodputLedger()
+    t_led_on, _ = _drain(model, None, args.slots, args.requests,
+                         args.new_tokens, args.reps, ledger=led)
 
     tick = tele.registry.get("serving_tick_seconds")
     overhead = (t_on - t_off) / t_off * 100.0
     rec_off_overhead = (t_rec_off - t_off) / t_off * 100.0
     rec_on_overhead = (t_rec_on - t_off) / t_off * 100.0
+    led_off_overhead = (t_led_off - t_off) / t_off * 100.0
+    led_on_overhead = (t_led_on - t_off) / t_off * 100.0
+    goodput = led.snapshot()
 
     reg = MetricRegistry()
     c = reg.counter("bench_total")
@@ -118,6 +132,13 @@ def main():
     jr = JourneyRecorder()
     jh = jr.begin("bench")
     ns_jev = _micro(lambda: jh.event("phase", rid=1))
+    mled = GoodputLedger()
+    ns_ladd = _micro(lambda: mled.add("goodput", 1))
+
+    def _add_flush():
+        mled.add("goodput", 1)
+        mled.flush_tick()
+    ns_lflush = _micro(_add_flush, n=50_000)
 
     print(f"workload: {args.requests} requests x {args.new_tokens} new "
           f"tokens, {args.slots} slots, best of {args.reps}")
@@ -131,6 +152,12 @@ def main():
     print(f"drain rec enabled   : {t_rec_on * 1e3:9.1f} ms   "
           f"({rec_on_overhead:+.2f}%, {rec.total} events, "
           f"{len(rec.events(kind='tick'))} tick profiles)")
+    print(f"drain ledger off    : {t_led_off * 1e3:9.1f} ms   "
+          f"({led_off_overhead:+.2f}% — structurally-zero guard)")
+    print(f"drain ledger on     : {t_led_on * 1e3:9.1f} ms   "
+          f"({led_on_overhead:+.2f}%, goodput ratio "
+          f"{goodput['goodput_ratio']:.3f} over {goodput['ticks']} "
+          f"ticks)")
     print(f"telemetry overhead  : {overhead:9.2f} %   (target < 2%)")
     print(f"counter.inc         : {ns_inc:9.0f} ns/op")
     print(f"hist.observe        : {ns_obs:9.0f} ns/op")
@@ -138,10 +165,13 @@ def main():
     print(f"recorder.record     : {ns_rec:9.0f} ns/op")
     print(f"record (disabled)   : {ns_rec_off:9.0f} ns/op")
     print(f"journey.event       : {ns_jev:9.0f} ns/op")
-    # guards: full telemetry <2%, DISABLED recorder <2% (its events/
-    # clock reads are asserted zero in tests; wall clock is the
-    # end-to-end check that "treated as None" really holds)
-    return 0 if overhead < 2.0 and rec_off_overhead < 2.0 else 1
+    print(f"ledger.add          : {ns_ladd:9.0f} ns/op")
+    print(f"ledger add+flush    : {ns_lflush:9.0f} ns/op")
+    # guards: full telemetry <2%, DISABLED recorder <2%, DISABLED
+    # ledger <2% (their events/clock reads are asserted zero in tests;
+    # wall clock is the end-to-end check that "treated as None" holds)
+    return 0 if (overhead < 2.0 and rec_off_overhead < 2.0
+                 and led_off_overhead < 2.0) else 1
 
 
 if __name__ == "__main__":

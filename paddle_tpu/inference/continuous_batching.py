@@ -58,7 +58,7 @@ class _Slot:
     __slots__ = ("rid", "ids", "prompt_len", "budget", "emitted",
                  "on_token", "streamed", "deadline", "phase", "fill_pos",
                  "filled", "n_pre", "seed", "priority", "preempts",
-                 "replayed", "journey")
+                 "replayed", "journey", "reprefill_upto")
 
     def __init__(self, rid, ids, prompt_len, budget, on_token=None,
                  deadline=None):
@@ -81,6 +81,10 @@ class _Slot:
         self.priority = 0             # preemption class (higher = safer)
         self.preempts = 0             # times this request was preempted
         self.journey = None           # fleet trace handle, or None
+        self.reprefill_upto = 0       # prefill rows below this position
+        #                               redo a registered prefix's
+        #                               sub-page tail (ledger:
+        #                               tail_reprefill, ragged mode)
         # the partial recorded BEFORE a preemption: a resumed slot
         # replays the identical chain, so the longer of (replayed,
         # emitted) is always the request's true partial — a deadline/
@@ -274,6 +278,23 @@ class ContinuousBatchingServer:
     ``serve_metrics``. A disabled recorder is treated exactly like
     the default None (same zero-cost contract as telemetry).
 
+    ``ledger`` (``telemetry.GoodputLedger``, or ``True``) turns on the
+    goodput ledger: every device token each tick is attributed to
+    exactly one kind — committed work (``goodput``) or a named waste
+    reason (``null_redirect`` / ``chunk_pad`` / ``skipped_page_dma`` /
+    ``replay`` / ``tail_reprefill`` / ``block_waste``) — published as
+    ``server_tokens_total{kind}``, the per-tick
+    ``serving_goodput_ratio`` gauge, ``srv.goodput()`` (also
+    ``/stats["goodput"]``), and a ``goodput`` postmortem section.
+    Kinds sum to the tick's total device tokens (conservation is
+    test-asserted); a disabled ledger is treated exactly like None.
+
+    ``journeys`` (``telemetry.JourneyRecorder``, or ``True``) lets a
+    STANDALONE server mint its own request journeys: ``submit()``
+    begins one per request unless a router-supplied handle arrives via
+    ``submit(journey=)``, and ``srv.journey(rid)`` returns the
+    timeline (also ``/debug/journey/<rid>``).
+
     Reliability (paddle_tpu.reliability): ``submit(deadline_s=...)``
     bounds waiting, ``max_queue`` + ``shed_policy`` bound the queue,
     the ``start()`` serve thread is SUPERVISED (``retry_policy`` /
@@ -297,7 +318,8 @@ class ContinuousBatchingServer:
                  preemption_policy=None,
                  prefill_mode=None, prefill_tokens_per_tick=None,
                  max_admissions_per_tick=None, telemetry=None,
-                 recorder=None, max_queue=None, shed_policy="reject",
+                 recorder=None, ledger=None, journeys=None,
+                 max_queue=None, shed_policy="reject",
                  retry_policy=None, breaker=None, fault_injector=None,
                  clock=None):
         self.model = model
@@ -339,6 +361,9 @@ class ContinuousBatchingServer:
             if num_pages is None:     # worst case: every slot maxed out
                 num_pages = self.max_slots * pages_per_slot + 1
             self.page_size = page_size
+            # the paged kernels' grid covers the FULL block-table width
+            # per slot — the goodput ledger's skipped-page-DMA model
+            self._bt_pages = pages_per_slot
             self._paged_bundle = model._decode_bundle(
                 max_cache_len, weight_dtype, mesh, cache_dtype,
                 cache_backend="paged", page_size=page_size,
@@ -363,6 +388,7 @@ class ContinuousBatchingServer:
                                if len(self._paged_bundle) > 5 else None)
         else:
             self.page_size = None
+            self._bt_pages = None
             self._caches = self._init_caches(self.max_slots)
             self._prefix = None
             self._auto_prefix = False
@@ -484,6 +510,33 @@ class ContinuousBatchingServer:
         self.recorder = recorder
         self._rec = recorder if (recorder is not None
                                  and recorder.enabled) else None
+        # goodput ledger (telemetry.GoodputLedger): per-tick device-
+        # token attribution — goodput vs null_redirect / chunk_pad /
+        # skipped_page_dma / replay / tail_reprefill / block_waste.
+        # True builds one on the telemetry registry (metrics ride
+        # server_tokens_total{kind} + serving_goodput_ratio); a
+        # DISABLED ledger is treated exactly like None — one `is None`
+        # check per site, no locks, no clock reads (it never reads a
+        # clock at all)
+        if ledger is True:
+            from ..telemetry import GoodputLedger
+            ledger = GoodputLedger(
+                registry=self._tele.registry
+                if self._tele is not None else None)
+        self.ledger = ledger
+        self._led = ledger if (ledger is not None
+                               and ledger.enabled) else None
+        # journey recorder for STANDALONE servers (closes the PR-9
+        # "router-minted only" cut): submit() mints "s<rid>" journeys
+        # when no router-supplied handle arrives, and journey(rid)
+        # returns the timeline. Router-fronted servers keep receiving
+        # handles via submit(journey=) — those always win.
+        if journeys is True:
+            from ..telemetry import JourneyRecorder
+            journeys = JourneyRecorder(clock=self._clock)
+        self.journeys = journeys
+        self._jrec = journeys if (journeys is not None
+                                  and journeys.enabled) else None
         # per-tick host->device dispatch profile {op: count} — the
         # dispatches-per-decode-tick baseline ROADMAP item 4 is
         # measured against; reset at each tick, published to telemetry
@@ -786,6 +839,14 @@ class ContinuousBatchingServer:
                 self._done_cv.notify_all()
             rid = self._next_rid
             self._next_rid += 1
+            if journey is None and self._jrec is not None:
+                # standalone server: mint this request's own journey
+                # ("s<rid>", location "server") so journey(rid) works
+                # without a router; a router-supplied handle (above)
+                # always wins — the fleet timeline stays singular
+                journey = self._jrec.begin(f"s{rid}", where="server")
+                journey.event("submitted", rid=rid,
+                              prompt_tokens=int(T))
             if seed is None:
                 seed = self._seed + rid
             deadline = None if deadline_s is None \
@@ -1122,6 +1183,18 @@ class ContinuousBatchingServer:
     def _npages_for(self, n_tokens):
         return -(-int(n_tokens) // self._kv.page_size)
 
+    def _skipped_dma(self, live_tokens):
+        """The goodput ledger's host-side MODEL of one slot's masked
+        page traffic in one kernel launch: the grid covers the full
+        block-table width, so every page wholly beyond the slot's
+        live length is DMAed but masked (PR-6 known cut) —
+        ``(table_width - ceil(live/pg)) * pg`` token-equivalents.
+        ROADMAP item 2 (overlap, live-page-only gathers) replaces
+        this model with zeros; this is the ONE definition both the
+        decode and prefill hooks charge."""
+        live = -(-int(live_tokens) // self.page_size)
+        return max(0, self._bt_pages - live) * self.page_size
+
     # -------------------------------------------- admission scheduling
     def _next_admission_locked(self):
         """``(item, source)`` of the next admission candidate, or
@@ -1362,6 +1435,15 @@ class ContinuousBatchingServer:
         st.fill_pos = st.filled = n_pre
         st.n_pre = n_pre
         st.seed = req.seed
+        if self._led is not None:
+            # ragged matching is page-granular, so a registered
+            # prefix's sub-page tail re-prefills with the remainder —
+            # the ledger's tail_reprefill kind. The longest registered
+            # match decides; rows below reprefill_upto that the prefill
+            # launches are recomputation of registered state
+            reg = self._match_prefix(ids)
+            if reg is not None and reg[0].shape[0] > n_pre:
+                st.reprefill_upto = int(reg[0].shape[0])
         self._bind_request(st, req, slot)
         self._slots[slot] = st
         self._prefill_fifo.append(slot)
@@ -1453,10 +1535,28 @@ class ContinuousBatchingServer:
             jnp.asarray(toks), jnp.asarray(t0), self._caches,
             jnp.asarray(out_idx))
         self._count_dispatches(1, op="prefill")
+        led = self._led
         for slot, start, take in plan:
             st = self._slots[slot]
             st.fill_pos = st.filled = start + take
             self.stats["prefill_tokens"] += take
+            if led is not None:
+                # the launch runs C query rows for each participating
+                # slot (idle slots are kernel-skipped): `take` real
+                # rows + pow2-ladder pad, and maxp page DMAs of which
+                # only the covered prefix is unmasked
+                if st.preempts:
+                    # a resumed request's prompt re-prefill is pure
+                    # preemption recompute, whatever rows it covers
+                    led.add("replay", take)
+                else:
+                    tail = max(0, min(start + take,
+                                      st.reprefill_upto) - start)
+                    led.add("tail_reprefill", tail)
+                    led.add("goodput", take - tail)
+                led.add("chunk_pad", C - take)
+                led.add("skipped_page_dma",
+                        self._skipped_dma(start + take))
             if st.journey is not None:
                 st.journey.event("prefill_chunk", start=start,
                                  take=take)
@@ -1593,6 +1693,17 @@ class ContinuousBatchingServer:
         tele = self._tele
         t_started = tele.prefill_started() if tele is not None else None
         wall0 = _time_mod.perf_counter()
+
+        def _ledger_prefill(n_seg):
+            # dense-path prefill rows: n_seg real rows (replay when a
+            # preempted request re-prefills its prompt) + the chunked
+            # prefill's remainder pad. The dense program runs on dense
+            # batch-1 caches — no page DMAs to model here.
+            if self._led is not None and n_seg:
+                self._led.add("replay" if isinstance(req, _Preempted)
+                              else "goodput", n_seg)
+                self._led.add("chunk_pad", self._chunk_pad(n_seg))
+
         if best is not None and best[0] == "tree":
             m = best[1]
             self._prefix.use(m)               # LRU: reuse is recency
@@ -1607,6 +1718,7 @@ class ContinuousBatchingServer:
                 caches=caches1, t0=n_pre)
             self._count_dispatches(self._n_prefill_calls(rest.shape[0]))
             self.stats["prefill_tokens"] += rest.shape[0]
+            _ledger_prefill(rest.shape[0])
             if tele is not None:
                 tele.on_prefix_auto(True, n_pre)
         elif best is not None:
@@ -1624,6 +1736,7 @@ class ContinuousBatchingServer:
                 self._count_dispatches(
                     self._n_prefill_calls(rest.shape[0]))
                 self.stats["prefill_tokens"] += rest.shape[0]
+                _ledger_prefill(rest.shape[0])
             else:
                 logits = pre_logits
             if tele is not None and self._auto_prefix:
@@ -1633,6 +1746,7 @@ class ContinuousBatchingServer:
                 self._bundle, ids[None], chunk=self._prefill_chunk)
             self._count_dispatches(self._n_prefill_calls(T))
             self.stats["prefill_tokens"] += T
+            _ledger_prefill(T)
             if tele is not None and self._auto_prefix:
                 tele.on_prefix_auto(False, 0)
         key = jax.random.PRNGKey(req_seed)
@@ -1892,6 +2006,12 @@ class ContinuousBatchingServer:
                     self._rec.record("tick", dispatches=dict(prof),
                                      total=total,
                                      active=int(self._active.sum()))
+            if self._led is not None:
+                # the conservation boundary: whatever this tick
+                # attributed (even a partial, faulted tick) is folded
+                # and published NOW — kinds sum to the tick's device
+                # tokens by construction of the sites above
+                self._led.flush_tick()
 
     def _step_inner(self):
         self._prefill_used = 0       # per-tick prefill token budget
@@ -1948,14 +2068,33 @@ class ContinuousBatchingServer:
         self._tick_dispatch("decode")
         toks = np.asarray(toks)                    # [slots, tick_block]
         decoded = wasted = 0
+        led = self._led
+        if led is not None:
+            # rows of slots holding no live decode work still ride the
+            # program: empty slots and mid-prefill slots (parked past
+            # the table so their writes null-redirect; the dense
+            # backend drops them out of bounds — same waste class)
+            led.add("null_redirect",
+                    (self.max_slots - n_active) * toks.shape[1])
         for slot in range(self.max_slots):
             if not self._active[slot]:
                 continue
             st = self._slots[slot]
+            if led is not None and self._kv is not None:
+                led.add("skipped_page_dma", self._skipped_dma(
+                    st.prompt_len + len(st.emitted)))
             for j in range(toks.shape[1]):
                 st.emitted.append(int(toks[slot, j]))
+                if led is not None:
+                    # a resumed slot's rows below its pre-preemption
+                    # offset re-generate tokens the waiter already has
+                    led.add("replay"
+                            if len(st.emitted) <= len(st.replayed)
+                            else "goodput", 1)
                 if self._finished(st):
                     wasted += toks.shape[1] - (j + 1)
+                    if led is not None:
+                        led.add("block_waste", toks.shape[1] - (j + 1))
                     break              # later block tokens are waste
             decoded += min(j + 1, toks.shape[1])
             st.stream(self._deferred_cbs)
@@ -2198,6 +2337,11 @@ class ContinuousBatchingServer:
                 "preemptions": bal.preemptions}
             sections["block_table"] = self._kv.occupancy()
             sections["prefix_cache"] = self._prefix.stats()
+        if self._led is not None:
+            # how much of the hardware's recent work was useful is
+            # exactly what an incident review wants next to the pool
+            # state ("were we thrashing before this died?")
+            sections["goodput"] = self._led.snapshot()
         sections.update(extra)
         return self._rec.postmortem(reason, **sections)
 
@@ -2206,6 +2350,26 @@ class ContinuousBatchingServer:
         recorder) — served over ``/debug/postmortem`` via
         ``serving.serve_metrics``."""
         return [] if self._rec is None else self._rec.postmortems()
+
+    def journey(self, rid):
+        """Timeline of a SELF-MINTED journey (standalone server
+        constructed with ``journeys=``): the request's phase events in
+        arrival order, or None without a journey recorder / for an
+        unknown-evicted rid / for a request whose journey was minted
+        by a router (query the router for those — its id space, its
+        timeline). Served over ``/debug/journey/<rid>`` via
+        ``serving.serve_metrics``."""
+        if self._jrec is None:
+            return None
+        return self._jrec.journey(f"s{int(rid)}")
+
+    def goodput(self):
+        """The goodput ledger's cumulative snapshot (``{"tokens":
+        {kind: n}, "goodput_ratio": ...}``), or None without an
+        enabled ledger — also ``/stats["goodput"]`` via
+        ``serving.serve_metrics`` and the ``goodput`` postmortem
+        section."""
+        return None if self._led is None else self._led.snapshot()
 
     def _fail_all_locked(self, cause):
         """Breaker-open path: fail EVERY queued and in-flight request

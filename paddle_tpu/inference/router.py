@@ -211,6 +211,15 @@ class ReplicaRouter:
     with every replica's bundles (``/debug/postmortem``). Disabled
     recorders are treated exactly like None — zero cost.
 
+    ``slos`` (a list of ``telemetry.SLO`` declarations, or a pre-built
+    ``SLOEngine``) arms fleet SLO alerting over the MERGED metrics:
+    ``fleet_snapshot()`` folds every replica's registry into one
+    snapshot (``fleet_metrics()`` renders it as the ``/fleet``
+    Prometheus page), and ``slo_report()`` computes multi-window
+    rolling burn rates with ok/warning/page alert states — served on
+    ``/slo`` and folded into the aggregated ``/healthz`` detail by
+    ``serve_metrics(router)``.
+
     Clocks: deadline math spans router and replicas, so construct the
     replicas with the SAME clock as the router when injecting a
     ``FakeClock`` (real ``MonotonicClock``s already share a time base).
@@ -222,7 +231,7 @@ class ReplicaRouter:
 
     def __init__(self, replicas, policy="affinity", seed=0,
                  telemetry=None, journeys=None, recorder=None,
-                 clock=None, fault_injector=None,
+                 slos=None, clock=None, fault_injector=None,
                  breakers=None, retry_policy=None, wait_slice=0.05):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -259,6 +268,23 @@ class ReplicaRouter:
         self.recorder = recorder
         self._rec = recorder if (recorder is not None
                                  and recorder.enabled) else None
+        # fleet SLOs (telemetry.slo): a list of SLO declarations builds
+        # an SLOEngine over this router's fleet-merged snapshot (burn
+        # metrics ride the router registry when telemetry is on); a
+        # pre-built engine is bound to the fleet source if it has none.
+        # A disabled engine is treated exactly like None — zero clock
+        # reads, zero locks, the source is never called.
+        if slos is not None and not hasattr(slos, "evaluate"):
+            from ..telemetry.slo import SLOEngine
+            slos = SLOEngine(
+                slos, self.fleet_snapshot, clock=self._clock,
+                registry=self._tele.registry
+                if self._tele is not None else None)
+        elif slos is not None and slos.source is None:
+            slos.bind(self.fleet_snapshot)
+        self.slo_engine = slos
+        self._slo = slos if (slos is not None
+                             and slos.enabled) else None
         self._faults = fault_injector
         if self._faults is not None:
             if self._tele is not None \
@@ -762,6 +788,41 @@ class ReplicaRouter:
         if route is not None and route.item.journey is not None:
             route.item.journey.event("failed",
                                      error=type(err).__name__)
+
+    # ------------------------------------------------------ fleet metrics
+    def fleet_snapshot(self):
+        """ONE fleet-wide registry snapshot: the router's own metrics
+        (when telemetry is on) merged with every replica's —
+        counters/gauges summed, histograms folded bucket-wise
+        (``telemetry.exposition.merge_snapshots``). Replicas without
+        telemetry contribute nothing. This is also the SLO engine's
+        default source."""
+        from ..telemetry.exposition import merge_snapshots
+        snaps = []
+        if self._tele is not None:
+            snaps.append(self._tele.registry.snapshot())
+        for rep in self.replicas:
+            tele = getattr(rep, "telemetry", None)
+            if tele is not None and getattr(tele, "enabled", False):
+                snaps.append(tele.registry.snapshot())
+        return merge_snapshots(snaps)
+
+    def fleet_metrics(self):
+        """The merged fleet snapshot as ONE Prometheus text page —
+        served on ``/fleet`` by ``serve_metrics(router)``, and
+        round-trippable through ``telemetry.parse_prometheus`` (parsed
+        values equal the element-wise sum of the per-replica pages)."""
+        from ..telemetry.exposition import render_snapshot
+        return render_snapshot(self.fleet_snapshot())
+
+    def slo_report(self):
+        """Evaluate the fleet SLOs NOW (one clock read, one merged
+        snapshot) and return the burn-rate report — ``/slo``'s payload
+        and the ``/healthz`` ``"slo"`` detail. None without an enabled
+        ``SLOEngine``."""
+        if self._slo is None:
+            return None
+        return self._slo.evaluate()
 
     # ----------------------------------------------- journeys/postmortem
     def journey(self, rid):

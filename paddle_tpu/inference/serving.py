@@ -261,9 +261,20 @@ def serve_metrics(target, host="127.0.0.1", port=0):
     Debug surfaces (ISSUE 10): server/router targets serve their
     captured bundles on ``/debug/postmortem`` (the router aggregates
     its own plus every replica's; an empty list without a
-    ``FlightRecorder``) and routers serve per-request fleet timelines
-    on ``/debug/journey/<rid>`` (404 for unknown rids — every rid,
-    without a ``JourneyRecorder``).
+    ``FlightRecorder``) and per-request journey timelines on
+    ``/debug/journey/<rid>`` (router-minted at the front door,
+    server-minted on a standalone server constructed with
+    ``journeys=``; 404 for unknown rids — every rid, without a
+    ``JourneyRecorder``).
+
+    Fleet surfaces (ISSUE 11): router targets serve ONE merged
+    Prometheus page across every replica's registry on ``/fleet``
+    (``router.fleet_metrics()``) and — with an ``SLOEngine`` attached
+    (``ReplicaRouter(slos=...)``) — the burn-rate report on ``/slo``,
+    whose worst state also rides the ``/healthz`` body as an ``"slo"``
+    detail (the 200/503 readiness verdict is unchanged). A server
+    constructed with a ``GoodputLedger`` exposes its token-attribution
+    summary under ``/stats["goodput"]``.
     """
     from ..telemetry.exposition import MetricsServer
 
@@ -293,6 +304,10 @@ def serve_metrics(target, host="127.0.0.1", port=0):
             if kv is not None:
                 stats["kv_pool"] = kv.telemetry_stats()
                 stats["prefix_cache"] = target._prefix.stats()
+            g = target.goodput() if callable(
+                getattr(target, "goodput", None)) else None
+            if g is not None:
+                stats["goodput"] = g
             return stats
     health = None
     if hasattr(target, "health"):
@@ -310,6 +325,18 @@ def serve_metrics(target, host="127.0.0.1", port=0):
     postmortem = getattr(target, "postmortems", None)
     if not callable(postmortem):
         postmortem = None
+    fleet = getattr(target, "fleet_metrics", None)
+    if not callable(fleet):
+        fleet = None
+    slo = slo_states = None
+    if getattr(target, "slo_engine", None) is not None \
+            and getattr(target.slo_engine, "enabled", False):
+        slo = target.slo_report
+        # /healthz reads the CACHED states (one dict copy per probe);
+        # /slo scrapes are the only evaluation driver
+        slo_states = target.slo_engine.states
     return MetricsServer(registry, host=host, port=port,
                          extra_stats=extra, health=health,
-                         journey=journey, postmortem=postmortem).start()
+                         journey=journey, postmortem=postmortem,
+                         fleet=fleet, slo=slo,
+                         slo_states=slo_states).start()

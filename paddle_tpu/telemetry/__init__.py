@@ -16,8 +16,15 @@ observability rather than one-off profiling sessions):
   ``/stats`` (JSON) scrape endpoint, plus ``/debug/journey/<rid>`` and
   ``/debug/postmortem`` when the owner wires them.
 - ``FlightRecorder`` (flight.py): bounded ring of structured server
-  events + postmortem bundles — the "what just happened" companion to
-  the aggregate metrics.
+  events + postmortem bundles (optionally persisted to disk) — the
+  "what just happened" companion to the aggregate metrics.
+- ``GoodputLedger`` (goodput.py): per-tick attribution of every device
+  token to goodput or a named waste reason (null redirects, chunk pad,
+  masked page DMAs, preemption replay, registered-tail re-prefill,
+  block waste) — conservation-checked, the perf-tier baseline.
+- ``SLO`` / ``SLOEngine`` (slo.py): declarative fleet SLOs over the
+  merged metrics, multi-window rolling burn rates on the injectable
+  clock, ok/warning/page alert states.
 - ``JourneyRecorder`` / ``Journey`` (journey.py): per-request fleet
   timelines (trace id minted at the router, handles rebound per hop)
   merged into one Perfetto trace with cross-replica flow events.
@@ -37,19 +44,24 @@ from .metrics import (DEFAULT_BUCKETS, Counter, Gauge,  # noqa: F401
                       Histogram, MetricRegistry, NULL_INSTRUMENT,
                       NullInstrument)
 from .tracing import NULL_SPAN, NullSpan, Span, Tracer  # noqa: F401
-from .exposition import (MetricsServer, parse_prometheus,  # noqa: F401
-                         render_prometheus)
+from .exposition import (MetricsServer, merge_snapshots,  # noqa: F401
+                         parse_prometheus, render_prometheus,
+                         render_snapshot)
 from .flight import FlightRecorder  # noqa: F401
+from .goodput import GoodputLedger  # noqa: F401
 from .journey import Journey, JourneyRecorder  # noqa: F401
 from .serving import RouterTelemetry, ServerTelemetry  # noqa: F401
+from .slo import SLO, SLOEngine  # noqa: F401
 from .training import TelemetryCallback  # noqa: F401
 
 __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "NullInstrument", "NULL_INSTRUMENT", "DEFAULT_BUCKETS",
            "Tracer", "Span", "NullSpan", "NULL_SPAN",
            "MonotonicClock", "FakeClock",
-           "MetricsServer", "render_prometheus", "parse_prometheus",
-           "FlightRecorder", "Journey", "JourneyRecorder",
+           "MetricsServer", "render_prometheus", "render_snapshot",
+           "merge_snapshots", "parse_prometheus",
+           "FlightRecorder", "GoodputLedger", "Journey",
+           "JourneyRecorder", "SLO", "SLOEngine",
            "ServerTelemetry", "RouterTelemetry", "TelemetryCallback",
            "default_registry"]
 

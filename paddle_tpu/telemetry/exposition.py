@@ -6,6 +6,18 @@
 tests can assert the exposition ROUND-TRIPS (render -> parse -> same
 values), not for scraping production endpoints.
 
+``merge_snapshots`` folds N registry snapshots (one per replica) into
+one fleet-wide snapshot — counters and gauges sum, histograms sum
+bucket-wise (bounds must agree) plus sum/count, labeled children merge
+by label values — and ``render_snapshot`` serializes any snapshot, so
+``render_snapshot(merge_snapshots(...))`` is ONE Prometheus page for
+the whole fleet (``ReplicaRouter.fleet_metrics()``; round-trippable
+through ``parse_prometheus``). Gauges SUM across replicas — right for
+depths/occupancy/pool pages — EXCEPT gauges named ``*_ratio``, which
+fold by arithmetic mean (summing two replicas' 0.7 goodput ratios
+into an impossible 1.4 would be exactly the page no scraper could
+trust).
+
 ``MetricsServer`` is a stdlib ThreadingHTTPServer exposing
 - ``/metrics`` — Prometheus text (scrape target),
 - ``/stats``   — the registry snapshot as JSON plus any extra
@@ -14,7 +26,21 @@ values), not for scraping production endpoints.
 - ``/healthz`` — when a ``health`` callback is wired (see
   ``inference.serving.serve_metrics``): 200 with ``{"state": ...}``
   while the server is healthy or degraded, 503 while draining or dead
-  — the load-balancer / readiness contract,
+  — the load-balancer / readiness contract; with an ``slo_states``
+  callback also wired the body carries an ``"slo"`` detail (worst
+  alert state + the non-ok alerts) read from the engine's CACHED
+  states — a probe stays one health read plus a dict copy, never a
+  fleet evaluation, and a failing detail is dropped rather than
+  allowed to kill the probe (the 200/503 verdict survives telemetry
+  errors),
+- ``/fleet``   — when a ``fleet`` callback is wired (a router):
+  ONE merged Prometheus page across every replica's registry (a merge
+  error answers 500 + error JSON, like ``/slo``),
+- ``/slo``     — when an ``slo`` callback is wired (a router with an
+  ``SLOEngine``): the burn-rate report as JSON. Each GET evaluates —
+  ``/slo`` scrapes are THE alerting cadence (point your scraper
+  here); an evaluation error answers 500 with the error body instead
+  of a dropped connection,
 - ``/debug/journey/<rid>`` — when a ``journey`` callback is wired (a
   router with a ``JourneyRecorder``): the request's fleet-wide phase
   timeline as JSON; 404 for an unknown/evicted rid,
@@ -25,8 +51,8 @@ values), not for scraping production endpoints.
 import json
 import threading
 
-__all__ = ["render_prometheus", "parse_prometheus", "MetricsServer",
-           "snapshot_json"]
+__all__ = ["render_prometheus", "render_snapshot", "merge_snapshots",
+           "parse_prometheus", "MetricsServer", "snapshot_json"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -55,8 +81,14 @@ def _labels_str(names, values, extra=()):
 
 def render_prometheus(registry):
     """Serialize every instrument in ``registry`` (text format 0.0.4)."""
+    return render_snapshot(registry.snapshot())
+
+
+def render_snapshot(snap):
+    """Serialize a registry SNAPSHOT (``MetricRegistry.snapshot()``
+    shape — or a ``merge_snapshots`` fold of several) in text format
+    0.0.4."""
     out = []
-    snap = registry.snapshot()
     for name in sorted(snap):
         m = snap[name]
         if m["help"]:
@@ -80,6 +112,62 @@ def render_prometheus(registry):
                 out.append(f"{name}{_labels_str(lnames, lvalues)} "
                            f"{_fmt_value(sample)}")
     return "\n".join(out) + "\n"
+
+
+def merge_snapshots(snapshots):
+    """Fold registry snapshots (one per replica) into one fleet-wide
+    snapshot of the same shape. Counters and gauges SUM; histograms
+    sum bucket-wise (identical bounds required) plus ``sum``/``count``;
+    labeled children merge by label-value tuple (a child present on
+    one replica only passes through). Gauges named ``*_ratio`` fold by
+    MEAN over the replicas that report them (a ratio has no meaningful
+    sum). A metric registered with a different kind or labelnames on
+    different replicas is a config error and raises — silently mixing
+    them would render a page no scraper could trust. Inputs are never
+    mutated."""
+    merged = {}
+    ratio_n = {}                 # (name, key) -> replicas contributing
+    for snap in snapshots:
+        for name, m in snap.items():
+            cur = merged.get(name)
+            if cur is None:
+                cur = merged[name] = {
+                    "kind": m["kind"], "help": m["help"],
+                    "labelnames": tuple(m["labelnames"]), "samples": {}}
+            elif cur["kind"] != m["kind"] \
+                    or cur["labelnames"] != tuple(m["labelnames"]):
+                raise ValueError(
+                    f"metric {name!r} disagrees across replicas: "
+                    f"{cur['kind']}{cur['labelnames']} vs "
+                    f"{m['kind']}{tuple(m['labelnames'])}")
+            for key, s in m["samples"].items():
+                have = cur["samples"].get(key)
+                if m["kind"] == "histogram":
+                    if have is None:
+                        cur["samples"][key] = {
+                            "buckets": [(le, c) for le, c in
+                                        s["buckets"]],
+                            "sum": s["sum"], "count": s["count"]}
+                        continue
+                    if [le for le, _ in have["buckets"]] \
+                            != [le for le, _ in s["buckets"]]:
+                        raise ValueError(
+                            f"histogram {name!r} bucket bounds "
+                            f"disagree across replicas")
+                    have["buckets"] = [
+                        (le, a + b) for (le, a), (_, b)
+                        in zip(have["buckets"], s["buckets"])]
+                    have["sum"] += s["sum"]
+                    have["count"] += s["count"]
+                else:
+                    cur["samples"][key] = \
+                        (0.0 if have is None else have) + s
+                    if m["kind"] == "gauge" and name.endswith("_ratio"):
+                        k = (name, key)
+                        ratio_n[k] = ratio_n.get(k, 0) + 1
+    for (name, key), n in ratio_n.items():
+        merged[name]["samples"][key] /= n
+    return merged
 
 
 def snapshot_json(registry):
@@ -163,7 +251,8 @@ class _Handler:
     http.server import stays off the non-serving path)."""
 
     def __new__(cls, registry, extra_stats, health=None, journey=None,
-                postmortem=None):
+                postmortem=None, fleet=None, slo=None,
+                slo_states=None):
         from http.server import BaseHTTPRequestHandler
 
         class Handler(BaseHTTPRequestHandler):
@@ -173,6 +262,32 @@ class _Handler:
                 if path == "/metrics":
                     body = render_prometheus(registry).encode()
                     ctype = CONTENT_TYPE
+                elif path == "/fleet" and fleet is not None:
+                    # one merged Prometheus page for the whole fleet.
+                    # Same hardening as /slo: a merge error (mixed-
+                    # version fleet registries disagreeing) answers
+                    # 500, never a dropped connection
+                    try:
+                        body = fleet().encode()
+                        ctype = CONTENT_TYPE
+                    except Exception as e:
+                        status = 500
+                        body = json.dumps({"error": repr(e)}).encode()
+                        ctype = "application/json"
+                elif path == "/slo" and slo is not None:
+                    # each GET evaluates the burn rates NOW (alerting
+                    # is scrape-driven; tests drive evaluate() on a
+                    # FakeClock instead). An evaluation error — e.g. a
+                    # mixed-version fleet whose registries disagree —
+                    # answers 500 with the error, not a dropped
+                    # connection
+                    try:
+                        payload = {"slos": slo()}
+                    except Exception as e:
+                        status = 500
+                        payload = {"error": repr(e)}
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 elif path == "/stats":
                     stats = {"metrics": snapshot_json(registry)}
                     if extra_stats is not None:
@@ -203,7 +318,29 @@ class _Handler:
                     from ..reliability.health import is_serving_state
                     state = health()
                     status = 200 if is_serving_state(state) else 503
-                    body = json.dumps({"state": state}).encode()
+                    payload = {"state": state}
+                    if slo_states is not None:
+                        # fold the SLO verdict into the health DETAIL
+                        # — from the engine's CACHED states (the last
+                        # /slo evaluation), so a probe never pays a
+                        # fleet evaluation and probe frequency never
+                        # becomes the alert cadence. Best-effort, and
+                        # it never flips the 200/503 verdict: a
+                        # paging (or crashing) SLO layer on a serving
+                        # fleet must not make the LB drain it
+                        try:
+                            from .slo import OK, STATE_CODES
+                            states = slo_states()
+                            payload["slo"] = {
+                                "worst": max(
+                                    states.values(), default=OK,
+                                    key=STATE_CODES.__getitem__),
+                                "alerts": {n: s
+                                           for n, s in states.items()
+                                           if s != OK}}
+                        except Exception:
+                            pass        # detail dropped, probe lives
+                    body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -230,7 +367,8 @@ class MetricsServer:
 
     def __init__(self, registry, host="127.0.0.1", port=0,
                  extra_stats=None, health=None, journey=None,
-                 postmortem=None):
+                 postmortem=None, fleet=None, slo=None,
+                 slo_states=None):
         self.registry = registry
         self._host = host
         self._port = int(port)
@@ -240,6 +378,11 @@ class MetricsServer:
         #                            /debug/journey/<rid>
         self._postmortem = postmortem   # () -> [bundle, ...], for
         #                                 /debug/postmortem
+        self._fleet = fleet        # () -> merged Prometheus text, /fleet
+        self._slo = slo            # () -> burn-rate report (evaluates),
+        #                            for /slo
+        self._slo_states = slo_states   # () -> {slo: state} CACHED,
+        #                                 for the /healthz "slo" detail
         self._httpd = None
         self._thread = None
 
@@ -258,7 +401,8 @@ class MetricsServer:
         self._httpd = ThreadingHTTPServer(
             (self._host, self._port),
             _Handler(self.registry, self._extra, self._health,
-                     self._journey, self._postmortem))
+                     self._journey, self._postmortem, self._fleet,
+                     self._slo, self._slo_states))
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
             daemon=True)

@@ -32,6 +32,12 @@ open), request failure, and ``kill()``; the router on replica death
 and fleet-wide request loss. Bundles are kept in a bounded deque
 (newest wins) and served over ``/debug/postmortem``
 (``telemetry.MetricsServer`` via ``inference.serving.serve_metrics``).
+With ``postmortem_dir=`` each bundle is ALSO persisted to disk as one
+JSON file (atomic tmp + rename, ``postmortem-<seq>.json`` numbering
+that survives restarts, newest ``max_postmortems`` files retained) —
+an incident that takes the process down no longer takes its own
+evidence with it. Persistence is best-effort: a failing disk during an
+incident increments ``persist_errors`` and never breaks the capture.
 
 Event shape: a flat dict ``{"seq": int, "t": float, "kind": str,
 **fields}`` — ``seq``/``t``/``kind`` are reserved keys; keep fields
@@ -57,11 +63,13 @@ class FlightRecorder:
 
     ``capacity`` bounds the ring (oldest events overwritten);
     ``keep_events`` is how many recent events each postmortem bundle
-    snapshots; ``max_postmortems`` bounds the bundle store.
+    snapshots; ``max_postmortems`` bounds the bundle store (and, with
+    ``postmortem_dir``, the on-disk file count — newest win).
     """
 
     def __init__(self, capacity=4096, clock=None, enabled=True,
-                 keep_events=256, max_postmortems=8):
+                 keep_events=256, max_postmortems=8,
+                 postmortem_dir=None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.clock = clock if clock is not None else MonotonicClock()
@@ -71,7 +79,21 @@ class FlightRecorder:
         self._ring = [None] * self.capacity
         self._seq = 0
         self._lock = threading.Lock()
-        self._postmortems = deque(maxlen=int(max_postmortems))
+        self._max_postmortems = int(max_postmortems)
+        self._postmortems = deque(maxlen=self._max_postmortems)
+        self.postmortem_dir = postmortem_dir
+        self.persist_errors = 0
+        self._pm_file_seq = 0
+        if postmortem_dir is not None:
+            import os
+            import re
+            os.makedirs(postmortem_dir, exist_ok=True)
+            # numbering continues across restarts so a new process
+            # cannot clobber the previous crash's evidence
+            pat = re.compile(r"^postmortem-(\d+)\.json$")
+            seqs = [int(m.group(1)) for fn in os.listdir(postmortem_dir)
+                    for m in [pat.match(fn)] if m]
+            self._pm_file_seq = max(seqs) + 1 if seqs else 0
 
     # ----------------------------------------------------------- record
     def record(self, kind, /, **fields):
@@ -148,7 +170,38 @@ class FlightRecorder:
         bundle.update(sections)
         with self._lock:
             self._postmortems.append(bundle)
+            seq, self._pm_file_seq = self._pm_file_seq, \
+                self._pm_file_seq + 1
+        if self.postmortem_dir is not None:
+            self._persist(seq, bundle)     # I/O outside the lock
         return bundle
+
+    def _persist(self, seq, bundle):
+        """Write one bundle to ``postmortem_dir`` atomically (tmp +
+        rename — a crash mid-write leaves a tmp file, never a torn
+        bundle) and prune to the newest ``max_postmortems`` files.
+        Best-effort: disk failures during an incident must not break
+        the in-memory capture."""
+        import json
+        import os
+        if self._max_postmortems <= 0:
+            return          # retention of zero keeps zero files
+        try:
+            name = f"postmortem-{seq:08d}.json"
+            tmp = os.path.join(self.postmortem_dir,
+                               f".{name}.{os.getpid()}.tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.postmortem_dir, name))
+            kept = sorted(fn for fn in os.listdir(self.postmortem_dir)
+                          if fn.startswith("postmortem-")
+                          and fn.endswith(".json"))
+            for fn in kept[:-self._max_postmortems]:
+                os.remove(os.path.join(self.postmortem_dir, fn))
+        except OSError:
+            self.persist_errors += 1
 
     def postmortems(self):
         """Retained bundles, oldest first (the store is bounded —

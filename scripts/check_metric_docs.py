@@ -19,10 +19,19 @@ incident.) Dynamic names (a variable instead of a literal) are
 invisible to the scan — keep metric names literal, which the registry
 API already encourages.
 
+LABELS are part of the interface too (ISSUE 11): a dashboard keying on
+``server_tokens_total{kind=...}`` breaks just as hard when the label
+set drifts as when the name does. The scan therefore also extracts
+each registration's declared ``labelnames=(...)`` and fails unless
+README documents the metric with a brace group covering every label —
+i.e. some ``metric_name{...}`` occurrence whose braces mention each
+declared label name (``{kind}``, ``{kind=goodput|...}`` and multi-line
+groups all count).
+
 Usage: python scripts/check_metric_docs.py [--list]
-Exit status 1 lists every undocumented metric. Wired into the test
-suite (tests/test_flight_recorder.py) alongside check_no_bare_except,
-so drift fails tier-1.
+Exit status 1 lists every undocumented metric (or label). Wired into
+the test suite (tests/test_flight_recorder.py) alongside
+check_no_bare_except, so drift fails tier-1.
 """
 from __future__ import annotations
 
@@ -36,15 +45,23 @@ _REG = re.compile(
     r"\.(?:counter|gauge|histogram)\(\s*[\"']([A-Za-z_:][A-Za-z0-9_:]*)[\"']",
     re.S)
 
+# declared label names inside one registration's trailing window
+_LABELNAMES = re.compile(r"labelnames\s*=\s*\(([^)]*)\)", re.S)
+_QUOTED = re.compile(r"[\"']([A-Za-z_][A-Za-z0-9_]*)[\"']")
+_WORD = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
 # metric names that are registered by BENCH/test scaffolding living
 # inside the scanned tree, not part of the operator interface
 IGNORED = frozenset()
 
 
-def registered_metrics(root):
-    """{name: [relpath, ...]} of literal metric registrations under
-    ``root``."""
-    out = {}
+def _scan(root):
+    """One walk over ``root``: ({name: [relpath, ...]},
+    {name: sorted labelnames}) for every literal registration. The
+    labelnames window for one registration runs to the NEXT
+    registration call so a label-less metric can never borrow its
+    neighbour's labels."""
+    metrics, labels = {}, {}
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fn in sorted(filenames):
@@ -54,12 +71,27 @@ def registered_metrics(root):
             with open(path, "r", encoding="utf-8",
                       errors="replace") as f:
                 src = f.read()
-            for m in _REG.finditer(src):
+            regs = list(_REG.finditer(src))
+            for i, m in enumerate(regs):
                 name = m.group(1)
-                if name not in IGNORED:
-                    out.setdefault(name, []).append(
-                        os.path.relpath(path, os.path.dirname(root)))
-    return out
+                if name in IGNORED:
+                    continue
+                metrics.setdefault(name, []).append(
+                    os.path.relpath(path, os.path.dirname(root)))
+                end = regs[i + 1].start() if i + 1 < len(regs) \
+                    else len(src)
+                lm = _LABELNAMES.search(src, m.end(), end)
+                if lm is not None:
+                    declared = _QUOTED.findall(lm.group(1))
+                    if declared:
+                        labels.setdefault(name, set()).update(declared)
+    return metrics, {n: sorted(ls) for n, ls in labels.items()}
+
+
+def registered_metrics(root):
+    """{name: [relpath, ...]} of literal metric registrations under
+    ``root``."""
+    return _scan(root)[0]
 
 
 def undocumented(metrics, readme_text):
@@ -68,10 +100,37 @@ def undocumented(metrics, readme_text):
                   if name not in readme_text)
 
 
+def registered_labels(root):
+    """{name: sorted labelnames} for every literal registration that
+    declares labels (see ``_scan`` for the window rule)."""
+    return _scan(root)[1]
+
+
+def undocumented_labels(labels_by_metric, readme_text):
+    """[(name, [missing labels])] for labeled metrics README documents
+    without their labels. A metric passes when SOME ``name{...}``
+    occurrence's brace group mentions every declared label name
+    (``{kind}``, ``{kind=a|b}``, wrapped groups all count)."""
+    bad = []
+    for name, labels in sorted(labels_by_metric.items()):
+        best_missing = labels
+        for m in re.finditer(re.escape(name) + r"\{([^}]*)\}",
+                             readme_text):
+            doc = set(_WORD.findall(m.group(1)))
+            missing = [l for l in labels if l not in doc]  # noqa: E741
+            if len(missing) < len(best_missing):
+                best_missing = missing
+            if not missing:
+                break
+        if best_missing:
+            bad.append((name, best_missing))
+    return bad
+
+
 def main(argv=None):
     argv = sys.argv if argv is None else argv
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    metrics = registered_metrics(os.path.join(repo, "paddle_tpu"))
+    metrics, labels = _scan(os.path.join(repo, "paddle_tpu"))
     with open(os.path.join(repo, "README.md"), "r",
               encoding="utf-8") as f:
         readme = f.read()
@@ -84,10 +143,18 @@ def main(argv=None):
         print(f"{name}: registered in {', '.join(sorted(set(paths)))} "
               f"but never mentioned in README.md — add it to the "
               f"metric table (or rename the metric back)")
-    if missing:
+    documented = {n for n in labels if n not in dict(missing)}
+    label_drift = undocumented_labels(
+        {n: labels[n] for n in documented}, readme)
+    for name, miss in label_drift:
+        print(f"{name}: declares labels {labels[name]} but no "
+              f"{name}{{...}} occurrence in README.md mentions "
+              f"{miss} — document the metric WITH its labels "
+              f"(e.g. `{name}{{{miss[0]}}}`)")
+    if missing or label_drift:
         return 1
-    print(f"OK: all {len(metrics)} registered metric names are "
-          f"documented in README.md")
+    print(f"OK: all {len(metrics)} registered metric names "
+          f"({len(labels)} labeled) are documented in README.md")
     return 0
 
 
