@@ -67,6 +67,38 @@ def _build(args, n, policy):
     return ReplicaRouter(reps, policy=policy), reps
 
 
+def _build_remote(args, n, policy):
+    """N replicas as SPAWNED PROCESSES behind the wire transport
+    (ISSUE 12) — same server config, reached through RemoteReplica
+    proxies. Returns (router, reps, procs); callers must _teardown."""
+    from paddle_tpu.inference.remote import (RemoteReplica,
+                                             spawn_replica_host)
+    from paddle_tpu.inference.router import ReplicaRouter
+    from _remote_stub import make_stub_server
+    kw = {"max_slots": args.slots, "max_cache_len": args.max_cache_len,
+          "page_size": args.page_size}
+    procs, reps = [], []
+    for _ in range(n):
+        proc, addr = spawn_replica_host(make_stub_server, kw,
+                                        heartbeat_s=0.02,
+                                        start_server=True)
+        procs.append(proc)
+        reps.append(RemoteReplica(addr, call_timeout_s=10.0))
+    return ReplicaRouter(reps, policy=policy), reps, procs
+
+
+def _teardown_remote(reps, procs):
+    for rep in reps:
+        try:
+            rep.shutdown()
+        except Exception:
+            pass                     # already dead: fine for teardown
+    for proc in procs:
+        proc.join(10)
+        if proc.is_alive():
+            proc.kill()
+
+
 def _workload(args):
     rng = np.random.default_rng(0)
     groups = [rng.integers(0, 16, (args.system_tokens,)).astype(np.int32)
@@ -83,11 +115,16 @@ def _workload(args):
     return rounds
 
 
-def _run_mode(args, rounds, n, policy):
+def _run_mode(args, rounds, n, policy, remote=False):
     from _serving_stub import stub_tokens
-    router, reps = _build(args, n, policy)
+    procs = None
+    if remote:
+        router, reps, procs = _build_remote(args, n, policy)
+    else:
+        router, reps = _build(args, n, policy)
     router.start(poll_interval=0.005)
     n_req = sum(len(r) for r in rounds)
+    paced = 0.0
     t0 = time.perf_counter()
     for rnd in rounds:                      # steady traffic: one round
         rids = [(router.submit(p, max_new_tokens=args.new_tokens), p)
@@ -96,15 +133,28 @@ def _run_mode(args, rounds, n, policy):
             got = router.wait(rid, timeout=120)
             np.testing.assert_array_equal(
                 got, stub_tokens(p, args.new_tokens))
-    wall = time.perf_counter() - t0
+        if remote:
+            # steady-traffic pacing: let the round's donations reach
+            # the pushed sketches before the next round routes (the
+            # digest cadence is what an in-process fleet gets for
+            # free). Pacing is idle time between rounds, so it is
+            # SUBTRACTED from the reported wall.
+            time.sleep(0.06)
+            paced += 0.06
+    wall = time.perf_counter() - t0 - paced
+    if remote:
+        time.sleep(0.1)                     # final digest refresh
     hits = sum(r.stats["prefix_auto_hits"] for r in reps)
     prefill = sum(r.stats["prefill_tokens"] for r in reps)
     router.stop()
+    if procs is not None:
+        _teardown_remote(reps, procs)
     # cold misses = admissions that found no cached prefix anywhere in
     # the fleet: 1-replica/affinity pay one per GROUP, round-robin one
     # per (replica, group) pair its rotation touches — the spread is
     # exactly the locality the affinity policy exists to keep
-    return {"mode": f"{policy}-{n}" if n > 1 else "1-replica",
+    tag = f"{policy}-{n}" if n > 1 else "1-replica"
+    return {"mode": tag + ("-remote" if remote else ""),
             "hit_rate": hits / n_req, "cold_misses": n_req - hits,
             "hits": hits, "prefill_tokens": prefill,
             "affinity_hits": router.stats["affinity_hits"],
@@ -182,6 +232,42 @@ def _bench_rolling_restart(args, rounds):
             "requeued": router.stats["requeued"]}
 
 
+def _bench_remote(args, rounds):
+    """ISSUE 12: the same affinity workload over PROCESS replicas —
+    sketch routing from pushed digests, rolling restart of real
+    processes, and the per-call wire overhead (ping p50/p99)."""
+    from _serving_stub import stub_tokens
+    mode = _run_mode(args, rounds, args.replicas, "affinity",
+                     remote=True)
+
+    router, reps, procs = _build_remote(args, args.replicas, "affinity")
+    try:
+        rtts = sorted(reps[0].ping() for _ in range(200))
+        p50 = rtts[len(rtts) // 2]
+        p99 = rtts[int(len(rtts) * 0.99)]
+        router.start(poll_interval=0.005)
+        rids = [(router.submit(p, max_new_tokens=args.new_tokens), p)
+                for rnd in rounds for p in rnd]
+        t0 = time.perf_counter()
+        router.rolling_restart(drain_timeout=120.0)
+        rr_wall = time.perf_counter() - t0
+        failed = 0
+        for rid, p in rids:
+            try:
+                np.testing.assert_array_equal(
+                    router.wait(rid, timeout=120),
+                    stub_tokens(p, args.new_tokens))
+            except Exception:
+                failed += 1
+        router.stop()
+    finally:
+        _teardown_remote(reps, procs)
+    mode.update({"wire_p50_us": p50 * 1e6, "wire_p99_us": p99 * 1e6,
+                 "rr_wall_s": rr_wall, "rr_failed": failed,
+                 "rr_restarts": router.stats["restarts"]})
+    return mode
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests-per-group", type=int, default=12)
@@ -194,6 +280,11 @@ def main(argv=None):
     ap.add_argument("--max-cache-len", type=int, default=128)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--failover-k", type=int, default=8)
+    ap.add_argument("--remote", action="store_true",
+                    help="also run the affinity fleet as spawned "
+                         "PROCESS replicas over the wire transport "
+                         "(ISSUE 12): hit-rate parity, rolling "
+                         "restart of processes, per-call overhead")
     args = ap.parse_args(argv)
 
     rounds = _workload(args)
@@ -220,7 +311,24 @@ def main(argv=None):
           f"{rr['drain_wall_s'] * 1e3:.1f} ms under load, "
           f"{rr['failed']} failed requests, "
           f"{rr['requeued']} requeued")
-    return {"modes": modes, "failover": fo, "rolling_restart": rr}
+    out = {"modes": modes, "failover": fo, "rolling_restart": rr}
+    if args.remote:
+        rm = _bench_remote(args, rounds)
+        inproc = modes[-1]               # the in-process affinity fleet
+        print(f"\n  remote ({args.replicas} process replicas over the "
+              f"wire transport):")
+        print(f"    {rm['mode']:<22} hit_rate {rm['hit_rate']:.2f} "
+              f"(in-process {inproc['hit_rate']:.2f}, "
+              f"delta {rm['hit_rate'] - inproc['hit_rate']:+.3f}), "
+              f"wall {rm['wall_s'] * 1e3:.1f} ms")
+        print(f"    wire round trip: p50 {rm['wire_p50_us']:.0f} us, "
+              f"p99 {rm['wire_p99_us']:.0f} us")
+        print(f"    rolling restart of processes: "
+              f"{rm['rr_restarts']} bounced in "
+              f"{rm['rr_wall_s'] * 1e3:.1f} ms, "
+              f"{rm['rr_failed']} failed requests")
+        out["remote"] = rm
+    return out
 
 
 if __name__ == "__main__":

@@ -20,7 +20,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "convert_to_mixed_precision", "get_version",
            # serving stack (beyond the reference surface)
            "BatchScheduler", "ContinuousBatchingServer", "ReplicaRouter",
-           "RouterSupervisor", "scan_decode",
+           "RouterSupervisor", "ReplicaHost", "RemoteReplica",
+           "spawn_replica_host", "scan_decode",
            "greedy_generate", "sample_generate", "beam_generate",
            "fsm_generate", "phrases_to_fsm", "process_logits",
            "speculative_generate", "export_decode", "load_decode",
@@ -258,6 +259,8 @@ from .decode_loop import (scan_decode, greedy_generate,  # noqa: E402,F401
                           phrases_to_fsm, process_logits)
 from .continuous_batching import ContinuousBatchingServer  # noqa: E402,F401
 from .router import ReplicaRouter, RouterSupervisor  # noqa: E402,F401
+from .remote import (ReplicaHost, RemoteReplica,  # noqa: E402,F401
+                     spawn_replica_host)
 from .speculative import speculative_generate  # noqa: E402,F401
 from .deploy_decode import (export_decode, load_decode,  # noqa: E402,F401
                             DeployedGenerator)

@@ -848,6 +848,10 @@ class ContinuousBatchingServer:
                 journey.event("submitted", rid=rid,
                               prompt_tokens=int(T))
             if seed is None:
+                # default-seed rule; remote.ReplicaHost._op_submit
+                # reports the same value to its client mirror — keep
+                # the two in sync (tests/test_remote_replica.py pins
+                # the parity)
                 seed = self._seed + rid
             deadline = None if deadline_s is None \
                 else self._clock.now() + float(deadline_s)

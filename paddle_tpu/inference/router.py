@@ -55,7 +55,12 @@ retries).
 Everything here is host-side and replica-agnostic: the router only
 touches the public server surface (``submit`` / ``wait`` / ``cancel`` /
 ``evacuate`` / ``health`` / ``queue_depth`` / ``in_flight`` /
-``prefix_sketch`` / ``stop`` / ``start``).
+``prefix_sketch`` / ``stop`` / ``start``) — which is exactly why a
+``remote.RemoteReplica`` (a process-isolated replica behind the typed
+wire transport, ISSUE 12) drops in unchanged: the router routes over
+any mix of in-process server objects and remote processes, with the
+load/affinity reads served from pushed digests instead of in-process
+peeks.
 """
 import threading
 
@@ -191,7 +196,11 @@ class ReplicaRouter:
 
     ``policy``: ``"affinity"`` (default — longest cached prefix wins,
     least-loaded fallback), ``"least_loaded"``, or ``"round_robin"``
-    (the affinity-blind bench baseline).
+    (the affinity-blind bench baseline). ``pressure_weight`` (default
+    2.0) scales how strongly a replica's ``preempt_pressure()`` counts
+    against it in the least-loaded score relative to one queued or
+    in-flight request — raise it to divert traffic from a thrashing
+    pool sooner, set 0 to ignore preemption pressure entirely.
 
     ``telemetry`` (``telemetry.RouterTelemetry``, or ``True`` for a
     default one) publishes per-replica routed/affinity/requeue
@@ -232,14 +241,19 @@ class ReplicaRouter:
     def __init__(self, replicas, policy="affinity", seed=0,
                  telemetry=None, journeys=None, recorder=None,
                  slos=None, clock=None, fault_injector=None,
-                 breakers=None, retry_policy=None, wait_slice=0.05):
+                 breakers=None, retry_policy=None, wait_slice=0.05,
+                 pressure_weight=2.0):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "least_loaded", "round_robin"):
             raise ValueError(f"policy must be 'affinity', 'least_loaded'"
                              f" or 'round_robin', got {policy!r}")
+        if pressure_weight < 0:
+            raise ValueError(f"pressure_weight must be >= 0, got "
+                             f"{pressure_weight}")
         self.replicas = list(replicas)
         self.policy = policy
+        self.pressure_weight = float(pressure_weight)
         self._seed = int(seed)
         if telemetry is True:
             from ..telemetry import RouterTelemetry
@@ -396,9 +410,14 @@ class ReplicaRouter:
             try:
                 out = self.replicas[idx].wait(
                     rrid, timeout=min(remaining, self._wait_slice))
-            except TimeoutError:
-                continue              # re-read the route: it may have
-            except ReliabilityError:  # moved to a sibling meanwhile
+            except ReliabilityError:
+                # matched BEFORE TimeoutError: DeadlineExceeded
+                # subclasses both, and it is a terminal typed outcome
+                # — the old clause order swallowed it as a
+                # not-finished-yet poll and the waiter span until its
+                # own timeout, surfacing untyped (ISSUE 12 fix). The
+                # stale-gen re-check below still absorbs errors from
+                # a replica the request already left.
                 with self._lock:
                     cur = self._routes.get(rid)
                     if cur is not None and cur.gen != gen:
@@ -409,6 +428,9 @@ class ReplicaRouter:
                     self._routes.pop(rid, None)
                     self._by_replica[idx].pop(rrid, None)
                 raise
+            except TimeoutError:
+                continue              # re-read the route: it may have
+            #                           moved to a sibling meanwhile
             except RuntimeError as e:
                 # a DEAD SERVE THREAD raises a generic RuntimeError for
                 # every waiter WITHOUT consuming any per-rid state —
@@ -486,13 +508,16 @@ class ReplicaRouter:
                 self._rr += 1
             return serving[k:] + serving[:k], aff
         # preemption pressure joins the load score, weighted ABOVE
-        # plain queue depth: a replica thrashing its KV pool (parked
-        # preempted requests it must replay) is slower for EVERY
-        # resident request, so the fleet sheds new load away from it
-        # until the backlog drains. Lock-free reads, like the rest.
+        # plain queue depth (``pressure_weight``, default 2.0): a
+        # replica thrashing its KV pool (parked preempted requests it
+        # must replay) is slower for EVERY resident request, so the
+        # fleet sheds new load away from it until the backlog drains —
+        # a higher weight diverts sooner, 0 ignores pressure entirely.
+        # Lock-free reads, like the rest.
+        w = self.pressure_weight
         load = {idx: (self.replicas[idx].queue_depth()
                       + self.replicas[idx].in_flight()
-                      + 2 * self.replicas[idx].preempt_pressure())
+                      + w * self.replicas[idx].preempt_pressure())
                 for idx in serving}
         if self.policy == "affinity":
             fps_by_pg = {}
@@ -805,6 +830,18 @@ class ReplicaRouter:
             tele = getattr(rep, "telemetry", None)
             if tele is not None and getattr(tele, "enabled", False):
                 snaps.append(tele.registry.snapshot())
+                continue
+            # process-isolated replica (RemoteReplica): its registry
+            # lives across the wire — one snapshot op per fleet fold,
+            # so /fleet spans process boundaries. Only serving replicas
+            # are asked (a stale/dead one would spend the scrape's wire
+            # budget to contribute nothing); the snapshot op itself is
+            # bounded by the proxy's short snapshot timeout
+            remote = getattr(rep, "registry_snapshot", None)
+            if callable(remote) and is_serving_state(rep.health):
+                snap = remote()
+                if snap:
+                    snaps.append(snap)
         return merge_snapshots(snaps)
 
     def fleet_metrics(self):
@@ -919,17 +956,20 @@ class ReplicaRouter:
                                    "ph": "i", "s": "p",
                                    "pid": pid_of(ev["where"]), "tid": 0,
                                    "ts": ev["t"] * 1e6, "args": args})
-                # one flow per journey, stepping at each location
-                # change — the cross-replica connection Perfetto draws
-                hops, last = [], None
-                for ev in timeline:
-                    if ev["where"] != last:
-                        hops.append(ev)
-                        last = ev["where"]
-                if len(hops) >= 2:
-                    for i, ev in enumerate(hops):
+                # one flow per journey, one step bound to EVERY journey
+                # event (not one per consecutive-`where` group): each
+                # s/t/f step carries the exact timestamp and pid of the
+                # event it binds to, so an A->B->A bounce renders as
+                # two distinct arrows anchored at the events that
+                # crossed the boundary — and interleaved timelines
+                # (replica events landing between two router events)
+                # cannot collapse or fabricate hops. Journeys that
+                # never left one location draw no flow.
+                if len(timeline) >= 2 \
+                        and len({ev["where"] for ev in timeline}) >= 2:
+                    for i, ev in enumerate(timeline):
                         ph = "s" if i == 0 else \
-                            ("f" if i == len(hops) - 1 else "t")
+                            ("f" if i == len(timeline) - 1 else "t")
                         fe = {"name": "journey", "cat": "journey",
                               "ph": ph, "id": tid,
                               "pid": pid_of(ev["where"]), "tid": 0,

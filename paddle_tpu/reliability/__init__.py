@@ -29,12 +29,14 @@ Everything here is host-side, dependency-free (stdlib + the telemetry
 clock protocol), and deterministic under test.
 """
 from .errors import (CallbackError, CheckpointCorruptError,  # noqa: F401
-                     CircuitOpenError, DeadlineExceeded, InjectedFault,
-                     PreemptedError, QueueFullError, ReliabilityError,
-                     ReplicaLostError, RequestCancelled, SchedulerClosed,
-                     ServerClosed, StepFailedError, TrainAnomalyError)
+                     CircuitOpenError, DeadlineExceeded, FrameError,
+                     InjectedFault, PreemptedError, QueueFullError,
+                     ReliabilityError, ReplicaLostError, RequestCancelled,
+                     SchedulerClosed, ServerClosed, StepFailedError,
+                     TrainAnomalyError, TransportError)
 from .faults import (CKPT_RENAME, CKPT_SWAP, CKPT_WRITE,  # noqa: F401
                      DATA_NEXT, DECODE_TICK, FaultInjector, KV_GROW,
+                     NET_CONNECT, NET_PARTITION, NET_RECV, NET_SEND,
                      ON_TOKEN, PAGE_ALLOC, PREFILL, ROUTER_DISPATCH,
                      ROUTER_EVACUATE, SERVER_PREEMPT, TRAIN_STEP)
 from .health import (DEAD, DEGRADED, DRAINING, HEALTH_CODES,  # noqa: F401
@@ -51,7 +53,7 @@ from .training import (AnomalyPolicy, ResumableLoader,  # noqa: F401
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
            "CircuitOpenError", "ReplicaLostError", "PreemptedError",
-           "InjectedFault",
+           "InjectedFault", "TransportError", "FrameError",
            "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError",
            "RetryPolicy", "CircuitBreaker", "ServeSupervisor",
@@ -60,6 +62,7 @@ __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
            "KV_GROW", "SERVER_PREEMPT",
            "ON_TOKEN", "ROUTER_DISPATCH", "ROUTER_EVACUATE",
+           "NET_SEND", "NET_RECV", "NET_CONNECT", "NET_PARTITION",
            "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
            "TRAIN_STEP", "DATA_NEXT",
            "write_checkpoint", "read_checkpoint", "verify_checkpoint",

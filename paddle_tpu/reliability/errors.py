@@ -11,7 +11,7 @@ these DIRECTLY (no RuntimeError wrapping) — a client distinguishing
 __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
            "CircuitOpenError", "ReplicaLostError", "PreemptedError",
-           "InjectedFault",
+           "InjectedFault", "TransportError", "FrameError",
            "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError"]
 
@@ -82,6 +82,24 @@ class PreemptedError(ReliabilityError):
     zero escapes). A preempted request ultimately resolves like any
     other: result, partial (deadline/cancel/hard stop), or a different
     typed failure."""
+
+
+class TransportError(ReliabilityError):
+    """A wire-transport failure between a router and a remote replica
+    (``inference/transport.py``): the connection died, was severed by
+    an injected ``net.*`` fault, or a call's reply never arrived. It
+    marks exactly ONE call's outcome — the request may still be alive
+    on the remote host, so the router treats it like any transient
+    dispatch failure (breaker + failover), never as a request
+    verdict."""
+
+
+class FrameError(TransportError):
+    """One frame on the wire was unusable — truncated payload, a
+    length prefix past the frame cap, or bytes that do not decode as a
+    JSON object. The receiver fails the affected call (or drops the
+    frame when no call can be attributed) and, unless the stream lost
+    sync (oversize/truncation), keeps serving the connection."""
 
 
 class InjectedFault(ReliabilityError):

@@ -29,7 +29,9 @@ from .errors import InjectedFault
 __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
            "KV_GROW", "SERVER_PREEMPT",
            "ON_TOKEN", "PREFIX_EVICT", "PREFIX_DONATE",
-           "ROUTER_DISPATCH", "ROUTER_EVACUATE", "CKPT_WRITE",
+           "ROUTER_DISPATCH", "ROUTER_EVACUATE",
+           "NET_SEND", "NET_RECV", "NET_CONNECT", "NET_PARTITION",
+           "CKPT_WRITE",
            "CKPT_RENAME", "CKPT_SWAP", "TRAIN_STEP", "DATA_NEXT"]
 
 # failure points wired into the serving stack (callers may add their own)
@@ -55,6 +57,19 @@ PREFIX_DONATE = "prefix.donate"     # PrefixCache.donate: harvest-time
 ROUTER_DISPATCH = "router.dispatch"  # ReplicaRouter: one replica submit
 ROUTER_EVACUATE = "router.evacuate"  # RouterSupervisor: harvesting a
 #                                      lost replica's queued requests
+
+# wire-level failure points (inference/transport.py). A fire's EFFECT
+# is chosen by the armed error class — transport.NetDrop (frame
+# vanishes), NetDelay (late), NetTruncate (partial frame, then the
+# socket hard-closes), NetSever / plain InjectedFault (connection
+# severed) — so one injector scripts a whole partition storm.
+NET_SEND = "net.send"          # Connection.send: one outbound frame
+NET_RECV = "net.recv"          # Connection.recv: one inbound frame
+NET_CONNECT = "net.connect"    # RemoteReplica connect/reconnect attempt
+NET_PARTITION = "net.partition"  # checked on EVERY send AND recv (and
+#                                  at connect): a fired partition cuts
+#                                  the link whatever direction traffic
+#                                  was flowing
 
 # failure points wired into the training / checkpoint stack
 CKPT_WRITE = "ckpt.write"           # durable save: per-file payload write

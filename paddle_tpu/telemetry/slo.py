@@ -163,6 +163,11 @@ class SLOEngine:
         #                          [{"t", "slo", "from", "to"}]
         self._g_burn = self._g_state = self._c_trans = None
         self._children = {}
+        # optional background evaluator (ISSUE 12): start(interval)
+        self._thread = None
+        self._stop_evt = threading.Event()
+        self.eval_errors = 0
+        self.last_eval_error = None
         if (self.enabled and registry is not None
                 and getattr(registry, "enabled", False)):
             self._g_burn = registry.gauge(
@@ -184,6 +189,51 @@ class SLOEngine:
         is handed a pre-built engine). Returns self."""
         self.source = source
         return self
+
+    # ----------------------------------------------- background driver
+    def start(self, interval=1.0):
+        """Run ``evaluate()`` on a background daemon thread every
+        ``interval`` (wall-clock) seconds, so the cached ``states()``
+        that ``/healthz`` folds into its SLO detail stay fresh without
+        depending on anything scraping ``/slo`` (ISSUE 12; PR 10 cut).
+        Sample TIMESTAMPS still come from the injectable ``clock`` —
+        only the wake-up cadence is wall time. An evaluation that
+        raises is counted (``eval_errors`` / ``last_eval_error``) and
+        the thread keeps going: a flaky snapshot source must not
+        silently stop alerting. No-op (no thread) when disabled.
+        Returns self."""
+        if interval <= 0:
+            raise ValueError(f"interval must be > 0, got {interval}")
+        if not self.enabled:
+            return self
+        if self._thread is not None:
+            raise RuntimeError("SLO engine already started")
+        self._stop_evt.clear()
+
+        def loop():
+            while not self._stop_evt.wait(interval):
+                try:
+                    self.evaluate()
+                except Exception as e:
+                    self.eval_errors += 1
+                    self.last_eval_error = e
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout=5.0):
+        """Stop the background evaluator (if any) and JOIN its thread.
+        Idempotent; the engine remains usable for pull-driven
+        ``evaluate()`` calls afterwards."""
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    f"SLO evaluator thread did not stop within "
+                    f"{timeout}s (an evaluate() call is wedged)")
+            self._thread = None
 
     # ------------------------------------------------------- evaluate
     def evaluate(self):

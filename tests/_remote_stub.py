@@ -1,0 +1,29 @@
+"""Module-level replica factories for SPAWNED host processes.
+
+``remote.spawn_replica_host(factory)`` pickles the factory by
+reference, so it must live in an importable module (not a test body).
+A spawned child re-imports this module from scratch — force the CPU
+platform BEFORE anything touches jax, exactly as conftest.py does for
+the parent (the child does not run conftest)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from _serving_stub import StubModel  # noqa: E402
+from paddle_tpu.inference.continuous_batching import \
+    ContinuousBatchingServer  # noqa: E402
+
+
+def make_stub_server(**kw):
+    """A paged StubModel server with the router-test defaults; any
+    kwarg overrides pass straight through (``do_sample=True`` for the
+    seeded-sampling parity drills)."""
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_cache_len", 64)
+    kw.setdefault("cache_backend", "paged")
+    kw.setdefault("page_size", 8)
+    return ContinuousBatchingServer(StubModel(), **kw)

@@ -544,6 +544,53 @@ class TestJourneyAcceptance:
         assert any(m["name"] == "journey.preempted" and m["pid"] == 2
                    for m in marks)
 
+    def test_flow_steps_bind_to_journey_events(self, tmp_path):
+        """ISSUE 12 satellite (PR 9 known cut): flow steps bind to the
+        JOURNEY EVENTS themselves — one step per event at its exact
+        (ts, pid) — not to consecutive-``where`` groups. An A->B->A
+        bounce whose return hop emits MORE events at A must render an
+        arrow anchored at each event, so the bounce reads as two
+        distinct crossings (the old grouping collapsed the extra A
+        events into the group's first timestamp)."""
+        fc = FakeClock()
+        jr = JourneyRecorder(clock=fc)
+        router = ReplicaRouter([_server()], journeys=jr)
+        h = jr.begin("r0", where="router")
+        script = [("submitted", "router"), ("dispatched", "router"),
+                  ("queued", "replica0"), ("evacuated", "router"),
+                  ("held", "router"), ("dispatched", "router")]
+        for phase, where in script:
+            fc.advance(1.0)
+            jr.event("r0", phase, where)
+        path = tmp_path / "bounce.json"
+        router.export_fleet_trace(str(path))
+        evs = json.loads(path.read_text())["traceEvents"]
+        flows = [e for e in evs
+                 if e.get("cat") == "journey" and e.get("id") == "r0"]
+        # one flow step per journey event, phased s/t.../f
+        assert len(flows) == len(script)
+        assert [e["ph"] for e in flows] == \
+            ["s"] + ["t"] * (len(script) - 2) + ["f"]
+        # each step anchored at ITS event's pid and timestamp — the
+        # bounce back to the router contributes three distinct anchors,
+        # not one collapsed hop at the group's first event
+        marks = [e for e in evs if e.get("ph") == "i"
+                 and e.get("args", {}).get("journey") == "r0"]
+        assert [(f["pid"], f["ts"]) for f in flows] == \
+            [(m["pid"], m["ts"]) for m in marks]
+        assert [f["pid"] for f in flows] == [0, 0, 1, 0, 0, 0]
+
+    def test_single_location_journey_draws_no_flow(self, tmp_path):
+        jr = JourneyRecorder()
+        router = ReplicaRouter([_server()], journeys=jr)
+        jr.begin("r9", where="router")
+        jr.event("r9", "submitted", "router")
+        jr.event("r9", "collected", "router")
+        path = tmp_path / "flat.json"
+        router.export_fleet_trace(str(path))
+        evs = json.loads(path.read_text())["traceEvents"]
+        assert not [e for e in evs if e.get("cat") == "journey"]
+
 
 # --------------------------------------------------------------------------
 # /debug endpoints

@@ -31,6 +31,7 @@ Everything runs on the StubModel double — tier-1 fast, no transformer
 compiles."""
 import json
 import os
+import time
 import urllib.error
 import urllib.request
 
@@ -625,6 +626,87 @@ class TestSLO:
                 ms.close()
         finally:
             rep.stop()
+
+
+class TestSLOBackgroundEvaluator:
+    """ISSUE 12 satellite (PR 10 known cut): ``start(interval)`` keeps
+    the cached alert states — the ``/healthz`` SLO detail — fresh on a
+    background thread, without anything scraping ``/slo``."""
+
+    def test_states_refresh_without_explicit_evaluate(self):
+        reg = MetricRegistry()
+        req = reg.counter("serving_requests_total", "req",
+                          labelnames=("state",))
+        fc = FakeClock()
+        slo = SLO("avail", "availability", target=0.9, window=120,
+                  fast_window=10)
+        eng = SLOEngine([slo], lambda: reg.snapshot(), clock=fc)
+        assert eng.start(interval=0.01) is eng
+        try:
+            deadline = time.monotonic() + 5
+            while not eng._samples["avail"]:
+                assert time.monotonic() < deadline, "never evaluated"
+                time.sleep(0.005)
+            assert eng.states() == {"avail": "ok"}
+            # budget starts burning hard; the DETAIL flips to page with
+            # nobody calling evaluate() or scraping /slo
+            req.labels(state="failed").inc(50)
+            fc.advance(5.0)
+            deadline = time.monotonic() + 5
+            while eng.state("avail") != "page":
+                assert time.monotonic() < deadline, \
+                    f"state stuck at {eng.states()}"
+                time.sleep(0.005)
+        finally:
+            eng.close()
+        # close() JOINED the thread: samples stop accumulating
+        n = len(eng._samples["avail"])
+        time.sleep(0.05)
+        assert len(eng._samples["avail"]) == n
+        # still usable pull-driven afterwards
+        fc.advance(1.0)
+        assert eng.evaluate()[0]["name"] == "avail"
+
+    def test_evaluation_errors_counted_thread_survives(self):
+        calls = []
+
+        def flaky_source():
+            calls.append(0)
+            if len(calls) == 1:
+                raise ValueError("transient scrape failure")
+            return {}
+
+        eng = SLOEngine([SLO("a", "availability", 0.9, 60)],
+                        flaky_source, clock=FakeClock())
+        eng.start(interval=0.01)
+        try:
+            deadline = time.monotonic() + 5
+            while len(calls) < 3:
+                assert time.monotonic() < deadline, "thread died"
+                time.sleep(0.005)
+        finally:
+            eng.close()
+        assert eng.eval_errors == 1
+        assert isinstance(eng.last_eval_error, ValueError)
+
+    def test_disabled_engine_start_is_a_noop(self):
+        eng = SLOEngine([SLO("a", "availability", 0.9, 60)],
+                        lambda: {}, enabled=False)
+        assert eng.start(interval=0.01) is eng
+        assert eng._thread is None
+        eng.close()                          # idempotent, no thread
+
+    def test_double_start_refused_and_interval_validated(self):
+        eng = SLOEngine([SLO("a", "availability", 0.9, 60)],
+                        lambda: {}, clock=FakeClock())
+        with pytest.raises(ValueError, match="interval"):
+            eng.start(interval=0)
+        eng.start(interval=60)
+        try:
+            with pytest.raises(RuntimeError, match="already started"):
+                eng.start(interval=60)
+        finally:
+            eng.close()
 
 
 # --------------------------------------------------------------------------

@@ -836,6 +836,32 @@ class TestPreemptPressureRouting:
         pending = next(r._queue[0] for r in reps if r.queue_depth())
         assert pending.priority == 2
 
+    def test_pressure_weight_configurable_diverts_sooner(self):
+        """ISSUE 12 satellite: the 2x pressure heuristic is now the
+        ``pressure_weight`` knob — a higher weight diverts away from a
+        thrashing replica SOONER (while a lower one still prefers it),
+        and 0 ignores pressure entirely."""
+        def routed_with(weight):
+            router, reps = _router(2, policy="least_loaded",
+                                   pressure_weight=weight)
+            # rep0: 1 parked preempted request; rep1: 2 queued requests
+            reps[0]._preempted.append(object())
+            for _ in range(2):
+                reps[1].submit(_prompt(9, 9), max_new_tokens=2)
+            router.submit(_prompt(1, 2), max_new_tokens=2)
+            return router.stats["routed"]
+
+        # weight 5: rep0 scores 5 > rep1's 2 -> divert to rep1 already
+        # at pressure 1; weight 1 (and 0): rep0 scores 1 (or 0) < 2 ->
+        # the default-2x tie-break order is not yet reached
+        assert routed_with(5.0) == [0, 1]
+        assert routed_with(1.0) == [1, 0]
+        assert routed_with(0.0) == [1, 0]
+
+    def test_pressure_weight_validated(self):
+        with pytest.raises(ValueError, match="pressure_weight"):
+            _router(2, pressure_weight=-1.0)
+
 
 class TestDeadReplicaParkedFlush:
     def test_poll_flushes_parked_preempted_on_dead_replica(self):

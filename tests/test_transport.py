@@ -1,0 +1,329 @@
+"""Wire transport layer (ISSUE 12): frame layout, typed error
+marshalling, snapshot transit, net.* chaos behaviours, and the
+frame-corruption fuzz contract — a bad frame fails the ONE affected
+call with a typed ``TransportError``, never wedges a waiter, never
+kills the receive loop."""
+import json
+import random
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import transport
+from paddle_tpu.inference.transport import (Connection, MAX_FRAME,
+                                            NetDelay, NetDrop, NetSever,
+                                            NetTruncate, decode_snapshot,
+                                            encode_snapshot, jsonable,
+                                            marshal_error,
+                                            unmarshal_error)
+from paddle_tpu.reliability import (NET_PARTITION, NET_RECV, NET_SEND,
+                                    DeadlineExceeded, FaultInjector,
+                                    FrameError, QueueFullError,
+                                    ReliabilityError, ReplicaLostError,
+                                    TransportError, errors, faults)
+
+pytestmark = pytest.mark.net
+
+
+def _pair(fault_injector=None, registry=None):
+    a, b = socket.socketpair()
+    return (Connection(a, fault_injector=fault_injector,
+                       registry=registry, peer="a"),
+            Connection(b, peer="b"))
+
+
+class TestFraming:
+    def test_roundtrip_and_order(self):
+        a, b = _pair()
+        for i in range(5):
+            a.send({"i": i, "payload": "x" * (i * 100)})
+        got = [b.recv(timeout=2)["i"] for i in range(5)]
+        assert got == list(range(5))
+        a.close()
+        b.close()
+
+    def test_large_frame_roundtrips(self):
+        a, b = _pair()
+        msg = {"blob": "y" * 300_000}
+        got = {}
+
+        def rx():               # a frame bigger than the kernel buffer
+            got["msg"] = b.recv(timeout=10)   # needs a live reader
+
+        th = threading.Thread(target=rx)
+        th.start()
+        a.send(msg)
+        th.join(10)
+        assert got.get("msg") == msg
+
+    def test_timeout_is_plain_timeout(self):
+        a, b = _pair()
+        with pytest.raises(TimeoutError):
+            b.recv(timeout=0.05)
+        a.send({"late": 1})          # connection still fine afterwards
+        assert b.recv(timeout=2) == {"late": 1}
+
+    def test_oversize_outbound_refused_without_desync(self):
+        a, b = _pair()
+        with pytest.raises(FrameError):
+            a.send({"blob": "z" * (MAX_FRAME + 1)})
+        a.send({"ok": 1})            # nothing hit the wire: still live
+        assert b.recv(timeout=2) == {"ok": 1}
+
+    def test_oversize_inbound_severs(self):
+        raw_a, raw_b = socket.socketpair()
+        b = Connection(raw_b, peer="b")
+        raw_a.sendall(struct.pack("!I", MAX_FRAME + 1) + b"x" * 16)
+        with pytest.raises(TransportError):
+            b.recv(timeout=2)
+        assert b.closed
+
+    def test_peer_close_is_transport_error(self):
+        a, b = _pair()
+        a.close()
+        with pytest.raises(TransportError):
+            b.recv(timeout=2)
+
+    def test_garbage_payload_is_frame_error_stream_survives(self):
+        """The fuzz contract's foundation: a length-valid frame whose
+        payload is not JSON spoils only itself."""
+        raw_a, raw_b = socket.socketpair()
+        b = Connection(raw_b, peer="b")
+        rng = random.Random(7)       # seeded-PRNG chaos pattern
+        for _ in range(5):
+            junk = bytes(rng.randrange(256) for _ in range(40))
+            raw_a.sendall(struct.pack("!I", len(junk)) + junk)
+            with pytest.raises(FrameError):
+                b.recv(timeout=2)
+        ok = json.dumps({"fine": True}).encode()
+        raw_a.sendall(struct.pack("!I", len(ok)) + ok)
+        assert b.recv(timeout=2) == {"fine": True}
+
+    def test_truncated_frame_then_eof_severs(self):
+        raw_a, raw_b = socket.socketpair()
+        b = Connection(raw_b, peer="b")
+        raw_a.sendall(struct.pack("!I", 100) + b"{\"half\":")
+        raw_a.close()
+        with pytest.raises(TransportError):
+            b.recv(timeout=2)
+
+
+class TestErrorMarshalling:
+    def test_reliability_family_roundtrips_by_type(self):
+        for name in ("DeadlineExceeded", "QueueFullError",
+                     "ServerClosed", "RequestCancelled",
+                     "CircuitOpenError", "ReplicaLostError",
+                     "TransportError", "FrameError"):
+            cls = getattr(errors, name)
+            back = unmarshal_error(marshal_error(cls("boom")))
+            assert type(back) is cls
+            assert "boom" in str(back)
+
+    def test_structured_ctor_degrades_to_typed_base(self):
+        err = errors.CallbackError([("r1", ValueError("bad"))])
+        back = unmarshal_error(marshal_error(err))
+        assert isinstance(back, ReliabilityError)
+        assert "CallbackError" in str(back)
+
+    def test_builtins_roundtrip(self):
+        for exc in (TimeoutError("slow"), ValueError("nope"),
+                    KeyError("missing")):
+            back = unmarshal_error(marshal_error(exc))
+            assert type(back) is type(exc)
+
+    def test_unknown_kind_becomes_tagged_runtimeerror(self):
+        back = unmarshal_error({"kind": "WeirdVendorError",
+                                "message": "huh"})
+        assert type(back) is RuntimeError
+        assert "WeirdVendorError" in str(back)
+
+    def test_typed_deadline_survives_isinstance_contracts(self):
+        back = unmarshal_error(marshal_error(DeadlineExceeded("late")))
+        assert isinstance(back, TimeoutError)       # family contract
+        assert isinstance(back, ReliabilityError)
+        assert not isinstance(back, QueueFullError)
+
+
+class TestJsonTransit:
+    def test_jsonable_numpy_and_sets(self):
+        out = jsonable({"a": np.int32(3), "b": np.arange(3),
+                        "c": frozenset({2, 1}), "d": (1, "x"),
+                        "e": None})
+        assert out == {"a": 3, "b": [0, 1, 2], "c": [1, 2],
+                       "d": [1, "x"], "e": None}
+        json.dumps(out)              # actually serializable
+
+    def test_snapshot_roundtrip_and_fleet_merge(self):
+        from paddle_tpu.telemetry import MetricRegistry
+        from paddle_tpu.telemetry.exposition import merge_snapshots
+        reg = MetricRegistry()
+        reg.counter("c_total", "c", labelnames=("k",)) \
+           .labels(k="x").inc(3)
+        reg.gauge("g", "g").set(7)
+        reg.histogram("h_seconds", "h").observe(0.02)
+        snap = reg.snapshot()
+        back = decode_snapshot(json.loads(json.dumps(
+            encode_snapshot(snap))))
+        assert back["c_total"]["samples"][("x",)] == 3
+        assert back["g"]["samples"][()] == 7
+        assert back["h_seconds"]["samples"][()]["count"] == 1
+        # a decoded remote snapshot merges with a local one
+        merged = merge_snapshots([snap, back])
+        assert merged["c_total"]["samples"][("x",)] == 6
+
+
+class TestNetChaos:
+    def test_drop_on_send_loses_frame_connection_lives(self):
+        fi = FaultInjector(seed=3).on(NET_SEND, schedule=[0],
+                                      error=NetDrop)
+        a, b = _pair(fault_injector=fi)
+        assert a.send({"n": 0}) is False       # dropped
+        assert a.send({"n": 1}) is True
+        assert b.recv(timeout=2) == {"n": 1}
+        assert fi.fired(NET_SEND) == 1
+
+    def test_delay_on_send_delivers_late(self):
+        fi = FaultInjector(seed=3).on(NET_SEND, schedule=[0],
+                                      error=NetDelay)
+        a, b = _pair(fault_injector=fi)
+        assert a.send({"n": 0}) is True
+        assert b.recv(timeout=2) == {"n": 0}
+
+    def test_truncate_on_send_severs_both_ends(self):
+        fi = FaultInjector(seed=3).on(NET_SEND, schedule=[1],
+                                      error=NetTruncate)
+        a, b = _pair(fault_injector=fi)
+        a.send({"n": 0})
+        with pytest.raises(TransportError):
+            a.send({"n": 1})
+        assert a.closed
+        assert b.recv(timeout=2) == {"n": 0}   # frame 0 was fine
+        with pytest.raises(TransportError):    # then the broken stream
+            while True:
+                b.recv(timeout=2)
+
+    def test_sever_on_recv(self):
+        fi = FaultInjector(seed=3).on(NET_RECV, schedule=[0],
+                                      error=NetSever)
+        a, b = _pair()
+        b._faults = fi
+        a.send({"n": 0})
+        with pytest.raises(TransportError):
+            b.recv(timeout=2)
+        assert b.closed
+
+    def test_drop_on_recv_discards_one_frame(self):
+        fi = FaultInjector(seed=3).on(NET_RECV, schedule=[0],
+                                      error=NetDrop)
+        a, b = _pair()
+        b._faults = fi
+        a.send({"n": 0})
+        a.send({"n": 1})
+        assert b.recv(timeout=2) == {"n": 1}   # frame 0 vanished
+
+    def test_partition_checked_on_both_directions(self):
+        fi = FaultInjector(seed=3).on(NET_PARTITION, schedule=[0])
+        a, b = _pair(fault_injector=fi)
+        with pytest.raises(TransportError):
+            a.send({"n": 0})
+        fi2 = FaultInjector(seed=3).on(NET_PARTITION, schedule=[0])
+        c, d = _pair()
+        d._faults = fi2
+        c.send({"n": 0})
+        with pytest.raises(TransportError):
+            d.recv(timeout=2)
+
+    def test_connect_fault_refuses_typed(self):
+        lst = socket.create_server(("127.0.0.1", 0))
+        try:
+            addr = lst.getsockname()
+            fi = FaultInjector(seed=3).on(faults.NET_CONNECT,
+                                          schedule=[0])
+            with pytest.raises(TransportError):
+                transport.connect(addr, timeout=2, fault_injector=fi)
+        finally:
+            lst.close()
+
+    @pytest.mark.chaos
+    def test_same_seed_same_injection_trace(self):
+        """Wire chaos rides the seeded per-point PRNG streams: two
+        runs with the same seed and visit sequence fire identically
+        (the partition-storm determinism contract)."""
+        def run(seed):
+            fi = FaultInjector(seed=seed) \
+                .on(NET_SEND, probability=0.3, error=NetDrop) \
+                .on(NET_RECV, probability=0.2, error=NetDrop)
+            a, b = _pair(fault_injector=fi)
+            b._faults = fi
+            delivered = []
+            for i in range(30):
+                a.send({"i": i})
+            a.close()
+            while True:
+                try:
+                    delivered.append(b.recv(timeout=2)["i"])
+                except TransportError:
+                    break
+            return list(fi.trace), delivered
+
+        t1, d1 = run(11)
+        t2, d2 = run(11)
+        t3, _ = run(12)
+        assert t1 == t2 and d1 == d2
+        assert t1 != t3
+        assert len(d1) < 30          # the storm actually dropped frames
+
+
+class TestFuzzOneCallFails:
+    """Satellite: truncated / oversized / garbage frames fail exactly
+    the affected call, typed — concurrent callers and the receive loop
+    survive."""
+
+    def test_receiver_loop_survives_seeded_garbage_storm(self):
+        raw_a, raw_b = socket.socketpair()
+        b = Connection(raw_b, peer="b")
+        rng = random.Random(1234)
+        good, bad = 0, 0
+        for i in range(40):
+            if rng.random() < 0.5:
+                junk = bytes(rng.randrange(256)
+                             for _ in range(rng.randrange(1, 80)))
+                raw_a.sendall(struct.pack("!I", len(junk)) + junk)
+            else:
+                ok = json.dumps({"i": i}).encode()
+                raw_a.sendall(struct.pack("!I", len(ok)) + ok)
+        raw_a.close()
+        while True:
+            try:
+                msg = b.recv(timeout=2)
+            except FrameError:
+                bad += 1             # one frame failed, loop continues
+                continue
+            except TransportError:
+                break                # EOF at the end
+            good += 1
+        assert good > 0 and bad > 0
+
+    def test_truncate_fails_one_call_not_the_waiter(self):
+        """A chaos-truncated SEND raises typed TransportError to that
+        caller immediately — the contract that no waiter ever wedges
+        on a frame that half-left."""
+        fi = FaultInjector(seed=9).on(NET_SEND, schedule=[2],
+                                      error=NetTruncate)
+        a, _b = _pair(fault_injector=fi)
+        a.send({"n": 0})
+        a.send({"n": 1})
+        t0 = threading.Event()
+
+        def doomed():
+            with pytest.raises(TransportError):
+                a.send({"n": 2})
+            t0.set()
+
+        th = threading.Thread(target=doomed)
+        th.start()
+        th.join(5)
+        assert t0.is_set()
