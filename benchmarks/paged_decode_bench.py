@@ -57,7 +57,7 @@ def _drain(srv, reqs):
 
 
 def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
-         n_requests=12):
+         n_requests=12, track=False):
     import jax.numpy as jnp
 
     import paddle_tpu as pt
@@ -66,7 +66,7 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     from paddle_tpu.inference.kv_cache import PagedKVCache
     from paddle_tpu.models.llama import (LlamaForCausalLM, llama_350m,
                                          llama_tiny)
-    from paddle_tpu.telemetry import GoodputLedger
+    from paddle_tpu.telemetry import CostCatalog, GoodputLedger
 
     pt.seed(7)
     cfg = (llama_tiny if model_name == "tiny" else llama_350m)(
@@ -100,16 +100,24 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
           f"goodput {good_d['goodput_ratio']:.3f}")
 
     led_p = GoodputLedger()
+    cat = CostCatalog()               # device-cost ledger (ISSUE 13)
     paged = ContinuousBatchingServer(model, max_slots=slots,
                                      max_cache_len=cache_len,
                                      cache_backend="paged",
                                      page_size=page_size,
                                      num_pages=num_pages,
-                                     ledger=led_p)
+                                     ledger=led_p, costs=cat)
     outs_p, toks_p, dt_p = _drain(paged, reqs)
     hbm_p = PagedKVCache.paged_hbm_bytes(num_pages, page_size, L, kvh,
                                          hd, itemsize)
-    compiles = getattr(paged._decode_jit, "_cache_size", lambda: -1)()
+    # the costed dispatch path runs the catalog's AOT executable
+    # (priced once, cached on the server), so the jit cache is idle
+    # and a decode shape leak can no longer recompile SILENTLY — it
+    # would fail the dispatch loudly. compiles == 1 verifies decode
+    # stayed one program; the catalog's post-warmup `recompiles`
+    # counter (printed below) is the live churn signal for the
+    # prefill chunk-width ladder
+    compiles = cat.compiles().get("decode", 0)
     good_p = led_p.snapshot()
     print(f"paged: {toks_p / dt_p:8,.0f} tok/s   "
           f"cache HBM {hbm_p / 2**20:8.2f} MiB "
@@ -120,10 +128,47 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
                if k != "goodput"}
     print(f"paged waste breakdown (tokens): {waste_p}")
     print(f"decode compiles across slot churn: {compiles} (want 1)")
+    # device-cost baseline (ISSUE 13): the compiled decode program's
+    # own price per generated token — THE roofline numbers the fused
+    # megakernel (ROADMAP item 2) must beat
+    costs = cat.snapshot()
+    dec = costs["ops"].get("decode", {"flops": 0.0, "hbm_bytes": 0.0,
+                                      "dispatches": 0})
+    flops_tok = dec["flops"] / max(toks_p, 1)
+    bytes_tok = dec["hbm_bytes"] / max(toks_p, 1)
+    mfu = costs["mfu"] if costs["mfu"] is not None else 0.0
+    print(f"device cost (compiled decode program): "
+          f"{flops_tok:10,.0f} FLOPs/tok  {bytes_tok:10,.0f} HBM B/tok  "
+          f"mfu {mfu:.4f}  roofline {costs['roofline_ratio'] or 0:.4f} "
+          f"(placeholder peaks; compiles {costs['compiles']}, "
+          f"recompiles {costs['recompiles']})")
     parity = all(np.array_equal(a, b) for a, b in zip(outs_d, outs_p))
     print(f"token parity dense vs paged: {parity}")
     if hbm_d < 2 * hbm_p:
         print("WARNING: <2x HBM reduction — workload not mixed enough?")
+    if track:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench_track", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "scripts", "bench_track.py"))
+        bench_track = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench_track)
+        note = (f"{model_name} model, {slots} slots, cache {cache_len},"
+                f" pg {page_size}; compiled-program pricing, "
+                f"placeholder peaks")
+        for metric, value, unit in (
+                ("paged_decode_tokens_per_sec", toks_p / dt_p,
+                 "tokens/s"),
+                ("paged_decode_flops_per_token", flops_tok, "flops"),
+                ("paged_decode_hbm_bytes_per_token", bytes_tok,
+                 "bytes"),
+                ("paged_decode_mfu", mfu, "ratio")):
+            r = bench_track.append_round(
+                {"metric": metric, "value": value, "unit": unit,
+                 "note": note})
+            print(f"tracked {r['metric']} = {r['value']}")
     return 0 if parity else 1
 
 
@@ -138,4 +183,6 @@ if __name__ == "__main__":
         kw["cache_len"] = int(argv[argv.index("--cache-len") + 1])
     if "--page-size" in argv:
         kw["page_size"] = int(argv[argv.index("--page-size") + 1])
+    if "--track" in argv:             # append this round to BENCHLOG
+        kw["track"] = True
     sys.exit(main(**kw))

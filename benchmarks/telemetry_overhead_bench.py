@@ -19,9 +19,12 @@ enabled = per-token attribution + per-tick flush), and reports:
   null-instrument call / recorder.record / disabled record / journey
   event / ledger add+flush, ns/op),
 - the enabled-vs-disabled overhead %% per layer — GUARDS: telemetry
-  <2%%, disabled-recorder <2%%, disabled-ledger <2%% (the
-  disabled-is-structurally-zero-cost contract, measured end to end
-  rather than assumed).
+  <2%%, disabled-recorder <2%%, disabled-ledger <2%%,
+  disabled-cost-catalog <2%% (the disabled-is-structurally-zero-cost
+  contract, measured end to end rather than assumed). The cost
+  catalog's ENABLED pair (ISSUE 13) additionally reports the AOT
+  pricing + compile-watch + phase-clock cost and the run's decode
+  FLOPs/MFU.
 
     python benchmarks/telemetry_overhead_bench.py [--slots N]
         [--requests N] [--new-tokens N] [--reps N]
@@ -47,7 +50,7 @@ def _build_model():
 
 
 def _drain(model, telemetry, slots, requests, new_tokens, reps,
-           recorder=None, ledger=None):
+           recorder=None, ledger=None, costs=None):
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
     rng = np.random.default_rng(0)
@@ -56,7 +59,8 @@ def _drain(model, telemetry, slots, requests, new_tokens, reps,
     srv = ContinuousBatchingServer(model, max_slots=slots,
                                    max_cache_len=128,
                                    telemetry=telemetry,
-                                   recorder=recorder, ledger=ledger)
+                                   recorder=recorder, ledger=ledger,
+                                   costs=costs)
     for p in prompts[:slots]:                       # warm the compiles
         srv.submit(p, max_new_tokens=4)
     srv.run()
@@ -85,9 +89,9 @@ def main():
     ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args()
 
-    from paddle_tpu.telemetry import (FlightRecorder, GoodputLedger,
-                                      JourneyRecorder, MetricRegistry,
-                                      ServerTelemetry)
+    from paddle_tpu.telemetry import (CostCatalog, FlightRecorder,
+                                      GoodputLedger, JourneyRecorder,
+                                      MetricRegistry, ServerTelemetry)
 
     model = _build_model()
     t_off, _ = _drain(model, None, args.slots, args.requests,
@@ -109,6 +113,14 @@ def main():
     led = GoodputLedger()
     t_led_on, _ = _drain(model, None, args.slots, args.requests,
                          args.new_tokens, args.reps, ledger=led)
+    # cost catalog + compile watch pair (ISSUE 13): disabled must be
+    # structurally free; enabled pays AOT pricing + phase clock reads
+    t_cost_off, _ = _drain(model, None, args.slots, args.requests,
+                           args.new_tokens, args.reps,
+                           costs=CostCatalog(enabled=False))
+    cat = CostCatalog()
+    t_cost_on, _ = _drain(model, None, args.slots, args.requests,
+                          args.new_tokens, args.reps, costs=cat)
 
     tick = tele.registry.get("serving_tick_seconds")
     overhead = (t_on - t_off) / t_off * 100.0
@@ -116,7 +128,10 @@ def main():
     rec_on_overhead = (t_rec_on - t_off) / t_off * 100.0
     led_off_overhead = (t_led_off - t_off) / t_off * 100.0
     led_on_overhead = (t_led_on - t_off) / t_off * 100.0
+    cost_off_overhead = (t_cost_off - t_off) / t_off * 100.0
+    cost_on_overhead = (t_cost_on - t_off) / t_off * 100.0
     goodput = led.snapshot()
+    cost_snap = cat.snapshot()
 
     reg = MetricRegistry()
     c = reg.counter("bench_total")
@@ -158,6 +173,13 @@ def main():
           f"({led_on_overhead:+.2f}%, goodput ratio "
           f"{goodput['goodput_ratio']:.3f} over {goodput['ticks']} "
           f"ticks)")
+    print(f"drain costs off     : {t_cost_off * 1e3:9.1f} ms   "
+          f"({cost_off_overhead:+.2f}% — structurally-zero guard)")
+    dec_cost = cost_snap["ops"].get("decode", {"flops": 0})
+    print(f"drain costs on      : {t_cost_on * 1e3:9.1f} ms   "
+          f"({cost_on_overhead:+.2f}%, {cost_snap['compiles']} "
+          f"compiles, decode {dec_cost['flops']:.3g} FLOPs, "
+          f"mfu {cost_snap['mfu'] or 0:.2e})")
     print(f"telemetry overhead  : {overhead:9.2f} %   (target < 2%)")
     print(f"counter.inc         : {ns_inc:9.0f} ns/op")
     print(f"hist.observe        : {ns_obs:9.0f} ns/op")
@@ -168,10 +190,12 @@ def main():
     print(f"ledger.add          : {ns_ladd:9.0f} ns/op")
     print(f"ledger add+flush    : {ns_lflush:9.0f} ns/op")
     # guards: full telemetry <2%, DISABLED recorder <2%, DISABLED
-    # ledger <2% (their events/clock reads are asserted zero in tests;
-    # wall clock is the end-to-end check that "treated as None" holds)
+    # ledger <2%, DISABLED cost catalog <2% (their events/clock reads
+    # are asserted zero in tests; wall clock is the end-to-end check
+    # that "treated as None" holds)
     return 0 if (overhead < 2.0 and rec_off_overhead < 2.0
-                 and led_off_overhead < 2.0) else 1
+                 and led_off_overhead < 2.0
+                 and cost_off_overhead < 2.0) else 1
 
 
 if __name__ == "__main__":

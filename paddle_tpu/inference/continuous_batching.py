@@ -289,6 +289,22 @@ class ContinuousBatchingServer:
     Kinds sum to the tick's total device tokens (conservation is
     test-asserted); a disabled ledger is treated exactly like None.
 
+    ``costs`` (``telemetry.CostCatalog``, or ``True``) turns on the
+    device-cost ledger + compile watch: every jitted serving program
+    (the decode block, each ragged-prefill chunk width) is priced ONCE
+    per shape signature from the compiler's own
+    ``cost_analysis`` at compile time, every dispatch is charged FLOPs
+    + HBM bytes (``server_flops_total{op}`` /
+    ``server_hbm_bytes_total{op}``, ``serving_mfu``), compiles are
+    timed (``server_compiles_total{op}``, ``serving_compile_seconds``)
+    and a compile AFTER warmup lands as a ``compile`` flight-recorder
+    event with ``recompile=True`` plus a ``compile_stall`` journey
+    phase on every request parked behind it, and each tick's wall is
+    split into phases (``serving_tick_phase_seconds{phase}``) —
+    ``srv.device_costs()`` (also ``/stats["costs"]``) and a ``costs``
+    postmortem section. A disabled catalog is treated exactly like
+    None (zero clock reads / locks on the tick path).
+
     ``journeys`` (``telemetry.JourneyRecorder``, or ``True``) lets a
     STANDALONE server mint its own request journeys: ``submit()``
     begins one per request unless a router-supplied handle arrives via
@@ -318,7 +334,7 @@ class ContinuousBatchingServer:
                  preemption_policy=None,
                  prefill_mode=None, prefill_tokens_per_tick=None,
                  max_admissions_per_tick=None, telemetry=None,
-                 recorder=None, ledger=None, journeys=None,
+                 recorder=None, ledger=None, journeys=None, costs=None,
                  max_queue=None, shed_policy="reject",
                  retry_policy=None, breaker=None, fault_injector=None,
                  clock=None):
@@ -526,6 +542,26 @@ class ContinuousBatchingServer:
         self.ledger = ledger
         self._led = ledger if (ledger is not None
                                and ledger.enabled) else None
+        # device-cost catalog + compile watch (telemetry.CostCatalog):
+        # every jitted serving program priced once per shape signature
+        # at compile time (lower/compile/cost_analysis — the catalog
+        # keeps the executable, so pricing costs no duplicate compile),
+        # every dispatch charged FLOPs + HBM bytes, recompiles after
+        # warmup surfaced, tick wall split into phases. True builds one
+        # on the telemetry registry + server clock; a DISABLED catalog
+        # is treated exactly like None — one `is None` check per site,
+        # zero locks, zero clock reads on the tick path
+        if costs is True:
+            from ..telemetry import CostCatalog
+            costs = CostCatalog(
+                registry=self._tele.registry
+                if self._tele is not None else None, clock=self._clock)
+        self.costs = costs
+        self._costs = costs if (costs is not None
+                                and costs.enabled) else None
+        self._phase_timer = None    # per-tick, set by _step_locked
+        self._decode_prog = None    # priced decode program (static sig)
+        self._kv_row_nbytes = None  # lazy: bytes per K+V token row
         # journey recorder for STANDALONE servers (closes the PR-9
         # "router-minted only" cut): submit() mints "s<rid>" journeys
         # when no router-supplied handle arrives, and journey(rid)
@@ -1031,6 +1067,10 @@ class ContinuousBatchingServer:
             return dense.at[:, :, :n].set(s.astype(dense.dtype))
 
         pool = self._caches["pool"]
+        if self._costs is not None:    # byte model priced lazily: the
+            # pool flatten must not run on the costs=None path
+            self._charge_transfer("page_gather",
+                                  2 * n * self._row_nbytes())
         return {"k": take(pool["k"], base["k"]),
                 "v": take(pool["v"], base["v"])}
 
@@ -1043,6 +1083,8 @@ class ContinuousBatchingServer:
                                 bt=jnp.asarray(self._kv.block_table))
             self._kv.dirty = False
             self._tick_dispatch("block_table")
+            self._charge_transfer("block_table",
+                                  2 * self._kv.block_table.nbytes)
 
     def _pool_gauges(self):
         """Refresh the page-pool occupancy gauges (paged backend)."""
@@ -1534,10 +1576,21 @@ class ContinuousBatchingServer:
         self._sync_block_table()
         tele = self._tele
         t_started = tele.prefill_started() if tele is not None else None
+        if self._phase_timer is not None:
+            self._phase_timer.mark("admission")
         wall0 = _time_mod.perf_counter()
-        logits, self._caches = self._ragged_fn(
-            jnp.asarray(toks), jnp.asarray(t0), self._caches,
-            jnp.asarray(out_idx))
+        toks_d, t0_d, out_d = (jnp.asarray(toks), jnp.asarray(t0),
+                               jnp.asarray(out_idx))
+        prefill_fn = self._ragged_fn
+        if self._costs is not None:
+            # one priced program per chunk width on the pow2 ladder —
+            # a width first seen AFTER warmup is exactly the recompile
+            # the watch exists to surface
+            prefill_fn = self._cost_program(
+                "prefill", self._ragged_fn,
+                (toks_d, t0_d, self._caches, out_d))
+        logits, self._caches = prefill_fn(toks_d, t0_d, self._caches,
+                                          out_d)
         self._count_dispatches(1, op="prefill")
         led = self._led
         for slot, start, take in plan:
@@ -1567,6 +1620,8 @@ class ContinuousBatchingServer:
         for slot in done:
             self._activate(slot, logits[slot:slot + 1])
         self.stats["prefill_wall_s"] += _time_mod.perf_counter() - wall0
+        if self._phase_timer is not None:
+            self._phase_timer.mark("prefill_launch")
         if tele is not None:
             tele.on_prefill_batch(t_started, used)
 
@@ -1615,18 +1670,21 @@ class ContinuousBatchingServer:
             self._tok = self._tok.at[idx].set(vals)
             self._pending_tok.clear()
             self._count_dispatches(1, op="state_push")
+            self._charge_transfer("state_push", 2 * self._tok.nbytes)
         if self._pending_t:
             idx = jnp.asarray(list(self._pending_t), jnp.int32)
             vals = jnp.asarray(list(self._pending_t.values()), jnp.int32)
             self._t = self._t.at[idx].set(vals)
             self._pending_t.clear()
             self._count_dispatches(1, op="state_push")
+            self._charge_transfer("state_push", 2 * self._t.nbytes)
         if self._pending_key:
             idx = jnp.asarray(list(self._pending_key), jnp.int32)
             vals = jnp.stack(list(self._pending_key.values()))
             self._keys = self._keys.at[idx].set(vals)
             self._pending_key.clear()
             self._count_dispatches(1, op="state_push")
+            self._charge_transfer("state_push", 2 * self._keys.nbytes)
 
     def _count_dispatches(self, n=1, op="prefill"):
         """Account ``n`` host->device dispatches on the admission/
@@ -1644,6 +1702,53 @@ class ContinuousBatchingServer:
         (the decode program itself, block-table syncs) in this tick's
         per-op profile only."""
         self._tick_disp[op] = self._tick_disp.get(op, 0) + n
+
+    def _cost_program(self, op, fn, args):
+        """The cost catalog's priced executable for ``fn`` at ``args``'
+        shape signature (compiled + priced on first sight; calling it
+        dispatches AND charges). The compile-watch funnel lives here: a
+        fresh compile lands a ``compile`` recorder event, and one that
+        happens AFTER the catalog warmed is a RECOMPILE — flagged on
+        the event and stamped as a ``compile_stall`` journey phase on
+        every request parked behind the stalled tick (queued, mid-
+        prefill, live slots, preempted), so the latency spike those
+        requests see is attributable to XLA. Caller guarantees
+        ``self._costs is not None``."""
+        prog = self._costs.program(op, fn, args)
+        if getattr(prog, "compiled_now", False):
+            if self._rec is not None:
+                self._rec.record("compile", op=op,
+                                 recompile=prog.recompile,
+                                 seconds=prog.compile_s)
+            if prog.recompile:
+                stalled = [item.journey for item in self._queue]
+                stalled += [rec.journey for rec in self._preempted]
+                stalled += [st.journey for st in self._slots
+                            if st is not None]
+                for journey in stalled:
+                    if journey is not None:
+                        journey.event("compile_stall", op=op)
+        return prog
+
+    def _charge_transfer(self, op, nbytes):
+        """Price a host<->device data movement that is not a compiled
+        program (slot-state push, page gather/scatter, block-table
+        sync): bytes moved — read + write of the touched buffers —
+        zero FLOPs. No-op without an enabled cost catalog."""
+        if self._costs is not None:
+            self._costs.charge_bytes(op, int(nbytes))
+
+    def _row_nbytes(self):
+        """Bytes one token's K+V rows occupy across every layer of the
+        page pool — the unit the page gather/scatter transfer charges
+        are priced in. Computed once from the pool leaves."""
+        if self._kv_row_nbytes is None:
+            pool = self._caches["pool"]
+            pg = self._kv.page_size
+            self._kv_row_nbytes = sum(
+                leaf.nbytes // (leaf.shape[1] * pg)
+                for leaf in jax.tree_util.tree_leaves(pool))
+        return self._kv_row_nbytes
 
     def _n_prefill_calls(self, seg_len):
         """Dense-prefill program launches ``_run_prefill`` makes for a
@@ -1696,6 +1801,8 @@ class ContinuousBatchingServer:
             self._count_headroom(slot, T)
         tele = self._tele
         t_started = tele.prefill_started() if tele is not None else None
+        if self._phase_timer is not None:
+            self._phase_timer.mark("admission")
         wall0 = _time_mod.perf_counter()
 
         def _ledger_prefill(n_seg):
@@ -1731,6 +1838,12 @@ class ContinuousBatchingServer:
                 lambda full, r: full.at[:, :, :r.shape[2]].set(r),
                 self._init_caches(1), rows)
             self._count_dispatches(1, op="page_scatter")  # dense-row seed
+            if self._costs is not None:    # byte model priced lazily:
+                # the tree flatten must not run on the costs=None path
+                self._charge_transfer(
+                    "page_scatter",
+                    2 * sum(leaf.nbytes for leaf
+                            in jax.tree_util.tree_leaves(rows)))
             rest = ids[n_pre:]
             self.stats["prefix_hit_tokens"] += n_pre
             if rest.shape[0]:
@@ -1773,6 +1886,15 @@ class ContinuousBatchingServer:
             n_prompt = -(-T // pg) - len(pre_pages)
             if own[:n_prompt]:
                 self._count_dispatches(1, op="page_scatter")  # remainder pages
+                if self._costs is not None:
+                    # charged HERE, not inside _fill_pages: the other
+                    # _fill_pages caller is register_prefix, which
+                    # stays off the cost ledger like it stays off
+                    # goodput
+                    self._charge_transfer(
+                        "page_scatter",
+                        2 * len(own[:n_prompt]) * pg
+                        * self._row_nbytes())
             self._fill_pages(caches1, own[:n_prompt],
                              len(pre_pages) * pg)
         else:
@@ -1780,9 +1902,20 @@ class ContinuousBatchingServer:
                 lambda pool, one: pool.at[:, slot].set(one[:, 0]),
                 self._caches, caches1)
             self._count_dispatches(1, op="page_scatter")  # dense row copy
+            if self._costs is not None:
+                self._charge_transfer(
+                    "page_scatter",
+                    2 * sum(leaf.nbytes for leaf
+                            in jax.tree_util.tree_leaves(caches1)))
         self._tok = self._tok.at[slot].set(first)
         self._t = self._t.at[slot].set(T)
         self._count_dispatches(3, op="state_push")    # tok/t/key pushes
+        if self._costs is not None:
+            # three transfers, charged as three — the cost ledger's
+            # dispatch count must reconcile 1:1 with the tick profile
+            self._charge_transfer("state_push", 2 * self._tok.nbytes)
+            self._charge_transfer("state_push", 2 * self._t.nbytes)
+            self._charge_transfer("state_push", 2 * self._keys.nbytes)
         self._active[slot] = True
         st = _Slot(rid, ids, T, budget, on_token, deadline)
         st.n_pre = n_pre
@@ -1795,6 +1928,8 @@ class ContinuousBatchingServer:
         self._slots[slot] = st
         self.stats["admissions"] += 1
         self.stats["prefill_wall_s"] += _time_mod.perf_counter() - wall0
+        if self._phase_timer is not None:
+            self._phase_timer.mark("prefill_launch")
         if tele is not None:
             tele.on_prefill_batch(t_started, T - n_pre)
             tele.on_first_token(rid, T - n_pre, n_pre)
@@ -1979,6 +2114,8 @@ class ContinuousBatchingServer:
         step()/run() caller or the supervised serve loop, which fails
         exactly the offending requests."""
         cbs, self._deferred_cbs = self._deferred_cbs, []
+        ct = self._costs
+        t_cb = ct.clock.now() if (ct is not None and cbs) else None
         errors = []
         for cb, rid, toks in cbs:
             try:
@@ -1987,6 +2124,11 @@ class ContinuousBatchingServer:
                 cb(rid, toks)
             except Exception as e:
                 errors.append((rid, e))
+        if t_cb is not None:
+            # fires OUTSIDE the lock after the tick flushed, so this
+            # phase folds into the NEXT tick's breakdown (a one-tick
+            # skew, documented in telemetry.costs)
+            ct.add_phase("token_callbacks", ct.clock.now() - t_cb)
         if errors:
             raise CallbackError(errors, what="on_token callback")
 
@@ -1997,9 +2139,17 @@ class ContinuousBatchingServer:
         partial profile in the recorder is exactly what a postmortem
         wants to see)."""
         self._tick_disp = {}
+        ct = self._costs
+        if ct is not None:
+            self._phase_timer = ct.phase_timer()
         try:
             return self._step_inner()
         finally:
+            if self._phase_timer is not None:
+                # trailing work since the last mark (token-emit loop,
+                # end-of-tick harvest/admit, or an early return's
+                # remainder) is bookkeeping
+                self._phase_timer.close("bookkeeping")
             prof = self._tick_disp
             if prof:
                 total = sum(prof.values())
@@ -2007,20 +2157,34 @@ class ContinuousBatchingServer:
                 if self._tele is not None:
                     self._tele.on_tick_dispatches(prof)
                 if self._rec is not None:
+                    extra = {}
+                    if ct is not None:
+                        extra["phases"] = ct.pending_phases()
                     self._rec.record("tick", dispatches=dict(prof),
                                      total=total,
-                                     active=int(self._active.sum()))
+                                     active=int(self._active.sum()),
+                                     **extra)
             if self._led is not None:
                 # the conservation boundary: whatever this tick
                 # attributed (even a partial, faulted tick) is folded
                 # and published NOW — kinds sum to the tick's device
                 # tokens by construction of the sites above
                 self._led.flush_tick()
+            if ct is not None:
+                # same boundary for the cost side: fold charges +
+                # phases, publish FLOPs/bytes/MFU, advance the compile
+                # watch's warmup
+                ct.flush_tick()
+                self._phase_timer = None
 
     def _step_inner(self):
         self._prefill_used = 0       # per-tick prefill token budget
         self._expire_locked()
         self._admit()
+        if self._phase_timer is not None:
+            # scheduling work minus the prefill launches (those mark
+            # themselves out as "prefill_launch" from inside)
+            self._phase_timer.mark("admission")
         if not self._active.any():
             if self._tele is not None:     # keep the gauge live when a
                 self._tele.set_active_slots(0)   # drained tick skips decode
@@ -2028,6 +2192,8 @@ class ContinuousBatchingServer:
         # harvest BEFORE stepping: a slot whose budget is spent (or that
         # emitted eos at admission) must not decode further
         self._harvest()
+        if self._phase_timer is not None:
+            self._phase_timer.mark("bookkeeping")
         if not self._active.any():
             if self._tele is not None:
                 self._tele.set_active_slots(0)
@@ -2066,11 +2232,28 @@ class ContinuousBatchingServer:
         tele = self._tele
         n_active = int(self._active.sum())
         t_tick = tele.tick_started() if tele is not None else None
+        decode_fn = self._decode_jit
+        if self._costs is not None:
+            # the catalog's AOT executable is the SAME HLO the jit
+            # cache would build (bit-identical tokens); calling it
+            # charges the compiled program's FLOPs/bytes per dispatch.
+            # Priced ONCE and cached: the decode signature is static
+            # by construction (fixed slot count / cache geometry), so
+            # the hot loop must not re-hash the caches pytree per tick
+            if self._decode_prog is None:
+                self._decode_prog = self._cost_program(
+                    "decode", self._decode_jit,
+                    (self._tok, self._caches, self._t, self._keys))
+            decode_fn = self._decode_prog
         (self._tok, self._caches, self._t, self._keys,
-         toks) = self._decode_jit(self._tok, self._caches, self._t,
-                                  self._keys)
+         toks) = decode_fn(self._tok, self._caches, self._t,
+                           self._keys)
         self._tick_dispatch("decode")
         toks = np.asarray(toks)                    # [slots, tick_block]
+        if self._phase_timer is not None:
+            # covers grow/state-flush/block-table sync, the decode
+            # compile (watched separately), dispatch, and device sync
+            self._phase_timer.mark("decode_launch")
         decoded = wasted = 0
         led = self._led
         if led is not None:
@@ -2346,6 +2529,11 @@ class ContinuousBatchingServer:
             # exactly what an incident review wants next to the pool
             # state ("were we thrashing before this died?")
             sections["goodput"] = self._led.snapshot()
+        if self._costs is not None:
+            # per-op FLOPs/bytes totals, compile counts, and the last
+            # tick's phase breakdown — "was it host-bound" answerable
+            # from the crash scene without a live server
+            sections["costs"] = self._costs.snapshot()
         sections.update(extra)
         return self._rec.postmortem(reason, **sections)
 
@@ -2374,6 +2562,29 @@ class ContinuousBatchingServer:
         ``serving.serve_metrics`` and the ``goodput`` postmortem
         section."""
         return None if self._led is None else self._led.snapshot()
+
+    def device_costs(self):
+        """The cost catalog's cumulative snapshot (per-op FLOPs/HBM
+        bytes, compile counts, recompiles/warmup state, MFU/roofline,
+        last tick's phase breakdown), or None without an enabled
+        catalog — also ``/stats["costs"]`` via
+        ``serving.serve_metrics`` and the ``costs`` postmortem
+        section."""
+        return None if self._costs is None else self._costs.snapshot()
+
+    def utilization(self):
+        """Per-replica utilization digest for routing-side views: the
+        goodput ratio (ledger) and MFU (cost catalog) — whatever is
+        wired. Rides remote heartbeat digests (``inference.remote``)
+        so ``/fleet`` and the router see per-replica utilization
+        without a registry pull; cheap enough for a heartbeat cadence
+        (one short ledger lock, one attribute read)."""
+        util = {}
+        if self._led is not None:
+            util["goodput_ratio"] = self._led.goodput_ratio()
+        if self._costs is not None:
+            util["mfu"] = self._costs.mfu()
+        return util
 
     def _fail_all_locked(self, cause):
         """Breaker-open path: fail EVERY queued and in-flight request

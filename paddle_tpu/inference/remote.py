@@ -272,7 +272,13 @@ class ReplicaHost:
                 "preempt_pressure": int(srv.preempt_pressure()),
                 "health": srv.health,
                 "sketch": [int(fp) for fp in srv.prefix_sketch()],
-                "stats": jsonable(dict(srv.stats))}
+                "stats": jsonable(dict(srv.stats)),
+                # goodput ratio + MFU (ISSUE 13): routing-side views
+                # see per-replica utilization from the heartbeat
+                # alone, no registry pull ({} when neither the ledger
+                # nor the cost catalog is wired)
+                "util": jsonable(srv.utilization())
+                if callable(getattr(srv, "utilization", None)) else {}}
 
     def _push(self, msg):
         """Best-effort broadcast to every live connection (token
@@ -1047,6 +1053,16 @@ class RemoteReplica:
 
     def prefix_sketch(self):
         return self._sketch
+
+    def utilization(self):
+        """The replica's goodput ratio + MFU from its last heartbeat
+        digest (lock-free attribute read, same staleness contract as
+        the other routing reads) — ``{}`` when the remote server wires
+        neither a goodput ledger nor a cost catalog, or the wire is
+        dead (a corpse reports no utilization)."""
+        if self._wire_dead():
+            return {}
+        return dict((self._digest or {}).get("util") or {})
 
     @property
     def stats(self):

@@ -293,7 +293,14 @@ def serve_metrics(target, host="127.0.0.1", port=0):
                 {"health": rep.health,
                  "queue_depth": rep.queue_depth(),
                  "in_flight": rep.in_flight(),
-                 "stats": dict(rep.stats)}
+                 "stats": dict(rep.stats),
+                 # goodput ratio + MFU when the replica wires a
+                 # ledger/cost catalog ({} otherwise); remote replicas
+                 # answer from their last heartbeat digest — no
+                 # registry pull
+                 "util": (rep.utilization()
+                          if callable(getattr(rep, "utilization",
+                                              None)) else {})}
                 for rep in target.replicas]
             return stats
     elif hasattr(target, "stats"):        # ContinuousBatchingServer
@@ -308,6 +315,10 @@ def serve_metrics(target, host="127.0.0.1", port=0):
                 getattr(target, "goodput", None)) else None
             if g is not None:
                 stats["goodput"] = g
+            c = target.device_costs() if callable(
+                getattr(target, "device_costs", None)) else None
+            if c is not None:
+                stats["costs"] = c
             return stats
     health = None
     if hasattr(target, "health"):

@@ -22,6 +22,10 @@ observability rather than one-off profiling sessions):
   token to goodput or a named waste reason (null redirects, chunk pad,
   masked page DMAs, preemption replay, registered-tail re-prefill,
   block waste) — conservation-checked, the perf-tier baseline.
+- ``CostCatalog`` (costs.py): compiled-program cost catalog + compile
+  watch + tick-phase attribution — every dispatch priced in FLOPs/HBM
+  bytes from ``lower().compile().cost_analysis()``, recompiles after
+  warmup surfaced, MFU/roofline gauges.
 - ``SLO`` / ``SLOEngine`` (slo.py): declarative fleet SLOs over the
   merged metrics, multi-window rolling burn rates on the injectable
   clock, ok/warning/page alert states.
@@ -47,6 +51,7 @@ from .tracing import NULL_SPAN, NullSpan, Span, Tracer  # noqa: F401
 from .exposition import (MetricsServer, merge_snapshots,  # noqa: F401
                          parse_prometheus, render_prometheus,
                          render_snapshot)
+from .costs import CostCatalog  # noqa: F401
 from .flight import FlightRecorder  # noqa: F401
 from .goodput import GoodputLedger  # noqa: F401
 from .journey import Journey, JourneyRecorder  # noqa: F401
@@ -60,7 +65,7 @@ __all__ = ["MetricRegistry", "Counter", "Gauge", "Histogram",
            "MonotonicClock", "FakeClock",
            "MetricsServer", "render_prometheus", "render_snapshot",
            "merge_snapshots", "parse_prometheus",
-           "FlightRecorder", "GoodputLedger", "Journey",
+           "CostCatalog", "FlightRecorder", "GoodputLedger", "Journey",
            "JourneyRecorder", "SLO", "SLOEngine",
            "ServerTelemetry", "RouterTelemetry", "TelemetryCallback",
            "default_registry"]

@@ -18,6 +18,10 @@ fold by arithmetic mean (summing two replicas' 0.7 goodput ratios
 into an impossible 1.4 would be exactly the page no scraper could
 trust).
 
+Gauges that are RATIOS but not named ``*_ratio`` opt into mean-folding
+via ``MEAN_GAUGES`` (today: ``serving_mfu`` — two replicas at 0.4 MFU
+are a 0.4-MFU fleet, not 0.8).
+
 ``MetricsServer`` is a stdlib ThreadingHTTPServer exposing
 - ``/metrics`` — Prometheus text (scrape target),
 - ``/stats``   — the registry snapshot as JSON plus any extra
@@ -55,6 +59,10 @@ __all__ = ["render_prometheus", "render_snapshot", "merge_snapshots",
            "parse_prometheus", "MetricsServer", "snapshot_json"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# ratio-semantics gauges whose names don't end in "_ratio": folded by
+# MEAN in merge_snapshots like the *_ratio family
+MEAN_GAUGES = frozenset({"serving_mfu"})
 
 
 def _escape_help(s):
@@ -119,12 +127,13 @@ def merge_snapshots(snapshots):
     snapshot of the same shape. Counters and gauges SUM; histograms
     sum bucket-wise (identical bounds required) plus ``sum``/``count``;
     labeled children merge by label-value tuple (a child present on
-    one replica only passes through). Gauges named ``*_ratio`` fold by
-    MEAN over the replicas that report them (a ratio has no meaningful
-    sum). A metric registered with a different kind or labelnames on
-    different replicas is a config error and raises — silently mixing
-    them would render a page no scraper could trust. Inputs are never
-    mutated."""
+    one replica only passes through). Gauges named ``*_ratio`` — plus
+    the ratio-semantics names in ``MEAN_GAUGES`` (``serving_mfu``) —
+    fold by MEAN over the replicas that report them (a ratio has no
+    meaningful sum). A metric registered with a different kind or
+    labelnames on different replicas is a config error and raises —
+    silently mixing them would render a page no scraper could trust.
+    Inputs are never mutated."""
     merged = {}
     ratio_n = {}                 # (name, key) -> replicas contributing
     for snap in snapshots:
@@ -162,7 +171,9 @@ def merge_snapshots(snapshots):
                 else:
                     cur["samples"][key] = \
                         (0.0 if have is None else have) + s
-                    if m["kind"] == "gauge" and name.endswith("_ratio"):
+                    if m["kind"] == "gauge" \
+                            and (name.endswith("_ratio")
+                                 or name in MEAN_GAUGES):
                         k = (name, key)
                         ratio_n[k] = ratio_n.get(k, 0) + 1
     for (name, key), n in ratio_n.items():

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Bench-round tracker: schema'd appends to BENCHLOG.jsonl plus a
+tolerance-band regression gate (ISSUE 13).
+
+BENCHLOG.jsonl is the repo's bench trajectory — one JSON object per
+recorded round — but nothing used to validate what landed there or
+notice when a recorded number fell off a cliff. This tool closes both
+gaps:
+
+- ``append`` validates a round against THE schema (required
+  ``metric``/``value``/``unit``, optional ``vs_baseline``/``note``/
+  ``ts``; unknown keys rejected, values type- and finiteness-checked,
+  ``ts`` auto-stamped ISO-8601 UTC when absent) and appends one line.
+  Benches call the library form (``append_round``) so every entry is
+  schema-clean by construction.
+- ``check`` (also spelled ``--check``) reads the LATEST round per
+  metric and compares it against the committed tolerance bands in
+  ``scripts/bench_bands.json`` (``{metric: {"min": .., "max": ..,
+  "note": ..}}``; either bound optional). A banded metric that is
+  missing from the log, out of band, or sitting on a malformed line
+  exits 1 and names the offender — the bench trajectory is a
+  regression GATE, not a scrapbook. Metrics without bands pass
+  through (benches may record freely; promotion to a band is a
+  deliberate commit).
+
+The check validates the COMMITTED log against the COMMITTED bands — a
+pure file check, deterministic in CI, no bench re-run. Recording a new
+round that regresses a banded metric is what flips the gate.
+
+Usage:
+    python scripts/bench_track.py append --metric paged_decode_mfu \
+        --value 0.017 --unit ratio [--note "..."] [--vs-baseline 1.1]
+    python scripts/bench_track.py check          # or: --check
+    python scripts/bench_track.py check --log BENCHLOG.jsonl \
+        --bands scripts/bench_bands.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_LOG = os.path.join(REPO, "BENCHLOG.jsonl")
+DEFAULT_BANDS = os.path.join(REPO, "scripts", "bench_bands.json")
+
+REQUIRED_KEYS = ("metric", "value", "unit")
+OPTIONAL_KEYS = ("ts", "vs_baseline", "note")
+ALLOWED_KEYS = frozenset(REQUIRED_KEYS + OPTIONAL_KEYS)
+
+
+class BenchLogError(ValueError):
+    """A round or log line that violates the BENCHLOG schema, or a
+    band check that cannot even be evaluated (malformed files fail
+    the gate loudly, never silently pass)."""
+
+
+def _utc_now_iso():
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def validate_round(round_dict):
+    """Normalize + validate one bench round. Returns a NEW dict in
+    stable key order with ``ts`` stamped if absent; raises
+    ``BenchLogError`` naming the first violation."""
+    if not isinstance(round_dict, dict):
+        raise BenchLogError(f"round must be a dict, got "
+                            f"{type(round_dict).__name__}")
+    unknown = set(round_dict) - ALLOWED_KEYS
+    if unknown:
+        raise BenchLogError(
+            f"unknown round key(s) {sorted(unknown)} — allowed: "
+            f"{sorted(ALLOWED_KEYS)}")
+    for k in REQUIRED_KEYS:
+        if k not in round_dict:
+            raise BenchLogError(f"round missing required key {k!r}")
+    metric = round_dict["metric"]
+    if not isinstance(metric, str) or not metric \
+            or not all(c.isascii() and (c.isalnum() or c == "_")
+                       for c in metric):
+        raise BenchLogError(
+            f"metric must be a nonempty [A-Za-z0-9_] string, got "
+            f"{metric!r}")
+    value = round_dict["value"]
+    if isinstance(value, bool) or not isinstance(value, (int, float)) \
+            or not math.isfinite(value):
+        raise BenchLogError(f"value must be a finite number, got "
+                            f"{value!r}")
+    unit = round_dict["unit"]
+    if not isinstance(unit, str) or not unit:
+        raise BenchLogError(f"unit must be a nonempty string, got "
+                            f"{unit!r}")
+    out = {"metric": metric, "value": float(value), "unit": unit}
+    vs = round_dict.get("vs_baseline")
+    if vs is not None:
+        if isinstance(vs, bool) or not isinstance(vs, (int, float)) \
+                or not math.isfinite(vs):
+            raise BenchLogError(f"vs_baseline must be a finite number, "
+                                f"got {vs!r}")
+        out["vs_baseline"] = float(vs)
+    ts = round_dict.get("ts")
+    if ts is None:
+        ts = _utc_now_iso()
+    elif not isinstance(ts, str) or not ts:
+        raise BenchLogError(f"ts must be an ISO-8601 string, got {ts!r}")
+    out["ts"] = ts
+    note = round_dict.get("note")
+    if note is not None:
+        if not isinstance(note, str):
+            raise BenchLogError(f"note must be a string, got {note!r}")
+        out["note"] = note
+    return out
+
+
+def append_round(round_dict, path=DEFAULT_LOG):
+    """Validate ``round_dict`` and append it as one JSONL line.
+    Returns the normalized round actually written."""
+    r = validate_round(round_dict)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(json.dumps(r) + "\n")
+    return r
+
+
+def load_rounds(path=DEFAULT_LOG):
+    """Every round in the log, oldest first, schema-validated.
+    A malformed line raises ``BenchLogError`` with its line number —
+    the check must fail loudly on a corrupt log."""
+    rounds = []
+    if not os.path.exists(path):
+        return rounds
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise BenchLogError(
+                    f"{path}:{i}: not valid JSON ({e})") from e
+            try:
+                rounds.append(validate_round(obj))
+            except BenchLogError as e:
+                raise BenchLogError(f"{path}:{i}: {e}") from e
+    return rounds
+
+
+def load_bands(path=DEFAULT_BANDS):
+    """``{metric: {"min"?: float, "max"?: float, "note"?: str}}``."""
+    with open(path, encoding="utf-8") as f:
+        bands = json.load(f)
+    if not isinstance(bands, dict):
+        raise BenchLogError(f"{path}: bands file must be a JSON object")
+    for metric, band in bands.items():
+        if not isinstance(band, dict):
+            raise BenchLogError(f"{path}: band for {metric!r} must be "
+                                f"an object")
+        unknown = set(band) - {"min", "max", "note"}
+        if unknown:
+            raise BenchLogError(f"{path}: band for {metric!r} has "
+                                f"unknown key(s) {sorted(unknown)}")
+        if "min" not in band and "max" not in band:
+            raise BenchLogError(f"{path}: band for {metric!r} needs "
+                                f"min and/or max")
+        for bound in ("min", "max"):
+            v = band.get(bound)
+            if v is not None and (isinstance(v, bool)
+                                  or not isinstance(v, (int, float))
+                                  or not math.isfinite(v)):
+                raise BenchLogError(
+                    f"{path}: band for {metric!r}: {bound} must be a "
+                    f"finite number, got {v!r}")
+    return bands
+
+
+def check(log_path=DEFAULT_LOG, bands_path=DEFAULT_BANDS):
+    """Gate the log against the bands: the LATEST round of every
+    banded metric must exist and sit inside its band. Returns
+    ``(ok, [report lines])``."""
+    report = []
+    try:
+        rounds = load_rounds(log_path)
+        bands = load_bands(bands_path)
+    except (BenchLogError, OSError, json.JSONDecodeError) as e:
+        return False, [f"FAIL {e}"]
+    latest = {}
+    for r in rounds:                       # file order; last wins
+        latest[r["metric"]] = r
+    ok = True
+    for metric in sorted(bands):
+        band = bands[metric]
+        r = latest.get(metric)
+        if r is None:
+            ok = False
+            report.append(f"FAIL {metric}: banded but never recorded "
+                          f"in {os.path.basename(log_path)}")
+            continue
+        lo, hi = band.get("min"), band.get("max")
+        v = r["value"]
+        if lo is not None and v < lo:
+            ok = False
+            report.append(f"FAIL {metric}: {v} < min {lo} "
+                          f"(round ts={r['ts']})")
+        elif hi is not None and v > hi:
+            ok = False
+            report.append(f"FAIL {metric}: {v} > max {hi} "
+                          f"(round ts={r['ts']})")
+        else:
+            band_s = f"[{lo if lo is not None else '-inf'}, " \
+                     f"{hi if hi is not None else '+inf'}]"
+            report.append(f"ok   {metric}: {v} in {band_s}")
+    return ok, report
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `--check` is the documented short spelling of the subcommand
+    if argv and argv[0] == "--check":
+        argv[0] = "check"
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap_add = sub.add_parser("append", help="validate + append one round")
+    ap_add.add_argument("--metric", required=True)
+    ap_add.add_argument("--value", type=float, required=True)
+    ap_add.add_argument("--unit", required=True)
+    ap_add.add_argument("--vs-baseline", type=float, default=None)
+    ap_add.add_argument("--note", default=None)
+    ap_add.add_argument("--log", default=DEFAULT_LOG)
+    ap_chk = sub.add_parser("check", help="gate the log against the "
+                                          "committed bands")
+    ap_chk.add_argument("--log", default=DEFAULT_LOG)
+    ap_chk.add_argument("--bands", default=DEFAULT_BANDS)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "append":
+        try:
+            r = append_round({"metric": args.metric, "value": args.value,
+                              "unit": args.unit,
+                              "vs_baseline": args.vs_baseline,
+                              "note": args.note}, path=args.log)
+        except BenchLogError as e:
+            print(f"FAIL {e}", file=sys.stderr)
+            return 1
+        print(f"appended {json.dumps(r)}")
+        return 0
+    ok, report = check(log_path=args.log, bands_path=args.bands)
+    for line in report:
+        print(line)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
