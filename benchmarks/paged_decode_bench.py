@@ -15,12 +15,17 @@ Drives the same mixed-length workload — request budgets spanning
 - token parity (the paged backend is bit-identical on the XLA path),
 - steady-state GOODPUT ratio per mode (ISSUE 11: the goodput ledger's
   useful / total device tokens — the paged backend trades dense HBM
-  for masked page DMAs the ledger makes visible, and the fused-
-  megakernel / speculative-decode PRs will be judged on moving this
-  number).
+  for masked page DMAs the ledger makes visible),
+- the FUSED serving tick (ISSUE 14, ``serving_mode="fused"``): one
+  launch per tick over a live-page DMA schedule — tokens/s, goodput
+  ratio (the acceptance bar: >= 10x the split paged ratio, because
+  ``skipped_page_dma`` collapses to the schedule's ladder pad and
+  ``null_redirect`` to zero), dispatches per tick, and the fused
+  program's compiled FLOPs/HBM-bytes per token next to the split
+  decode program's.
 
     python benchmarks/paged_decode_bench.py [--model tiny|350m]
-        [--slots N] [--cache-len N] [--page-size N]
+        [--slots N] [--cache-len N] [--page-size N] [--track]
 """
 import os
 import sys
@@ -47,7 +52,22 @@ def _mixed_requests(rng, max_cache_len, n_requests):
     return reqs
 
 
-def _drain(srv, reqs):
+def _warm_reqs(reqs, rng):
+    """Same (prompt_len, budget) pairs — so the warm drain visits the
+    same compile-geometry ladder points — but FRESH tokens, so the
+    auto prefix cache stays cold for the timed drain."""
+    return [(rng.integers(0, 256, (len(p),)).astype(np.int32), n)
+            for p, n in reqs]
+
+
+def _drain(srv, reqs, warm=None):
+    if warm is not None:
+        # untimed compile-warm pass: tokens/s below measures the
+        # steady state, not XLA (the ladder compile counts are still
+        # reported from the cost catalog)
+        for p, n in warm:
+            srv.submit(p, max_new_tokens=n)
+        srv.run()
     t0 = time.perf_counter()
     rids = [srv.submit(p, max_new_tokens=n) for p, n in reqs]
     outs = srv.run()
@@ -78,6 +98,7 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
 
     rng = np.random.default_rng(0)
     reqs = _mixed_requests(rng, cache_len, n_requests)
+    warm = _warm_reqs(reqs, rng)
     extents = sorted((len(p) + n for p, n in reqs), reverse=True)
     # pool = worst-case concurrent working set (+1 null page, + one
     # page per slot of block-boundary slack)
@@ -90,7 +111,7 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     dense = ContinuousBatchingServer(model, max_slots=slots,
                                      max_cache_len=cache_len,
                                      ledger=led_d)
-    outs_d, toks_d, dt_d = _drain(dense, reqs)
+    outs_d, toks_d, dt_d = _drain(dense, reqs, warm=warm)
     hbm_d = PagedKVCache.dense_hbm_bytes(slots, cache_len, L, kvh, hd,
                                          itemsize)
     good_d = led_d.snapshot()
@@ -107,7 +128,7 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
                                      page_size=page_size,
                                      num_pages=num_pages,
                                      ledger=led_p, costs=cat)
-    outs_p, toks_p, dt_p = _drain(paged, reqs)
+    outs_p, toks_p, dt_p = _drain(paged, reqs, warm=warm)
     hbm_p = PagedKVCache.paged_hbm_bytes(num_pages, page_size, L, kvh,
                                          hd, itemsize)
     # the costed dispatch path runs the catalog's AOT executable
@@ -134,8 +155,12 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     costs = cat.snapshot()
     dec = costs["ops"].get("decode", {"flops": 0.0, "hbm_bytes": 0.0,
                                       "dispatches": 0})
-    flops_tok = dec["flops"] / max(toks_p, 1)
-    bytes_tok = dec["hbm_bytes"] / max(toks_p, 1)
+    # catalog totals span the warm + timed drains; per-token divides
+    # by ALL generated tokens (no eos in this workload, so the warm
+    # drain generated exactly its budgets)
+    warm_toks = sum(n for _, n in warm)
+    flops_tok = dec["flops"] / max(toks_p + warm_toks, 1)
+    bytes_tok = dec["hbm_bytes"] / max(toks_p + warm_toks, 1)
     mfu = costs["mfu"] if costs["mfu"] is not None else 0.0
     print(f"device cost (compiled decode program): "
           f"{flops_tok:10,.0f} FLOPs/tok  {bytes_tok:10,.0f} HBM B/tok  "
@@ -146,6 +171,45 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     print(f"token parity dense vs paged: {parity}")
     if hbm_d < 2 * hbm_p:
         print("WARNING: <2x HBM reduction — workload not mixed enough?")
+
+    # ------------------------------------------------ fused serving tick
+    led_f = GoodputLedger()
+    cat_f = CostCatalog()
+    fused = ContinuousBatchingServer(model, max_slots=slots,
+                                     max_cache_len=cache_len,
+                                     cache_backend="paged",
+                                     page_size=page_size,
+                                     num_pages=num_pages,
+                                     serving_mode="fused",
+                                     ledger=led_f, costs=cat_f)
+    outs_f, toks_f, dt_f = _drain(fused, reqs, warm=warm)
+    good_f = led_f.snapshot()
+    print(f"fused: {toks_f / dt_f:8,.0f} tok/s   "
+          f"cache HBM {hbm_p / 2**20:8.2f} MiB (same pool)   "
+          f"goodput {good_f['goodput_ratio']:.3f}")
+    waste_f = {k: v for k, v in sorted(good_f["tokens"].items())
+               if k != "goodput"}
+    print(f"fused waste breakdown (tokens): {waste_f}")
+    disp_tick = fused.stats["tick_dispatches"]
+    print(f"fused dispatches: {disp_tick} across warm + timed drains "
+          f"(one per tick; split admission ticks add prefill + "
+          f"state_push + block_table on top of decode)")
+    costs_f = cat_f.snapshot()
+    fop = costs_f["ops"].get("fused", {"flops": 0.0, "hbm_bytes": 0.0})
+    print(f"device cost (compiled fused program):  "
+          f"{fop['flops'] / max(toks_f + warm_toks, 1):10,.0f} "
+          f"FLOPs/tok  "
+          f"{fop['hbm_bytes'] / max(toks_f + warm_toks, 1):10,.0f} "
+          f"HBM B/tok  (compiles {costs_f['compiles']} on the "
+          f"geometry ladder, recompiles {costs_f['recompiles']})")
+    ratio_gain = good_f["goodput_ratio"] / max(good_p["goodput_ratio"],
+                                               1e-9)
+    parity_f = all(np.array_equal(a, b) for a, b in zip(outs_d, outs_f))
+    print(f"token parity dense vs fused: {parity_f}")
+    fused_ok = parity_f and ratio_gain >= 10.0
+    print(f"goodput gain fused/split: {ratio_gain:,.0f}x "
+          f"({'OK' if ratio_gain >= 10.0 else 'REGRESSION'}; "
+          f"ISSUE 14 acceptance bar is 10x)")
     if track:
         import importlib.util
         spec = importlib.util.spec_from_file_location(
@@ -164,12 +228,16 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
                 ("paged_decode_flops_per_token", flops_tok, "flops"),
                 ("paged_decode_hbm_bytes_per_token", bytes_tok,
                  "bytes"),
-                ("paged_decode_mfu", mfu, "ratio")):
+                ("paged_decode_mfu", mfu, "ratio"),
+                ("fused_decode_tokens_per_sec", toks_f / dt_f,
+                 "tokens/s"),
+                ("fused_paged_goodput_ratio", good_f["goodput_ratio"],
+                 "ratio")):
             r = bench_track.append_round(
                 {"metric": metric, "value": value, "unit": unit,
                  "note": note})
             print(f"tracked {r['metric']} = {r['value']}")
-    return 0 if parity else 1
+    return 0 if parity and fused_ok else 1
 
 
 if __name__ == "__main__":

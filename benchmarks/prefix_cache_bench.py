@@ -10,6 +10,11 @@ every request is ``system_prompt + short user tail`` — through
   auto hit pays the page-gather -> dense-seed -> scatter detour),
 - ``ragged on``  auto prefix cache + batched ragged prefill straight
   into pool pages (ISSUE 6, the paged default),
+- ``fused  on``  auto prefix cache + the FUSED serving tick (ISSUE 14,
+  ``serving_mode="fused"``): every admission tick is ONE launch —
+  prefill chunks and decode rows together over a live-page DMA
+  schedule — so TTFT sheds the split path's per-admission dispatch
+  overhead,
 
 and reports:
 
@@ -32,7 +37,7 @@ and reports:
 
     python benchmarks/prefix_cache_bench.py [--requests N]
         [--system-tokens N] [--tail-tokens N] [--new-tokens N]
-        [--slots N] [--num-pages N] [--reps N] [--budget N]
+        [--slots N] [--num-pages N] [--reps N] [--budget N] [--track]
 """
 import argparse
 import os
@@ -62,14 +67,15 @@ def _prompts(args):
          .astype(np.int32)]) for _ in range(args.requests)]
 
 
-def _drain(model, prompts, args, auto, prefill_mode):
+def _drain(model, prompts, args, auto, prefill_mode,
+           serving_mode="split"):
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
     srv = ContinuousBatchingServer(
         model, max_slots=args.slots, max_cache_len=args.max_cache_len,
         cache_backend="paged", page_size=args.page_size,
         num_pages=args.num_pages, auto_prefix_cache=auto,
-        prefill_mode=prefill_mode,
+        prefill_mode=prefill_mode, serving_mode=serving_mode,
         prefill_tokens_per_tick=args.budget)
     for p in prompts[:args.slots]:                  # warm the compiles
         srv.submit(p, max_new_tokens=2)
@@ -77,6 +83,15 @@ def _drain(model, prompts, args, auto, prefill_mode):
     for p in prompts[:2]:       # warm the HIT path's programs too (the
         srv.submit(p, max_new_tokens=2)   # remainder chunk geometry
     srv.run()                             # differs from the cold one)
+    n_warm = min(args.requests, args.slots) + min(args.requests, 2)
+    if serving_mode == "fused":
+        # the fused (C, W, G) geometry ladder depends on the FULL
+        # admission mix — one untimed full pass keeps ladder compiles
+        # out of the timed reps' TTFT tail
+        for p in prompts:
+            srv.submit(p, max_new_tokens=args.new_tokens)
+        srv.run()
+        n_warm += args.requests
     best = float("inf")
     ttfts = []
     for _ in range(args.reps):
@@ -95,7 +110,7 @@ def _drain(model, prompts, args, auto, prefill_mode):
         assert all(r in outs for r in submits)
         ttfts += [first_seen[r] - t for r, t in submits.items()
                   if r in first_seen]
-    return best, ttfts, srv
+    return best, ttfts, srv, n_warm
 
 
 def _row(name, t_wall, ttfts, srv):
@@ -124,22 +139,27 @@ def main():
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--budget", type=int, default=None,
                     help="prefill_tokens_per_tick (ragged mode)")
+    ap.add_argument("--track", action="store_true",
+                    help="append fused TTFT round to BENCHLOG.jsonl")
     args = ap.parse_args()
 
     model = _build_model()
     prompts = _prompts(args)
-    t_off, tt_off, off = _drain(model, prompts, args, auto=False,
-                                prefill_mode="dense")
-    t_dn, tt_dn, dense_on = _drain(model, prompts, args, auto=True,
+    t_off, tt_off, off, _ = _drain(model, prompts, args, auto=False,
                                    prefill_mode="dense")
-    t_rg, tt_rg, ragged = _drain(model, prompts, args, auto=True,
-                                 prefill_mode="ragged")
+    t_dn, tt_dn, dense_on, w_dn = _drain(model, prompts, args,
+                                         auto=True,
+                                         prefill_mode="dense")
+    t_rg, tt_rg, ragged, w_rg = _drain(model, prompts, args, auto=True,
+                                       prefill_mode="ragged")
+    t_fu, tt_fu, fused, w_fu = _drain(model, prompts, args, auto=True,
+                                      prefill_mode="ragged",
+                                      serving_mode="fused")
 
-    # total admissions incl. warmup, derived from what _drain actually
-    # submits (prompts[:slots] cold + prompts[:2] hit-path warmers —
-    # both clamp when --requests is small)
+    # per-server admission counts incl. warmup (_drain returns how
+    # many warmers it submitted; only the FIRST wave — submitted
+    # before any donation — is structurally cold)
     warm = min(args.requests, args.slots)   # pre-donation => cold
-    n_req = args.requests * args.reps + warm + min(args.requests, 2)
     shared_run = args.system_tokens // args.page_size * args.page_size
 
     print(f"workload: {args.requests} requests x {args.reps} reps "
@@ -149,9 +169,13 @@ def main():
     _row("auto off", t_off, tt_off, off)
     d_dn = _row("dense  on", t_dn, tt_dn, dense_on)
     d_rg = _row("ragged on", t_rg, tt_rg, ragged)
+    d_fu = _row("fused  on", t_fu, tt_fu, fused)
 
     ok = True
-    for name, srv in (("dense", dense_on), ("ragged", ragged)):
+    for name, srv, n_warm in (("dense", dense_on, w_dn),
+                              ("ragged", ragged, w_rg),
+                              ("fused", fused, w_fu)):
+        n_req = args.requests * args.reps + n_warm
         hits = srv.stats["prefix_auto_hits"]
         steady = hits / max(n_req - warm, 1)
         print(f"{name:6s} hit rate  : steady-state {hits}/{n_req - warm}"
@@ -174,6 +198,29 @@ def main():
           f"counters are the signal, CPU wall time is "
           f"dispatch-dominated)")
     ok = ok and d_rg < d_dn
+    # ISSUE 14: the fused tick IS the admission dispatch — exactly one
+    # launch carries each admission wave's chunks
+    print(f"fused  dispatches : {d_fu:.2f} per admission "
+          f"({'OK' if d_fu <= d_rg else 'REGRESSION'}; the launch "
+          f"doubles as the decode tick)")
+    ok = ok and d_fu <= d_rg
+    if args.track:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "bench_track", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "scripts", "bench_track.py"))
+        bench_track = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench_track)
+        p50 = float(np.percentile(tt_fu, 50) * 1e3) if tt_fu else 0.0
+        r = bench_track.append_round(
+            {"metric": "fused_prefix_ttft_p50_ms", "value": p50,
+             "unit": "ms",
+             "note": f"{args.requests} reqs x {args.reps} reps, "
+                     f"system {args.system_tokens} tok, CPU "
+                     f"llama_tiny; serving_mode=fused"})
+        print(f"tracked {r['metric']} = {r['value']:.1f}")
     return 0 if ok else 1
 
 

@@ -278,7 +278,7 @@ def _paged_attend(q, k_pool, v_pool, bt, t, scale):
     return paged_attention(q[:, 0], k_pool, v_pool, bt, t + 1, scale)[:, None]
 
 
-def _page_write_seq(pool, kv, bt, t):
+def _page_write_seq(pool, kv, bt, t, last=None):
     """Ragged-prefill page write: pool [P, pg, h, hd] <- kv
     [B, s, h, hd] at per-slot position runs [t_b, t_b + s). The
     multi-token analogue of ``_page_write`` with the same null-page
@@ -288,7 +288,13 @@ def _page_write_seq(pool, kv, bt, t):
     would poison every slot's attention through 0-weight reads).
     Positions inside the table but past a slot's allocation land in its
     NULL_PAGE tail entries — finite garbage the length masks hide,
-    exactly like a wasted decode step."""
+    exactly like a wasted decode step.
+
+    ``last`` ([B] int32, optional): each slot's last VALID position —
+    rows past it are null-redirected zeroed too. The fused tick passes
+    it so a decode slot's C-row group writes exactly its one token
+    (the C-1 pad rows never touch the slot's real pages) and an idle
+    slot (``last = -1``) writes nothing at all."""
     pg = pool.shape[1]
     b, s = kv.shape[0], kv.shape[1]
     maxp = bt.shape[1]
@@ -297,6 +303,8 @@ def _page_write_seq(pool, kv, bt, t):
     P = _positions(t, b, s)                              # [B, s]
     pidx = P // pg
     oob = pidx >= maxp
+    if last is not None:
+        oob = jnp.logical_or(oob, P > last[:, None])
     page = jnp.where(
         oob, jnp.int32(0),
         jnp.take_along_axis(bt, jnp.minimum(pidx, maxp - 1), axis=1))
@@ -327,7 +335,22 @@ def _paged_prefill_attend(q, k_pool, v_pool, bt, t, scale):
                                     sm_scale=scale)
 
 
-def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
+def _fused_attend(q, k_pool, v_pool, bt, t, last, dec, ss, sp, scale):
+    """Fused mixed prefill/decode tick attention through the LIVE
+    block-table slice: q [B, C, nh, hd] packed row groups (a prefill
+    chunk, a single decode row, or idle garbage per slot) at per-slot
+    offsets ``t``, DMA schedule ``(ss, sp)`` covering only live pages
+    (ops/pallas/fused_tick.py). Decode slots (``dec``) route through
+    an s=1-shaped fallback einsum so fused serving stays bit-identical
+    to the unfused decode program; idle slots (``last < 0``) read as
+    zeros."""
+    from ..ops.pallas.fused_tick import fused_tick_attention
+    return fused_tick_attention(q, k_pool, v_pool, bt, t, last, dec,
+                                ss, sp, sm_scale=scale)
+
+
+def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None,
+                   fused=None):
     """Shared llama-family attention sublayer for the decode scan:
     pre-RMSNorm, rope at absolute positions, GQA cache write + masked
     cached attention, output projection + residual. ``lc`` is this
@@ -338,7 +361,12 @@ def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
     PREFILL chunk — K/V written straight into pool pages at per-slot
     offsets ``t`` and attended causally through the block table, which
     is what lets the server prefill several admissions as one launch
-    with no dense-cache detour.
+    with no dense-cache detour. ``fused`` (a ``(last, dec, ss, sp)``
+    tuple) switches the paged s > 1 path to the FUSED TICK: ``bt`` is
+    then the live block-table slice, rows past ``last`` null-redirect
+    zeroed on write, and attention runs the fused kernel whose DMA
+    schedule ``(ss, sp)`` covers only live pages — prefill chunks and
+    s=1 decode rows (``dec``) of one serving tick in a single launch.
     Returns (xx, lc, h2) with h2 = the post-attention norm for the FFN."""
     b, s, nh, kvh, hd, scale = dims
     cos, sin = tables
@@ -349,7 +377,13 @@ def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None):
     v = _mm(h, blk["wv"]).reshape(b, s, kvh, hd)
     q = rope_mod._apply_rotary_jnp(q, cos, sin, position_ids=pos)
     k = rope_mod._apply_rotary_jnp(k, cos, sin, position_ids=pos)
-    if bt is not None and s > 1:
+    if bt is not None and fused is not None:
+        last, dec, ss, sp = fused
+        lc = {"k": _page_write_seq(lc["k"], k, bt, t, last=last),
+              "v": _page_write_seq(lc["v"], v, bt, t, last=last)}
+        att = _fused_attend(q, lc["k"], lc["v"], bt, t, last, dec,
+                            ss, sp, scale)
+    elif bt is not None and s > 1:
         lc = {"k": _page_write_seq(lc["k"], k, bt, t),
               "v": _page_write_seq(lc["v"], v, bt, t)}
         att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t, scale)
@@ -398,6 +432,44 @@ def _make_ragged_prefill_fn(step_fn, head_fn, embed_tokens):
         return head_fn(rows)[:, -1], caches
 
     return ragged_prefill
+
+
+def _make_fused_tick_fn(fused_step, head_fn, embed_tokens):
+    """Build the paged bundle's FUSED-TICK entry point (ISSUE 14): one
+    whole serving tick — every slot's prefill chunk at its prefix
+    offset AND every live slot's s=1 decode row — as ONE program, K/V
+    written straight into pool pages and attended through a DMA
+    schedule that covers only live pages (ops/pallas/fused_tick.py).
+
+    Signature: ``(tokens [S, C], t0 [S], last [S], dec [S], caches,
+    out_idx [S], bt_live [S, W], sched_slot [G], sched_page [G]) ->
+    (logits [S, V], caches)``. Per slot: a prefill chunk carries
+    ``t0 = fill position``, ``last = t0 + take - 1``; a decode row
+    carries its token in column 0 with ``t0 = last = t`` (the write
+    position) and ``dec = 1``; an idle slot carries ``last = -1`` (its
+    writes null-redirect zeroed, the kernel skips it entirely).
+    ``out_idx`` picks the logits row — the last prompt token for a
+    completing prefill, row 0 for decode. ``bt_live`` is the block
+    tables SLICED to the live page frontier and ``(sched_slot,
+    sched_page)`` the pow2-padded live-page DMA schedule
+    (``fused_tick.build_schedule``), so the compiled program's HBM
+    traffic scales with live tokens, not the configured cache length.
+    Geometry (C, W, G) rides pow2 ladders — compiles stay O(log).
+
+    Returned RAW (unjitted), unlike the prefill/ragged entries: the
+    server composes its sampling epilogue around it and jits the WHOLE
+    tick as one program, which is what collapses the per-tick dispatch
+    histogram to ``{"fused": 1}``."""
+    def fused_tick(tokens, t0, last, dec, caches, out_idx, bt_live,
+                   sched_slot, sched_page):
+        S = tokens.shape[0]
+        x = embed_tokens(tokens, t0)
+        out, caches = fused_step(x, caches, t0, last, dec, bt_live,
+                                 sched_slot, sched_page)
+        rows = out[jnp.arange(S), out_idx][:, None]        # [S, 1, H]
+        return head_fn(rows)[:, -1], caches
+
+    return fused_tick
 
 
 def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
@@ -458,17 +530,16 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
     def embed_fn(tok, t):
         return p["table"][tok][:, None, :]
 
-    def step_fn(x, caches, t):
+    def _run_layers(x, caches, t, bt, fused=None):
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
         pos = _positions(t, b, s)                         # [B, s]
-        bt = caches["bt"] if paged else None
 
         def layer(xx, xs):
             blk, lc = xs
             xx, lc, h2 = _rope_gqa_attn(
                 blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
-                (cos, sin), eps, bt=bt)
+                (cos, sin), eps, bt=bt, fused=fused)
             xx = xx + _mm(jax.nn.silu(_mm(h2, blk["wg"]))
                           * _mm(h2, blk["wu"]), blk["wd"])
             return xx, lc
@@ -481,14 +552,22 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
         return x, new_caches
 
+    def step_fn(x, caches, t):
+        return _run_layers(x, caches, t, caches["bt"] if paged else None)
+
+    def fused_step(x, caches, t, last, dec, bt_live, ss, sp):
+        return _run_layers(x, caches, t, bt_live,
+                           fused=(last, dec, ss, sp))
+
     def head_fn(out):
         return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
                 ).astype(jnp.float32)
 
     if paged:
-        ragged = _make_ragged_prefill_fn(
-            step_fn, head_fn, lambda tokens, t0: p["table"][tokens])
-        return init_caches, embed_fn, step_fn, head_fn, ragged
+        embed_tokens = lambda tokens, t0: p["table"][tokens]
+        ragged = _make_ragged_prefill_fn(step_fn, head_fn, embed_tokens)
+        fused = _make_fused_tick_fn(fused_step, head_fn, embed_tokens)
+        return init_caches, embed_fn, step_fn, head_fn, ragged, fused
     return init_caches, embed_fn, step_fn, head_fn
 
 
@@ -576,17 +655,16 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
     def embed_fn(tok, t):
         return p["table"][tok][:, None, :]
 
-    def step_fn(x, caches, t):
+    def _run_layers(x, caches, t, bt, fused=None):
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
         pos = _positions(t, b, s)
-        bt = caches["bt"] if paged else None
 
         def layer(xx, xs):
             blk, lc = xs
             xx, lc, h2 = _rope_gqa_attn(
                 blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
-                (cos, sin), eps, bt=bt)
+                (cos, sin), eps, bt=bt, fused=fused)
             xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
                                     blk["wu"], blk["wd"], top_k)
             return xx, lc
@@ -599,14 +677,22 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
         return x, new_caches
 
+    def step_fn(x, caches, t):
+        return _run_layers(x, caches, t, caches["bt"] if paged else None)
+
+    def fused_step(x, caches, t, last, dec, bt_live, ss, sp):
+        return _run_layers(x, caches, t, bt_live,
+                           fused=(last, dec, ss, sp))
+
     def head_fn(out):
         return (_rms(unwrap(out), p["norm"], eps) @ p["head"]
                 ).astype(jnp.float32)
 
     if paged:
-        ragged = _make_ragged_prefill_fn(
-            step_fn, head_fn, lambda tokens, t0: p["table"][tokens])
-        return init_caches, embed_fn, step_fn, head_fn, ragged
+        embed_tokens = lambda tokens, t0: p["table"][tokens]
+        ragged = _make_ragged_prefill_fn(step_fn, head_fn, embed_tokens)
+        fused = _make_fused_tick_fn(fused_step, head_fn, embed_tokens)
+        return init_caches, embed_fn, step_fn, head_fn, ragged, fused
     return init_caches, embed_fn, step_fn, head_fn
 
 
@@ -668,10 +754,9 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
             pos_emb = pos_emb[None]
         return (p["table"][tok] + pos_emb)[:, None, :]
 
-    def step_fn(x, caches, t):
+    def _run_layers(x, caches, t, bt, fused=None):
         x = unwrap(x)
         b, s = x.shape[0], x.shape[1]
-        bt = caches["bt"] if paged else None
 
         def layer(xx, xs):
             blk, lc = xs
@@ -679,7 +764,13 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
             qkv = (_mm(h, blk["attn.qkv.weight"]) + blk["attn.qkv.bias"]
                    ).reshape(b, s, 3, nh, hd)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            if paged and s > 1:              # ragged prefill chunk
+            if paged and fused is not None:  # fused serving tick
+                last, dec, ss, sp = fused
+                lc = {"k": _page_write_seq(lc["k"], k, bt, t, last=last),
+                      "v": _page_write_seq(lc["v"], v, bt, t, last=last)}
+                att = _fused_attend(q, lc["k"], lc["v"], bt, t, last,
+                                    dec, ss, sp, scale)
+            elif paged and s > 1:            # ragged prefill chunk
                 lc = {"k": _page_write_seq(lc["k"], k, bt, t),
                       "v": _page_write_seq(lc["v"], v, bt, t)}
                 att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t,
@@ -711,6 +802,13 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
         x, new_caches = jax.lax.scan(layer, x, (blk_tree, caches))
         return x, new_caches
 
+    def step_fn(x, caches, t):
+        return _run_layers(x, caches, t, caches["bt"] if paged else None)
+
+    def fused_step(x, caches, t, last, dec, bt_live, ss, sp):
+        return _run_layers(x, caches, t, bt_live,
+                           fused=(last, dec, ss, sp))
+
     def head_fn(out):
         h = _ln(unwrap(out), p["lnf_w"], p["lnf_b"], eps)
         return (h @ p["table"].T).astype(jnp.float32)
@@ -725,7 +823,9 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
 
         ragged = _make_ragged_prefill_fn(step_fn, head_fn,
                                          gpt_embed_tokens)
-        return init_caches, embed_fn, step_fn, head_fn, ragged
+        fused = _make_fused_tick_fn(fused_step, head_fn,
+                                    gpt_embed_tokens)
+        return init_caches, embed_fn, step_fn, head_fn, ragged, fused
     return init_caches, embed_fn, step_fn, head_fn
 
 
@@ -768,14 +868,22 @@ class GenerationMixin:
                 f"generate() not wired for {type(self).__name__}")
         # one prefill program per (bundle, prompt-shape): jit here, not
         # inside generate(), so repeated calls reuse the compile. Paged
-        # bundles carry a SIXTH element: the jitted ragged-prefill
+        # bundles carry a SIXTH element — the jitted ragged-prefill
         # entry point (packed multi-slot prompt chunks straight into
-        # pool pages; see _make_ragged_prefill_fn) — dense bundles stay
-        # 5-tuples for existing consumers (deploy_decode, speculative).
-        ragged = bundle[4:5]
+        # pool pages; see _make_ragged_prefill_fn) — and a SEVENTH:
+        # the RAW fused-tick entry point (_make_fused_tick_fn; one
+        # whole serving tick — prefill chunks + s=1 decode rows — as
+        # one program over a live-page DMA schedule). The fused entry
+        # stays unjitted so the server can compose its sampling
+        # epilogue around it and jit the WHOLE tick as one dispatch.
+        # Dense bundles stay 5-tuples for existing consumers
+        # (deploy_decode, speculative).
+        extras = bundle[4:]
         bundle = bundle[:4] + (jax.jit(bundle[2], donate_argnums=(1,)),)
-        if ragged:
-            bundle = bundle + (jax.jit(ragged[0], donate_argnums=(2,)),)
+        if extras:
+            bundle = bundle + (jax.jit(extras[0], donate_argnums=(2,)),)
+            if len(extras) > 1:
+                bundle = bundle + (extras[1],)
         cached[key] = bundle
         # each bundle closes over a full stacked weight copy: cap the
         # cache (LRU) so varied generate() shapes can't accumulate

@@ -22,14 +22,21 @@ layer turns the serving stack's host->device dispatch profile (PR 9's
   flops 0, documented per site.
 - **Compile watch**: trace/lower/compile of each new signature is
   timed (``server_compiles_total{op}``, ``serving_compile_seconds``)
-  and, once the catalog has WARMED (``warm_after_ticks`` consecutive
-  charged ticks without a compile), any further compile is flagged a
+  and, once an OP has WARMED (``warm_after_ticks`` consecutive
+  charged ticks without a compile of THAT op — warmup is per-op,
+  ISSUE 14 satellite), any further compile of it is flagged a
   RECOMPILE — the server lands it as a flight-recorder event and a
   ``compile_stall`` journey phase on every request parked behind the
   stalled tick, so an XLA-induced latency spike is attributable
-  instead of mystery.
+  instead of mystery. Per-op warmup keeps ops independent: the fused
+  program's pow2 geometry ladder (new chunk-width / schedule-length
+  signatures while traffic shapes are still being explored) neither
+  trips alarms for an op still climbing its own ladder nor holds the
+  decode program's shape-leak watch hostage. ``warmed`` (the global
+  view) is true once every compiled op has warmed.
 - **Tick-phase attribution**: the server splits each tick's wall into
-  phases (admission / prefill_launch / decode_launch / token_callbacks
+  phases (admission / prefill_launch / decode_launch / fused_launch
+  / token_callbacks
   / bookkeeping) through ``phase_timer()``; phases publish as
   ``serving_tick_phase_seconds{phase}`` and ride the recorder's
   per-tick events — the host-bound-vs-device-bound verdict the
@@ -88,7 +95,7 @@ COMPILE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 PHASE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 TICK_PHASES = ("admission", "prefill_launch", "decode_launch",
-               "token_callbacks", "bookkeeping")
+               "fused_launch", "token_callbacks", "bookkeeping")
 
 # CPU-safe placeholder peaks: any positive number keeps the MFU gauge
 # well-defined without hardware introspection; inject the real chip
@@ -173,9 +180,12 @@ class CostCatalog:
         self._compiles = {}       # op -> count
         self._compile_s_total = 0.0
         self._ticks = 0
-        self._quiet_ticks = 0     # charged ticks since the last compile
-        self._compiled_since_flush = False
-        self.warmed = False
+        # PER-OP compile watch (ISSUE 14 satellite): each op warms
+        # after warm_after_ticks consecutive charged ticks without a
+        # compile of THAT op, independently of the others' ladders
+        self._quiet = {}          # op -> charged ticks since its compile
+        self._warm = set()        # ops whose recompile alarm is armed
+        self._compiled_ops = set()   # ops compiled since the last flush
         self.recompiles = 0
         self.price_errors = 0
         self._last_phases = {}
@@ -258,11 +268,14 @@ class CostCatalog:
         dt = self.clock.now() - t0
         prog = _PricedProgram(self, op, key[1], run, flops, hbm, dt)
         prog.compiled_now = priced
-        prog.recompile = priced and self.warmed
+        # per-op alarm: only a compile of an op whose OWN watch armed
+        # (warm_after_ticks charged ticks without one) is a recompile —
+        # another op's ladder climb neither arms nor trips this one
+        prog.recompile = priced and op in self._warm
         self._programs[key] = prog
         if priced:
-            self._compiled_since_flush = True
             with self._lock:
+                self._compiled_ops.add(op)
                 self._compiles[op] = self._compiles.get(op, 0) + 1
                 self._compile_s_total += dt
                 if prog.recompile:
@@ -319,13 +332,17 @@ class CostCatalog:
     # ----------------------------------------------------------- flush
     def flush_tick(self):
         """Fold the tick's charges + phases into cumulative totals,
-        publish metrics, and advance the compile watch's warmup: a
-        CHARGED tick without a compile is quiet; ``warm_after_ticks``
-        consecutive quiet ticks arm recompile detection. Returns the
-        tick's ``{op: (flops, bytes, dispatches)}``, or None when
-        nothing was charged — an idle serve-loop poll, whose phase
-        scraps are DISCARDED (publishing microsecond "ticks" at the
-        poll rate would drown the phase histogram in idle noise)."""
+        publish metrics, and advance the compile watch's PER-OP
+        warmup: a charged tick is quiet FOR AN OP when that op did not
+        compile in it; ``warm_after_ticks`` consecutive quiet ticks
+        arm that op's recompile detection (warmth is sticky — a later
+        ladder climb alarms, which is the attribution the watch
+        exists to give, but never arms or trips another op's watch).
+        Returns the tick's ``{op: (flops, bytes, dispatches)}``, or
+        None when nothing was charged — an idle serve-loop poll,
+        whose phase scraps are DISCARDED (publishing microsecond
+        "ticks" at the poll rate would drown the phase histogram in
+        idle noise)."""
         tick, self._tick = self._tick, {}
         phases, self._phases = self._phases, {}
         if not tick:
@@ -357,14 +374,17 @@ class CostCatalog:
             if phases:
                 self._last_phases = phases
             self._ticks += 1
-            if self._compiled_since_flush:
-                self._quiet_ticks = 0
-            else:
-                self._quiet_ticks += 1
-                if not self.warmed \
-                        and self._quiet_ticks >= self._warm_after:
-                    self.warmed = True
-            self._compiled_since_flush = False
+            # advance every ever-compiled op's watch: compiled this
+            # flush -> its quiet run restarts; otherwise one more
+            # quiet charged tick toward (or past) its warm threshold
+            for op in self._compiles:
+                if op in self._compiled_ops:
+                    self._quiet[op] = 0
+                else:
+                    self._quiet[op] = self._quiet.get(op, 0) + 1
+                    if self._quiet[op] >= self._warm_after:
+                        self._warm.add(op)
+            self._compiled_ops.clear()
             if mfu is not None:
                 self._last_mfu = mfu
                 self._last_roofline = roofline
@@ -393,6 +413,20 @@ class CostCatalog:
         return tick or None
 
     # ------------------------------------------------------------ read
+    @property
+    def warmed(self):
+        """Global warm view: every op that has ever compiled has
+        finished its own ``warm_after_ticks`` quiet run. (Per-op warm
+        state drives the recompile alarms; see ``warm_ops`` in
+        ``snapshot()``.)"""
+        with self._lock:
+            return bool(self._compiles) \
+                and all(op in self._warm for op in self._compiles)
+
+    def warmed_op(self, op):
+        """Whether ``op``'s own recompile alarm is armed."""
+        return op in self._warm
+
     def mfu(self):
         """The last charged tick's model-FLOPs utilization (achieved
         FLOP/s over ``peak_flops``), or None before any charged tick
@@ -429,7 +463,9 @@ class CostCatalog:
                 "compile_seconds": self._compile_s_total,
                 "cataloged_programs": len(self._programs),
                 "recompiles": self.recompiles,
-                "warmed": self.warmed,
+                "warmed": bool(self._compiles) and all(
+                    op in self._warm for op in self._compiles),
+                "warm_ops": sorted(self._warm),
                 "price_errors": self.price_errors,
                 "ticks": self._ticks,
                 "mfu": self._last_mfu,

@@ -57,10 +57,17 @@ OPT_OUT = "no-roadmap:"
 # NotImplementedError: (repo-relative file, keyword its message must
 # mention). ISSUE 8: the optimistic-admission mode dispatch — the
 # optimistic+dense combo must refuse with a pointer, not silently
-# half-work or lose its annotation.
+# half-work or lose its annotation. ISSUE 14: the fused serving tick
+# runs ONE decode row per slot — tick_block > 1 is the speculative
+# multi-token verify shape (ROADMAP item 6) and must refuse with a
+# pointer until that lands. (ISSUE 14 LIFTED the PR-6 skipped-page-DMA
+# and null-redirect cuts for serving_mode="fused"; the split kernels
+# keep them as the documented baseline, no refusal site involved.)
 REQUIRED_CUTS = (
     (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
      "optimistic"),
+    (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
+     "tick_block"),
 )
 
 

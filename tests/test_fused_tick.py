@@ -1,0 +1,601 @@
+"""Fused mixed prefill/decode megakernel — one launch per serving tick
+(ISSUE 14).
+
+Four layers of coverage:
+
+- Schedule + kernel: ``build_schedule`` lists exactly the live pages
+  slot-major with a quarter-octave sentinel pad; the Pallas fused-tick
+  kernel
+  (interpret mode) matches the gather reference on mixed phases —
+  prefill chunks at prefix offsets, single decode rows, idle slots —
+  skips idle slots to exact zeros, and never reads past a row's causal
+  frontier. The XLA reference's masked softmax is BITWISE invariant to
+  the gathered frame's extent (the platform assumption the server's
+  live-width pow2 ladder rides; if this ever fails, the ladder must be
+  pinned to the full table width).
+- Server parity: ``serving_mode="fused"`` emits tokens bit-identical
+  to the split ragged path AND the dense backend (greedy + seeded
+  sampling, mixed lengths, chunk-straddling budgets, auto-hit
+  resumes).
+- Dispatch profile (acceptance): steady-state AND admission ticks
+  dispatch exactly once — every recorder tick event's per-op histogram
+  is ``{"fused": 1}`` — where the split path's admission ticks issue
+  prefill + state_push + block_table dispatches on top of decode.
+- Lifecycle + ledgers: mid-prefill cancel/deadline tear down leak-free;
+  optimistic-admission preemption replays bit-exactly through the
+  fused path; the goodput ledger charges NO null_redirect and only the
+  schedule's ladder pad as ``skipped_page_dma`` (the PR-6/PR-10 cut,
+  lifted); the cost catalog prices the fused program and its compiled
+  bytes are (near-)flat in the CONFIGURED block-table width; tick
+  phases attribute ``fused_launch``.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from _serving_stub import StubModel, stub_tokens
+from paddle_tpu.inference.continuous_batching import ContinuousBatchingServer
+from paddle_tpu.ops.pallas import fused_tick as ft
+
+
+def _rand(*shape, seed=0, scale=0.5):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
+
+
+@functools.lru_cache(maxsize=1)
+def _model():
+    # one llama across the module (the test_ragged_prefill pattern):
+    # every parity test shares the same (max_cache_len, page_size)
+    # bundles, so the compiles are paid once
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    pt.seed(21)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+def _stub_srv(**kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_cache_len", 32)
+    kw.setdefault("cache_backend", "paged")
+    kw.setdefault("page_size", 4)
+    kw.setdefault("serving_mode", "fused")
+    return ContinuousBatchingServer(StubModel(), **kw)
+
+
+# ------------------------------------------------------------- schedule
+
+
+class TestSchedule:
+    def test_live_pages_slot_major_with_pow2_pad(self):
+        ss, sp, n_live = ft.build_schedule([7, -1, 0, 8], 4, n_slots=4)
+        # slot 0: pages 0,1; slot 1 idle; slot 2: page 0; slot 3: 0..2
+        want_s = [0, 0, 2, 3, 3, 3]
+        want_p = [0, 1, 0, 0, 1, 2]
+        assert n_live == 6
+        assert len(ss) == 8                     # pow2 (min_entries=8)
+        np.testing.assert_array_equal(ss[:6], want_s)
+        np.testing.assert_array_equal(sp[:6], want_p)
+        np.testing.assert_array_equal(ss[6:], [4, 4])   # sentinel pad
+        np.testing.assert_array_equal(sp[6:], [0, 0])
+
+    def test_all_idle_and_ladder_growth(self):
+        ss, sp, n_live = ft.build_schedule([-1, -1], 4)
+        assert n_live == 0 and len(ss) == 8 and (np.asarray(ss) == 2).all()
+        # quarter-octave ladder: 9 live pages pads to 10 (not pow2 16)
+        ss2, _, n2 = ft.build_schedule([4 * 9 - 1], 4)
+        assert n2 == 9 and len(ss2) == 10
+        # pad never exceeds ~25% of the live entries past min_entries
+        for n in (9, 33, 67, 129, 257, 511):
+            total = ft._ladder(n, 8)
+            assert n <= total <= n + max(1, n // 4)
+
+
+# --------------------------------------------------------------- kernel
+
+
+class TestFusedTickKernel:
+    S, C, nh, kvh, hd, P, pg, W = 3, 4, 4, 2, 16, 12, 4, 4
+
+    def _mixed(self, seed=1):
+        """Slot 0: cold prefill chunk (4 rows). Slot 1: one decode row
+        at t=9. Slot 2: idle."""
+        q = _rand(self.S, self.C, self.nh, self.hd, seed=seed)
+        kp = _rand(self.P, self.pg, self.kvh, self.hd, seed=seed + 1)
+        vp = _rand(self.P, self.pg, self.kvh, self.hd, seed=seed + 2)
+        rng = np.random.RandomState(seed + 3)
+        bt = jnp.asarray(np.stack([
+            rng.choice(np.arange(1, self.P), self.W, replace=False)
+            for _ in range(self.S)]).astype(np.int32))
+        t0 = jnp.asarray(np.array([0, 9, 0], np.int32))
+        last = jnp.asarray(np.array([3, 9, -1], np.int32))
+        dec = jnp.asarray(np.array([0, 1, 0], np.int32))
+        ss, sp, _ = ft.build_schedule(np.asarray(last), self.pg,
+                                      n_slots=self.S)
+        return q, kp, vp, bt, t0, last, dec, jnp.asarray(ss), \
+            jnp.asarray(sp)
+
+    def test_kernel_matches_gather_oracle_mixed_phases(self):
+        q, kp, vp, bt, t0, last, dec, ss, sp = self._mixed()
+        out = ft.fused_tick_attention(q, kp, vp, bt, t0, last, dec,
+                                      ss, sp, sm_scale=0.25,
+                                      interpret=True)
+        ref = ft._ref_fused_tick(q, kp, vp, bt, t0, dec, 0.25)
+        # prefill slot: all 4 live rows; decode slot: row 0 only
+        np.testing.assert_allclose(np.asarray(out)[0],
+                                   np.asarray(ref)[0],
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out)[1, 0],
+                                   np.asarray(ref)[1, 0],
+                                   rtol=2e-5, atol=2e-5)
+        # idle slot reads as exact zeros on BOTH paths
+        assert (np.asarray(out)[2] == 0).all()
+
+    def test_kernel_never_reads_past_causal_frontier(self):
+        """Poisoning every pool row beyond the slots' frontiers must
+        not change one output bit — the schedule + mask are the proof
+        the kernel's page traffic stops at the live frontier."""
+        q, kp, vp, bt, t0, last, dec, ss, sp = self._mixed(seed=7)
+        out1 = ft.fused_tick_attention(q, kp, vp, bt, t0, last, dec,
+                                       ss, sp, sm_scale=0.3,
+                                       interpret=True)
+        # slot 0 sees positions <= 3 (page bt[0,0]); slot 1 sees
+        # <= 9 (pages bt[1,0..2], row 1 of page 2). Poison everything
+        # else, including all of idle slot 2's pages.
+        touched = set(np.asarray(bt)[0, :1]) | set(np.asarray(bt)[1, :3])
+        kp2, vp2 = kp, vp
+        for pid in range(self.P):
+            if pid not in touched:
+                kp2 = kp2.at[pid].set(1e3)
+                vp2 = vp2.at[pid].set(-1e3)
+        kp2 = kp2.at[int(np.asarray(bt)[1, 2]), 2:].set(1e3)
+        vp2 = vp2.at[int(np.asarray(bt)[1, 2]), 2:].set(-1e3)
+        out2 = ft.fused_tick_attention(q, kp2, vp2, bt, t0, last, dec,
+                                       ss, sp, sm_scale=0.3,
+                                       interpret=True)
+        np.testing.assert_array_equal(np.asarray(out1)[0],
+                                      np.asarray(out2)[0])
+        np.testing.assert_array_equal(np.asarray(out1)[1, 0],
+                                      np.asarray(out2)[1, 0])
+
+    def test_prefill_rows_bitwise_invariant_to_gathered_extent(self):
+        """Prefill rows through the reference path must not move a bit
+        when the gathered frame widens past every causal frontier (the
+        multi-row einsum is extent-stable on this XLA; decode rows are
+        pinned at the MODEL level below — a bare attention-only
+        program's s=1 reduce is shape-lucky at tiny head dims)."""
+        q, kp, vp, bt, t0, last, dec, _, _ = self._mixed(seed=11)
+        narrow = ft._ref_fused_tick(q, kp, vp, bt[:, :3], t0, dec, 0.25)
+        wide = ft._ref_fused_tick(q, kp, vp, bt, t0, dec, 0.25)
+        np.testing.assert_array_equal(np.asarray(narrow)[0],
+                                      np.asarray(wide)[0])
+
+    def test_model_decode_row_bitwise_invariant_to_table_width(self):
+        """THE platform assumption under the server's live-width pow2
+        ladder: the whole-model fused program's decode-row logits are
+        bitwise identical to the split s=1 decode program at EVERY
+        ladder width W, so the same request decodes the same tokens
+        whichever width its tick happens to ride. (If this ever fails
+        on a new XLA, pin the fused launch to the full table width.)"""
+        m = _model()
+        MCL, PG, NP, S = 64, 8, 33, 2
+        b = m._decode_bundle(MCL, cache_backend="paged", page_size=PG,
+                             num_pages=NP)
+        init_p, embed_fn, head_fn = b[0], b[1], b[3]
+        step_jit, ragged_jit, fused_fn = b[4], b[5], b[6]
+        fused_jit = jax.jit(fused_fn)
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 256, (12,)).astype(np.int32)
+        caches = init_p(S)
+        bt = np.zeros((S, MCL // PG), np.int32)
+        bt[0, :3] = [1, 2, 3]
+        bt[1, :3] = [4, 5, 6]
+        caches = dict(caches, bt=jnp.asarray(bt))
+        toks = np.zeros((S, 16), np.int32)
+        toks[0, :12] = ids
+        lg, caches = ragged_jit(
+            jnp.asarray(toks), jnp.asarray(np.array([0, MCL], np.int32)),
+            caches, jnp.asarray(np.array([11, 0], np.int32)))
+        nxt = int(np.argmax(np.asarray(lg)[0]))
+
+        t_dev = jnp.asarray(np.array([12, MCL], np.int32))
+        x = embed_fn(jnp.asarray(np.array([nxt, 0], np.int32)), t_dev)
+        out, _ = step_jit(x, copy(caches), t_dev)
+        lg_split = np.asarray(head_fn(out[:, -1:, :])[:, -1])[0]
+
+        last = np.array([12, -1], np.int32)
+        ss, sp, _ = ft.build_schedule(last, PG, n_slots=S)
+        toks_f = np.zeros((S, 2), np.int32)
+        toks_f[0, 0] = nxt
+        for W in (2, 4, 8):
+            lg_f, _ = fused_jit(
+                jnp.asarray(toks_f), t_dev, jnp.asarray(last),
+                jnp.asarray(np.array([1, 0], np.int32)), copy(caches),
+                jnp.asarray(np.zeros(S, np.int32)),
+                jnp.asarray(np.ascontiguousarray(bt[:, :W])),
+                jnp.asarray(ss), jnp.asarray(sp))
+            np.testing.assert_array_equal(np.asarray(lg_f)[0], lg_split)
+
+
+# -------------------------------------------------------- server parity
+
+
+class TestFusedServerParity:
+    def _three_way(self, model, prompts, n_new, budget=None,
+                   seeds=None, **kw):
+        """dense backend vs split ragged vs FUSED: bit-identical
+        per-request tokens. Returns the fused server."""
+        if seeds is None:
+            seeds = list(range(100, 100 + len(prompts)))
+        outs, servers = [], []
+        for mode_kw in ({"cache_backend": "dense"},
+                        {"cache_backend": "paged", "page_size": 8,
+                         "prefill_mode": "ragged",
+                         "prefill_tokens_per_tick": budget},
+                        {"cache_backend": "paged", "page_size": 8,
+                         "prefill_mode": "ragged",
+                         "serving_mode": "fused",
+                         "prefill_tokens_per_tick": budget}):
+            srv = ContinuousBatchingServer(model, max_slots=2,
+                                           max_cache_len=64,
+                                           **mode_kw, **kw)
+            rids = [srv.submit(p, max_new_tokens=n_new, seed=s)
+                    for p, s in zip(prompts, seeds)]
+            res = srv.run()
+            outs.append([res[r] for r in rids])
+            servers.append(srv)
+        for got_split, got_fused, got_dense in zip(outs[1], outs[2],
+                                                   outs[0]):
+            np.testing.assert_array_equal(got_split, got_dense)
+            np.testing.assert_array_equal(got_fused, got_dense)
+        return servers[2]
+
+    def test_greedy_parity_mixed_lengths(self):
+        """Mixed prompt lengths 1 / pg-1 / pg / multi-page — 5 requests
+        through 2 slots (refill mid-run), fused vs split vs dense all
+        bit-identical, pool returned clean."""
+        model = _model()
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (1, 7, 8, 12, 17)]
+        srv = self._three_way(model, prompts, 6)
+        assert srv.serving_mode == "fused"
+        free, live, pinned, cached = srv.pool_balance()
+        assert live == 0
+
+    def test_greedy_parity_chunk_straddling_budget(self):
+        """A 4-token budget slices prompts across ticks at arbitrary
+        cut points; mid-prefill slots are REAL prefill rows in the
+        fused launch and tokens must not move a bit."""
+        model = _model()
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (9, 13, 5)]
+        self._three_way(model, prompts, 5, budget=4)
+
+    def test_sampled_parity_seeded(self):
+        """The in-program sampling epilogue (PRNG keys riding the
+        launch as arguments) replays the host-eager chains exactly."""
+        model = _model()
+        rng = np.random.default_rng(2)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (4, 11, 6)]
+        self._three_way(model, prompts, 6, do_sample=True,
+                        temperature=1.3, top_k=9)
+
+    def test_sampled_parity_extreme_seeds(self):
+        """Seeds with bit 31 set (and negative ones) must pack into
+        the launch's int32 seed row by two's-complement wrap — NumPy 2
+        raises on a bare np.int32(big) — and still replay the host
+        PRNGKey chain bit-exactly."""
+        model = _model()
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+                   for n in (5, 9, 7)]
+        self._three_way(model, prompts, 5,
+                        seeds=[2**31, -1, 2**32 - 2],
+                        do_sample=True, temperature=0.9, top_k=11)
+
+    def test_auto_hit_resume_parity(self):
+        """A second identical prompt auto-hits the prefix cache and
+        resumes its remainder chunk at t0 > 0 inside the fused launch
+        — tokens bit-match the cold run."""
+        srv = _stub_srv(max_slots=1, max_cache_len=48)
+        p = (np.arange(13, dtype=np.int32) * 3) % 16
+        ra = srv.submit(p, max_new_tokens=5)
+        out_a = srv.run()[ra]
+        rb = srv.submit(p, max_new_tokens=5)
+        out_b = srv.run()[rb]
+        np.testing.assert_array_equal(out_a, stub_tokens(p, 5))
+        np.testing.assert_array_equal(out_b, out_a)
+        assert srv.stats["prefix_auto_hits"] == 1
+
+
+# -------------------------------------------- dispatch profile acceptance
+
+
+class TestFusedDispatchProfile:
+    def _tick_profiles(self, serving_mode):
+        from paddle_tpu.telemetry import FlightRecorder
+        rec = FlightRecorder()
+        srv = ContinuousBatchingServer(
+            StubModel(), max_slots=3, max_cache_len=48,
+            cache_backend="paged", page_size=4, recorder=rec,
+            serving_mode=serving_mode, prefill_tokens_per_tick=6)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 99, (n,)).astype(np.int32)
+                   for n in (5, 11, 3, 9, 2)]
+        rids = [srv.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, (6, 3, 9, 4, 2))]
+        outs = srv.run()
+        for rid, p, b in zip(rids, prompts, (6, 3, 9, 4, 2)):
+            np.testing.assert_array_equal(outs[rid], stub_tokens(p, b))
+        return [e["dispatches"] for e in rec.events()
+                if e["kind"] == "tick" and e["dispatches"]]
+
+    def test_every_tick_is_one_fused_dispatch(self):
+        """ACCEPTANCE (ISSUE 14): steady-state AND admission ticks —
+        slot refills mid-run included — show the per-op dispatch
+        histogram collapsed to {"fused": 1}, where the split path's
+        admission ticks issue prefill/state_push/block_table dispatches
+        on top of decode."""
+        fused = self._tick_profiles("fused")
+        assert fused and all(d == {"fused": 1} for d in fused), fused
+        split = self._tick_profiles("split")
+        assert any(len(d) > 1 or "prefill" in d for d in split), \
+            "split baseline lost its admission dispatches — " \
+            "the comparison is vacuous"
+        assert max(sum(d.values()) for d in split) > 1
+
+    def test_stats_count_one_dispatch_per_tick(self):
+        srv = _stub_srv()
+        rid = srv.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+        srv.step()                    # admission tick: prefill + first
+        assert srv.stats["tick_dispatches"] == 1
+        assert srv.stats["prefill_dispatches"] == 1
+        srv.step()                    # steady-state decode tick
+        assert srv.stats["tick_dispatches"] == 2
+        assert srv.stats["prefill_dispatches"] == 1   # no new admission
+        out = srv.run()
+        np.testing.assert_array_equal(
+            out[rid], stub_tokens(np.arange(6, dtype=np.int32), 4))
+
+
+# ----------------------------------------------------- lifecycle + replay
+
+
+class TestFusedLifecycle:
+    def test_cancel_and_deadline_mid_prefill_leak_free(self):
+        from paddle_tpu.telemetry.clock import FakeClock
+        fc = FakeClock()
+        srv = _stub_srv(max_slots=1, prefill_tokens_per_tick=2,
+                        clock=fc)
+        usable = srv._kv.num_pages - 1
+        long_p = (np.arange(20, dtype=np.int32) * 5) % 16
+        ra = srv.submit(long_p, max_new_tokens=4)
+        srv.step()                               # mid-prefill
+        st = next(s for s in srv._slots if s is not None)
+        assert st.phase == "prefill"
+        assert srv.cancel(ra) is True
+        assert np.asarray(srv._results[ra]).size == 0
+        free, live, pinned, cached = srv.pool_balance()
+        assert live == 0 and free + cached == usable
+
+        rb = srv.submit(long_p, max_new_tokens=4, deadline_s=5.0)
+        srv.step()
+        fc.advance(10.0)                         # expire mid-prefill
+        srv.step()
+        free, live, pinned, cached = srv.pool_balance()
+        assert live == 0 and free + cached == usable
+        assert np.asarray(srv._results[rb]).size == 0
+        rc = srv.submit(np.arange(4, dtype=np.int32), max_new_tokens=3)
+        np.testing.assert_array_equal(
+            srv.run()[rc], stub_tokens(np.arange(4, dtype=np.int32), 3))
+
+    def test_decode_never_starved_by_long_prefill(self):
+        """The split scheduler's starvation invariant carries over:
+        while a long prompt streams in under a small budget, an
+        in-flight decode slot advances EVERY tick (its row rides the
+        same launch)."""
+        srv = _stub_srv(max_slots=2, prefill_tokens_per_tick=3)
+        a = np.arange(3, dtype=np.int32)
+        ra = srv.submit(a, max_new_tokens=20)
+        srv.step()
+        st_a = next(s for s in srv._slots if s is not None)
+        assert srv._active.any()
+        b = (np.arange(24, dtype=np.int32) * 3) % 16
+        rb = srv.submit(b, max_new_tokens=4)
+        guard = 0
+        while any(s is not None and s.phase == "prefill"
+                  for s in srv._slots) or srv._queue:
+            before = len(st_a.emitted)
+            srv.step()
+            guard += 1
+            assert len(st_a.emitted) == before + 1, \
+                "in-flight decode starved by fused prefill rows"
+            assert guard < 50
+        outs = srv.run()
+        np.testing.assert_array_equal(outs[rb], stub_tokens(b, 4))
+        np.testing.assert_array_equal(outs[ra], stub_tokens(a, 20))
+
+    @pytest.mark.parametrize("do_sample", [False, True])
+    def test_preemption_replay_bit_exact(self, do_sample):
+        """Optimistic admission under a pool ~2.5x too small: victims
+        park and REPLAY through the fused path bit-exactly vs an
+        unpressured reserve run (greedy and seeded-sampled), zero
+        leaks."""
+        from paddle_tpu.reliability import CircuitBreaker, RetryPolicy
+
+        def run(admission, num_pages):
+            srv = ContinuousBatchingServer(
+                StubModel(), max_slots=4, max_cache_len=64,
+                cache_backend="paged", page_size=8,
+                num_pages=num_pages, admission=admission,
+                serving_mode="fused", do_sample=do_sample, seed=5,
+                retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0),
+                breaker=CircuitBreaker(failure_threshold=10_000))
+            rng = np.random.default_rng(3)
+            prompts = [rng.integers(0, 16, (int(n),)).astype(np.int32)
+                       for n in rng.integers(3, 12, 10)]
+            rids = [srv.submit(p, max_new_tokens=28, seed=100 + i)
+                    for i, p in enumerate(prompts)]
+            outs = srv.run()
+            return srv, prompts, [outs[r] for r in rids]
+
+        srv, prompts, outs = run("optimistic", 9)
+        _, _, outs2 = run("reserve", 49)
+        assert srv.stats["preemptions"] > 0, "pool never pressured"
+        for a, b in zip(outs, outs2):
+            np.testing.assert_array_equal(a, b)
+        if not do_sample:
+            for p, a in zip(prompts, outs):
+                np.testing.assert_array_equal(a, stub_tokens(p, 28))
+        bal = srv.pool_balance()
+        assert bal[1] == 0 and bal.preempted == 0
+
+
+# -------------------------------------------------- ledgers + cost + phase
+
+
+class TestFusedLedgers:
+    def _workload(self, serving_mode, **kw):
+        from paddle_tpu.telemetry import GoodputLedger
+        led = GoodputLedger()
+        # 64-wide block tables: the split kernels' full-width masked
+        # DMA dominates here exactly like the bench's 0.001 baseline
+        srv = ContinuousBatchingServer(
+            StubModel(), max_slots=3, max_cache_len=256,
+            cache_backend="paged", page_size=4, ledger=led,
+            serving_mode=serving_mode, prefill_tokens_per_tick=6, **kw)
+        rng = np.random.default_rng(0)
+        for n, b in ((9, 6), (5, 8), (13, 4), (3, 7)):
+            srv.submit(rng.integers(0, 99, (n,)).astype(np.int32),
+                       max_new_tokens=b)
+        srv.run()
+        return led.snapshot()
+
+    def test_goodput_no_null_redirect_and_pad_only_dma(self):
+        """The two waste kinds ISSUE 14 exists to kill: fused ticks
+        charge ZERO null_redirect (mid-prefill slots are real prefill
+        rows, idle slots are kernel-skipped) and skipped_page_dma
+        collapses from the split kernels' full-table-width model to
+        the schedule's quarter-octave ladder pad — the goodput ratio improves by
+        well over the 10x acceptance bar on the same workload."""
+        split = self._workload("split")
+        fused = self._workload("fused")
+        assert fused["tokens"].get("null_redirect", 0) == 0
+        assert split["tokens"]["null_redirect"] > 0
+        dma_f = fused["tokens"].get("skipped_page_dma", 0)
+        dma_s = split["tokens"]["skipped_page_dma"]
+        assert dma_f < dma_s / 5, (dma_f, dma_s)
+        assert fused["goodput_ratio"] >= 10 * split["goodput_ratio"], \
+            (fused["goodput_ratio"], split["goodput_ratio"])
+        # both modes did the same useful work
+        assert fused["tokens"]["goodput"] == split["tokens"]["goodput"]
+
+    def test_costs_price_fused_program_and_phases(self):
+        """Satellites: the fused program is priced through
+        ``CostCatalog.program()`` (charged per dispatch, compile
+        counted under op="fused") and tick-phase attribution survives
+        the fused path — ``fused_launch`` carries the launch wall,
+        ``last_tick_phases`` stays meaningful."""
+        from paddle_tpu.telemetry import CostCatalog
+        cat = CostCatalog()
+        srv = _stub_srv(costs=cat)
+        rid = srv.submit(np.arange(7, dtype=np.int32), max_new_tokens=5)
+        out = srv.run()
+        np.testing.assert_array_equal(
+            out[rid], stub_tokens(np.arange(7, dtype=np.int32), 5))
+        snap = cat.snapshot()
+        assert cat.compiles().get("fused", 0) >= 1
+        fused = snap["ops"]["fused"]
+        assert fused["dispatches"] >= 5 and fused["hbm_bytes"] > 0
+        # one program per (C, W, G) ladder point, cached host-side
+        assert len(srv._fused_progs) == len(
+            {k for k in srv._fused_progs})
+        phases = snap["last_tick_phases"]
+        assert "fused_launch" in phases
+        assert "decode_launch" not in phases and \
+            "prefill_launch" not in phases
+
+    def test_fused_bytes_flat_in_configured_table_width(self):
+        """Satellite (the direct proof the skipped-page DMA is gone):
+        the fused program's compiled cost-analysis bytes are
+        (near-)flat in the CONFIGURED block-table width for fixed live
+        pages — the launch takes the LIVE slice, so a 4x wider table
+        prices the same. The split kernels' bytes are AFFINE in that
+        width with positive slope (tests/test_costs.py pins the
+        slope), which is exactly the waste this kernel deletes."""
+        from paddle_tpu.telemetry import CostCatalog
+
+        def fused_bytes(max_cache_len):
+            cat = CostCatalog()
+            m = _model()
+            # num_pages PINNED: cost-analysis bytes count the whole
+            # K/V pool buffer, so only the table width may vary
+            srv = ContinuousBatchingServer(
+                m, max_slots=2, max_cache_len=max_cache_len,
+                cache_backend="paged", page_size=8, num_pages=40,
+                serving_mode="fused", costs=cat)
+            rng = np.random.default_rng(5)
+            for n in (5, 9):
+                srv.submit(rng.integers(0, 256, (n,)).astype(np.int32),
+                           max_new_tokens=3)
+            srv.run()
+            ops = cat.snapshot()["ops"]["fused"]
+            return ops["hbm_bytes"] / ops["dispatches"]
+
+        b_narrow = fused_bytes(64)        # 8-page tables
+        b_wide = fused_bytes(256)         # 32-page tables, same work
+        assert abs(b_wide - b_narrow) / b_narrow < 0.10, \
+            f"fused bytes moved {b_narrow:.0f} -> {b_wide:.0f} " \
+            f"with table width — the live-slice contract broke"
+
+
+# --------------------------------------------------------- config guards
+
+
+class TestFusedConfigGuards:
+    def test_serving_mode_validation(self):
+        with pytest.raises(ValueError, match="serving_mode"):
+            _stub_srv(serving_mode="bogus")
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousBatchingServer(StubModel(), max_cache_len=32,
+                                     serving_mode="fused")
+        with pytest.raises(ValueError, match="ragged"):
+            _stub_srv(prefill_mode="dense")
+        srv = _stub_srv(serving_mode="split")
+        assert srv.serving_mode == "split" and not srv._fused
+
+    def test_tick_block_gt_1_is_a_pointered_cut(self):
+        """tick_block > 1 under fused serving is the speculative-verify
+        shape (ROADMAP item 6) — must refuse with a pointer, and the
+        lint's REQUIRED_CUTS keeps the refusal from silently
+        vanishing."""
+        with pytest.raises(NotImplementedError, match="ROADMAP"):
+            _stub_srv(tick_block=4)
+
+    def test_six_tuple_bundle_refused(self):
+        class OldStub(StubModel):
+            def _decode_bundle(self, *a, **kw):
+                return StubModel._decode_bundle(self, *a, **kw)[:6]
+
+        with pytest.raises(ValueError, match="fused-tick entry"):
+            ContinuousBatchingServer(OldStub(), max_cache_len=32,
+                                     cache_backend="paged", page_size=4,
+                                     serving_mode="fused")
+        # without serving_mode="fused" the 6-tuple stays fully usable
+        srv = ContinuousBatchingServer(OldStub(), max_cache_len=32,
+                                       cache_backend="paged",
+                                       page_size=4)
+        rid = srv.submit(np.arange(5, dtype=np.int32), max_new_tokens=3)
+        np.testing.assert_array_equal(
+            srv.run()[rid], stub_tokens(np.arange(5, dtype=np.int32), 3))
