@@ -24,8 +24,16 @@ Drives the same mixed-length workload — request budgets spanning
   program's compiled FLOPs/HBM-bytes per token next to the split
   decode program's.
 
+- the SHARDED paged column (ISSUE 16, ``--mesh``): the same paged
+  workload served with the K/V pool sharded on the kv-head dim over a
+  tensor-parallel mesh at mp in {1, 2, 4} — tokens/s, compiled decode
+  HBM B/tok per shard, the measured per-device pool-byte fraction, and
+  token parity vs the mp=1 run. On CPU the mesh pays real collective
+  overhead per tick; the column is recorded honestly (capacity is the
+  win — per-device pool bytes — not CPU throughput).
+
     python benchmarks/paged_decode_bench.py [--model tiny|350m]
-        [--slots N] [--cache-len N] [--page-size N] [--track]
+        [--slots N] [--cache-len N] [--page-size N] [--track] [--mesh]
 """
 import os
 import sys
@@ -240,9 +248,139 @@ def main(model_name="tiny", slots=4, cache_len=1024, page_size=16,
     return 0 if parity and fused_ok else 1
 
 
+def _track_rounds(rows, note):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_track", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "bench_track.py"))
+    bench_track = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_track)
+    for metric, value, unit in rows:
+        r = bench_track.append_round(
+            {"metric": metric, "value": value, "unit": unit,
+             "note": note})
+        print(f"tracked {r['metric']} = {r['value']}")
+
+
+def mesh_main(slots=4, cache_len=256, page_size=16, n_requests=8,
+              track=False):
+    """``--mesh``: the sharded paged serving column (ISSUE 16).
+
+    Same mixed workload through a paged server at mp in {1, 2, 4} on a
+    kv-head-divisible tiny llama (4 kv heads — llama_tiny's 2 would cap
+    sharding at mp=2). The mp=1 run is the oracle: every mesh run must
+    emit identical tokens. Reported per mp: compile-warmed tokens/s,
+    the compiled decode program's HBM bytes per token PER SHARD
+    (catalog global bytes / shard count), and the measured per-device
+    pool bytes as a fraction of the mp=1 pool."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference.continuous_batching import \
+        ContinuousBatchingServer
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.telemetry import CostCatalog
+
+    if len(jax.devices()) < 4:
+        print(f"--mesh needs >= 4 devices, have {len(jax.devices())} "
+              f"(run under XLA_FLAGS="
+              f"--xla_force_host_platform_device_count=8)")
+        return 1
+    from jax.sharding import Mesh
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                      num_heads=8, num_kv_heads=4,
+                      intermediate_size=128,
+                      max_seq_len=max(cache_len, 128))
+    pt.seed(7)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    rng = np.random.default_rng(0)
+    reqs = _mixed_requests(rng, cache_len, n_requests)
+    warm = _warm_reqs(reqs, rng)
+    warm_toks = sum(n for _, n in warm)
+    extents = sorted((len(p) + n for p, n in reqs), reverse=True)
+    work_tokens = sum(extents[:slots])
+    num_pages = -(-work_tokens // page_size) + slots + 1
+    print(f"sharded paged column: {n_requests} requests, extents "
+          f"32..{cache_len}, {slots} slots, {num_pages} pages x "
+          f"{page_size} rows, 4 kv heads")
+
+    results = {}
+    for mp in (1, 2, 4):
+        cat = CostCatalog()
+        mesh = None if mp == 1 else Mesh(np.array(jax.devices()[:mp]),
+                                         ("mp",))
+        srv = ContinuousBatchingServer(model, max_slots=slots,
+                                       max_cache_len=cache_len,
+                                       cache_backend="paged",
+                                       page_size=page_size,
+                                       num_pages=num_pages, mesh=mesh,
+                                       costs=cat)
+        outs, toks, dt = _drain(srv, reqs, warm=warm)
+        shards = srv._pool_shards
+        op = "decode" if shards <= 1 else f"decode_mp{shards}"
+        dec = cat.snapshot()["ops"].get(op, {"hbm_bytes": 0.0})
+        bytes_tok_shard = dec["hbm_bytes"] / max(toks + warm_toks, 1) \
+            / max(shards, 1)
+        shard_bytes = srv._shard_pool_bytes()
+        results[mp] = dict(outs=outs, toks_s=toks / dt,
+                           bytes_tok_shard=bytes_tok_shard,
+                           shard_bytes=shard_bytes,
+                           compiles=cat.compiles().get(op, 0),
+                           recompiles=cat.recompiles)
+        frac = shard_bytes / results[1]["shard_bytes"]
+        # decode compiles == 1 is the steady-state gate: the sharded
+        # decode signature is static across slot churn. The catalog's
+        # `recompiles` counter also ticks on prefill chunk-width LADDER
+        # DISCOVERY (a cold catalog warms on the first width, then
+        # meets the next) — printed for honesty, not gated
+        print(f"mp={mp}: {toks / dt:8,.0f} tok/s   "
+              f"decode HBM/shard {bytes_tok_shard:10,.0f} B/tok   "
+              f"pool bytes/device {shard_bytes / 2**20:6.2f} MiB "
+              f"({frac:.3f}x of mp=1)   "
+              f"decode compiles {results[mp]['compiles']} (ladder "
+              f"recompiles {results[mp]['recompiles']})")
+
+    parity = all(
+        np.array_equal(a, b)
+        for mp in (2, 4)
+        for a, b in zip(results[1]["outs"], results[mp]["outs"]))
+    frac4 = results[4]["shard_bytes"] / results[1]["shard_bytes"]
+    print(f"token parity mp=2/mp=4 vs mp=1: {parity}")
+    print(f"per-device pool bytes at mp=4: {frac4:.3f}x of mp=1 "
+          f"(want <= 0.25 + block-boundary epsilon)")
+    ok = parity and frac4 <= 0.3 \
+        and all(r["compiles"] == 1 for r in results.values())
+    if track:
+        note = (f"tiny 4-kv-head llama, {slots} slots, cache "
+                f"{cache_len}, pg {page_size}; CPU forced-host mesh — "
+                f"collective overhead included, capacity (pool "
+                f"bytes/device) is the win")
+        _track_rounds(
+            [(f"sharded_paged_decode_tokens_per_sec_mp{mp}",
+              results[mp]["toks_s"], "tokens/s") for mp in (1, 2, 4)]
+            + [("sharded_paged_decode_hbm_bytes_per_token_per_shard_mp4",
+                results[4]["bytes_tok_shard"], "bytes"),
+               ("sharded_paged_pool_bytes_frac_mp4", frac4, "ratio")],
+            note)
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     kw = {}
     argv = sys.argv[1:]
+    if "--mesh" in argv:
+        # the forced host-device env must land BEFORE jax initializes
+        # (mesh_main imports jax lazily, so setting it here works)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if "--model" in argv:
         kw["model_name"] = argv[argv.index("--model") + 1]
     if "--slots" in argv:
@@ -253,4 +391,7 @@ if __name__ == "__main__":
         kw["page_size"] = int(argv[argv.index("--page-size") + 1])
     if "--track" in argv:             # append this round to BENCHLOG
         kw["track"] = True
+    if "--mesh" in argv:
+        kw.pop("model_name", None)
+        sys.exit(mesh_main(**kw))
     sys.exit(main(**kw))

@@ -195,13 +195,28 @@ class PoolBalance(tuple):
     admission state riding as ATTRIBUTES: ``preempted`` — requests
     currently parked on the preempted queue (their pages are already
     donated or freed, so they contribute nothing to ``live``) — and
-    ``preemptions`` — cumulative victims preempted so far."""
+    ``preemptions`` — cumulative victims preempted so far.
+
+    On a sharded pool (mesh serving) the per-shard view rides as
+    attributes too: ``num_shards`` (1 = unsharded/replicated),
+    ``per_shard`` — one ``{"free", "live", "pinned", "cached"}`` dict
+    per shard — and ``shard_page_bytes``, the pool bytes actually
+    resident on one shard's device. Because pages shard on the KV-HEAD
+    dim, every shard holds the same page set: the per-shard counts are
+    balanced by construction, and this view exists so dashboards,
+    storms, and postmortems can ASSERT that instead of assuming it
+    (a future page-partitioned layout reports through the same
+    surface)."""
 
     def __new__(cls, free, live, pinned, cached, preempted=0,
-                preemptions=0):
+                preemptions=0, num_shards=1, per_shard=(),
+                shard_page_bytes=None):
         self = super().__new__(cls, (free, live, pinned, cached))
         self.preempted = preempted
         self.preemptions = preemptions
+        self.num_shards = num_shards
+        self.per_shard = tuple(per_shard)
+        self.shard_page_bytes = shard_page_bytes
         return self
 
 
@@ -374,6 +389,7 @@ class ContinuousBatchingServer:
                  retry_policy=None, breaker=None, fault_injector=None,
                  clock=None):
         self.model = model
+        self.mesh = mesh
         self.max_slots = int(max_slots)
         self.max_cache_len = int(max_cache_len)
         self.eos_token_id = eos_token_id
@@ -424,6 +440,13 @@ class ContinuousBatchingServer:
                                     self.max_slots, pages_per_slot,
                                     fault_injector=fault_injector)
             self._caches = self._paged_bundle[0](self.max_slots)
+            # how many ways the pool actually sharded (1 = replicated
+            # fallback: kv heads not divisible by the mp axis) — the
+            # host-side bookkeeping's ONLY mesh knowledge, feeding the
+            # per-shard balance views and the cost-op namespacing
+            from ..models.generation import paged_pool_shards
+            self._pool_shards = paged_pool_shards(
+                mesh, int(self._caches["pool"]["k"].shape[3]))
             # the radix tree indexes EVERY page-granular prefix in the
             # pool: register_prefix entries live in it pinned; with
             # auto_prefix_cache (default) finished requests donate
@@ -442,6 +465,7 @@ class ContinuousBatchingServer:
         else:
             self.page_size = None
             self._bt_pages = None
+            self._pool_shards = 1
             self._caches = self._init_caches(self.max_slots)
             self._prefix = None
             self._auto_prefix = False
@@ -504,8 +528,8 @@ class ContinuousBatchingServer:
                 "the dense backend allocates every slot's full "
                 "[max_cache_len] KV rows up front, so there is no pool "
                 "to admit optimistically against — virtualizing dense "
-                "slot buffers is the same page-pool work as the paged "
-                "serving items in ROADMAP (items 1/3); use "
+                "slot buffers is the same page-pool work as the "
+                "quantized paged pool in ROADMAP (item 3); use "
                 "cache_backend='paged'")
         self.admission = admission
         self._optimistic = admission == "optimistic"
@@ -548,6 +572,17 @@ class ContinuousBatchingServer:
                     "serving_mode='fused' but this model's paged "
                     "decode bundle has no fused-tick entry point "
                     "(7th element); use serving_mode='split'")
+            if mesh is not None:
+                raise NotImplementedError(
+                    "fused+mesh is not wired yet: the sharded paged "
+                    "pool serves through the SPLIT tick (ragged "
+                    "prefill + decode programs shard per kv-head with "
+                    "block tables replicated), but the fused tick's "
+                    "live-page DMA schedule and folded sampling "
+                    "epilogue still assume one device — making the "
+                    "megakernel shard-aware is the mesh half of "
+                    "ROADMAP item 2 on top of item 1's sharded pool; "
+                    "use serving_mode='split' on meshes")
             if self.tick_block != 1:
                 raise NotImplementedError(
                     "serving_mode='fused' runs ONE decode row per slot "
@@ -1174,6 +1209,30 @@ class ContinuousBatchingServer:
             self._charge_transfer("block_table",
                                   2 * self._kv.block_table.nbytes)
 
+    def _shard_pool_bytes(self):
+        """K+V pool bytes actually RESIDENT on one shard's device —
+        measured off the live arrays (an addressable shard's buffer),
+        not derived, so a placement bug (pool silently replicated when
+        it should shard) shows up as 1x instead of 1/mp. Falls back to
+        global bytes / shards where the runtime hides buffers. The pool
+        shape and placement are fixed for the server's lifetime, so the
+        first measurement is memoized — this rides the per-tick gauge
+        path."""
+        if self._kv is None:
+            return None
+        memo = getattr(self, "_shard_bytes_memo", None)
+        if memo is not None:
+            return memo
+        pool = self._caches["pool"]
+        try:
+            memo = int(pool["k"].addressable_shards[0].data.nbytes
+                       + pool["v"].addressable_shards[0].data.nbytes)
+        except Exception:
+            memo = int((pool["k"].nbytes + pool["v"].nbytes)
+                       // max(1, self._pool_shards))
+        self._shard_bytes_memo = memo
+        return memo
+
     def _pool_gauges(self):
         """Refresh the page-pool occupancy gauges (paged backend)."""
         if self._tele is not None and self._kv is not None:
@@ -1182,6 +1241,8 @@ class ContinuousBatchingServer:
             cached = self._prefix.cached_pages
             self._tele.set_pool(self._kv.free_pages(),
                                 used - pinned - cached, pinned, cached)
+            self._tele.set_pool_shards(self._pool_shards,
+                                       self._shard_pool_bytes())
 
     def pool_balance(self):
         """``PoolBalance`` — a ``(free, live, pinned, cached)`` tuple
@@ -1202,9 +1263,20 @@ class ContinuousBatchingServer:
             pinned = self._prefix.pinned_pages
             cached = self._prefix.cached_pages
             live = self._kv.used_pages() - pinned - cached
+            shards = self._pool_shards
+            per_shard = ()
+            if shards > 1:
+                # kv-head sharding splits every page across ALL shards
+                # equally, so each shard's page counts equal the
+                # globals — the view makes that balance assertable
+                per_shard = tuple(
+                    {"free": free, "live": live, "pinned": pinned,
+                     "cached": cached} for _ in range(shards))
             return PoolBalance(free, live, pinned, cached,
                                preempted=len(self._preempted),
-                               preemptions=self.stats["preemptions"])
+                               preemptions=self.stats["preemptions"],
+                               num_shards=shards, per_shard=per_shard,
+                               shard_page_bytes=self._shard_pool_bytes())
 
     def _reclaim_pages(self, shortfall):
         """``PagedKVCache.alloc``'s reclaimer: evict LRU cached prefix
@@ -1682,7 +1754,7 @@ class ContinuousBatchingServer:
             # a width first seen AFTER warmup is exactly the recompile
             # the watch exists to surface
             prefill_fn = self._cost_program(
-                "prefill", self._ragged_fn,
+                self._cost_op("prefill"), self._ragged_fn,
                 (toks_d, t0_d, self._caches, out_d))
         logits, self._caches = prefill_fn(toks_d, t0_d, self._caches,
                                           out_d)
@@ -1797,6 +1869,17 @@ class ContinuousBatchingServer:
         (the decode program itself, block-table syncs) in this tick's
         per-op profile only."""
         self._tick_disp[op] = self._tick_disp.get(op, 0) + n
+
+    def _cost_op(self, name):
+        """Cost-catalog op name for a serving program: suffixed with
+        the pool shard count on a mesh (``decode_mp4``) so a catalog
+        SHARED across servers at different mp never sees one op's
+        shape signature change — a warmed op's new signature is
+        exactly what the post-warmup recompile alarm fires on, and a
+        mesh size is a deployment choice, not a recompile. Unsharded
+        servers keep the bare names (dashboards unchanged)."""
+        return name if self._pool_shards <= 1 \
+            else f"{name}_mp{self._pool_shards}"
 
     def _cost_program(self, op, fn, args):
         """The cost catalog's priced executable for ``fn`` at ``args``'
@@ -2386,8 +2469,8 @@ class ContinuousBatchingServer:
             key = (C, W, len(ss))
             prog = self._fused_progs.get(key)
             if prog is None:
-                prog = self._cost_program("fused", self._fused_jit,
-                                          args)
+                prog = self._cost_program(self._cost_op("fused"),
+                                          self._fused_jit, args)
                 self._fused_progs[key] = prog
             fn = prog
         nxt, keys_out, self._caches = fn(*args)
@@ -2610,7 +2693,7 @@ class ContinuousBatchingServer:
             # the hot loop must not re-hash the caches pytree per tick
             if self._decode_prog is None:
                 self._decode_prog = self._cost_program(
-                    "decode", self._decode_jit,
+                    self._cost_op("decode"), self._decode_jit,
                     (self._tok, self._caches, self._t, self._keys))
             decode_fn = self._decode_prog
         (self._tok, self._caches, self._t, self._keys,
@@ -2889,8 +2972,12 @@ class ContinuousBatchingServer:
             sections["pool_balance"] = {
                 "free": bal[0], "live": bal[1], "pinned": bal[2],
                 "cached": bal[3], "preempted": bal.preempted,
-                "preemptions": bal.preemptions}
-            sections["block_table"] = self._kv.occupancy()
+                "preemptions": bal.preemptions,
+                "num_shards": bal.num_shards,
+                "per_shard": list(bal.per_shard),
+                "shard_page_bytes": bal.shard_page_bytes}
+            sections["block_table"] = self._kv.occupancy(
+                num_shards=self._pool_shards)
             sections["prefix_cache"] = self._prefix.stats()
         if self._led is not None:
             # how much of the hardware's recent work was useful is

@@ -213,16 +213,29 @@ class PagedKVCache:
         return pages
 
     # ------------------------------------------------------- accounting
-    def occupancy(self):
+    def occupancy(self, num_shards=1):
         """Per-slot block-table occupancy, plain data — the postmortem
         bundle's "who holds which pages" section: pages held and
-        shared-prefix pages per occupied slot, plus the pool totals."""
-        return {"free_pages": self.free_pages(),
-                "used_pages": self.used_pages(),
-                "pages_per_slot": self.pages_per_slot,
-                "slots": {s: {"pages": len(p),
-                              "shared": self._slot_shared[s]}
-                          for s, p in enumerate(self._slot_pages) if p}}
+        shared-prefix pages per occupied slot, plus the pool totals.
+
+        With ``num_shards > 1`` (kv-head-sharded pool on a mesh) a
+        ``shards`` view is appended.  The allocator is host-side and
+        global — every page id exists on every shard, split on the
+        kv-head dim — so per-shard occupancy equals the global counts
+        on each shard; the view states that balance explicitly so
+        dashboards and postmortems assert it instead of assuming it."""
+        occ = {"free_pages": self.free_pages(),
+               "used_pages": self.used_pages(),
+               "pages_per_slot": self.pages_per_slot,
+               "slots": {s: {"pages": len(p),
+                             "shared": self._slot_shared[s]}
+                         for s, p in enumerate(self._slot_pages) if p}}
+        if num_shards > 1:
+            occ["shards"] = [{"shard": i,
+                              "free_pages": occ["free_pages"],
+                              "used_pages": occ["used_pages"]}
+                             for i in range(num_shards)]
+        return occ
 
     def telemetry_stats(self):
         """Point-in-time pool state + cumulative churn, plain data —

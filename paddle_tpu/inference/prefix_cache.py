@@ -49,6 +49,16 @@ leak-free by construction and asserted so under fault storms in
 tests/test_prefix_cache.py.
 
 Host-side only, mutated exclusively under the server lock.
+
+Mesh contract (ISSUE 16, sharded paged serving): the tree indexes
+PAGE IDS, and on a mesh the pool arrays those ids address are sharded
+on the kv-head dimension — so every cached page's K/V state is
+automatically split across the shards exactly like live pages, while
+the tree, refcounts, pins and LRU order stay host-side and GLOBAL.
+Donate/lookup/evict and ``register_prefix`` therefore need no mesh
+branch at all: a cached-prefix hit attaches the same page ids on every
+shard, and per-shard cache residency is balanced by construction
+(asserted in tests/test_sharded_paged_serving.py).
 """
 import numpy as np
 

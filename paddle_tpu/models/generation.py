@@ -97,6 +97,48 @@ def _mesh_caches(init_caches, mesh):
     return init
 
 
+def paged_pool_shards(mesh, num_kv_heads, axis="mp"):
+    """How many ways the paged K/V pool is sharded on ``mesh``: the
+    ``axis`` size when it divides the kv-head count, else 1 (the
+    replicated fallback, mirroring ``_apply_mesh``'s weight rule).
+    Host-side bookkeeping (allocator, prefix cache, postmortems) uses
+    this to report per-shard balance without touching device state."""
+    if mesh is None:
+        return 1
+    size = int(dict(mesh.shape).get(axis, 1))
+    return size if size > 1 and num_kv_heads % size == 0 else 1
+
+
+def _mesh_paged_caches(init_caches, mesh, axis="mp"):
+    """Mesh placement for a fresh PAGED cache tree: the global K/V page
+    pools shard on the kv-head dimension (axis 3 of
+    ``[layers, num_pages, page_size, kvh, hd]``) over the mesh's
+    ``axis`` — per-device pool bytes shrink by 1/mp at fixed page
+    capacity, the capacity unlock of ROADMAP item 1 — while the block
+    table stays REPLICATED: page ids are global, so the host-side
+    allocator, grow/preempt/donate, and the prefix radix tree never
+    learn the mesh exists. A kv-head count the axis size doesn't divide
+    falls back to a replicated pool (``paged_pool_shards`` reports 1),
+    exactly like ``_apply_mesh`` does for weights."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+
+    def init(batch):
+        tree = init_caches(batch)
+        kvh = tree["pool"]["k"].shape[3]
+        if paged_pool_shards(mesh, kvh, axis) > 1:
+            sh = NamedSharding(mesh, P(None, None, None, axis, None))
+        else:
+            sh = rep
+        return dict(tree,
+                    pool={n: jax.device_put(a, sh)
+                          for n, a in tree["pool"].items()},
+                    bt=jax.device_put(tree["bt"], rep))
+
+    return init
+
+
 def _mm(x, w):
     """x @ w where w is a raw array or an (int8, scale) pair. The int8
     path casts tile-wise inside the fused matmul (XLA folds the convert
@@ -210,16 +252,15 @@ def _check_paged_config(max_cache_len, page_size, num_pages, cache_dtype,
     """Validate a paged-cache decode bundle request. ``page_size`` must
     divide ``max_cache_len`` so the block-table width times page size
     equals the dense cache length — that equality is what makes the
-    paged decode path bit-identical to the dense one."""
+    paged decode path bit-identical to the dense one. A ``mesh`` is
+    accepted as-is: the pool shards on the kv-head dim (or falls back
+    to replicated) via ``_mesh_paged_caches`` — nothing to refuse."""
     if cache_dtype == "int8":
         raise NotImplementedError(
             "cache_dtype='int8' is not wired for the paged backend yet "
             "(ROADMAP item 3: quantized paged KV pool); use "
             "cache_backend='dense' with int8 caches")
-    if mesh is not None:
-        raise NotImplementedError(
-            "mesh sharding is not wired for the paged backend yet "
-            "(ROADMAP item 1: sharded paged serving)")
+    del mesh
     if not page_size or int(page_size) < 1:
         raise ValueError("paged backend needs page_size >= 1")
     if not num_pages or int(num_pages) < 2:
@@ -266,16 +307,20 @@ def _page_write(pool, kv, bt, t):
     return pool.at[page, t % pg].set(vals)
 
 
-def _paged_attend(q, k_pool, v_pool, bt, t, scale):
+def _paged_attend(q, k_pool, v_pool, bt, t, scale, mesh=None):
     """Decode-step attention through the block table: q [B, 1, nh, hd],
     pools [P, pg, kvh, hd], valid lengths t+1 (cache already written
-    through t). Pallas ragged kernel on TPU, bit-exact dense-mirroring
-    gather composition elsewhere. Returns [B, 1, nh, hd]."""
+    through t). Pallas ragged kernel on TPU (per-kv-head-shard launches
+    under ``mesh`` — XLA cannot partition a custom call, so the kernel
+    path shard_maps itself), bit-exact dense-mirroring gather
+    composition elsewhere (GSPMD partitions it from the pool's input
+    sharding). Returns [B, 1, nh, hd]."""
     from ..ops.pallas.paged_attention import paged_attention
     b = q.shape[0]
     if jnp.ndim(t) == 0:
         t = jnp.full((b,), t, jnp.int32)
-    return paged_attention(q[:, 0], k_pool, v_pool, bt, t + 1, scale)[:, None]
+    return paged_attention(q[:, 0], k_pool, v_pool, bt, t + 1, scale,
+                           mesh=mesh)[:, None]
 
 
 def _page_write_seq(pool, kv, bt, t, last=None):
@@ -315,7 +360,7 @@ def _page_write_seq(pool, kv, bt, t, last=None):
         vals.reshape((n,) + vals.shape[2:]))
 
 
-def _paged_prefill_attend(q, k_pool, v_pool, bt, t, scale):
+def _paged_prefill_attend(q, k_pool, v_pool, bt, t, scale, mesh=None):
     """Ragged packed-prefill attention through the block table: q
     [B, s, nh, hd] chunk rows starting at per-slot offsets ``t``, pools
     [P, pg, kvh, hd]; row j of slot b attends to positions <= t_b + j
@@ -332,7 +377,7 @@ def _paged_prefill_attend(q, k_pool, v_pool, bt, t, scale):
     limit = bt.shape[1] * k_pool.shape[1]          # tokens a table spans
     last = jnp.where(t >= limit, jnp.int32(-1), t + s - 1)
     return ragged_prefill_attention(q, k_pool, v_pool, bt, t, last=last,
-                                    sm_scale=scale)
+                                    sm_scale=scale, mesh=mesh)
 
 
 def _fused_attend(q, k_pool, v_pool, bt, t, last, dec, ss, sp, scale):
@@ -350,7 +395,7 @@ def _fused_attend(q, k_pool, v_pool, bt, t, last, dec, ss, sp, scale):
 
 
 def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None,
-                   fused=None):
+                   fused=None, mesh=None):
     """Shared llama-family attention sublayer for the decode scan:
     pre-RMSNorm, rope at absolute positions, GQA cache write + masked
     cached attention, output projection + residual. ``lc`` is this
@@ -386,11 +431,12 @@ def _rope_gqa_attn(blk, xx, lc, t, pos, dims, tables, eps, bt=None,
     elif bt is not None and s > 1:
         lc = {"k": _page_write_seq(lc["k"], k, bt, t),
               "v": _page_write_seq(lc["v"], v, bt, t)}
-        att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t, scale)
+        att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t, scale,
+                                    mesh=mesh)
     elif bt is not None:
         lc = {"k": _page_write(lc["k"], k, bt, t),
               "v": _page_write(lc["v"], v, bt, t)}
-        att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale)
+        att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale, mesh=mesh)
     else:
         lc = _kv_write(lc, "k", k, t)
         lc = _kv_write(lc, "v", v, t)
@@ -525,7 +571,8 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
                         cache_dtype)
 
     if mesh is not None:
-        init_caches = _mesh_caches(init_caches, mesh)
+        init_caches = (_mesh_paged_caches if paged
+                       else _mesh_caches)(init_caches, mesh)
 
     def embed_fn(tok, t):
         return p["table"][tok][:, None, :]
@@ -539,7 +586,7 @@ def _make_llama_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
             blk, lc = xs
             xx, lc, h2 = _rope_gqa_attn(
                 blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
-                (cos, sin), eps, bt=bt, fused=fused)
+                (cos, sin), eps, bt=bt, fused=fused, mesh=mesh)
             xx = xx + _mm(jax.nn.silu(_mm(h2, blk["wg"]))
                           * _mm(h2, blk["wu"]), blk["wd"])
             return xx, lc
@@ -650,7 +697,8 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
                         cache_dtype)
 
     if mesh is not None:
-        init_caches = _mesh_caches(init_caches, mesh)
+        init_caches = (_mesh_paged_caches if paged
+                       else _mesh_caches)(init_caches, mesh)
 
     def embed_fn(tok, t):
         return p["table"][tok][:, None, :]
@@ -664,7 +712,7 @@ def _make_mixtral_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
             blk, lc = xs
             xx, lc, h2 = _rope_gqa_attn(
                 blk, xx, lc, t, pos, (b, s, nh, kvh, hd, scale),
-                (cos, sin), eps, bt=bt, fused=fused)
+                (cos, sin), eps, bt=bt, fused=fused, mesh=mesh)
             xx = xx + _moe_topk_ffn(h2, blk["router"], blk["wg"],
                                     blk["wu"], blk["wd"], top_k)
             return xx, lc
@@ -746,7 +794,8 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
                         cache_dtype)
 
     if mesh is not None:
-        init_caches = _mesh_caches(init_caches, mesh)
+        init_caches = (_mesh_paged_caches if paged
+                       else _mesh_caches)(init_caches, mesh)
 
     def embed_fn(tok, t):
         pos_emb = p["wpe"][t]                # scalar t: [H]; [B] t: [B,H]
@@ -774,11 +823,12 @@ def _make_gpt_decode_fns(model, max_cache_len, weight_dtype=None, mesh=None,
                 lc = {"k": _page_write_seq(lc["k"], k, bt, t),
                       "v": _page_write_seq(lc["v"], v, bt, t)}
                 att = _paged_prefill_attend(q, lc["k"], lc["v"], bt, t,
-                                            scale)
+                                            scale, mesh=mesh)
             elif paged:
                 lc = {"k": _page_write(lc["k"], k, bt, t),
                       "v": _page_write(lc["v"], v, bt, t)}
-                att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale)
+                att = _paged_attend(q, lc["k"], lc["v"], bt, t, scale,
+                                    mesh=mesh)
             else:
                 lc = _kv_write(lc, "k", k, t)
                 lc = _kv_write(lc, "v", v, t)

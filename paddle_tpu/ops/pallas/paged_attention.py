@@ -169,6 +169,52 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables, lengths,
     )(flat_bt, lengths.astype(jnp.int32), q, k_pages, v_pages)
 
 
+# ------------------------------------------------- mesh-sharded kernel path
+
+
+def kv_head_shards(mesh, num_kv_heads, num_heads=None, axis="mp"):
+    """Ways an attention launch splits over ``mesh``'s ``axis`` on the
+    kv-head dimension: the axis size when it divides the kv heads (and
+    the query heads, which follows for any integral GQA ratio), else 1.
+    1 means "launch replicated" — the caller's divisibility fallback,
+    matching the pool placement rule in ``models/generation``."""
+    if mesh is None:
+        return 1
+    size = int(dict(mesh.shape).get(axis, 1))
+    if size <= 1 or num_kv_heads % size:
+        return 1
+    if num_heads is not None and num_heads % size:
+        return 1
+    return size
+
+
+def _paged_attention_sharded(q, k_pages, v_pages, block_tables, lengths,
+                             sm_scale, mesh, axis, interpret):
+    """Per-shard Pallas launches over the mesh's ``axis``: the page
+    pools arrive sharded on their kv-head dim, q splits into the
+    matching query-head groups (a GQA group never straddles a shard —
+    consecutive head blocks keep each kv head with its own rep query
+    heads), the block table and lengths ride replicated, and the
+    out_spec's head-axis concatenation IS the attention all-gather
+    GSPMD would insert on the fallback path. XLA cannot partition a
+    custom call, so the kernel path must shard_map itself; returns None
+    when the head counts don't divide the axis — the caller then runs
+    one replicated launch."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..._compat import shard_map
+    if kv_head_shards(mesh, k_pages.shape[2], q.shape[1], axis) <= 1:
+        return None
+    fn = functools.partial(_paged_attention_pallas, sm_scale=sm_scale,
+                           interpret=interpret)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, axis, None), P(None, None, axis, None),
+                  P(None, None, axis, None), P(None, None), P(None)),
+        out_specs=P(None, axis, None), check_vma=False,
+    )(q, k_pages, v_pages, block_tables, lengths)
+
+
 # ------------------------------------------------------ XLA reference path
 
 
@@ -203,7 +249,7 @@ def _ref_paged_attention(q, k_pages, v_pages, block_tables, lengths,
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths,
-                    sm_scale=None, interpret=False):
+                    sm_scale=None, interpret=False, mesh=None):
     """Ragged paged-attention decode step.
 
     q            [slots, num_heads, head_dim]   one query token per slot
@@ -213,6 +259,15 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths,
                  order; entries past a slot's allocation must hold a
                  valid id (the manager fills them with 0)
     lengths      [slots] int32  valid KV tokens per slot (ragged)
+    mesh         optional ``jax.sharding.Mesh`` whose ``mp`` axis the
+                 page pools are sharded over on their kv-head dim
+                 (sharded paged serving): the Pallas path then runs one
+                 launch PER SHARD via shard_map — each shard reads only
+                 its resident pool slice, block tables replicated —
+                 and the head-axis restitch is the attention
+                 all-gather. Ignored on the XLA fallback, where GSPMD
+                 partitions the gather/einsum composition from the
+                 pool's input sharding directly.
 
     Returns [slots, num_heads, head_dim]. Runs the Pallas kernel on TPU
     (or under ``interpret=True`` anywhere); elsewhere the gather-based
@@ -221,6 +276,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths,
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if available() or interpret:
+        if mesh is not None:
+            out = _paged_attention_sharded(
+                q, k_pages, v_pages, block_tables, lengths, sm_scale,
+                mesh, "mp", interpret)
+            if out is not None:
+                return out
         return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
                                        lengths, sm_scale,
                                        interpret=interpret)

@@ -195,6 +195,35 @@ def _ragged_prefill_pallas(q, k_pages, v_pages, block_tables, t0, last,
       q, k_pages, v_pages)
 
 
+# ------------------------------------------------- mesh-sharded kernel path
+
+
+def _ragged_prefill_sharded(q, k_pages, v_pages, block_tables, t0, last,
+                            sm_scale, mesh, axis, interpret):
+    """Per-shard Pallas launches over the mesh's ``axis`` (sharded
+    paged serving): pools sharded on kv heads, q split into the
+    matching query-head groups (head axis 2 of [S, C, nh, hd]), block
+    table / t0 / last replicated, output restitched on the head axis —
+    the same split ``paged_attention._paged_attention_sharded`` makes
+    for decode. Returns None when the head counts don't divide the
+    axis; the caller then runs one replicated launch."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..._compat import shard_map
+    from .paged_attention import kv_head_shards
+    if kv_head_shards(mesh, k_pages.shape[2], q.shape[2], axis) <= 1:
+        return None
+    fn = functools.partial(_ragged_prefill_pallas, sm_scale=sm_scale,
+                           interpret=interpret)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, None, axis, None), P(None, None, axis, None),
+                  P(None, None, axis, None), P(None, None), P(None),
+                  P(None)),
+        out_specs=P(None, None, axis, None), check_vma=False,
+    )(q, k_pages, v_pages, block_tables, t0, last)
+
+
 # ------------------------------------------------------ XLA reference path
 
 
@@ -229,7 +258,8 @@ def _ref_ragged_prefill(q, k_pages, v_pages, block_tables, t0, sm_scale):
 
 
 def ragged_prefill_attention(q, k_pages, v_pages, block_tables, t0,
-                             last=None, sm_scale=None, interpret=False):
+                             last=None, sm_scale=None, interpret=False,
+                             mesh=None):
     """Ragged packed-prefill attention over paged KV.
 
     q            [slots, chunk, num_heads, head_dim]  packed prompt
@@ -253,11 +283,26 @@ def ragged_prefill_attention(q, k_pages, v_pages, block_tables, t0,
     [slots, chunk, num_heads, head_dim]. Runs the Pallas kernel on TPU
     (or under ``interpret=True`` anywhere); elsewhere the gather-based
     XLA composition, which is bit-identical to the dense prefill path.
+    ``mesh`` (sharded paged serving) splits the kernel launch per
+    kv-head shard exactly like ``paged_attention`` — ignored on the
+    XLA fallback, where GSPMD partitions from the pool's sharding.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if last is None:
         last = t0 + q.shape[1] - 1
+
+    def _launch(qt, t0t, lastt):
+        if mesh is not None:
+            out = _ragged_prefill_sharded(qt, k_pages, v_pages,
+                                          block_tables, t0t, lastt,
+                                          sm_scale, mesh, "mp", interpret)
+            if out is not None:
+                return out
+        return _ragged_prefill_pallas(qt, k_pages, v_pages, block_tables,
+                                      t0t, lastt, sm_scale,
+                                      interpret=interpret)
+
     if available() or interpret:
         # the kernel's VMEM scratch is (rows * nh)-tall: tile the query
         # rows so scratch stays bounded whatever chunk width the
@@ -269,16 +314,12 @@ def ragged_prefill_attention(q, k_pages, v_pages, block_tables, t0,
         # survives the min().
         C = q.shape[1]
         if C <= _QUERY_TILE:
-            return _ragged_prefill_pallas(q, k_pages, v_pages,
-                                          block_tables, t0, last,
-                                          sm_scale, interpret=interpret)
+            return _launch(q, t0, last)
         outs = []
         for r0 in range(0, C, _QUERY_TILE):
             qt = q[:, r0:r0 + _QUERY_TILE]
             lastt = jnp.minimum(last, t0 + r0 + qt.shape[1] - 1)
-            outs.append(_ragged_prefill_pallas(
-                qt, k_pages, v_pages, block_tables, t0 + r0, lastt,
-                sm_scale, interpret=interpret))
+            outs.append(_launch(qt, t0 + r0, lastt))
         return jnp.concatenate(outs, axis=1)
     out = _ref_ragged_prefill(q, k_pages, v_pages, block_tables, t0,
                               sm_scale)

@@ -158,6 +158,13 @@ class ServerTelemetry:
         self._g_pool_live = pool.labels(state="live")
         self._g_pool_pinned = pool.labels(state="pinned")
         self._g_pool_cached = pool.labels(state="cached")
+        self._g_pool_shards = r.gauge(
+            "kv_pool_shards",
+            "Ways the paged KV pool is sharded over the mesh mp axis "
+            "(1 when unsharded or replicated)")
+        self._g_pool_shard_bytes = r.gauge(
+            "kv_pool_shard_page_bytes",
+            "Per-device bytes held by one shard of the paged K/V pool")
         self._g_pfx_cached = r.gauge(
             "kv_prefix_cached_pages",
             "Evictable pages held by the automatic prefix cache")
@@ -411,6 +418,15 @@ class ServerTelemetry:
         self._g_pool_pinned.set(pinned)
         self._g_pool_cached.set(cached)
         self._g_pfx_cached.set(cached)
+
+    def set_pool_shards(self, num_shards, shard_bytes):
+        """Per-shard pool placement: how many ways the K/V pool is
+        sharded and the measured bytes one device holds for it."""
+        if not self.enabled:
+            return
+        self._g_pool_shards.set(num_shards)
+        if shard_bytes is not None:
+            self._g_pool_shard_bytes.set(shard_bytes)
 
     def on_prefix_auto(self, hit, tokens):
         """One automatic (radix-tree) prefix lookup at admission:

@@ -21,11 +21,11 @@ methods raise bare; API refusals) opt out with a ``# no-roadmap:
 Required-cut rule (ISSUE 8): some dispatch sites must KEEP a
 ROADMAP-pointered refusal — ``REQUIRED_CUTS`` lists (file, keyword)
 pairs, and the lint fails if the file no longer contains a pointered
-``NotImplementedError`` mentioning the keyword. The first entry is the
-admission-mode dispatch: ``admission="optimistic"`` on the dense cache
-backend must refuse with a pointer (silently "supporting" the combo —
-or deleting the refusal wholesale — is exactly the kind of quiet
-contract change this lint exists to surface).
+``NotImplementedError`` mentioning the keyword — silently "supporting"
+a combo, or deleting a refusal wholesale, is exactly the kind of quiet
+contract change this lint exists to surface. Lifting a cut for real
+(as ISSUE 16 did for paged+mesh) means removing its entry here in the
+same change that makes the combo work.
 
 Usage: python scripts/check_no_bare_except.py [root ...]
 Exit status 1 lists every offending file:line. Wired into the test
@@ -63,11 +63,21 @@ OPT_OUT = "no-roadmap:"
 # pointer until that lands. (ISSUE 14 LIFTED the PR-6 skipped-page-DMA
 # and null-redirect cuts for serving_mode="fused"; the split kernels
 # keep them as the documented baseline, no refusal site involved.)
+# ISSUE 16 LIFTED the paged+mesh cut (the pool now shards on the
+# kv-head dim over the mp axis) and left two pointered refusals in its
+# place: the int8 paged pool (generation.py, ROADMAP item 3) and the
+# fused tick on a mesh (continuous_batching.py, ROADMAP item 2 — the
+# megakernel's DMA schedule and sampling epilogue are still
+# single-device; split mode serves meshes).
 REQUIRED_CUTS = (
+    (os.path.join("paddle_tpu", "models", "generation.py"),
+     "int8"),
     (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
      "optimistic"),
     (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
      "tick_block"),
+    (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
+     "fused+mesh"),
 )
 
 

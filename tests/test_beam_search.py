@@ -31,10 +31,12 @@ class TestBeamSearch:
                                max_cache_len=32, num_beams=1)
         np.testing.assert_array_equal(beam1.numpy(), greedy.numpy())
 
+    @pytest.mark.slow
     def test_exhaustive_beam_finds_global_optimum(self):
         """V=6, 3 new tokens, num_beams=36 >= V^2: the beam holds every
         depth-2 prefix, so it must return the argmax over all 216
-        completions scored by full-forward log-likelihood."""
+        completions scored by full-forward log-likelihood. (slow: 216
+        full forwards; the cheaper beam contracts stay tier-1.)"""
         model = _tiny_vocab_model(V=6)
         V, NEW = 6, 3
         rng = np.random.default_rng(22)

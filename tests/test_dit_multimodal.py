@@ -48,7 +48,10 @@ class TestDiT:
         out = m(x, t)
         np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-6)
 
+    @pytest.mark.slow
     def test_training_reduces_loss(self):
+        # slow: 8 optimizer steps of eager backward; diffusion loss +
+        # grads stay tier-1 via test_diffusion_loss_and_grads
         m, cfg = self._model()
         opt = pt.optimizer.AdamW(learning_rate=3e-3,
                                  parameters=m.parameters())

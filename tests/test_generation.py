@@ -41,9 +41,12 @@ class TestGreedyParity:
         got = model.generate(pt.to_tensor(ids), max_new_tokens=5)
         np.testing.assert_array_equal(np.asarray(got.numpy()), want)
 
+    @pytest.mark.slow
     def test_mixtral_generate_matches_eager(self):
         """MoE decode (dropless dense-expert top-2 combine) must equal
-        the eager capacity-dispatch forward at under-capacity loads."""
+        the eager capacity-dispatch forward at under-capacity loads.
+        (slow: two mixtral compiles; server-level mixtral parity stays
+        tier-1 in test_continuous_batching/test_paged_attention.)"""
         from paddle_tpu.models.mixtral import (MixtralForCausalLM,
                                                mixtral_tiny)
         pt.seed(31)
@@ -131,9 +134,11 @@ class TestSampling:
 
 
 class TestQwenVLGenerate:
+    @pytest.mark.slow
     def test_vl_generate_matches_eager_joint_forward(self):
         """Multimodal decode: visual prefix in the cache, text decoding
-        token-for-token equal to the full joint recompute."""
+        token-for-token equal to the full joint recompute. (slow: five
+        full joint recomputes; text-only VL decode stays tier-1.)"""
         from paddle_tpu.models.qwen_vl import QwenVL, qwen_vl_tiny
         pt.seed(81)
         model = QwenVL(qwen_vl_tiny())

@@ -260,10 +260,12 @@ class TestRaggedServerParity:
             np.testing.assert_array_equal(got_ragged, got_dense)
         return servers[2]
 
+    @pytest.mark.slow
     def test_greedy_parity_mixed_lengths(self):
         """Mixed prompt lengths: 1, page_size-1, page_size, multi-page
         — 5 requests through 2 slots (refill mid-run), all three
-        prefill paths bit-identical."""
+        prefill paths bit-identical. (slow: 3 servers x 5 requests;
+        chunk-straddling + sampled keep three-way parity tier-1.)"""
         model = _model()
         rng = np.random.default_rng(0)
         prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
