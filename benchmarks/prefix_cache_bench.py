@@ -35,9 +35,23 @@ and reports:
 - drain wall time per mode (best of N reps, compiles warmed first;
   noise-prone on shared CI — trust the counters).
 
+Then the MULTI-TURN SESSION workload (ISSUE 17): N users each serve a
+distinct first turn, then every user RETURNS with a second turn that
+extends their own history (turn-1 prompt + its generated tokens + a
+fresh tail — only an extension of the donated prompt run can re-hit
+its pages). The pool is squeezed so the first turns' donated pages
+cannot all stay HBM-resident, and the same workload runs twice at
+EQUAL device pool size: ``host_tier=None`` (evictions drop pages —
+the pre-tier stack) vs ``HostTier()`` (evictions spill to host, the
+returning turn restores). The bench self-asserts that the tiered run's
+turn-2 hit tokens STRICTLY beat the HBM-only run's, that restores
+actually happened (none corrupt), and that both runs' outputs are
+bit-identical — the tier changes residency, never tokens.
+
     python benchmarks/prefix_cache_bench.py [--requests N]
         [--system-tokens N] [--tail-tokens N] [--new-tokens N]
-        [--slots N] [--num-pages N] [--reps N] [--budget N] [--track]
+        [--slots N] [--num-pages N] [--reps N] [--budget N]
+        [--sessions N] [--session-tokens N] [--session-new N] [--track]
 """
 import argparse
 import os
@@ -113,6 +127,44 @@ def _drain(model, prompts, args, auto, prefill_mode,
     return best, ttfts, srv, n_warm
 
 
+def _session_bench(model, args, host_tier):
+    """One pass of the multi-turn session workload. Serving config is
+    pinned (1 slot, page 8, 7-page pool) so the two passes compare at
+    EQUAL device memory and the pool genuinely cannot hold every
+    user's history: 16-token turn-1 prompts donate 2 full pages each,
+    so by the later users the earlier users' pages have been evicted
+    — dropped when ``host_tier`` is None, spilled when it is on."""
+    from paddle_tpu.inference.continuous_batching import \
+        ContinuousBatchingServer
+    srv = ContinuousBatchingServer(
+        model, max_slots=1, max_cache_len=64, cache_backend="paged",
+        page_size=8, num_pages=7, auto_prefix_cache=True,
+        prefill_mode="ragged", host_tier=host_tier)
+    rng = np.random.default_rng(1)
+    users = [rng.integers(0, 256, (args.session_tokens,))
+             .astype(np.int32) for _ in range(args.sessions)]
+    outs1 = []
+    for p in users:                         # turn 1: distinct histories
+        rid = srv.submit(p, max_new_tokens=args.session_new)
+        outs1.append(np.asarray(srv.run()[rid]))
+    h_tok0 = srv.stats["prefix_auto_hit_tokens"]
+    outs2 = []
+    for p, o in zip(users, outs1):          # turn 2: extend OWN history
+        ext = np.concatenate([p, o.astype(np.int32),
+                              rng.integers(0, 256, (2,))
+                              .astype(np.int32)])
+        rid = srv.submit(ext, max_new_tokens=args.session_new)
+        outs2.append(np.asarray(srv.run()[rid]))
+    tier = srv.host_tier
+    free, live, pinned, cached = srv.pool_balance()
+    return {"hit_tokens": srv.stats["prefix_auto_hit_tokens"] - h_tok0,
+            "outs": outs1 + outs2, "live": live,
+            "spilled": tier.spilled_pages_total if tier else 0,
+            "restored": tier.restored_pages_total if tier else 0,
+            "corrupt": tier.restore_corrupt_total if tier else 0,
+            "host_stats": tier.stats() if tier else None}
+
+
 def _row(name, t_wall, ttfts, srv):
     s = srv.stats
     disp = s["prefill_dispatches"] / max(s["admissions"], 1)
@@ -139,8 +191,15 @@ def main():
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--budget", type=int, default=None,
                     help="prefill_tokens_per_tick (ragged mode)")
+    ap.add_argument("--sessions", type=int, default=6,
+                    help="returning users in the multi-turn workload")
+    ap.add_argument("--session-tokens", type=int, default=16,
+                    help="turn-1 prompt tokens per user (2 donated "
+                         "pages at the pinned page size 8)")
+    ap.add_argument("--session-new", type=int, default=4)
     ap.add_argument("--track", action="store_true",
-                    help="append fused TTFT round to BENCHLOG.jsonl")
+                    help="append fused TTFT + tiered-session rounds "
+                         "to BENCHLOG.jsonl")
     args = ap.parse_args()
 
     model = _build_model()
@@ -204,6 +263,34 @@ def main():
           f"({'OK' if d_fu <= d_rg else 'REGRESSION'}; the launch "
           f"doubles as the decode tick)")
     ok = ok and d_fu <= d_rg
+
+    # ISSUE 17: multi-turn sessions — N users return to their own
+    # history under a pool too small to keep it all HBM-resident
+    from paddle_tpu.inference.kv_tier import HostTier
+    hbm = _session_bench(model, args, None)
+    tiered = _session_bench(model, args, HostTier())
+    ideal = args.sessions * (args.session_tokens // 8) * 8
+    t_rate = tiered["hit_tokens"] / max(ideal, 1)
+    h_rate = hbm["hit_tokens"] / max(ideal, 1)
+    print(f"\nsessions ({args.sessions} users x 2 turns, 7-page pool "
+          f"both runs):")
+    print(f"hbm-only  turn 2  : {hbm['hit_tokens']:4d}/{ideal} hit "
+          f"tokens ({h_rate:.2f}) — evictions DROPPED the history")
+    hs = tiered["host_stats"]
+    print(f"tiered    turn 2  : {tiered['hit_tokens']:4d}/{ideal} hit "
+          f"tokens ({t_rate:.2f}), spilled "
+          f"{tiered['spilled']} pages, restored {tiered['restored']}, "
+          f"corrupt {tiered['corrupt']}; host now holds "
+          f"{hs['entries']} pages / {hs['bytes_used']} bytes")
+    sess_ok = (tiered["hit_tokens"] > hbm["hit_tokens"]
+               and tiered["restored"] > 0 and tiered["corrupt"] == 0
+               and tiered["live"] == 0 and hbm["live"] == 0
+               and all(np.array_equal(a, b) for a, b
+                       in zip(hbm["outs"], tiered["outs"])))
+    print(f"session guard     : tiered strictly beats hbm-only at "
+          f"equal device memory, outputs bit-identical "
+          f"({'OK' if sess_ok else 'REGRESSION'})")
+    ok = ok and sess_ok
     if args.track:
         import importlib.util
         spec = importlib.util.spec_from_file_location(
@@ -221,6 +308,13 @@ def main():
                      f"system {args.system_tokens} tok, CPU "
                      f"llama_tiny; serving_mode=fused"})
         print(f"tracked {r['metric']} = {r['value']:.1f}")
+        r2 = bench_track.append_round(
+            {"metric": "tiered_session_turn2_hit_rate", "value": t_rate,
+             "unit": "ratio",
+             "note": f"{args.sessions} users x 2 turns, 7-page pool, "
+                     f"host tier on (hbm-only baseline {h_rate:.2f}); "
+                     f"restored {tiered['restored']} pages"})
+        print(f"tracked {r2['metric']} = {r2['value']:.2f}")
     return 0 if ok else 1
 
 

@@ -161,6 +161,76 @@ def _run_mode(args, rounds, n, policy, remote=False):
             "wall_s": wall}
 
 
+def _build_tiered(args, n, policy):
+    """N replicas with a SQUEEZED pool (7 pages) and a host tier each
+    — the ISSUE 17 session fleet: a user's turn-1 history cannot stay
+    HBM-resident, so the affinity signal the router reads MUST cover
+    host-resident runs (``PrefixCache.sketch()`` keeps spilled
+    fingerprints) or returning sessions route blind."""
+    from _serving_stub import StubModel
+    from paddle_tpu.inference.continuous_batching import \
+        ContinuousBatchingServer
+    from paddle_tpu.inference.kv_tier import HostTier
+    from paddle_tpu.inference.router import ReplicaRouter
+    reps = [ContinuousBatchingServer(
+        StubModel(), max_slots=args.slots,
+        max_cache_len=args.max_cache_len, cache_backend="paged",
+        page_size=args.page_size, num_pages=7,
+        host_tier=HostTier()) for _ in range(n)]
+    return ReplicaRouter(reps, policy=policy), reps
+
+
+def _bench_sessions(args):
+    """Session-affinity column (ISSUE 17): U users each serve a
+    distinct 2-page first turn across the tiered fleet, then every
+    user RETURNS with a prompt extending their own history. Reported
+    per policy: turn-2 prefix hit tokens (the rate is hit / ideal),
+    pages restored from host, and host residency — round-robin's
+    rotation sends the returning turn to a different replica, so its
+    history is a cross-replica miss; affinity follows the sketch back
+    to the replica still holding it in EITHER tier."""
+    from _serving_stub import stub_tokens
+    rng = np.random.default_rng(11)
+    users = [rng.integers(0, 16, (args.session_tokens,))
+             .astype(np.int32) for _ in range(args.session_users)]
+    ideal = args.session_users * \
+        (args.session_tokens // args.page_size) * args.page_size
+    rows = []
+    for policy in ("round_robin", "affinity"):
+        router, reps = _build_tiered(args, args.replicas, policy)
+        rids = [(router.submit(p, max_new_tokens=4), p) for p in users]
+        _drain_single(router, reps)
+        for rid, p in rids:                 # turn 1: build histories
+            np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                          stub_tokens(p, 4))
+        h0 = sum(r.stats["prefix_auto_hit_tokens"] for r in reps)
+        r0 = sum(r.host_tier.restored_pages_total for r in reps)
+        exts = [np.concatenate([p, stub_tokens(p, 4)[:2],
+                                np.asarray([int(p[0]) % 16], np.int32)])
+                for p in users]
+        rids = [(router.submit(e, max_new_tokens=4), e) for e in exts]
+        _drain_single(router, reps)
+        for rid, e in rids:                 # turn 2: return to them
+            np.testing.assert_array_equal(router.wait(rid, timeout=5),
+                                          stub_tokens(e, 4))
+        hit_tok = sum(r.stats["prefix_auto_hit_tokens"]
+                      for r in reps) - h0
+        restored = sum(r.host_tier.restored_pages_total
+                       for r in reps) - r0
+        corrupt = sum(r.host_tier.restore_corrupt_total for r in reps)
+        host_pages = sum(r.host_tier.stats()["entries"] for r in reps)
+        rows.append({"mode": f"{policy}-{args.replicas}",
+                     "turn2_hit_tokens": hit_tok, "ideal": ideal,
+                     "hit_rate": hit_tok / max(ideal, 1),
+                     "restored": restored, "corrupt": corrupt,
+                     "host_pages": host_pages})
+    rr, aff = rows
+    assert aff["turn2_hit_tokens"] > rr["turn2_hit_tokens"], \
+        "session affinity must beat round-robin on returning turns"
+    assert aff["restored"] > 0 and aff["corrupt"] == 0
+    return rows
+
+
 def _bench_failover(args):
     """K requests queued on a victim replica; kill it; ONE poll
     harvests + re-dispatches all K. Deterministic single-threaded."""
@@ -280,6 +350,17 @@ def main(argv=None):
     ap.add_argument("--max-cache-len", type=int, default=128)
     ap.add_argument("--page-size", type=int, default=8)
     ap.add_argument("--failover-k", type=int, default=8)
+    ap.add_argument("--sessions", action="store_true",
+                    help="also run the ISSUE 17 session-affinity "
+                         "column: returning users over a TIERED fleet "
+                         "(7-page pools + host tier per replica) — "
+                         "affinity must follow the sketch back to the "
+                         "replica holding the user's spilled history")
+    ap.add_argument("--session-users", type=int, default=8)
+    ap.add_argument("--session-tokens", type=int, default=16)
+    ap.add_argument("--track", action="store_true",
+                    help="append the session-affinity round to "
+                         "BENCHLOG.jsonl (needs --sessions)")
     ap.add_argument("--remote", action="store_true",
                     help="also run the affinity fleet as spawned "
                          "PROCESS replicas over the wire transport "
@@ -312,6 +393,37 @@ def main(argv=None):
           f"{rr['failed']} failed requests, "
           f"{rr['requeued']} requeued")
     out = {"modes": modes, "failover": fo, "rolling_restart": rr}
+    if args.sessions:
+        rows = _bench_sessions(args)
+        print(f"\n  sessions ({args.session_users} users x 2 turns, "
+              f"tiered replicas: 7-page pools + host tier):")
+        print(f"  {'mode':<14} {'t2_hit_rate':>11} {'hit_tok':>8} "
+              f"{'restored':>8} {'host_pages':>10}")
+        for m in rows:
+            print(f"  {m['mode']:<14} {m['hit_rate']:>11.2f} "
+                  f"{m['turn2_hit_tokens']:>8} {m['restored']:>8} "
+                  f"{m['host_pages']:>10}")
+        print(f"  returning turns follow the sketch home: affinity "
+              f"restores spilled history, round-robin's rotation "
+              f"lands on replicas that never saw it")
+        out["sessions"] = rows
+        if args.track:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "bench_track",
+                os.path.join(_REPO, "scripts", "bench_track.py"))
+            bench_track = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(bench_track)
+            aff = rows[-1]
+            r = bench_track.append_round(
+                {"metric": "router_session_affinity_hit_rate",
+                 "value": aff["hit_rate"], "unit": "ratio",
+                 "note": f"{args.session_users} users x 2 turns, "
+                         f"{args.replicas} tiered stub replicas "
+                         f"(round-robin baseline "
+                         f"{rows[0]['hit_rate']:.2f}); "
+                         f"{aff['restored']} pages restored"})
+            print(f"  tracked {r['metric']} = {r['value']:.2f}")
     if args.remote:
         rm = _bench_remote(args, rounds)
         inproc = modes[-1]               # the in-process affinity fleet

@@ -9,7 +9,10 @@ spans), then again with a ``FlightRecorder`` attached DISABLED
 (``enabled=False`` — must be structurally free: the server treats it
 as None) and ENABLED (event ring + per-tick dispatch profiles), then
 the same pair for the ``GoodputLedger`` (disabled = treated as None;
-enabled = per-token attribution + per-tick flush), and reports:
+enabled = per-token attribution + per-tick flush), then the
+``HostTier`` pair (ISSUE 17) on a squeezed PAGED pool — disabled
+(``HostTier(enabled=False)``) must be treated as None while enabled
+pays real spill/restore device transfers — and reports:
 
 - drain wall time per mode (best of N reps, compile warmed first),
 - per-tick decode latency from the enabled run's own
@@ -20,11 +23,12 @@ enabled = per-token attribution + per-tick flush), and reports:
   event / ledger add+flush, ns/op),
 - the enabled-vs-disabled overhead %% per layer — GUARDS: telemetry
   <2%%, disabled-recorder <2%%, disabled-ledger <2%%,
-  disabled-cost-catalog <2%% (the disabled-is-structurally-zero-cost
-  contract, measured end to end rather than assumed). The cost
-  catalog's ENABLED pair (ISSUE 13) additionally reports the AOT
-  pricing + compile-watch + phase-clock cost and the run's decode
-  FLOPs/MFU.
+  disabled-cost-catalog <2%%, disabled-host-tier <2%% (the
+  disabled-is-structurally-zero-cost contract, measured end to end
+  rather than assumed). The cost catalog's ENABLED pair (ISSUE 13)
+  additionally reports the AOT pricing + compile-watch + phase-clock
+  cost and the run's decode FLOPs/MFU; the host tier's reports pages
+  spilled/restored and resident host bytes.
 
     python benchmarks/telemetry_overhead_bench.py [--slots N]
         [--requests N] [--new-tokens N] [--reps N]
@@ -50,7 +54,7 @@ def _build_model():
 
 
 def _drain(model, telemetry, slots, requests, new_tokens, reps,
-           recorder=None, ledger=None, costs=None):
+           recorder=None, ledger=None, costs=None, **srv_kw):
     from paddle_tpu.inference.continuous_batching import \
         ContinuousBatchingServer
     rng = np.random.default_rng(0)
@@ -60,7 +64,7 @@ def _drain(model, telemetry, slots, requests, new_tokens, reps,
                                    max_cache_len=128,
                                    telemetry=telemetry,
                                    recorder=recorder, ledger=ledger,
-                                   costs=costs)
+                                   costs=costs, **srv_kw)
     for p in prompts[:slots]:                       # warm the compiles
         srv.submit(p, max_new_tokens=4)
     srv.run()
@@ -121,6 +125,22 @@ def main():
     cat = CostCatalog()
     t_cost_on, _ = _drain(model, None, args.slots, args.requests,
                           args.new_tokens, args.reps, costs=cat)
+    # host-tier pair (ISSUE 17) rides on a PAGED baseline (the tier
+    # needs the paged backend) with a pool squeezed so donated prefix
+    # pages actually evict: disabled (HostTier(enabled=False)) must be
+    # treated as None — structurally free — while enabled pays real
+    # spill gathers on evict and restore scatters when the reps re-hit
+    from paddle_tpu.inference.kv_tier import HostTier
+    pg_kw = {"cache_backend": "paged", "page_size": 8, "num_pages": 44}
+    t_pg, _ = _drain(model, None, args.slots, args.requests,
+                     args.new_tokens, args.reps, **pg_kw)
+    t_ht_off, _ = _drain(model, None, args.slots, args.requests,
+                         args.new_tokens, args.reps,
+                         host_tier=HostTier(enabled=False), **pg_kw)
+    tier = HostTier()
+    t_ht_on, _ = _drain(model, None, args.slots, args.requests,
+                        args.new_tokens, args.reps, host_tier=tier,
+                        **pg_kw)
 
     tick = tele.registry.get("serving_tick_seconds")
     overhead = (t_on - t_off) / t_off * 100.0
@@ -130,6 +150,8 @@ def main():
     led_on_overhead = (t_led_on - t_off) / t_off * 100.0
     cost_off_overhead = (t_cost_off - t_off) / t_off * 100.0
     cost_on_overhead = (t_cost_on - t_off) / t_off * 100.0
+    ht_off_overhead = (t_ht_off - t_pg) / t_pg * 100.0
+    ht_on_overhead = (t_ht_on - t_pg) / t_pg * 100.0
     goodput = led.snapshot()
     cost_snap = cat.snapshot()
 
@@ -180,6 +202,15 @@ def main():
           f"({cost_on_overhead:+.2f}%, {cost_snap['compiles']} "
           f"compiles, decode {dec_cost['flops']:.3g} FLOPs, "
           f"mfu {cost_snap['mfu'] or 0:.2e})")
+    print(f"drain paged base    : {t_pg * 1e3:9.1f} ms   "
+          f"(host-tier pair baseline: squeezed 44-page pool)")
+    print(f"drain host-tier off : {t_ht_off * 1e3:9.1f} ms   "
+          f"({ht_off_overhead:+.2f}% — structurally-zero guard)")
+    print(f"drain host-tier on  : {t_ht_on * 1e3:9.1f} ms   "
+          f"({ht_on_overhead:+.2f}%, spilled "
+          f"{tier.spilled_pages_total} pages, restored "
+          f"{tier.restored_pages_total}, "
+          f"{tier.stats()['bytes_used']} host bytes resident)")
     print(f"telemetry overhead  : {overhead:9.2f} %   (target < 2%)")
     print(f"counter.inc         : {ns_inc:9.0f} ns/op")
     print(f"hist.observe        : {ns_obs:9.0f} ns/op")
@@ -190,12 +221,14 @@ def main():
     print(f"ledger.add          : {ns_ladd:9.0f} ns/op")
     print(f"ledger add+flush    : {ns_lflush:9.0f} ns/op")
     # guards: full telemetry <2%, DISABLED recorder <2%, DISABLED
-    # ledger <2%, DISABLED cost catalog <2% (their events/clock reads
-    # are asserted zero in tests; wall clock is the end-to-end check
-    # that "treated as None" holds)
+    # ledger <2%, DISABLED cost catalog <2%, DISABLED host tier <2%
+    # vs its paged baseline (their events/clock reads are asserted
+    # zero in tests; wall clock is the end-to-end check that "treated
+    # as None" holds)
     return 0 if (overhead < 2.0 and rec_off_overhead < 2.0
                  and led_off_overhead < 2.0
-                 and cost_off_overhead < 2.0) else 1
+                 and cost_off_overhead < 2.0
+                 and ht_off_overhead < 2.0) else 1
 
 
 if __name__ == "__main__":

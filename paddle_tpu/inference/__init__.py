@@ -19,7 +19,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "export_program", "export_layer", "load_exported",
            "convert_to_mixed_precision", "get_version",
            # serving stack (beyond the reference surface)
-           "BatchScheduler", "ContinuousBatchingServer", "ReplicaRouter",
+           "BatchScheduler", "ContinuousBatchingServer", "HostTier",
+           "ReplicaRouter",
            "RouterSupervisor", "ReplicaHost", "RemoteReplica",
            "spawn_replica_host", "scan_decode",
            "greedy_generate", "sample_generate", "beam_generate",
@@ -258,6 +259,7 @@ from .decode_loop import (scan_decode, greedy_generate,  # noqa: E402,F401
                           sample_generate, beam_generate, fsm_generate,
                           phrases_to_fsm, process_logits)
 from .continuous_batching import ContinuousBatchingServer  # noqa: E402,F401
+from .kv_tier import HostTier  # noqa: E402,F401
 from .router import ReplicaRouter, RouterSupervisor  # noqa: E402,F401
 from .remote import (ReplicaHost, RemoteReplica,  # noqa: E402,F401
                      spawn_replica_host)

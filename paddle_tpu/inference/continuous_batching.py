@@ -206,17 +206,26 @@ class PoolBalance(tuple):
     balanced by construction, and this view exists so dashboards,
     storms, and postmortems can ASSERT that instead of assuming it
     (a future page-partitioned layout reports through the same
-    surface)."""
+    surface).
+
+    Tiered KV (ISSUE 17) rides as attributes too: ``host`` —
+    host-resident radix-tree nodes (spilled pages; they hold NO device
+    page, so they are outside the 4-tuple, which keeps summing to the
+    usable pool) — and ``host_bytes``, the host tier's buffer bytes.
+    Chaos suites assert ``host == 0 and host_bytes == 0`` after a
+    drain + full eviction proves neither tier leaked."""
 
     def __new__(cls, free, live, pinned, cached, preempted=0,
                 preemptions=0, num_shards=1, per_shard=(),
-                shard_page_bytes=None):
+                shard_page_bytes=None, host=0, host_bytes=0):
         self = super().__new__(cls, (free, live, pinned, cached))
         self.preempted = preempted
         self.preemptions = preemptions
         self.num_shards = num_shards
         self.per_shard = tuple(per_shard)
         self.shard_page_bytes = shard_page_bytes
+        self.host = host
+        self.host_bytes = host_bytes
         return self
 
 
@@ -385,6 +394,7 @@ class ContinuousBatchingServer:
                  max_admissions_per_tick=None, serving_mode=None,
                  telemetry=None,
                  recorder=None, ledger=None, journeys=None, costs=None,
+                 host_tier=None, host_tier_bytes=None,
                  max_queue=None, shed_policy="reject",
                  retry_policy=None, breaker=None, fault_injector=None,
                  clock=None):
@@ -447,15 +457,40 @@ class ContinuousBatchingServer:
             from ..models.generation import paged_pool_shards
             self._pool_shards = paged_pool_shards(
                 mesh, int(self._caches["pool"]["k"].shape[3]))
+            # host KV tier (kv_tier.HostTier): eviction SPILLS cold
+            # prefix pages to checksummed host buffers instead of
+            # dropping them, and admissions hitting a spilled run
+            # restore it into fresh pool pages. True builds a default
+            # tier (host_tier_bytes= bounds it; None = unbounded);
+            # None/disabled keeps eviction exactly as before — zero
+            # locks, zero clock reads, structurally free, the same
+            # contract as ledger/recorder/costs
+            if host_tier is None and host_tier_bytes is not None:
+                host_tier = True
+            if host_tier is True:
+                from .kv_tier import HostTier
+                host_tier = HostTier(budget_bytes=host_tier_bytes,
+                                     fault_injector=fault_injector)
+            self.host_tier = host_tier
+            self._host = host_tier if (host_tier is not None
+                                       and host_tier.enabled) else None
+            if self._host is not None and self._host._faults is None:
+                # like the recorder: a bare tier adopts the server's
+                # injector so tier.spill/tier.restore storms need no
+                # extra wiring
+                self._host._faults = fault_injector
             # the radix tree indexes EVERY page-granular prefix in the
             # pool: register_prefix entries live in it pinned; with
             # auto_prefix_cache (default) finished requests donate
             # their prompt pages into it and lookups happen on every
             # admission — unpinned entries are evicted LRU whenever
-            # the allocator runs short
+            # the allocator runs short (demoted to the host tier when
+            # one is attached)
             from .prefix_cache import PrefixCache
             self._prefix = PrefixCache(self._kv,
-                                       fault_injector=fault_injector)
+                                       fault_injector=fault_injector,
+                                       host_tier=self._host,
+                                       spill=self._spill_payload)
             self._kv.reclaimer = self._reclaim_pages
             self._auto_prefix = bool(auto_prefix_cache)
             self._ragged_fn = (self._paged_bundle[5]
@@ -463,6 +498,12 @@ class ContinuousBatchingServer:
             self._fused_fn = (self._paged_bundle[6]
                               if len(self._paged_bundle) > 6 else None)
         else:
+            if host_tier is True or (host_tier is not None
+                                     and host_tier.enabled):
+                raise ValueError("host_tier= needs cache_backend="
+                                 "'paged' (the tier spills pool pages)")
+            self.host_tier = None
+            self._host = None
             self.page_size = None
             self._bt_pages = None
             self._pool_shards = 1
@@ -1197,6 +1238,126 @@ class ContinuousBatchingServer:
         return {"k": take(pool["k"], base["k"]),
                 "v": take(pool["v"], base["v"])}
 
+    def _spill_payload(self, page):
+        """One pool page's K and V rows as host numpy arrays — the
+        demotion gather ``PrefixCache.evict`` routes through the host
+        tier. On a sharded pool the gather goes PER SHARD: each
+        device ships only its kv-head slice (``addressable_shards``,
+        ordered by kv-head offset) and the slices concatenate on the
+        head dim — never a full-pool replication bounce (the PR-14
+        gap). Runs inside an allocator reclaim under the server lock,
+        off the tick path."""
+        page = int(page)
+        out = []
+        for name in ("k", "v"):
+            leaf = self._caches["pool"][name]
+            if self._pool_shards > 1:
+                try:
+                    shards = sorted(leaf.addressable_shards,
+                                    key=lambda s: s.index[3].start or 0)
+                    out.append(np.concatenate(
+                        [np.asarray(s.data[:, page]) for s in shards],
+                        axis=2))
+                    continue
+                except Exception:
+                    pass       # runtime hid the buffers: global gather
+            out.append(np.asarray(jax.device_get(leaf[:, page])))
+        return out
+
+    def _restore_match(self, m):
+        """Restore a tree match's host-resident suffix into freshly
+        allocated pool pages so admission can take the WHOLE run by
+        reference through the normal ``admit_slot``/refcount path —
+        a restored run is bit-exact with a never-evicted one. Returns
+        a fresh all-hot ``PrefixMatch`` over the same nodes (possibly
+        trimmed to the hot prefix), or None when nothing survives.
+        Any failure is a MISS for the affected pages, never a request
+        failure: an injected ``tier.restore`` fault leaves the run
+        spilled for a later attempt, a checksum mismatch forgets the
+        corrupt node (and its all-host subtree) for good, and an
+        OutOfPages trims to the hot prefix.
+
+        On a sharded pool the scatter goes PER SHARD: the host
+        payload is laid out against the pool's own sharding
+        (``jax.device_put`` with the leaf's sharding — each device
+        receives only its kv-head slice) before one batched
+        ``.at[].set`` — the restore mirror of the spill gather."""
+        from .prefix_cache import PrefixMatch
+        nodes = m.nodes
+        hot = m.hot_len()
+        if hot == len(nodes):
+            return m
+        tele = self._tele
+        t0 = tele.restore_started() if tele is not None else None
+        payloads, restoring, n_restored = [], [], 0
+        for nd in nodes[hot:]:
+            try:
+                payload = self._host.get(nd.host, fp=nd.fp)
+            except Exception:
+                break          # transient (injected) miss: run stays
+            #                    spilled, nodes intact for retry
+            if payload is None:
+                # checksum mismatch: the payload is unservable — drop
+                # the node and everything under it so the corrupt
+                # entry can never be matched again
+                if tele is not None:
+                    tele.on_host_restore_corrupt()
+                if self._rec is not None:
+                    self._rec.record("restore_corrupt", fp=nd.fp)
+                self._prefix.drop_subtree(nd)
+                break
+            payloads.append(payload)
+            restoring.append(nd)
+        if restoring:
+            # fresh pages for the suffix: protect the whole run across
+            # the alloc — its reclaim sweep must not demote the hot
+            # prefix (not yet referenced by a slot) or shrink away the
+            # very entries being restored
+            self._prefix.protect(nodes[:hot] + restoring)
+            try:
+                fresh = self._kv.alloc(len(restoring))
+            except Exception:
+                fresh = None   # pool exhausted even after reclaim:
+            finally:           # serve the hot prefix only
+                self._prefix.protect(())
+            if fresh is not None:
+                idx = jnp.asarray(np.asarray(fresh, np.int32))
+                pool = dict(self._caches["pool"])
+                for j, name in enumerate(("k", "v")):
+                    leaf = pool[name]
+                    # [L, n, pg, kvh, hd]: page payloads stacked on a
+                    # new pages axis, matching leaf[:, idx]
+                    val = np.stack([p[j] for p in payloads], axis=1)
+                    val = val.astype(leaf.dtype)
+                    if self._pool_shards > 1:
+                        try:
+                            val = jax.device_put(
+                                val, leaf.sharding)
+                        except Exception:
+                            pass
+                    pool[name] = leaf.at[:, idx].set(jnp.asarray(val))
+                self._caches = dict(self._caches, pool=pool)
+                for nd, page in zip(restoring, fresh):
+                    self._prefix.promote(nd, page)
+                if self._costs is not None:
+                    # priced like the gather/scatter detours: bytes
+                    # moved both ways, zero FLOPs — and NOT a tick
+                    # dispatch (restores must not count against the
+                    # megakernel's serving_tick_dispatches profile)
+                    self._charge_transfer(
+                        "page_restore",
+                        2 * len(fresh) * self._kv.page_size
+                        * self._row_nbytes())
+                if self._rec is not None:
+                    self._rec.record("restore", pages=len(fresh))
+                n_restored = len(fresh)
+                hot += n_restored
+        if tele is not None:
+            tele.on_host_restore(n_restored, t0)
+        if hot == 0:
+            return None
+        return PrefixMatch(nodes[:hot], self._kv.page_size)
+
     def _sync_block_table(self):
         """Push the host block-table mirror to the device copy the
         decode program reads. Same shape every time — page churn never
@@ -1240,7 +1401,8 @@ class ContinuousBatchingServer:
             pinned = self._prefix.pinned_pages
             cached = self._prefix.cached_pages
             self._tele.set_pool(self._kv.free_pages(),
-                                used - pinned - cached, pinned, cached)
+                                used - pinned - cached, pinned, cached,
+                                self._prefix.host_pages)
             self._tele.set_pool_shards(self._pool_shards,
                                        self._shard_pool_bytes())
 
@@ -1276,20 +1438,42 @@ class ContinuousBatchingServer:
                                preempted=len(self._preempted),
                                preemptions=self.stats["preemptions"],
                                num_shards=shards, per_shard=per_shard,
-                               shard_page_bytes=self._shard_pool_bytes())
+                               shard_page_bytes=self._shard_pool_bytes(),
+                               host=self._prefix.host_pages,
+                               host_bytes=self._host.bytes_used
+                               if self._host is not None else 0)
 
     def _reclaim_pages(self, shortfall):
         """``PagedKVCache.alloc``'s reclaimer: evict LRU cached prefix
         pages when the free list runs short. An injected
         ``prefix.evict`` fault aborts THIS sweep — alloc then raises
         OutOfPages and admission defers to the next tick; either way
-        no page leaks and no request fails."""
+        no page leaks and no request fails. With a host tier the
+        sweep DEMOTES instead of dropping: spills are counted (and
+        priced — ``page_spill``, 2x bytes moved, never a tick
+        dispatch) here by diffing the tier's totals across the sweep,
+        so the eviction metrics split into spilled vs dropped."""
+        tier = self._host
+        s0 = tier.spilled_pages_total if tier is not None else 0
         try:
             freed = self._prefix.evict(shortfall)
         except Exception:
             return 0
-        if freed and self._tele is not None:
-            self._tele.on_prefix_evict(freed)
+        spilled = tier.spilled_pages_total - s0 \
+            if tier is not None else 0
+        if spilled:
+            if self._tele is not None:
+                self._tele.on_host_spill(spilled)
+            if self._rec is not None:
+                self._rec.record("spill", pages=spilled)
+            if self._costs is not None:
+                self._charge_transfer(
+                    "page_spill",
+                    2 * spilled * self._kv.page_size
+                    * self._row_nbytes())
+        dropped = freed - spilled
+        if dropped and self._tele is not None:
+            self._tele.on_prefix_evict(dropped)
         if freed and self._rec is not None:
             self._rec.record("evict", pages=freed)
         return freed
@@ -1379,7 +1563,12 @@ class ContinuousBatchingServer:
         elif best[0] == "reg":
             shared, nodes = len(best[1][3]), ()
         else:
-            shared, nodes = len(best[1].pages), best[1].nodes
+            # only the HOT prefix is shared by reference; a
+            # host-resident suffix needs fresh pool pages (the restore
+            # allocates them before admit_slot), so it counts toward
+            # need exactly like prefilling those tokens would
+            hot = best[1].hot_len()
+            shared, nodes = hot, best[1].nodes[:hot]
         need = self._npages_for(
             self._extent_tokens(head.ids.shape[0], head.budget)) - shared
         avail = self._kv.free_pages() \
@@ -1615,6 +1804,14 @@ class ContinuousBatchingServer:
             self._faults.check(faults.PREFILL, rid=req.rid)
         ids = req.ids
         T = ids.shape[0]
+        if best is not None and best[0] == "tree" \
+                and self._host is not None:
+            # the match may carry a host-resident suffix: restore it
+            # into fresh pool pages FIRST so admit_slot below shares
+            # the whole run by reference like any hot hit (a failed
+            # restore just trims the match — prefill covers the rest)
+            m = self._restore_match(best[1])
+            best = None if m is None else ("tree", m)
         if best is not None:
             m = best[1]
             n_pre, pre_pages = m.tokens, m.pages
@@ -1955,6 +2152,13 @@ class ContinuousBatchingServer:
         # only the remainder is prefilled.
         if best is None:
             best = self._best_hit(ids)
+        if best is not None and best[0] == "tree" \
+                and self._host is not None:
+            # restore any host-resident suffix before the pages are
+            # shared/gathered below (dense path mirror of the ragged
+            # _reserve_one wiring)
+            m2 = self._restore_match(best[1])
+            best = None if m2 is None else ("tree", m2)
         if best is not None and best[0] == "tree":
             n_pre, pre_pages = best[1].tokens, best[1].pages
         elif best is not None:
@@ -2975,9 +3179,10 @@ class ContinuousBatchingServer:
                 "preemptions": bal.preemptions,
                 "num_shards": bal.num_shards,
                 "per_shard": list(bal.per_shard),
-                "shard_page_bytes": bal.shard_page_bytes}
+                "shard_page_bytes": bal.shard_page_bytes,
+                "host": bal.host, "host_bytes": bal.host_bytes}
             sections["block_table"] = self._kv.occupancy(
-                num_shards=self._pool_shards)
+                num_shards=self._pool_shards, host_tier=self._host)
             sections["prefix_cache"] = self._prefix.stats()
         if self._led is not None:
             # how much of the hardware's recent work was useful is

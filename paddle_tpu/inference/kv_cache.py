@@ -213,7 +213,7 @@ class PagedKVCache:
         return pages
 
     # ------------------------------------------------------- accounting
-    def occupancy(self, num_shards=1):
+    def occupancy(self, num_shards=1, host_tier=None):
         """Per-slot block-table occupancy, plain data — the postmortem
         bundle's "who holds which pages" section: pages held and
         shared-prefix pages per occupied slot, plus the pool totals.
@@ -223,7 +223,12 @@ class PagedKVCache:
         global — every page id exists on every shard, split on the
         kv-head dim — so per-shard occupancy equals the global counts
         on each shard; the view states that balance explicitly so
-        dashboards and postmortems assert it instead of assuming it."""
+        dashboards and postmortems assert it instead of assuming it.
+
+        ``host_tier`` (a ``kv_tier.HostTier.stats()`` dict, or the
+        tier itself) appends the host tier's residency as a
+        ``host_tier`` section — the "where did the evicted pages GO"
+        half of the occupancy picture once spill-to-host is on."""
         occ = {"free_pages": self.free_pages(),
                "used_pages": self.used_pages(),
                "pages_per_slot": self.pages_per_slot,
@@ -235,6 +240,10 @@ class PagedKVCache:
                               "free_pages": occ["free_pages"],
                               "used_pages": occ["used_pages"]}
                              for i in range(num_shards)]
+        if host_tier is not None:
+            occ["host_tier"] = dict(host_tier.stats()
+                                    if hasattr(host_tier, "stats")
+                                    else host_tier)
         return occ
 
     def telemetry_stats(self):

@@ -50,6 +50,20 @@ tests/test_prefix_cache.py.
 
 Host-side only, mutated exclusively under the server lock.
 
+Tiered residency (ISSUE 17): with a ``kv_tier.HostTier`` attached,
+eviction becomes SPILL-TO-HOST instead of drop. A demoted node stays
+in the tree — same key, same fingerprint (so ``sketch()`` keeps
+advertising the run to the router) — but its ``page`` becomes None and
+``host`` holds the tier entry with the page's checksummed K/V payload.
+Demotion goes bottom-up (a node is demoted only once it has no hot
+descendant), so every root-to-leaf path is a HOT prefix followed by a
+HOST suffix; ``lookup()`` returns the full run and the server restores
+the host suffix into freshly-allocated pool pages before prefill.
+Host entries are forgotten for real only at the bottom of the
+hierarchy: when the tier's byte budget overflows, the LRU host LEAF
+entries leave the tree (``_host_shrink``). One LRU clock
+(``last_used``/``seq``) orders both tiers.
+
 Mesh contract (ISSUE 16, sharded paged serving): the tree indexes
 PAGE IDS, and on a mesh the pool arrays those ids address are sharded
 on the kv-head dimension — so every cached page's K/V state is
@@ -96,10 +110,12 @@ class _Node:
     """One cached page: ``key`` is the page's token tuple, ``page`` its
     pool id. ``last_used``/``seq`` order eviction (LRU, then insertion
     order); ``pinned`` marks register_prefix entries; ``fp`` is the
-    node's rolling path fingerprint (see ``sketch()``)."""
+    node's rolling path fingerprint (see ``sketch()``). A HOST-resident
+    node (demoted by eviction) has ``page is None`` and ``host`` set to
+    its ``kv_tier.HostEntry``; exactly one of the two is ever set."""
 
     __slots__ = ("key", "page", "parent", "children", "pinned",
-                 "last_used", "seq", "fp")
+                 "last_used", "seq", "fp", "host")
 
     def __init__(self, key, page, parent, fp=0):
         self.key = key
@@ -110,6 +126,7 @@ class _Node:
         self.last_used = 0
         self.seq = 0
         self.fp = fp
+        self.host = None
 
 
 class PrefixMatch:
@@ -134,6 +151,18 @@ class PrefixMatch:
             return None
         return PrefixMatch(self.nodes[:-1], self._page_size)
 
+    def hot_len(self):
+        """Leading nodes that are device-resident RIGHT NOW — the
+        shared run an admission can take without a restore; everything
+        after is the host suffix (demotion is bottom-up, so the split
+        is always prefix/suffix). ``pages``/``tokens`` are snapshots
+        from construction: after restoring/promoting nodes, build a
+        fresh ``PrefixMatch`` from the same nodes."""
+        for i, n in enumerate(self.nodes):
+            if n.page is None:
+                return i
+        return len(self.nodes)
+
 
 class PrefixCache:
     """Radix-tree index of cached prefix pages over one ``PagedKVCache``.
@@ -146,9 +175,18 @@ class PrefixCache:
     (``pool_balance()`` / the ``kv_pool_pages`` gauge).
     """
 
-    def __init__(self, kv, fault_injector=None):
+    def __init__(self, kv, fault_injector=None, host_tier=None,
+                 spill=None):
         self.kv = kv
         self.page_size = kv.page_size
+        # second tier (kv_tier.HostTier): eviction demotes instead of
+        # dropping. ``spill(page_id) -> payload arrays`` is the
+        # server-bound device gather (per-shard on a mesh); without
+        # both, eviction behaves exactly as before.
+        self._tier = host_tier \
+            if (host_tier is not None and host_tier.enabled
+                and spill is not None) else None
+        self._spill = spill
         self._root = _Node(None, None, None, fp=_SKETCH_ROOT)
         # fingerprint index maintained INCREMENTALLY alongside the tree
         # (one rolling hash per node) and published as an immutable
@@ -166,6 +204,7 @@ class PrefixCache:
         self._faults = fault_injector
         self.pinned_pages = 0   # nodes register_prefix pinned (never evicted)
         self.cached_pages = 0   # unpinned nodes (evictable when refcount 1)
+        self.host_pages = 0     # host-resident nodes (no device page)
         # cumulative stats (the server mirrors these into telemetry)
         self.donated_pages_total = 0   # new nodes created by donate()
         self.dedup_pages_total = 0     # donated pages already in the tree
@@ -211,10 +250,17 @@ class PrefixCache:
         return PrefixMatch(run, self.page_size)
 
     def node_run(self, ids):
-        """Existing nodes covering ``ids`` (which must be page-aligned)
-        — register_prefix adopts these instead of re-allocating."""
+        """Existing HOT nodes covering ``ids`` (which must be
+        page-aligned) — register_prefix adopts these instead of
+        re-allocating. The run stops at the first host-resident node:
+        a pinned entry computes (and pins) its own fresh pages from
+        there, replacing the spilled payloads (``extend_pinned``)."""
         ids = np.asarray(ids).reshape(-1)
-        return self._walk(ids, len(ids) // self.page_size)
+        run = self._walk(ids, len(ids) // self.page_size)
+        for i, n in enumerate(run):
+            if n.page is None:
+                return run[:i]
+        return run
 
     def _touch(self, node):
         self._tick += 1
@@ -257,7 +303,17 @@ class PrefixCache:
         node, new = self._root, 0
         for key, page in zip(self._page_keys(ids, nf), pages[:nf]):
             child = node.children.get(key)
-            if child is not None:
+            if child is not None and child.page is None:
+                # host-resident: the donated page IS this prefix's KV
+                # state, recomputed by the slot that just finished —
+                # adopt it (a free promotion) and drop the spilled
+                # payload instead of ever reading it back
+                self._tier.discard(child.host)
+                child.host = None
+                child.page = page
+                self.host_pages -= 1
+                self.cached_pages += 1
+            elif child is not None:
                 # already cached (maybe the very page this slot shared
                 # at admission): drop the slot's duplicate reference
                 self.kv.release([page])
@@ -292,6 +348,11 @@ class PrefixCache:
             ok = True
             for ch in n.children.values():
                 ok = walk(ch) and ok
+            if n.page is None:
+                # host-resident: holds no device page — transparent to
+                # the sweep (never a candidate, never a blocker; its
+                # hot ancestors demote right over it)
+                return ok
             ok = (ok and not n.pinned and id(n) not in ex
                   and self.kv.refcount(n.page) == 1)
             if ok:
@@ -316,29 +377,133 @@ class PrefixCache:
         self._protected = frozenset(id(n) for n in nodes)
 
     def evict(self, need):
-        """Free up to ``need`` pages, least-recently-used leaf first
-        (ties by insertion order — fully deterministic). Returns the
-        number freed; raising (``prefix.evict`` fault) happens strictly
-        before any state changes."""
+        """Free up to ``need`` device pages, least-recently-used leaf
+        first (ties by insertion order — fully deterministic). With a
+        host tier attached the victim is DEMOTED — payload spilled to
+        the tier, node kept (fingerprint and all) with ``page=None`` —
+        and only dropped outright when the spill itself fails
+        (injected ``tier.spill`` fault / gather error). Either way the
+        victim's device page is freed, so the sweep is leak-free under
+        fault storms. Returns the number of device pages freed;
+        raising (``prefix.evict`` fault) happens strictly before any
+        state changes."""
         if self._faults is not None:
             self._faults.check(PREFIX_EVICT, need=int(need))
         safe = set(self._evictable())
         freed = 0
         while freed < int(need):
-            leaves = [n for n in safe if not n.children]
+            # device-leaves: safe nodes with no HOT child (a demoted
+            # child stays in the tree, so "no children" is too strong
+            # once the tier is on; a hot child not in ``safe`` already
+            # disqualified its ancestors in the walk)
+            leaves = [n for n in safe
+                      if not any(ch.page is not None
+                                 for ch in n.children.values())]
             if not leaves:
                 break
             victim = min(leaves, key=lambda n: (n.last_used, n.seq))
-            del victim.parent.children[victim.key]
             safe.discard(victim)
-            self._sketch.discard(victim.fp)
-            self.kv.release([victim.page])
-            self.cached_pages -= 1
-            self.evicted_pages_total += 1
+            if self._demote(victim):
+                self.kv.release([victim.page])
+                victim.page = None
+                self.cached_pages -= 1
+                self.host_pages += 1
+            else:
+                # plain drop — the node leaves the tree, taking its
+                # (all-host) subtree with it
+                self.drop_subtree(victim)
             freed += 1
         if freed:
             self._sketch_dirty = True
+        self._host_shrink()
         return freed
+
+    def _demote(self, victim):
+        """Try to spill ``victim``'s page payload to the host tier.
+        True on success (caller flips the node to host residency);
+        False — no tier, injected spill fault, or gather failure —
+        means fall back to dropping, with no tier state changed."""
+        if self._tier is None:
+            return False
+        try:
+            payload = self._spill(victim.page)
+            victim.host = self._tier.put(payload, page=int(victim.page))
+        except Exception:
+            victim.host = None
+            return False
+        return True
+
+    def drop_subtree(self, node):
+        """Remove ``node`` and everything below it from the tree: hot
+        pages go back to the allocator, host entries leave the tier,
+        fingerprints leave the sketch. Used for the spill-fault drop
+        path and for forgetting a corrupted host run. Returns device
+        pages released."""
+        if node.parent is not None \
+                and node.parent.children.get(node.key) is node:
+            del node.parent.children[node.key]
+        released = 0
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            n.children = {}
+            self._sketch.discard(n.fp)
+            if n.page is not None:
+                self.kv.release([n.page])
+                if n.pinned:
+                    self.pinned_pages -= 1
+                else:
+                    self.cached_pages -= 1
+                self.evicted_pages_total += 1
+                released += 1
+            elif n.host is not None:
+                self._tier.discard(n.host, evicted=True)
+                n.host = None
+                self.host_pages -= 1
+        self._sketch_dirty = True
+        return released
+
+    def _host_shrink(self):
+        """The bottom of the hierarchy: while the host tier is over
+        its byte budget, its least-recently-used LEAF entries are
+        forgotten for real (LRU then insertion order, leaf first —
+        the same deterministic order as device eviction)."""
+        if self._tier is None or not self._tier.over_budget():
+            return
+        leaves = []
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            if n.page is None and not n.children \
+                    and id(n) not in self._protected:
+                leaves.append(n)
+        while self._tier.over_budget() and leaves:
+            victim = min(leaves, key=lambda n: (n.last_used, n.seq))
+            leaves.remove(victim)
+            del victim.parent.children[victim.key]
+            self._sketch.discard(victim.fp)
+            self._tier.discard(victim.host, evicted=True)
+            victim.host = None
+            self.host_pages -= 1
+            self._sketch_dirty = True
+            p = victim.parent
+            if p is not self._root and p.page is None \
+                    and not p.children and id(p) not in self._protected:
+                leaves.append(p)
+
+    def promote(self, node, page):
+        """A restore landed: ``node``'s payload is back in pool page
+        ``page`` (the caller transfers its one allocator reference to
+        the node — the normal donate ownership contract) and the host
+        entry's bytes return to the tier."""
+        self._tier.discard(node.host)
+        node.host = None
+        node.page = page
+        self.host_pages -= 1
+        self.cached_pages += 1
+        self._touch(node)
 
     # ----------------------------------------------------------- pinning
     def extend_pinned(self, ids, run, own_pages):
@@ -357,14 +522,24 @@ class PrefixCache:
         keys = self._page_keys(ids, len(ids) // self.page_size)
         added = False
         for key, page in zip(keys[len(run):], own_pages):
-            child = _Node(key, page, node, fp=hash((node.fp, key)))
+            child = node.children.get(key)
+            if child is not None:
+                # a host-resident node on this path (node_run stopped
+                # above it): the entry's freshly-computed page replaces
+                # the spilled payload — promote-by-pin, no restore read
+                self._tier.discard(child.host)
+                child.host = None
+                child.page = page
+                self.host_pages -= 1
+            else:
+                child = _Node(key, page, node, fp=hash((node.fp, key)))
+                self._seq += 1
+                child.seq = self._seq
+                node.children[key] = child
+                self._sketch.add(child.fp)
+                added = True
             child.pinned = True
-            self._seq += 1
-            child.seq = self._seq
             self._touch(child)
-            node.children[key] = child
-            self._sketch.add(child.fp)
-            added = True
             node = child
             self.pinned_pages += 1
         if added:
@@ -407,6 +582,7 @@ class PrefixCache:
         fleet will re-prefill)."""
         return {"cached_pages": self.cached_pages,
                 "pinned_pages": self.pinned_pages,
+                "host_pages": self.host_pages,
                 "sketch_size": len(self._sketch),
                 "donated_pages_total": self.donated_pages_total,
                 "dedup_pages_total": self.dedup_pages_total,

@@ -311,6 +311,9 @@ def serve_metrics(target, host="127.0.0.1", port=0):
             if kv is not None:
                 stats["kv_pool"] = kv.telemetry_stats()
                 stats["prefix_cache"] = target._prefix.stats()
+                tier = getattr(target, "_host", None)
+                if tier is not None:
+                    stats["host_tier"] = tier.stats()
             g = target.goodput() if callable(
                 getattr(target, "goodput", None)) else None
             if g is not None:

@@ -29,6 +29,7 @@ from .errors import InjectedFault
 __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
            "KV_GROW", "SERVER_PREEMPT",
            "ON_TOKEN", "PREFIX_EVICT", "PREFIX_DONATE",
+           "TIER_SPILL", "TIER_RESTORE",
            "ROUTER_DISPATCH", "ROUTER_EVACUATE",
            "NET_SEND", "NET_RECV", "NET_CONNECT", "NET_PARTITION",
            "CKPT_WRITE",
@@ -52,6 +53,16 @@ ON_TOKEN = "server.on_token"        # streamed-token callback delivery
 PREFIX_EVICT = "prefix.evict"       # PrefixCache.evict: LRU reclaim sweep
 PREFIX_DONATE = "prefix.donate"     # PrefixCache.donate: harvest-time
 #                                     adoption of a slot's prompt pages
+TIER_SPILL = "tier.spill"           # HostTier.put: demoting one evicted
+#                                     page's payload to host RAM (fires
+#                                     BEFORE the store — a faulted spill
+#                                     falls back to a plain drop, so the
+#                                     device page is freed either way)
+TIER_RESTORE = "tier.restore"       # HostTier.get: fetching a spilled
+#                                     payload at admission (fires BEFORE
+#                                     the read — a faulted restore is a
+#                                     cache MISS for that run, never a
+#                                     request failure)
 
 # failure points wired into the multi-replica router (inference/router.py)
 ROUTER_DISPATCH = "router.dispatch"  # ReplicaRouter: one replica submit

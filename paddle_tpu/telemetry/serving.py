@@ -158,6 +158,7 @@ class ServerTelemetry:
         self._g_pool_live = pool.labels(state="live")
         self._g_pool_pinned = pool.labels(state="pinned")
         self._g_pool_cached = pool.labels(state="cached")
+        self._g_pool_host = pool.labels(state="host")
         self._g_pool_shards = r.gauge(
             "kv_pool_shards",
             "Ways the paged KV pool is sharded over the mesh mp axis "
@@ -177,6 +178,23 @@ class ServerTelemetry:
         self._c_pfx_evicted = r.counter(
             "kv_prefix_evicted_pages_total",
             "Cached prefix pages reclaimed by LRU eviction")
+        # tiered KV (ISSUE 17): the host tier under the prefix cache
+        self._c_host_spilled = r.counter(
+            "kv_host_spilled_pages_total",
+            "Prefix pages demoted to the host KV tier at eviction")
+        self._c_host_restored = r.counter(
+            "kv_host_restored_pages_total",
+            "Host-tier pages promoted back into pool pages at "
+            "admission")
+        self._c_host_corrupt = r.counter(
+            "kv_host_restore_corrupt_total",
+            "Host-tier restores dropped on checksum mismatch (served "
+            "as a cache miss, never a request failure)")
+        self._h_restore = r.histogram(
+            "serving_restore_seconds",
+            "One admission's host-tier restore: checksummed payload "
+            "reads plus the batched pool scatter",
+            buckets=TICK_BUCKETS)
         self._c_null_writes = r.counter(
             "kv_null_redirected_writes_total",
             "Inactive-slot decode writes redirected to the null page "
@@ -410,7 +428,7 @@ class ServerTelemetry:
             self._g_active.set(n)
 
     # ------------------------------------------------------- cache state
-    def set_pool(self, free, live, pinned, cached=0):
+    def set_pool(self, free, live, pinned, cached=0, host=0):
         if not self.enabled:
             return
         self._g_pool_free.set(free)
@@ -418,6 +436,7 @@ class ServerTelemetry:
         self._g_pool_pinned.set(pinned)
         self._g_pool_cached.set(cached)
         self._g_pfx_cached.set(cached)
+        self._g_pool_host.set(host)
 
     def set_pool_shards(self, num_shards, shard_bytes):
         """Per-shard pool placement: how many ways the K/V pool is
@@ -447,6 +466,36 @@ class ServerTelemetry:
     def on_prefix_evict(self, pages):
         if self.enabled and pages:
             self._c_pfx_evicted.inc(pages)
+
+    def on_host_spill(self, pages):
+        """``pages`` prefix pages demoted to the host tier by one
+        eviction sweep (the tier kept them; ``on_prefix_evict`` counts
+        only pages dropped for real)."""
+        if self.enabled and pages:
+            self._c_host_spilled.inc(pages)
+
+    def restore_started(self):
+        """Clock read for ``on_host_restore``'s latency observation —
+        only called when a restore actually happens (host suffix hit),
+        so the no-tier hot path stays clock-free."""
+        return self.clock.now() if self.enabled else None
+
+    def on_host_restore(self, pages, started=None):
+        """``pages`` host-tier pages promoted back into pool pages by
+        one admission's restore (latency observed from ``started`` =
+        ``restore_started()``)."""
+        if not self.enabled:
+            return
+        if pages:
+            self._c_host_restored.inc(pages)
+        if started is not None:
+            self._h_restore.observe(self.clock.now() - started)
+
+    def on_host_restore_corrupt(self):
+        """A host-tier payload failed its sha256 check at restore —
+        served as a cache miss."""
+        if self.enabled:
+            self._c_host_corrupt.inc()
 
     def add_null_writes(self, n):
         if self.enabled and n:

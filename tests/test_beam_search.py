@@ -65,6 +65,7 @@ class TestBeamSearch:
             f"beam {tuple(out[0, 3:])} != brute-force {best_seq} "
             f"(score {best_score:.4f})")
 
+    @pytest.mark.slow
     def test_beam_improves_or_matches_greedy_likelihood(self):
         model = _tiny_vocab_model(V=16)
         rng = np.random.default_rng(23)

@@ -65,6 +65,7 @@ class TestMeshChangeRestore:
         assert y.sharding.spec == P("mp", "dp")
 
 
+@pytest.mark.slow
 def test_elastic_kill_relaunch(tmp_path):
     """2 real worker processes -> rank 1 crashes -> pod fails -> relaunch
     1 worker on a smaller/reshaped mesh resuming from checkpoint."""

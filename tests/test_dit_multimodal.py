@@ -113,6 +113,7 @@ class TestQwenVL:
         assert m.projector.weight.grad is not None
         assert m.lm_head.weight.grad is not None
 
+    @pytest.mark.slow
     def test_auto_parallel_shard(self):
         from paddle_tpu.models.qwen_vl import shard_qwen_vl
         from paddle_tpu.parallel.auto_parallel import ProcessMesh

@@ -295,6 +295,7 @@ class TestFusedServerParity:
         self._three_way(model, prompts, 6, do_sample=True,
                         temperature=1.3, top_k=9)
 
+    @pytest.mark.slow
     def test_sampled_parity_extreme_seeds(self):
         """Seeds with bit 31 set (and negative ones) must pack into
         the launch's int32 seed row by two's-complement wrap — NumPy 2

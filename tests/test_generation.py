@@ -19,6 +19,7 @@ def _naive_greedy(model, ids_np, n_new):
 
 
 class TestGreedyParity:
+    @pytest.mark.slow
     def test_llama_gqa_generate_matches_eager(self):
         from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
         pt.seed(11)
@@ -159,6 +160,7 @@ class TestQwenVLGenerate:
                              max_cache_len=64)
         np.testing.assert_array_equal(got.numpy(), cur)
 
+    @pytest.mark.slow
     def test_vl_generate_text_only(self):
         """Without pixels it degrades to plain llama-style decode."""
         from paddle_tpu.models.qwen_vl import QwenVL, qwen_vl_tiny

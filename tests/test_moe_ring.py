@@ -66,6 +66,7 @@ def test_ring_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_backward():
     from paddle_tpu.ops.pallas.ring_attention import ring_attention
     mesh = dist.init_mesh(dp=1, sp=4, mp=1)
@@ -118,6 +119,7 @@ def test_ulysses_matches_dense():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_moe_grouped_dispatch_matches_flat_shapes():
     """group_size path: per-group capacity, one [E, G*C, D] expert batch."""
     from paddle_tpu.parallel.moe import MoELayer

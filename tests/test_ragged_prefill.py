@@ -275,6 +275,7 @@ class TestRaggedServerParity:
         free, live, pinned, cached = srv.pool_balance()
         assert live == 0
 
+    @pytest.mark.slow
     def test_greedy_parity_chunk_straddling_budget(self):
         """A 4-token-per-tick budget slices every prompt across ticks
         at arbitrary (non-page-aligned) cut points; tokens must not

@@ -90,6 +90,7 @@ def _run_pair(model, mesh, prompts, n_new, seeds=None, srv_kw=None):
 
 
 class TestShardedPagedParity:
+    @pytest.mark.slow
     def test_greedy_parity_preemption_and_pool_shrink_mp4(self, model4):
         """The acceptance drill: optimistic admission on a tight pool
         forces a preemption/replay on BOTH servers; tokens stay

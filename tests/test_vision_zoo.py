@@ -106,6 +106,7 @@ def test_adaptive_pool_non_divisible_matches_torch():
     np.testing.assert_allclose(got, ref, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_adaptive_pool_upsample_case():
     # in_size < out_size (AlexNet on small inputs)
     x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
