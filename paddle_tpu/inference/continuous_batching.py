@@ -25,9 +25,10 @@ from ..core.tensor import unwrap
 from .kv_cache import OutOfPages
 from ..reliability import (CallbackError, CircuitOpenError, DEAD,
                            DEGRADED, DRAINING, DeadlineExceeded, HEALTHY,
-                           HealthMonitor, PreemptedError, QueueFullError,
-                           ReliabilityError, RequestCancelled,
-                           ServeSupervisor, ServerClosed, faults)
+                           HealthMonitor, MigrationError, PreemptedError,
+                           QueueFullError, ReliabilityError,
+                           RequestCancelled, ServeSupervisor, ServerClosed,
+                           faults)
 from ..telemetry.clock import MonotonicClock
 
 __all__ = ["ContinuousBatchingServer", "PreemptionPolicy", "PoolBalance"]
@@ -641,6 +642,12 @@ class ContinuousBatchingServer:
         # state_push dispatch ever fires on the tick path
         self._host_keys = np.zeros((self.max_slots, 2), np.uint32)
         self._preempted = []      # _Preempted records awaiting re-admission
+        self._migrating = {}      # rid -> (slot, tele t0): paused slots
+        #                           whose gathered pages are in flight to
+        #                           a sibling (migrate_out) — settled by
+        #                           migrate_finish (handoff committed,
+        #                           pages released/donated here) or
+        #                           migrate_abort (resume decoding here)
         self._priority_seen = False   # sticky: any submit(priority != 0)
         self._prefill_fifo = []   # slot ids mid-prefill, admission order
         self._prefill_used = 0    # tokens prefilled this tick
@@ -664,7 +671,12 @@ class ContinuousBatchingServer:
                       "prefill_wall_s": 0.0, "tick_dispatches": 0,
                       # admission="optimistic" accounting
                       "preemptions": 0, "preempt_resumed": 0,
-                      "grow_pages": 0, "headroom_pages": 0}
+                      "grow_pages": 0, "headroom_pages": 0,
+                      # live KV-page migration accounting: handoffs
+                      # committed as the SOURCE / degraded to
+                      # evacuate+replay / restored as the TARGET
+                      "migrations": 0, "migration_fallbacks": 0,
+                      "migrated_in": 0}
         # telemetry (paddle_tpu.telemetry.ServerTelemetry): True builds
         # a default-enabled one; None (default) keeps the hot path at
         # a single attribute check — no locks, no clock reads
@@ -3035,6 +3047,13 @@ class ContinuousBatchingServer:
             st = self._slots[slot]
             if st is None or st.deadline is None:
                 continue
+            if st.phase == "migrating":
+                # its pages are in flight to a sibling: expiring the
+                # slot here would tear down state migrate_finish/
+                # migrate_abort still owns. The pause spans ONE
+                # migration attempt; the deadline bites again the
+                # moment the slot resumes (or on the target)
+                continue
             if now is None:
                 now = self._clock.now()
             if now >= st.deadline:
@@ -3167,6 +3186,15 @@ class ContinuousBatchingServer:
                         "preempts": rec.preempts,
                         "emitted": len(rec.emitted)}
                        for rec in self._preempted],
+            # live KV-page migration state: the in-flight pauses an
+            # incident interrupted plus the cumulative outcome split —
+            # "did this replica hand its work off or flush it?" is the
+            # first question a drain/crash review asks
+            "migration": {
+                "in_flight": sorted(self._migrating),
+                "migrations": self.stats["migrations"],
+                "fallbacks": self.stats["migration_fallbacks"],
+                "migrated_in": self.stats["migrated_in"]},
         }
         if self._kv is not None:
             # pool_balance() is the ONE definition of the balance the
@@ -3594,6 +3622,314 @@ class ContinuousBatchingServer:
                 self._pool_gauges()
             self._done_cv.notify_all()
         return harvested
+
+    # ------------------------------------------- live KV-page migration
+    def migrate_out(self, rid):
+        """Gather a live mid-decode request's FULL resumable state so a
+        sibling replica can continue it without re-prefilling: the
+        written pool pages (per-shard gathers on a mesh — the
+        ``_spill_payload`` path the host tier proved), the resolved
+        sampling seed, the emitted-token log, and the stream offset.
+        Returns ``(state, payloads)`` — ``state`` is a JSON-able dict
+        (page payloads carry their sha256 so the target verifies END TO
+        END, not just per wire frame), ``payloads`` is one ``[k, v]``
+        host-array pair per page.
+
+        The slot is PAUSED, not torn down: decode stops stepping it and
+        its pages stay pinned until the caller settles the handoff with
+        ``migrate_finish`` (target committed — release here, donate the
+        prompt prefix as usual) or ``migrate_abort`` (anything failed —
+        resume decoding here bit-exactly). Raises ``MigrationError``
+        when the request is not migratable (unknown rid, mid-prefill,
+        dense backend, already in flight); an injected
+        ``migrate.gather`` fault fires BEFORE the pause, so a faulted
+        attempt leaves the slot decoding untouched — never a leak."""
+        from .kv_tier import _sha256
+        with self._lock:
+            if self._kv is None:
+                raise MigrationError(
+                    "cache_backend='dense' has no page pool to migrate "
+                    f"(request {rid})")
+            slot = next((s for s in range(self.max_slots)
+                         if self._slots[s] is not None
+                         and self._slots[s].rid == rid), None)
+            if slot is None:
+                raise MigrationError(
+                    f"request {rid} holds no slot here (queued, parked, "
+                    f"finished, or foreign rids are not migratable — "
+                    f"evacuate/replay covers them)")
+            st = self._slots[slot]
+            if st.phase != "decode" or not st.emitted:
+                raise MigrationError(
+                    f"request {rid} is mid-{st.phase} — only mid-decode "
+                    f"slots migrate (a drain lets prefills finish "
+                    f"first)")
+            if rid in self._migrating:
+                raise MigrationError(
+                    f"request {rid} already has a migration in flight")
+            if self._faults is not None:
+                self._faults.check(faults.MIGRATE_GATHER, rid=rid)
+            t0 = self._tele.migration_started() \
+                if self._tele is not None else None
+            # the LAST emitted token is the decode program's pending
+            # input — sampled but not yet written, so the target
+            # rewrites nothing and re-prefills nothing
+            written = st.prompt_len + len(st.emitted) - 1
+            npages = self._npages_for(written)
+            pages = self._kv.slot_pages(slot)[:npages]
+            payloads = [self._spill_payload(p) for p in pages]
+            if self._costs is not None:
+                self._charge_transfer(
+                    "page_migrate",
+                    2 * npages * self._kv.page_size * self._row_nbytes())
+            remaining = None if st.deadline is None else \
+                max(0.0, st.deadline - self._clock.now())
+            state = {
+                "rid": rid,
+                "ids": [int(t) for t in st.ids],
+                "prompt_len": int(st.prompt_len),
+                "budget": int(st.budget),
+                "seed": int(st.seed),
+                "emitted": [int(t) for t in st.emitted],
+                "replayed": [int(t) for t in st.replayed],
+                "streamed": int(st.streamed),
+                "preempts": int(st.preempts),
+                "priority": int(st.priority),
+                "n_pre": int(st.n_pre),
+                "deadline_s": remaining,
+                "page_size": int(self._kv.page_size),
+                "written": int(written),
+                "sha256": [_sha256(p) for p in payloads],
+            }
+            # pause: the decode tick skips inactive rows, and (split
+            # mode) the device write cursor parks on the null page like
+            # a mid-prefill row — resume re-pushes tok/t/key exactly as
+            # _activate does, so nothing the device scribbles while
+            # paused is ever read
+            self._active[slot] = False
+            st.phase = "migrating"
+            if not self._fused:
+                self._pending_t[slot] = self.max_cache_len
+            self._migrating[rid] = (slot, t0)
+            if self._rec is not None:
+                self._rec.record("migrate_out", rid=rid, pages=npages,
+                                 tokens=len(st.emitted))
+            if st.journey is not None:
+                st.journey.event("migrating", at="source", pages=npages,
+                                 tokens=len(st.emitted))
+            return state, payloads
+
+    def migrate_finish(self, rid):
+        """Commit a migration: the target restored ``rid`` (and owns its
+        waiter now), so release the paused slot's pages here — through
+        the normal teardown, so the written prompt prefix is DONATED to
+        the prefix cache exactly like a finished request's. Counts
+        ``server_migrations_total{result="ok"}`` with the pause-to-
+        commit wall in ``serving_migration_seconds``. Nothing lands in
+        results or failures: like an evacuated rid, the caller owns the
+        request now."""
+        with self._lock:
+            ent = self._migrating.pop(rid, None)
+            if ent is None:
+                raise MigrationError(
+                    f"request {rid} has no migration in flight")
+            slot, t0 = ent
+            st = self._slots[slot]
+            if st is not None and st.rid == rid:
+                if st.journey is not None:
+                    st.journey.event("migrating", at="source",
+                                     handoff=True)
+                self._release_slot(slot)
+            if self._rec is not None:
+                self._rec.record("migrate_done", rid=rid)
+            self.stats["migrations"] += 1
+            if self._tele is not None:
+                self._tele.on_migration("ok", t0)
+                self._tele.on_cancel(rid)   # lifecycle closed HERE; the
+                #                             target counts nothing (no
+                #                             submit/admit there either)
+                self._pool_gauges()
+            self._done_cv.notify_all()
+
+    def migrate_abort(self, rid):
+        """Abort a migration and RESUME the paused slot bit-exactly:
+        re-push the pending token, write position, and the PRNG key
+        recomputed from the resolved seed (``PRNGKey(seed)`` advanced
+        one split per emitted token — the identical chain the device
+        carried), exactly as ``_activate`` primes a fresh slot. The
+        caller degrades to evacuate+replay or simply lets the slot keep
+        decoding here; either way zero pages moved and zero leaked.
+        Counts ``{result="fallback"}`` and freezes a postmortem (its
+        ``migration`` section carries the in-flight/outcome state).
+        Returns False when nothing was in flight for ``rid``."""
+        with self._lock:
+            ent = self._migrating.pop(rid, None)
+            if ent is None:
+                return False
+            slot, t0 = ent
+            st = self._slots[slot]
+            if st is None or st.rid != rid:
+                return False   # torn down behind the pause (hard stop)
+            st.phase = "decode"
+            if not self._fused:
+                key = jax.random.PRNGKey(st.seed)
+                if self.do_sample:
+                    for _ in range(len(st.emitted)):
+                        key, _ = jax.random.split(key)
+                self._pending_key[slot] = key
+                self._pending_tok[slot] = int(st.emitted[-1])
+                self._pending_t[slot] = \
+                    st.prompt_len + len(st.emitted) - 1
+            self._active[slot] = True
+            self.stats["migration_fallbacks"] += 1
+            if self._rec is not None:
+                self._rec.record("migrate_fallback", rid=rid)
+                self._postmortem_locked("migration_fallback")
+            if st.journey is not None:
+                st.journey.event("migrating", at="source", fallback=True)
+            if self._tele is not None:
+                self._tele.on_migration("fallback", t0)
+            return True
+
+    def migrate_in(self, state, payloads, on_token=None, journey=None):
+        """Restore a migrated request into THIS replica and resume its
+        decode mid-chain: fresh pool pages through the normal
+        ``admit_slot`` path, one batched scatter of the received page
+        payloads (laid out per shard on a mesh — the ``_restore_match``
+        mirror of the source's per-shard gather), and the slot primed
+        exactly as ``_activate`` would have left it at this point of
+        the chain — so the token stream continues bit-exactly, greedy
+        or seeded-sampled, with ZERO re-prefill dispatches (the scatter
+        is priced as ``page_migrate`` bytes, never counted as a
+        prefill). Returns the request's NEW rid here (``wait`` on it as
+        usual).
+
+        Every refusal is typed and leak-free: an injected
+        ``migrate.restore`` fault, a page failing its end-to-end sha256
+        check, or a geometry mismatch raises ``MigrationError`` BEFORE
+        any allocation; ``OutOfPages`` (no free slot / pool exhausted)
+        propagates from the admit; a scatter failure rolls the fresh
+        pages back. The source aborts and the caller replays — never a
+        request failure."""
+        from .kv_tier import _sha256
+        with self._lock:
+            if self._kv is None:
+                raise MigrationError(
+                    "cache_backend='dense' has no page pool to restore "
+                    "migrated pages into")
+            if not self._accepting:
+                raise MigrationError(
+                    "replica is draining/stopped — not accepting "
+                    "migrated requests")
+            if self._faults is not None:
+                self._faults.check(faults.MIGRATE_RESTORE,
+                                   rid=state.get("rid"))
+            if int(state.get("page_size", self.page_size)) \
+                    != self.page_size:
+                raise MigrationError(
+                    f"page-size mismatch: source pages are "
+                    f"{state.get('page_size')} tokens, this pool's are "
+                    f"{self.page_size} — migration ships pages whole")
+            emitted = [int(t) for t in state["emitted"]]
+            prompt_len = int(state["prompt_len"])
+            budget = int(state["budget"])
+            if not emitted or len(emitted) >= budget:
+                raise MigrationError(
+                    "only mid-decode state restores (source sends "
+                    "nothing for queued/finished requests)")
+            written = prompt_len + len(emitted) - 1
+            if len(payloads) != self._npages_for(written):
+                raise MigrationError(
+                    f"page-count mismatch: {len(payloads)} payloads for "
+                    f"{written} written rows "
+                    f"(expected {self._npages_for(written)})")
+            for i, want in enumerate(state.get("sha256") or ()):
+                if _sha256(payloads[i]) != want:
+                    raise MigrationError(
+                        f"migrated page {i}/{len(payloads)} failed its "
+                        f"end-to-end sha256 check")
+            slot = next((s for s in range(self.max_slots)
+                         if self._slots[s] is None), None)
+            if slot is None:
+                raise OutOfPages(
+                    f"no free slot for a migrated request "
+                    f"(all {self.max_slots} busy)")
+            remaining = budget - len(emitted)
+            own = self._kv.admit_slot(
+                slot, max(written, self._extent_tokens(written,
+                                                       remaining)))
+            try:
+                idx = jnp.asarray(np.asarray(own[:len(payloads)],
+                                             np.int32))
+                pool = dict(self._caches["pool"])
+                for j, name in enumerate(("k", "v")):
+                    leaf = pool[name]
+                    # [L, n, pg, kvh, hd]: page payloads stacked on a
+                    # new pages axis, matching leaf[:, idx]
+                    val = np.stack([p[j] for p in payloads], axis=1)
+                    val = val.astype(leaf.dtype)
+                    if self._pool_shards > 1:
+                        try:
+                            val = jax.device_put(val, leaf.sharding)
+                        except Exception:
+                            pass
+                    pool[name] = leaf.at[:, idx].set(jnp.asarray(val))
+                self._caches = dict(self._caches, pool=pool)
+            except Exception:
+                self._kv.free_slot(slot)
+                raise
+            if self._costs is not None:
+                # priced like spill/restore — bytes both ways, zero
+                # FLOPs, and NOT a prefill dispatch: the acceptance
+                # counter (stats["prefill_dispatches"]) stays frozen
+                self._charge_transfer(
+                    "page_migrate",
+                    2 * len(payloads) * self.page_size
+                    * self._row_nbytes())
+            rid = self._next_rid
+            self._next_rid += 1
+            dl = state.get("deadline_s")
+            st = _Slot(rid, np.asarray(state["ids"], np.int32),
+                       prompt_len, budget, on_token,
+                       None if dl is None
+                       else self._clock.now() + float(dl))
+            st.seed = int(state["seed"])
+            st.emitted = list(emitted)
+            st.streamed = int(state.get("streamed", 0))
+            st.replayed = tuple(int(t) for t in
+                                state.get("replayed", ()))
+            st.preempts = int(state.get("preempts", 0))
+            st.priority = int(state.get("priority", 0))
+            st.n_pre = int(state.get("n_pre", 0))
+            st.journey = journey
+            self._slots[slot] = st
+            # prime the decode chain exactly where the source paused
+            # it: pending input = last emitted token, write position =
+            # the first unwritten row, PRNG key = seed advanced one
+            # split per emitted token (greedy never consumes it)
+            key = jax.random.PRNGKey(st.seed)
+            if self.do_sample:
+                for _ in range(len(emitted)):
+                    key, _ = jax.random.split(key)
+            if self._fused:
+                self._host_keys[slot] = np.asarray(key, np.uint32)
+            else:
+                self._pending_key[slot] = key
+                self._pending_tok[slot] = int(emitted[-1])
+                self._pending_t[slot] = written
+            self._active[slot] = True
+            self.stats["migrated_in"] += 1
+            if self._rec is not None:
+                self._rec.record("migrate_in", rid=rid,
+                                 pages=len(payloads),
+                                 tokens=len(emitted))
+            if journey is not None:
+                journey.event("migrating", at="target", slot=slot,
+                              tokens=len(emitted))
+            if self._tele is not None:
+                self._pool_gauges()
+            self._done_cv.notify_all()
+            return rid
 
     def kill(self, timeout=60.0):
         """Simulate a replica crash (failover drills, chaos suites):

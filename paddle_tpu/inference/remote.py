@@ -45,8 +45,9 @@ import time
 
 import numpy as np
 
-from ..reliability import DEAD, DRAINING, TransportError
-from ..reliability.errors import CallbackError, FrameError
+from ..reliability import DEAD, DRAINING, RetryPolicy, TransportError
+from ..reliability.errors import (CallbackError, FrameError,
+                                  MigrationError)
 from ..telemetry.clock import MonotonicClock
 from . import transport
 from .transport import (decode_snapshot, encode_snapshot, jsonable,
@@ -62,6 +63,11 @@ __all__ = ["ReplicaHost", "RemoteReplica", "spawn_replica_host"]
 # thread-per-call there would be continuous create/teardown churn on
 # the serving hot path.
 _THREADED_OPS = frozenset({"stop", "kill", "start", "shutdown"})
+
+# ops that need the CALLING connection (not just the message): a
+# migrate_out streams its binary page frames back on the same socket
+# that carried the request, never as a broadcast
+_CONN_OPS = frozenset({"migrate_out"})
 
 
 class _WireJourney:
@@ -138,6 +144,10 @@ class ReplicaHost:
         # can tell a dropped chunk from the next one (bounded with
         # the same cap as _delivered)
         self._streamed = collections.OrderedDict()
+        # inbound migration page frames, parked per transfer id until
+        # the migrate_in op closes the set (bounded: an abandoned
+        # transfer — client died mid-stream — ages out, never leaks)
+        self._mig_in = collections.OrderedDict()
         self._threads = []
 
     @property
@@ -308,7 +318,7 @@ class ReplicaHost:
             fn = getattr(self, "_op_" + op, None)
             if fn is None:
                 raise ValueError(f"unknown wire op {op!r}")
-            result = fn(msg)
+            result = fn(conn, msg) if op in _CONN_OPS else fn(msg)
         except Exception as e:
             reply = {"re": cid, "ok": False, "err": marshal_error(e)}
         else:
@@ -435,6 +445,129 @@ class ReplicaHost:
     def _op_abandon(self, msg):
         return bool(self.server.abandon(int(msg["rid"]),
                                         unmarshal_error(msg["err"])))
+
+    # --------------------------------------------- live KV-page migration
+    def _op_migrate_out(self, conn, msg):
+        """Pause one mid-decode request and stream its KV pages BACK to
+        the calling connection as binary page frames (one frame per
+        page, K and V stacked, sha256-checked by the transport), then
+        reply with the serialized migration state. The slot stays
+        paused until the caller settles with migrate_finish /
+        migrate_abort; a failure streaming the pages aborts HERE (the
+        caller may never be able to ask) and fails the call typed."""
+        rid = int(msg["rid"])
+        xid = msg.get("xid")
+        state, payloads = self.server.migrate_out(rid)
+        try:
+            for i, p in enumerate(payloads):
+                a = np.ascontiguousarray(np.stack(p))   # [2, L, pg, ...]
+                conn.send_pages(
+                    {"push": "pages", "xid": xid, "i": i,
+                     "n": len(payloads), "shape": list(a.shape),
+                     "dtype": str(a.dtype)}, a.tobytes())
+        except Exception as e:
+            self.server.migrate_abort(rid)
+            raise MigrationError(
+                f"page stream to the caller failed at frame "
+                f"{i}/{len(payloads)}: {e!r}") from e
+        return jsonable(state)
+
+    def _op_migrate_page(self, msg):
+        """One inbound migration page frame (fire-and-forget, id 0):
+        park the raw payload under its transfer id until migrate_in
+        closes the set. Malformed frames are dropped — the completeness
+        check in _op_migrate_in degrades that attempt typed."""
+        xid = msg.get("xid")
+        buf = msg.get("_payload")
+        if xid is None or buf is None:
+            return False
+        a = np.frombuffer(buf, dtype=np.dtype(msg["dtype"]))
+        a = a.reshape(msg["shape"])
+        with self._dlock:
+            slot = self._mig_in.setdefault(xid, {})
+            slot[int(msg["i"])] = a
+            self._mig_in.move_to_end(xid)
+            while len(self._mig_in) > 8:
+                self._mig_in.popitem(last=False)
+        return True
+
+    def _op_migrate_in(self, msg):
+        """Commit a migration INTO this host's server: reassemble the
+        parked page payloads, restore through the server's normal admit
+        path, and continue the token stream at the source's offset (the
+        client mirror already holds the pre-migration prefix, so the
+        forwarder must not restart at 0)."""
+        xid = msg.get("xid")
+        state = dict(msg["state"])
+        with self._dlock:
+            got = self._mig_in.pop(xid, None) or {}
+        n = len(state.get("sha256") or ())
+        payloads = [got.get(i) for i in range(n)]
+        if n == 0 or any(p is None for p in payloads):
+            raise MigrationError(
+                f"page frames lost on the wire: {len(got)}/{n} arrived "
+                f"for transfer {xid!r}")
+        journey = None
+        tid = msg.get("tid")
+        if tid is not None:
+            journey = _WireJourney(self, tid,
+                                   msg.get("where") or "replica")
+        rid = self.server.migrate_in(state, payloads,
+                                     on_token=self._forwarder,
+                                     journey=journey)
+        with self._dlock:
+            self._streamed[int(rid)] = int(state.get("streamed") or 0)
+            self._streamed.move_to_end(int(rid))
+        return {"rid": int(rid)}
+
+    def _op_migrate_finish(self, msg):
+        rid = int(msg["rid"])
+        self.server.migrate_finish(rid)
+        with self._dlock:
+            self._streamed.pop(rid, None)
+        return True
+
+    def _op_migrate_abort(self, msg):
+        return bool(self.server.migrate_abort(int(msg["rid"])))
+
+    def _op_fetch_tokens(self, msg):
+        """Backfill a gap the wire chewed into a client's token stream
+        (ISSUE 18 satellite): re-push this request's emitted tokens
+        from ``off`` onward as a normal offset-carrying token frame,
+        read from whatever still remembers them — the live slot, the
+        preempted parking lot, the finished-result map, or the wait
+        delivery stash. Returns the number of tokens re-pushed (None:
+        rid unknown here, nothing to repair from)."""
+        rid = int(msg["rid"])
+        off = max(0, int(msg.get("off") or 0))
+        srv = self.server
+        toks = None
+        with self._dlock:
+            hit = self._delivered.get(rid)
+        if hit is not None and hit[0] == "ok":
+            toks = list(hit[1])
+        if toks is None:
+            with srv._lock:
+                for st in srv._slots:
+                    if st is not None and st.rid == rid:
+                        toks = [int(t) for t in st.emitted]
+                        break
+                if toks is None:
+                    for rec in srv._preempted:
+                        if rec.rid == rid:
+                            toks = [int(t) for t in rec.emitted]
+                            break
+                if toks is None:
+                    out = srv._results.get(rid)
+                    if out is not None:
+                        toks = [int(t) for t in out]
+        if toks is None:
+            return None
+        back = [int(t) for t in toks[off:]]
+        if back:
+            self._push({"push": "tokens", "rid": rid, "off": off,
+                        "toks": back})
+        return len(back)
 
     def _op_stats(self, msg):
         return jsonable(dict(self.server.stats))
@@ -607,6 +740,14 @@ class RemoteReplica:
         # answers the submit): parked here until the mirror registers,
         # bounded — unclaimed entries are dropped oldest-first
         self._early_tokens = collections.OrderedDict()  # rid -> [msg]
+        # binary page frames for in-flight migrate_out calls, parked
+        # per transfer id until the state reply closes the set
+        self._mig_pages = {}          # xid -> {page index: ndarray}
+        # retry/backoff for the migration wire ops (transient failures
+        # only — a typed host refusal never retries)
+        self.migrate_retry = RetryPolicy(base_delay_s=0.02,
+                                         max_delay_s=0.25)
+        self.migrate_attempts = 3
         self._digest = None
         self._sketch = frozenset()
         self._last_hb = -1e9
@@ -717,6 +858,27 @@ class RemoteReplica:
             self._on_tokens(msg)
         elif kind == "journey":
             self._on_journey(msg)
+        elif kind == "pages":
+            self._on_pages(msg)
+
+    def _on_pages(self, msg):
+        """One binary page frame for an in-flight migrate_out: park it
+        under its transfer id. Frames for unknown transfer ids (an
+        aborted or retried attempt, another client's migration riding
+        the broadcast path) are dropped; a malformed header drops ONE
+        frame and the completeness check downstream degrades that
+        attempt typed."""
+        xid = msg.get("xid")
+        buf = msg.get("_payload")
+        with self._state_lock:
+            slot = self._mig_pages.get(xid)
+            if slot is None or buf is None:
+                return
+            try:
+                a = np.frombuffer(buf, dtype=np.dtype(msg["dtype"]))
+                slot[int(msg["i"])] = a.reshape(msg["shape"])
+            except Exception:
+                return
 
     def _on_digest(self, d):
         if not isinstance(d, dict):
@@ -756,9 +918,16 @@ class RemoteReplica:
             if off > have:
                 # an earlier chunk was lost to the wire: appending this
                 # one would punch a silent GAP into the partial (and the
-                # user's stream). Keep the contiguous prefix only — the
-                # full result still arrives via wait(), and a flushed
-                # partial stays a bit-exact prefix.
+                # user's stream). Keep the contiguous prefix — and ask
+                # the host to BACKFILL from its own emitted-token log
+                # (fire-and-forget: we are ON the reader thread; the
+                # repair arrives as a normal offset-carrying token push
+                # that stitches the prefix back together, re-covering
+                # this chunk's range too). Re-asked on every subsequent
+                # out-of-order chunk, so a repair the storm also eats
+                # is retried for free.
+                self._post("fetch_tokens", rid=int(msg["rid"]),
+                           off=have)
                 return
             toks = [int(t) for t in toks[have - off:]]
             if not toks:
@@ -958,8 +1127,29 @@ class RemoteReplica:
                 self._settle_all(rid)
                 raise           # typed failure unmarshalled remotely
             else:
+                self._reconcile_stream(rid, out)
                 self._settle_all(rid)
                 return np.asarray(out, np.int32)
+
+    def _reconcile_stream(self, rid, toks):
+        """Terminal backfill: a wire-returned result is the WHOLE
+        stream, so any token pushes chaos ate with nothing behind them
+        to trigger a ``fetch_tokens`` re-ask are delivered to the
+        stream callback here, before the mirror settles — a waited
+        request's callback never ends truncated."""
+        with self._state_lock:
+            m = self._mirror.get(rid)
+            if m is None or m.done or m.on_token is None:
+                return
+            tail = [int(t) for t in toks[len(m.tokens):]]
+            if not tail:
+                return
+            m.tokens.extend(tail)
+            cb = m.on_token
+        try:
+            cb(rid, tail)
+        except Exception:
+            pass                # a poisoned stream cannot spoil wait()
 
     def _settle_mirror(self, rid):
         m = self._mirror.pop(rid, None)
@@ -990,6 +1180,146 @@ class RemoteReplica:
             return bool(self._call("cancel", rid=int(rid)))
         except (TransportError, TimeoutError):
             return False    # unreachable host: failover settles it
+
+    # ------------------------------------------- live KV-page migration
+    def _mint_xid(self):
+        with self._id_lock:
+            xid = f"x{self._next_id}"
+            self._next_id += 1
+        return xid
+
+    def migrate_out(self, rid, retry=None):
+        """Pause ``rid`` on the host and pull its full resumable state
+        over the wire: the serialized migration dict plus one host
+        array per KV page (binary page frames, sha256-checked per
+        frame by the transport and end-to-end again by the target's
+        ``migrate_in``). Transient failures — a severed call, page
+        frames the storm ate — RESUME the slot and retry with backoff;
+        a typed host refusal (``MigrationError``: not mid-decode,
+        dense backend) propagates immediately so the caller degrades
+        to evacuate+replay. The client mirror stays registered until
+        ``migrate_finish`` commits the handoff."""
+        policy = retry if retry is not None else self.migrate_retry
+        last = None
+        for attempt in range(self.migrate_attempts):
+            if attempt:
+                policy.sleep(attempt - 1)
+            xid = self._mint_xid()
+            with self._state_lock:
+                self._mig_pages[xid] = {}
+            try:
+                try:
+                    state = self._call("migrate_out", rid=int(rid),
+                                       xid=xid)
+                except MigrationError:
+                    raise             # host refusal: not transient
+                except (TransportError, TimeoutError) as e:
+                    last = e
+                    self.migrate_abort(rid)   # resume if it paused
+                    continue
+                with self._state_lock:
+                    got = self._mig_pages.get(xid) or {}
+                n = len(state.get("sha256") or ())
+                payloads = [got.get(i) for i in range(n)]
+                if n == 0 or any(p is None for p in payloads):
+                    last = MigrationError(
+                        f"{self.name}: request {rid}: page frames lost "
+                        f"on the wire ({len(got)}/{n} arrived)")
+                    self.migrate_abort(rid)   # slot is paused: resume
+                    continue
+                with self._state_lock:
+                    m = self._mirror.get(rid)
+                    if m is not None:
+                        # CLIENT-truth delivery offset: the target
+                        # seeds its mirror from this, so gap repair
+                        # picks up exactly where this wire left off
+                        state["delivered"] = [int(t) for t in m.tokens]
+                return state, payloads
+            finally:
+                with self._state_lock:
+                    self._mig_pages.pop(xid, None)
+        raise last
+
+    def migrate_in(self, state, payloads, on_token=None, journey=None):
+        """Restore a migrated request INTO this replica: stream the
+        page payloads as binary frames, then commit with the state
+        (the reply is the COMMIT POINT — the new remote rid). A mirror
+        is registered client-side, seeded with the already-delivered
+        token prefix, so dead-host synthesis and gap repair keep
+        working across the handoff. Any failure propagates — the
+        caller aborts the source and falls back."""
+        conn = self._ensure_conn()
+        xid = self._mint_xid()
+        for i, p in enumerate(payloads):
+            a = np.ascontiguousarray(np.stack(p) if isinstance(p, list)
+                                     else p)
+            conn.send_pages({"id": 0, "op": "migrate_page", "xid": xid,
+                             "i": i, "n": len(payloads),
+                             "shape": list(a.shape),
+                             "dtype": str(a.dtype)}, a.tobytes())
+        tid = getattr(journey, "tid", None)
+        where = getattr(journey, "where", None)
+        if tid is not None:
+            self._journeys[tid] = journey
+        streamed = int(state.get("streamed") or 0)
+        pre = state.get("delivered")
+        if pre is None:
+            # in-process sources stream synchronously: server-truth
+            # offset IS client truth there
+            pre = (state.get("emitted") or [])[:streamed]
+        pre = [int(t) for t in pre]
+        deadline = None if state.get("deadline_s") is None \
+            else self._clock.now() + float(state["deadline_s"])
+
+        def record(reply):
+            with self._state_lock:
+                m = _Mirror(reply["rid"],
+                            np.asarray(state["ids"], np.int32),
+                            int(state["budget"]), int(state["seed"]),
+                            on_token, deadline,
+                            int(state.get("priority") or 0),
+                            journey, tid)
+                m.tokens = list(pre)
+                self._mirror[reply["rid"]] = m
+                parked = self._early_tokens.pop(reply["rid"], ())
+            for pm in parked:         # pushes that raced this reply
+                self._on_tokens(pm)
+
+        try:
+            reply = self._call("migrate_in", xid=xid,
+                               state=jsonable(state), tid=tid,
+                               where=where, on_reply=record)
+        except BaseException:
+            if tid is not None:
+                self._journeys.pop(tid, None)
+            raise
+        return reply["rid"]
+
+    def migrate_finish(self, rid):
+        """Settle a committed handoff on the source: drop the local
+        mirror FIRST — a post-commit host crash must not let dead-wire
+        evacuate synthesis double-deliver a request that now lives on
+        the target — then release the host's paused slot best-effort
+        (an unreachable host's slot dies with the process anyway)."""
+        with self._state_lock:
+            m = self._mirror.pop(rid, None)
+            if m is not None:
+                m.done = True
+                self._journeys.pop(m.tid, None)
+        try:
+            self._call("migrate_finish", rid=int(rid))
+            return True
+        except (TransportError, TimeoutError, MigrationError):
+            return False
+
+    def migrate_abort(self, rid):
+        """Resume a paused migration source slot (best-effort: an
+        unreachable host has nothing usefully paused — the failover
+        path settles the request from the mirror)."""
+        try:
+            return bool(self._call("migrate_abort", rid=int(rid)))
+        except (TransportError, TimeoutError):
+            return False
 
     # --------------------------------------------------- router surface
     def _wire_dead(self):

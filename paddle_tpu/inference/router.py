@@ -68,9 +68,10 @@ import numpy as np
 
 from ..core.tensor import unwrap
 from ..reliability import (CircuitBreaker, DEAD, DEGRADED, DeadlineExceeded,
-                           HEALTHY, QueueFullError, ReliabilityError,
-                           ReplicaLostError, RequestCancelled, RetryPolicy,
-                           ServerClosed, faults, is_serving_state)
+                           HEALTHY, MigrationError, QueueFullError,
+                           ReliabilityError, ReplicaLostError,
+                           RequestCancelled, RetryPolicy, ServerClosed,
+                           faults, is_serving_state)
 from ..telemetry.clock import MonotonicClock
 from .prefix_cache import prefix_fingerprints
 
@@ -336,7 +337,11 @@ class ReplicaRouter:
         self._stats = {"routed": [0] * n, "affinity_hits": 0,
                        "fallbacks": 0, "dispatch_retries": 0,
                        "evacuations": 0, "requeued": 0,
-                       "replica_lost": 0, "orphaned": 0, "restarts": 0}
+                       "replica_lost": 0, "orphaned": 0, "restarts": 0,
+                       # live KV-page migrations: mid-decode requests
+                       # handed to a sibling WITH their pages / attempts
+                       # degraded to the evacuate+replay path
+                       "migrations": 0, "migration_fallbacks": 0}
         self.supervisor = RouterSupervisor(self, retry=retry_policy)
         self._stop_evt = threading.Event()
         self._thread = None
@@ -653,13 +658,102 @@ class ReplicaRouter:
         if self._tele is not None:
             self._tele.on_dispatch_retry(idx)
 
+    # ----------------------------------------------------- live migration
+    def _migrate_live(self, idx):
+        """Hand replica ``idx``'s mid-decode requests to siblings WITH
+        their KV pages (ISSUE 18): each migrated request resumes
+        exactly where it paused — zero re-prefill, zero token replay,
+        zero partial flush. Best-effort per request: any failure (not
+        migratable, page frames lost to the wire, no sibling with
+        capacity, target refusal) leaves the request decoding on the
+        source for the legacy drain/evacuate path and counts a
+        fallback — never a request failure. Returns the number
+        migrated."""
+        rep = self.replicas[idx]
+        if not (hasattr(rep, "migrate_out")
+                and hasattr(rep, "migrate_in")):
+            return 0
+        with self._lock:
+            pairs = list(self._by_replica[idx].items())  # rrid -> rid
+        moved = 0
+        for rrid, rid in pairs:
+            with self._lock:
+                route = self._routes.get(rid)
+            if route is None or route.idx != idx \
+                    or route.item.cancelled:
+                continue
+            item = route.item
+            try:
+                state, payloads = rep.migrate_out(rrid)
+            except MigrationError:
+                continue    # not mid-decode here (queued, finishing):
+                #             nothing to migrate — evacuate covers it
+            except Exception:
+                continue    # wire down / injected gather fault: the
+                #             slot was never paused (or already
+                #             resumed); the drain path takes over
+            new_rrid = None
+            tdx = None
+            order, _ = self._candidates(item.ids, exclude=(idx,))
+            for cand in order:
+                target = self.replicas[cand]
+                if not hasattr(target, "migrate_in"):
+                    continue
+                journey = None if item.journey is None \
+                    else item.journey.at(f"replica{cand}")
+                try:
+                    new_rrid = target.migrate_in(
+                        state, payloads, on_token=item.on_token,
+                        journey=journey)
+                except Exception:
+                    continue    # OutOfPages / restore fault / refusal:
+                    #             try the next sibling
+                tdx = cand
+                break
+            if new_rrid is None:
+                rep.migrate_abort(rrid)   # resume decoding at home
+                with self._lock:
+                    self._stats["migration_fallbacks"] += 1
+                if item.journey is not None:
+                    item.journey.event("migrating", at="router",
+                                       source=idx, fallback=True)
+                continue
+            # COMMIT: the request lives on the target now. Re-home the
+            # route FIRST (a waiter blocked on the source re-reads it
+            # within one wait slice; the gen bump marks stale errors),
+            # THEN release the source slot — so no window exists where
+            # a waiter can race a released rid.
+            with self._lock:
+                self._by_replica[idx].pop(rrid, None)
+                cur = self._routes.get(rid)
+                if cur is route:
+                    route.idx, route.rrid = tdx, new_rrid
+                    route.gen += 1
+                self._by_replica[tdx][new_rrid] = rid
+                self._stats["migrations"] += 1
+            if item.journey is not None:
+                item.journey.event("migrating", at="router",
+                                   source=idx, target=tdx)
+            if self._rec is not None:
+                self._rec.record("migration", rid=rid, source=idx,
+                                 target=tdx)
+            rep.migrate_finish(rrid)
+            moved += 1
+        return moved
+
     # ---------------------------------------------------------- failover
     def _failover(self, idx, flush_partials):
         """Harvest replica ``idx``'s queue (the ``router.evacuate``
         chaos point — an injected fault aborts BEFORE any state moves)
-        and requeue everything onto siblings."""
+        and requeue everything onto siblings. A draining (not dead)
+        replica's mid-decode slots are live-migrated first — pages and
+        sampler state hand off to a sibling instead of riding out the
+        drain on a sick replica; a dead one has no wire to pull pages
+        over, so its mirror-synthesized partial flush stands."""
         if self._faults is not None:
             self._faults.check(faults.ROUTER_EVACUATE, replica=idx)
+        if not flush_partials:
+            self._migrate_live(idx)
         harvested = self.replicas[idx].evacuate(
             flush_partials=flush_partials)
         with self._lock:
@@ -1077,6 +1171,11 @@ class ReplicaRouter:
         replica restarts and rejoins the rotation before the next one
         goes down."""
         for idx, rep in enumerate(self.replicas):
+            # mid-decode slots hand off LIVE (KV pages + sampler
+            # state) to siblings — zero re-prefill, zero replay; the
+            # evacuation below covers the queued remainder, and any
+            # failed migration simply rides out the graceful drain
+            self._migrate_live(idx)
             harvested = rep.evacuate()      # queued -> siblings now,
             with self._lock:                # instead of riding out the
                 self._stats["evacuations"] += 1   # drain wall

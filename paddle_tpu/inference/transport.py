@@ -14,12 +14,31 @@ Frame layout (the whole protocol)::
     | big-endian u32 | (one JSON object per frame)            |
     +----------------+----------------------------------------+
 
-One frame is one message. A length above ``MAX_FRAME`` (or a stream
-that ends mid-frame) means the byte stream can no longer be trusted
-and the connection is closed; a payload that is not a JSON object
-spoils only ITSELF — framing stayed in sync, so the receiver drops
-the frame and keeps serving (the frame-corruption fuzz suite pins
-both behaviours).
+One frame is one message. A length above the connection's frame cap
+(``max_frame_bytes``, default ``MAX_FRAME``) or a stream that ends
+mid-frame means the byte stream can no longer be trusted and the
+connection is closed; a payload that is not a JSON object spoils only
+ITSELF — framing stayed in sync, so the receiver drops the frame and
+keeps serving (the frame-corruption fuzz suite pins both behaviours).
+
+BINARY page frames (ISSUE 18, live KV-page migration): float arrays
+must not round-trip through JSON, so ``send_pages(header, payload)``
+emits one ordinary JSON header frame — the caller's dict plus
+``_bin`` (raw byte count) and ``_sha256`` (payload digest) — followed
+by exactly ``_bin`` raw bytes on the same stream::
+
+    +--------+---------------------+---------------------------+
+    | length | header JSON (+_bin, | raw payload: <_bin> bytes |
+    | u32    |  +_sha256)          | (page bytes, no encoding) |
+    +--------+---------------------+---------------------------+
+
+``recv`` reads the payload unconditionally (any consumer keeps the
+stream in sync) and verifies the digest: a mismatch raises
+``FrameError`` AFTER the bytes were consumed — only that transfer is
+spoiled, the connection keeps serving, and the migration layer above
+degrades to replay. An oversized payload fails typed (``FrameError``)
+BEFORE anything hits the wire; senders chunk page groups under the
+cap instead.
 
 Typed errors cross the wire by NAME: ``marshal_error`` flattens any
 exception to ``{"kind", "message"}`` and ``unmarshal_error`` rebuilds
@@ -41,6 +60,7 @@ Everything here is stdlib-only and import-light: a spawned replica
 host must be able to load the wire layer before it pays for jax.
 """
 import builtins
+import hashlib
 import json
 import select
 import socket
@@ -58,7 +78,9 @@ __all__ = ["Connection", "connect", "MAX_FRAME", "NetDrop", "NetDelay",
            "encode_snapshot", "decode_snapshot", "jsonable"]
 
 # one frame must hold a full registry snapshot or postmortem bundle,
-# never an attacker-sized allocation: past this the stream is closed
+# never an attacker-sized allocation: past this the stream is closed.
+# The DEFAULT cap — a Connection carrying big page groups raises its
+# own ``max_frame_bytes`` instead of loosening every peer's guard.
 MAX_FRAME = 8 * 1024 * 1024
 _LEN = struct.Struct("!I")
 
@@ -207,10 +229,16 @@ class Connection:
     ``net_frames_total{dir}`` / ``net_bytes_total{dir}`` /
     ``net_transport_errors_total``; with the default None the hot path
     pays one ``is None`` check per frame.
+
+    ``max_frame_bytes`` caps BOTH directions and both frame kinds
+    (JSON payloads and binary page payloads): an outbound oversize
+    fails typed (``FrameError``) before any bytes hit the wire, an
+    inbound oversize is a desynced stream. Both peers of a page-
+    migrating link must agree on the raised cap.
     """
 
     def __init__(self, sock, fault_injector=None, registry=None,
-                 peer=""):
+                 peer="", max_frame_bytes=MAX_FRAME):
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
@@ -219,6 +247,7 @@ class Connection:
         self._send_lock = threading.Lock()
         self._rbuf = bytearray()
         self._faults = fault_injector
+        self.max_frame_bytes = int(max_frame_bytes)
         self.peer = peer or _peername(sock)
         self.closed = False
         self._c_frames = self._c_bytes = self._c_errors = None
@@ -253,7 +282,7 @@ class Connection:
             except NetDelay as e:
                 time.sleep(type(e).SECONDS)
             except NetTruncate as e:
-                if point == faults.NET_SEND:
+                if point in (faults.NET_SEND, faults.NET_PAGE_SEND):
                     return ("truncate", e)
                 self._fail(TransportError(
                     f"injected {pt} truncation severed {self.peer}"), e)
@@ -280,14 +309,53 @@ class Connection:
             raise TransportError(
                 f"connection to {self.peer} is closed")
         payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-        if len(payload) > MAX_FRAME:
+        if len(payload) > self.max_frame_bytes:
             raise FrameError(
-                f"frame of {len(payload)} bytes exceeds MAX_FRAME "
-                f"({MAX_FRAME}); refusing to desync the stream")
+                f"frame of {len(payload)} bytes exceeds max_frame_bytes "
+                f"({self.max_frame_bytes}); refusing to desync the "
+                f"stream")
         verdict = self._chaos(faults.NET_SEND)
+        return self._send_frame(_LEN.pack(len(payload)) + payload,
+                                len(payload), verdict, faults.NET_SEND)
+
+    def send_pages(self, header, payload):
+        """Frame one BINARY page frame: ``header`` (a JSON-able dict,
+        augmented with ``_bin`` = payload byte count and ``_sha256`` =
+        payload digest) as an ordinary JSON frame, then the raw
+        ``payload`` bytes on the same stream — pool pages cross the
+        wire without JSON-encoding float arrays. Chaos point is
+        ``net.page_send`` (plus the partition point), so a storm can
+        target migration traffic without touching control frames.
+        Returns True/False like ``send``; an oversized payload or
+        header raises ``FrameError`` BEFORE any bytes hit the wire
+        (chunk the page group under ``max_frame_bytes`` instead)."""
+        if self.closed:
+            raise TransportError(
+                f"connection to {self.peer} is closed")
+        payload = bytes(payload)
+        if len(payload) > self.max_frame_bytes:
+            raise FrameError(
+                f"binary page frame of {len(payload)} bytes exceeds "
+                f"max_frame_bytes ({self.max_frame_bytes}); chunk the "
+                f"page group instead of desyncing the stream")
+        head = dict(header)
+        head["_bin"] = len(payload)
+        head["_sha256"] = hashlib.sha256(payload).hexdigest()
+        hb = json.dumps(head, separators=(",", ":")).encode("utf-8")
+        if len(hb) > self.max_frame_bytes:
+            raise FrameError(
+                f"page-frame header of {len(hb)} bytes exceeds "
+                f"max_frame_bytes ({self.max_frame_bytes})")
+        verdict = self._chaos(faults.NET_PAGE_SEND)
+        return self._send_frame(_LEN.pack(len(hb)) + hb + payload,
+                                len(hb) + len(payload), verdict,
+                                faults.NET_PAGE_SEND)
+
+    def _send_frame(self, frame, nbytes, verdict, point):
+        """Common tail of send/send_pages: apply the chaos verdict and
+        put ``frame`` on the wire."""
         if verdict == "drop":
             return False
-        frame = _LEN.pack(len(payload)) + payload
         if isinstance(verdict, tuple):      # ("truncate", fault)
             with self._send_lock:
                 try:
@@ -296,7 +364,7 @@ class Connection:
                     pass                    # peer already gone: the
                 #                             truncation outcome stands
             self._fail(TransportError(
-                f"injected net.send truncation severed {self.peer}"),
+                f"injected {point} truncation severed {self.peer}"),
                 verdict[1])
         with self._send_lock:
             try:
@@ -306,7 +374,7 @@ class Connection:
                     f"send to {self.peer} failed: {e}"), e)
         if self._c_frames is not None:
             self._c_frames.labels(dir="sent").inc()
-            self._c_bytes.labels(dir="sent").inc(len(payload))
+            self._c_bytes.labels(dir="sent").inc(nbytes)
         return True
 
     # ------------------------------------------------------------- recv
@@ -327,10 +395,11 @@ class Connection:
     def _recv_frame(self, timeout):
         head = self._read_exact(_LEN.size, timeout)
         (n,) = _LEN.unpack(head)
-        if n > MAX_FRAME:
+        if n > self.max_frame_bytes:
             self._fail(TransportError(
-                f"inbound frame claims {n} bytes (> MAX_FRAME "
-                f"{MAX_FRAME}); stream from {self.peer} desynced"))
+                f"inbound frame claims {n} bytes (> max_frame_bytes "
+                f"{self.max_frame_bytes}); stream from {self.peer} "
+                f"desynced"))
         payload = self._read_exact(n, timeout)
         if self._c_bytes is not None:
             self._c_bytes.labels(dir="recv").inc(n)
@@ -341,6 +410,28 @@ class Connection:
             # frame is spoiled; the connection keeps serving
             raise FrameError(
                 f"corrupt {n}-byte frame from {self.peer}: {e}") from e
+        nbin = obj.get("_bin") if isinstance(obj, dict) else None
+        if nbin is None:
+            return obj
+        # binary page frame: the raw payload is consumed UNCONDITIONALLY
+        # (whoever reads the stream keeps it in sync) and verified here;
+        # a digest mismatch spoils only this transfer — framing held, so
+        # the connection keeps serving and the migration layer above
+        # degrades to replay
+        nbin = int(nbin)
+        if nbin > self.max_frame_bytes:
+            self._fail(TransportError(
+                f"binary page frame claims {nbin} payload bytes "
+                f"(> max_frame_bytes {self.max_frame_bytes}); stream "
+                f"from {self.peer} desynced"))
+        blob = self._read_exact(nbin, timeout)
+        if self._c_bytes is not None:
+            self._c_bytes.labels(dir="recv").inc(nbin)
+        if hashlib.sha256(blob).hexdigest() != obj.get("_sha256"):
+            raise FrameError(
+                f"binary page frame from {self.peer} failed its "
+                f"sha256 check ({nbin} bytes)")
+        obj["_payload"] = blob
         return obj
 
     def _read_exact(self, n, timeout):
@@ -412,7 +503,8 @@ def _peername(sock):
         return "<disconnected>"
 
 
-def connect(address, timeout=5.0, fault_injector=None, registry=None):
+def connect(address, timeout=5.0, fault_injector=None, registry=None,
+            max_frame_bytes=MAX_FRAME):
     """Dial ``address`` (the ``net.connect`` chaos point) and return a
     ``Connection``. A fired fault or OS-level refusal raises
     ``TransportError``."""
@@ -434,4 +526,6 @@ def connect(address, timeout=5.0, fault_injector=None, registry=None):
             f"connect to {address} failed: {e}") from e
     sock.settimeout(None)
     return Connection(sock, fault_injector=fault_injector,
-                      registry=registry, peer=f"{address[0]}:{address[1]}")
+                      registry=registry,
+                      peer=f"{address[0]}:{address[1]}",
+                      max_frame_bytes=max_frame_bytes)

@@ -30,13 +30,14 @@ clock protocol), and deterministic under test.
 """
 from .errors import (CallbackError, CheckpointCorruptError,  # noqa: F401
                      CircuitOpenError, DeadlineExceeded, FrameError,
-                     InjectedFault, PreemptedError, QueueFullError,
-                     ReliabilityError, ReplicaLostError, RequestCancelled,
-                     SchedulerClosed, ServerClosed, StepFailedError,
-                     TrainAnomalyError, TransportError)
+                     InjectedFault, MigrationError, PreemptedError,
+                     QueueFullError, ReliabilityError, ReplicaLostError,
+                     RequestCancelled, SchedulerClosed, ServerClosed,
+                     StepFailedError, TrainAnomalyError, TransportError)
 from .faults import (CKPT_RENAME, CKPT_SWAP, CKPT_WRITE,  # noqa: F401
                      DATA_NEXT, DECODE_TICK, FaultInjector, KV_GROW,
-                     NET_CONNECT, NET_PARTITION, NET_RECV, NET_SEND,
+                     MIGRATE_GATHER, MIGRATE_RESTORE, NET_CONNECT,
+                     NET_PAGE_SEND, NET_PARTITION, NET_RECV, NET_SEND,
                      ON_TOKEN, PAGE_ALLOC, PREFILL, ROUTER_DISPATCH,
                      ROUTER_EVACUATE, SERVER_PREEMPT, TRAIN_STEP)
 from .health import (DEAD, DEGRADED, DRAINING, HEALTH_CODES,  # noqa: F401
@@ -54,6 +55,7 @@ __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
            "CircuitOpenError", "ReplicaLostError", "PreemptedError",
            "InjectedFault", "TransportError", "FrameError",
+           "MigrationError",
            "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError",
            "RetryPolicy", "CircuitBreaker", "ServeSupervisor",
@@ -63,6 +65,7 @@ __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "KV_GROW", "SERVER_PREEMPT",
            "ON_TOKEN", "ROUTER_DISPATCH", "ROUTER_EVACUATE",
            "NET_SEND", "NET_RECV", "NET_CONNECT", "NET_PARTITION",
+           "NET_PAGE_SEND", "MIGRATE_GATHER", "MIGRATE_RESTORE",
            "CKPT_WRITE", "CKPT_RENAME", "CKPT_SWAP",
            "TRAIN_STEP", "DATA_NEXT",
            "write_checkpoint", "read_checkpoint", "verify_checkpoint",

@@ -12,6 +12,7 @@ __all__ = ["ReliabilityError", "DeadlineExceeded", "QueueFullError",
            "RequestCancelled", "ServerClosed", "SchedulerClosed",
            "CircuitOpenError", "ReplicaLostError", "PreemptedError",
            "InjectedFault", "TransportError", "FrameError",
+           "MigrationError",
            "CallbackError", "CheckpointCorruptError", "TrainAnomalyError",
            "StepFailedError"]
 
@@ -100,6 +101,17 @@ class FrameError(TransportError):
     JSON object. The receiver fails the affected call (or drops the
     frame when no call can be attributed) and, unless the stream lost
     sync (oversize/truncation), keeps serving the connection."""
+
+
+class MigrationError(ReliabilityError):
+    """A live KV-page migration attempt could not complete: the request
+    is not migratable (mid-prefill, dense backend, already in flight),
+    a gathered/received page failed its sha256 check, or the two ends
+    disagree on page geometry. It marks exactly ONE migration attempt's
+    outcome — the request itself is STILL LIVE on the source (paused at
+    worst, resumed by ``migrate_abort``) — so callers degrade to the
+    evacuate+replay path (``server_migrations_total{result=fallback}``)
+    and NEVER surface this to a waiter."""
 
 
 class InjectedFault(ReliabilityError):

@@ -32,6 +32,7 @@ __all__ = ["FaultInjector", "PREFILL", "DECODE_TICK", "PAGE_ALLOC",
            "TIER_SPILL", "TIER_RESTORE",
            "ROUTER_DISPATCH", "ROUTER_EVACUATE",
            "NET_SEND", "NET_RECV", "NET_CONNECT", "NET_PARTITION",
+           "NET_PAGE_SEND", "MIGRATE_GATHER", "MIGRATE_RESTORE",
            "CKPT_WRITE",
            "CKPT_RENAME", "CKPT_SWAP", "TRAIN_STEP", "DATA_NEXT"]
 
@@ -81,6 +82,21 @@ NET_PARTITION = "net.partition"  # checked on EVERY send AND recv (and
 #                                  at connect): a fired partition cuts
 #                                  the link whatever direction traffic
 #                                  was flowing
+NET_PAGE_SEND = "net.page_send"  # Connection.send_pages: one outbound
+#                                  BINARY page frame (header + raw
+#                                  payload) — same error-class effects
+#                                  as NET_SEND, scoped to migration
+#                                  traffic so a storm can corrupt page
+#                                  transfers without touching control
+#                                  frames
+
+# live KV-page migration failure points (ISSUE 18). Both fire BEFORE
+# any state changes hands, so a faulted migration is a clean typed
+# refusal the caller degrades to evacuate+replay — never a leak.
+MIGRATE_GATHER = "migrate.gather"    # migrate_out: gathering a paused
+#                                      slot's written pages off the pool
+MIGRATE_RESTORE = "migrate.restore"  # migrate_in: scattering received
+#                                      pages into fresh pool pages
 
 # failure points wired into the training / checkpoint stack
 CKPT_WRITE = "ckpt.write"           # durable save: per-file payload write

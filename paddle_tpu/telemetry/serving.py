@@ -195,6 +195,23 @@ class ServerTelemetry:
             "One admission's host-tier restore: checksummed payload "
             "reads plus the batched pool scatter",
             buckets=TICK_BUCKETS)
+        # live KV-page migration (ISSUE 18): this replica as the SOURCE
+        mig = r.counter(
+            "server_migrations_total",
+            "Live KV-page migrations attempted with this replica as "
+            "the source, by outcome: ok = pages handed off and the "
+            "slot released; fallback = degraded to evacuate+replay "
+            "(checksum mismatch, frame loss, target refusal, dead "
+            "wire)",
+            labelnames=("result",))
+        self._c_mig_ok = mig.labels(result="ok")
+        self._c_mig_fallback = mig.labels(result="fallback")
+        self._h_migration = r.histogram(
+            "serving_migration_seconds",
+            "One live migration at the source: pause + per-shard page "
+            "gathers + wire transfer, until the slot is released (ok) "
+            "or resumed (fallback)",
+            buckets=TICK_BUCKETS)
         self._c_null_writes = r.counter(
             "kv_null_redirected_writes_total",
             "Inactive-slot decode writes redirected to the null page "
@@ -496,6 +513,24 @@ class ServerTelemetry:
         served as a cache miss."""
         if self.enabled:
             self._c_host_corrupt.inc()
+
+    def migration_started(self):
+        """Clock read for ``on_migration``'s latency observation —
+        only called when a migration actually starts, so the no-
+        migration hot path stays clock-free."""
+        return self.clock.now() if self.enabled else None
+
+    def on_migration(self, result, started=None):
+        """One live KV-page migration settled at the source:
+        ``result`` is ``"ok"`` (handoff committed, slot released) or
+        ``"fallback"`` (degraded to evacuate+replay); latency observed
+        from ``started`` = ``migration_started()``."""
+        if not self.enabled:
+            return
+        (self._c_mig_ok if result == "ok"
+         else self._c_mig_fallback).inc()
+        if started is not None:
+            self._h_migration.observe(self.clock.now() - started)
 
     def add_null_writes(self, n):
         if self.enabled and n:

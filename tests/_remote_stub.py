@@ -27,3 +27,22 @@ def make_stub_server(**kw):
     kw.setdefault("cache_backend", "paged")
     kw.setdefault("page_size", 8)
     return ContinuousBatchingServer(StubModel(), **kw)
+
+
+def make_slow_stub_server(tick_sleep_s=0.01, **kw):
+    """A stub server whose serve tick is paced by ``tick_sleep_s``:
+    spawned kill-drills need the decode loop slow enough that a
+    migration call arriving over the wire reliably catches requests
+    MID-decode (an unpaced StubModel drains a 48-token budget inside
+    one client round-trip)."""
+    import time
+
+    srv = make_stub_server(**kw)
+    inner = srv._fire_callbacks
+
+    def paced():
+        time.sleep(tick_sleep_s)
+        inner()
+
+    srv._fire_callbacks = paced
+    return srv

@@ -1,0 +1,729 @@
+"""Live KV-page migration (ISSUE 18): drains hand off mid-decode
+state instead of flushing partials.
+
+Contracts pinned here:
+
+- a mid-decode request migrated source -> target continues BIT-EXACT
+  (greedy AND seeded-sampled) against a never-migrated oracle, with
+  ZERO re-prefill on the target (``prefill_tokens`` and ``admissions``
+  stay 0; a fused target's ``prefill_dispatches`` stays frozen too);
+- every failure degrades to requeue-replay, typed and leak-free:
+  checksum mismatch, injected ``migrate.gather``/``migrate.restore``
+  chaos, a target with no free slot, a SIGKILLed target process — the
+  source resumes the paused slot bit-exactly and counts
+  ``server_migrations_total{result="fallback"}``;
+- the wire protocol ships one sha256-checked binary frame per page and
+  the client's ``fetch_tokens`` backfill heals token-push gaps a
+  ``net.send`` drop storm tears into the stream (the ``_on_tokens``
+  regression);
+- a 25% chaos storm over ``net.*`` + ``migrate.*`` replays identically
+  under the same seed (single-threaded, step()-driven, so the fault
+  trace is exact);
+- per-shard gathers/scatters are topology-neutral: pages migrate
+  between mp=1 and mp=2 pools bit-exactly (real llama sampling, so
+  the restored PRNG chain is genuinely exercised).
+"""
+import random
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from _remote_stub import make_stub_server
+from _serving_stub import StubModel, stub_tokens
+from paddle_tpu.inference.continuous_batching import ContinuousBatchingServer
+from paddle_tpu.inference.kv_cache import OutOfPages
+from paddle_tpu.inference.remote import ReplicaHost, RemoteReplica
+from paddle_tpu.inference.transport import Connection, NetDelay, NetDrop
+from paddle_tpu.reliability import (MIGRATE_GATHER, MIGRATE_RESTORE,
+                                    NET_PAGE_SEND, NET_RECV, NET_SEND,
+                                    FaultInjector, InjectedFault,
+                                    MigrationError)
+
+SERVER_KW = dict(max_slots=2, max_cache_len=64, cache_backend="paged",
+                 page_size=8, num_pages=17)
+PROMPT = (np.arange(1, 12, dtype=np.int32) % 13)
+BUDGET = 48          # prompt 11 + 48 <= max_cache_len 64; big enough
+#                      that the handoff reliably lands mid-decode
+
+
+def _loopback_available():
+    try:
+        s = socket.create_server(("127.0.0.1", 0))
+        s.close()
+        return True
+    except OSError:
+        return False
+
+
+def _sink(got, dt=0.003):
+    """A throttling stream callback: 3 ms per chunk keeps the decode
+    loop slow enough that migrate_out always catches the request
+    mid-decode (callbacks fire on the serving thread)."""
+    def cb(rid, toks):
+        got.extend(int(t) for t in toks)
+        time.sleep(dt)
+    return cb
+
+
+def _wait(pred, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out on: {msg}"
+        time.sleep(0.005)
+
+
+def _servers(n, **overrides):
+    kw = dict(SERVER_KW)
+    kw.update(overrides)
+    return [ContinuousBatchingServer(StubModel(), **kw)
+            for _ in range(n)]
+
+
+class _Throttle(NetDelay):
+    """Every host send dawdles 10 ms: the deferred token-push callbacks
+    fire on the serving thread, so this paces the decode loop and the
+    wire drills reliably catch the request MID-decode (the StubModel
+    otherwise finishes a 48-token budget in the round-trip window)."""
+    SECONDS = 0.01
+
+
+def _throttle_fi():
+    return FaultInjector(seed=1).on(NET_SEND, probability=1.0,
+                                    error=_Throttle)
+
+
+class _StormFactory:
+    """probability-1.0 ``net.send`` rule for the drop-storm drill:
+    every send fires — most resolve to the pacing delay (keeping the
+    stream stretched mid-air), a seeded fraction DROP the frame
+    outright, capped so the tail of the stream gets through clean and
+    the backfill's repair pushes eventually land."""
+
+    def __init__(self, seed, p_drop=0.25, max_drops=6):
+        self.rng = random.Random(seed)
+        self.p_drop, self.max_drops = p_drop, max_drops
+        self.drops = 0
+
+    def __call__(self):
+        if self.drops < self.max_drops \
+                and self.rng.random() < self.p_drop:
+            self.drops += 1
+            return NetDrop("storm drop")
+        return _Throttle("pacing")
+
+
+# =================================================== in-process parity
+class TestMigrationInProcess:
+    # the greedy half is the tier-1 canary; sampled PRNG re-derivation
+    # stays covered tier-1 by the abort test below and in full by the
+    # slow wire-sampled parity case
+    @pytest.mark.parametrize(
+        "do_sample", [False, pytest.param(True, marks=pytest.mark.slow)],
+        ids=["greedy", "sampled"])
+    def test_mid_decode_migration_bitexact_zero_reprefill(self,
+                                                          do_sample):
+        """The acceptance drill: pause mid-decode, gather, restore on
+        a sibling, resume mid-chain — tokens bit-exact vs a
+        never-migrated oracle, zero prefill work on the target, zero
+        leaked pages on either end, journey + metrics attributed."""
+        kw = dict(do_sample=do_sample)
+        if do_sample:
+            kw.update(temperature=0.7, top_k=8)
+        tgt, oracle = _servers(2, **kw)
+        src = ContinuousBatchingServer(
+            StubModel(), telemetry=True, journeys=True, recorder=True,
+            **dict(SERVER_KW, **kw))
+        got = []
+        src.start(); tgt.start(); oracle.start()
+        try:
+            rid_o = oracle.submit(PROMPT, max_new_tokens=BUDGET, seed=5)
+            rid = src.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            state, payloads = src.migrate_out(rid)
+            assert state["seed"] == 5          # resolved seed travels
+            assert state["sha256"] and len(state["sha256"]) \
+                == len(payloads)
+            new_rid = tgt.migrate_in(state, payloads,
+                                     on_token=_sink(got))
+            src.migrate_finish(rid)
+            out = tgt.wait(new_rid, timeout=60)
+            ref = oracle.wait(rid_o, timeout=60)
+            np.testing.assert_array_equal(out, ref)
+            if not do_sample:
+                np.testing.assert_array_equal(
+                    out, stub_tokens(PROMPT, BUDGET))
+            # the stream healed across the handoff: every token once,
+            # in order, no re-delivery of the pre-migration prefix
+            _wait(lambda: len(got) >= BUDGET, timeout=10,
+                  msg="stream drained")
+            assert got == [int(t) for t in ref]
+            # zero re-prefill on the target: no admission, no prompt
+            # tokens pushed — the restore scatter is priced as
+            # page_migrate bytes, not prefill
+            assert tgt.stats["prefill_tokens"] == 0
+            assert tgt.stats["admissions"] == 0
+            assert src.stats["migrations"] == 1
+            assert tgt.stats["migrated_in"] == 1
+            for s in (src, tgt):
+                assert s.pool_balance()[1] == 0
+            # attribution: the journey crossed a "migrating" phase and
+            # the source counted {result="ok"} with a latency sample
+            timeline = src.journey(rid)
+            assert any(e["phase"] == "migrating" for e in timeline)
+            snap = src._tele.registry.snapshot()
+            assert snap["server_migrations_total"]["samples"][
+                ("ok",)] == 1
+            assert snap["serving_migration_seconds"]["samples"][()][
+                "count"] == 1
+        finally:
+            src.stop(); tgt.stop(); oracle.stop()
+
+    def test_fused_target_prefill_dispatches_frozen(self):
+        """A fused-tick target restores through the same path with its
+        prefill dispatch counter EXACTLY frozen (split targets count
+        state pushes there; fused has no push op to excuse)."""
+        src, oracle = _servers(2)
+        (tgt,) = _servers(1, serving_mode="fused",
+                          prefill_mode="ragged")
+        got = []
+        src.start(); tgt.start(); oracle.start()
+        try:
+            rid_o = oracle.submit(PROMPT, max_new_tokens=BUDGET, seed=5)
+            rid = src.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            before = tgt.stats["prefill_dispatches"]
+            state, payloads = src.migrate_out(rid)
+            new_rid = tgt.migrate_in(state, payloads,
+                                     on_token=_sink(got))
+            src.migrate_finish(rid)
+            np.testing.assert_array_equal(tgt.wait(new_rid, timeout=60),
+                                          oracle.wait(rid_o, timeout=60))
+            assert tgt.stats["prefill_dispatches"] == before
+            assert tgt.stats["prefill_tokens"] == 0
+        finally:
+            src.stop(); tgt.stop(); oracle.stop()
+
+    def test_abort_resumes_bitexact_and_counts_fallback(self):
+        """migrate_abort re-primes the paused slot (pending token,
+        write cursor, PRNG key mid-chain) so the SOURCE finishes the
+        stream bit-exactly — the universal fallback every failure
+        path below degrades to."""
+        src, oracle = _servers(2, do_sample=True, temperature=0.7,
+                               top_k=8)
+        got = []
+        src.start(); oracle.start()
+        try:
+            rid_o = oracle.submit(PROMPT, max_new_tokens=BUDGET, seed=9)
+            rid = src.submit(PROMPT, max_new_tokens=BUDGET, seed=9,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            state, payloads = src.migrate_out(rid)
+            assert src.migrate_abort(rid) is True
+            assert src.migrate_abort(rid) is False   # idempotent
+            np.testing.assert_array_equal(src.wait(rid, timeout=60),
+                                          oracle.wait(rid_o, timeout=60))
+            assert src.stats["migration_fallbacks"] == 1
+            assert src.stats["migrations"] == 0
+            assert src.pool_balance()[1] == 0
+        finally:
+            src.stop(); oracle.stop()
+
+    def test_refusals_typed_and_leak_free(self):
+        """Non-migratable requests refuse with ``MigrationError`` (a
+        named, wire-marshallable class) without touching the slot:
+        unknown rids, finished rids, double migrations, dense pools,
+        and tampered payloads/geometry at the restore end."""
+        src, tgt = _servers(2)
+        (dense,) = _servers(1, cache_backend="dense")
+        got = []
+        src.start(); tgt.start(); dense.start()
+        try:
+            with pytest.raises(MigrationError):
+                src.migrate_out(12345)                 # unknown rid
+            with pytest.raises(MigrationError):
+                dense.migrate_out(0)                   # no page pool
+            done = src.submit(PROMPT, max_new_tokens=4)
+            src.wait(done, timeout=60)
+            with pytest.raises(MigrationError):
+                src.migrate_out(done)                  # finished rid
+            rid = src.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            state, payloads = src.migrate_out(rid)
+            with pytest.raises(MigrationError):
+                src.migrate_out(rid)                   # already paused
+            # target-side refusals, each before any page sticks:
+            bad = dict(state, page_size=4)
+            with pytest.raises(MigrationError):
+                tgt.migrate_in(bad, payloads)          # geometry
+            with pytest.raises(MigrationError):
+                tgt.migrate_in(state, payloads[:-1])   # page count
+            tampered = [[np.array(a) for a in p] for p in payloads]
+            tampered[0][0].flat[0] += 1.0
+            with pytest.raises(MigrationError):
+                tgt.migrate_in(state, tampered)        # e2e sha256
+            assert tgt.pool_balance()[1] == 0          # nothing stuck
+            assert tgt.stats["migrated_in"] == 0
+            # the source still resumes cleanly after all that
+            assert src.migrate_abort(rid) is True
+            np.testing.assert_array_equal(
+                src.wait(rid, timeout=60),
+                stub_tokens(PROMPT, BUDGET))
+            assert src.pool_balance()[1] == 0
+        finally:
+            src.stop(); tgt.stop(); dense.stop()
+
+    def test_chaos_gather_and_restore_fall_back(self):
+        """``migrate.gather`` fires BEFORE the pause (the faulted
+        attempt leaves the slot decoding untouched); ``migrate.restore``
+        fires before any allocation on the target — both degrade to
+        abort/resume with zero leaked pages anywhere."""
+        fi_src = FaultInjector(seed=6).on(MIGRATE_GATHER, schedule=[0])
+        fi_tgt = FaultInjector(seed=6).on(MIGRATE_RESTORE, schedule=[0])
+        kw = dict(SERVER_KW)
+        src = ContinuousBatchingServer(StubModel(),
+                                       fault_injector=fi_src, **kw)
+        tgt = ContinuousBatchingServer(StubModel(),
+                                       fault_injector=fi_tgt, **kw)
+        got = []
+        src.start(); tgt.start()
+        try:
+            rid = src.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            with pytest.raises(InjectedFault):
+                src.migrate_out(rid)                  # gather chaos
+            state, payloads = src.migrate_out(rid)    # fault spent
+            with pytest.raises(InjectedFault):
+                tgt.migrate_in(state, payloads)       # restore chaos
+            assert tgt.pool_balance()[1] == 0
+            assert src.migrate_abort(rid) is True
+            np.testing.assert_array_equal(
+                src.wait(rid, timeout=60),
+                stub_tokens(PROMPT, BUDGET))
+            assert src.stats["migration_fallbacks"] == 1
+            assert src.pool_balance()[1] == 0
+        finally:
+            src.stop(); tgt.stop()
+
+    def test_target_without_free_slot_refuses_typed(self):
+        """A packed target raises ``OutOfPages`` from the normal admit
+        path — the router treats it like any restore failure and falls
+        back; the source resumes bit-exactly."""
+        src, tgt = _servers(2)
+        got = []
+        src.start(); tgt.start()
+        try:
+            hold = [tgt.submit(PROMPT, max_new_tokens=BUDGET,
+                               on_token=_sink([], dt=0.005))
+                    for _ in range(2)]         # both target slots busy
+            rid = src.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            state, payloads = src.migrate_out(rid)
+            with pytest.raises(OutOfPages):
+                tgt.migrate_in(state, payloads)
+            assert src.migrate_abort(rid) is True
+            np.testing.assert_array_equal(
+                src.wait(rid, timeout=60),
+                stub_tokens(PROMPT, BUDGET))
+            for h in hold:
+                tgt.wait(h, timeout=60)
+            assert src.pool_balance()[1] == 0
+            assert tgt.pool_balance()[1] == 0
+        finally:
+            src.stop(); tgt.stop()
+
+
+# ============================================== wire + router + drills
+@pytest.mark.net
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="cannot bind a loopback socket here")
+class TestWireMigration:
+    @pytest.fixture
+    def fleet(self):
+        opened = []
+
+        def pair(src_faults=None, **kw):
+            src = make_stub_server(num_pages=17, **kw)
+            tgt = make_stub_server(num_pages=17, **kw)
+            hs = ReplicaHost(src, heartbeat_s=30,
+                             fault_injector=src_faults).start()
+            ht = ReplicaHost(tgt, heartbeat_s=30).start()
+            rs = RemoteReplica(hs.address)
+            rt = RemoteReplica(ht.address)
+            src.start(); tgt.start()
+            opened.extend([(rs, rt), (hs, ht), (src, tgt)])
+            return src, tgt, hs, ht, rs, rt
+
+        yield pair
+        for rs, rt in opened[0::3]:
+            rs.close(); rt.close()
+        for hs, ht in opened[1::3]:
+            hs.close(); ht.close()
+        for src, tgt in opened[2::3]:
+            src.stop(); tgt.stop()
+
+    @pytest.mark.parametrize(
+        "do_sample",
+        [False, pytest.param(True, marks=pytest.mark.slow)],
+        ids=["greedy", "sampled"])
+    def test_wire_migration_bitexact(self, fleet, do_sample):
+        """The tentpole over real sockets: binary page frames out of
+        the source host, restored on the target host, the client
+        stream re-homed — bit-exact vs a never-migrated oracle with
+        zero re-prefill and zero leaks on both processes' pools."""
+        kw = dict(do_sample=do_sample)
+        if do_sample:
+            kw.update(temperature=0.7, top_k=8)
+        src, tgt, hs, ht, rs, rt = fleet(src_faults=_throttle_fi(),
+                                         **kw)
+        oracle = make_stub_server(num_pages=17, **kw)
+        oracle.start()
+        got = []
+        try:
+            rid_o = oracle.submit(PROMPT, max_new_tokens=BUDGET, seed=5)
+            rid = rs.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                            on_token=lambda r, t: got.extend(
+                                int(x) for x in t))
+            _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+            state, payloads = rs.migrate_out(rid)
+            # client-truth delivery offset rides with the state so the
+            # target's mirror starts exactly where this client stopped
+            assert state.get("delivered") is not None
+            new_rid = rt.migrate_in(
+                state, payloads,
+                on_token=lambda r, t: got.extend(int(x) for x in t))
+            assert rs.migrate_finish(rid) is True
+            out = rt.wait(new_rid, timeout=60)
+            ref = oracle.wait(rid_o, timeout=60)
+            np.testing.assert_array_equal(out, ref)
+            if not do_sample:
+                np.testing.assert_array_equal(
+                    out, stub_tokens(PROMPT, BUDGET))
+            _wait(lambda: len(got) >= BUDGET, timeout=10,
+                  msg="stream drained")
+            assert got == [int(t) for t in ref]
+            assert tgt.stats["prefill_tokens"] == 0
+            assert tgt.stats["admissions"] == 0
+            assert src.stats["migrations"] == 1
+            assert tgt.stats["migrated_in"] == 1
+            for s in (src, tgt):
+                assert s.pool_balance()[1] == 0
+        finally:
+            oracle.stop()
+
+    def test_wire_checksum_mismatch_falls_back(self, fleet):
+        """A payload corrupted between hosts fails the END-TO-END
+        sha256 at restore (typed, over the wire) — the source aborts,
+        resumes, and finishes the stream itself; zero leaks."""
+        src, tgt, hs, ht, rs, rt = fleet(src_faults=_throttle_fi())
+        got = []
+        rid = rs.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                        on_token=lambda r, t: got.extend(
+                            int(x) for x in t))
+        _wait(lambda: len(got) >= 6, msg="first streamed tokens")
+        state, payloads = rs.migrate_out(rid)
+        tampered = [np.array(p) for p in payloads]
+        tampered[0].flat[0] += 1.0
+        with pytest.raises(MigrationError):
+            rt.migrate_in(state, tampered)
+        assert rs.migrate_abort(rid) is True
+        np.testing.assert_array_equal(rs.wait(rid, timeout=60),
+                                      stub_tokens(PROMPT, BUDGET))
+        assert src.stats["migration_fallbacks"] == 1
+        assert tgt.stats["migrated_in"] == 0
+        for s in (src, tgt):
+            assert s.pool_balance()[1] == 0
+
+    def test_drop_storm_backfill_heals_token_stream(self, fleet):
+        """The ``remote._on_tokens`` regression (satellite): a
+        ``net.send`` drop storm on the HOST side eats token-push
+        frames mid-stream; the client detects each gap and repairs it
+        with ``fetch_tokens`` backfill from the host's stash — the
+        delivered stream ends COMPLETE and exact, not truncated at the
+        first hole."""
+        storm = _StormFactory(seed=8)
+        fi = FaultInjector(seed=8, enabled=False) \
+            .on(NET_SEND, probability=1.0, error=storm)
+        src, tgt, hs, ht, rs, rt = fleet(src_faults=fi)
+        got = []
+        rid = rs.submit(PROMPT, max_new_tokens=BUDGET, seed=5,
+                        on_token=lambda r, t: got.extend(
+                            int(x) for x in t))
+        _wait(lambda: len(got) >= 4, msg="stream started")
+        fi.arm()                       # the storm eats mid-stream pushes
+        np.testing.assert_array_equal(rs.wait(rid, timeout=60),
+                                      stub_tokens(PROMPT, BUDGET))
+        _wait(lambda: len(got) >= BUDGET, timeout=15,
+              msg="backfill healed the stream")
+        assert got == [int(t) for t in stub_tokens(PROMPT, BUDGET)]
+        assert storm.drops >= 1        # the storm actually tore frames
+
+
+# ============================================= kill drill (real SIGKILL)
+@pytest.mark.net
+@pytest.mark.slow
+@pytest.mark.skipif(not _loopback_available(),
+                    reason="cannot bind a loopback socket here")
+class TestMidMigrationKillDrill:
+    @pytest.fixture
+    def procs(self):
+        spawned = []
+        yield spawned
+        for proc in spawned:
+            if proc.is_alive():
+                proc.kill()
+            proc.join(10)
+
+    def test_sigkill_target_falls_back_zero_leaks_one_flow(
+            self, procs, tmp_path):
+        """Mid-migration SIGKILL: the target PROCESS dies between the
+        source's gather and the restore. The router degrades to
+        fallback (``migration_fallbacks`` counts, ``migrations`` does
+        not), the source resumes the paused slot and finishes the
+        stream BIT-EXACT with zero failed requests and zero leaked
+        pages — and the request's journey still renders as ONE
+        connected flow across process boundaries in the fleet trace."""
+        import json as _json
+        import os as _os
+        import signal as _signal
+
+        from _remote_stub import make_slow_stub_server
+        from paddle_tpu.inference.remote import spawn_replica_host
+        from paddle_tpu.inference.router import ReplicaRouter
+
+        server_kw = dict(max_slots=2, max_cache_len=64, page_size=8,
+                         num_pages=17, tick_sleep_s=0.01)
+        addrs = []
+        for _ in range(2):
+            proc, addr = spawn_replica_host(
+                make_slow_stub_server, server_kw, heartbeat_s=0.05,
+                start_server=True)
+            procs.append(proc)
+            addrs.append(addr)
+        reps = [RemoteReplica(addr, call_timeout_s=2.0)
+                for addr in addrs]
+        router = ReplicaRouter(reps, policy="least_loaded",
+                               journeys=True, recorder=True)
+        got = []
+        try:
+            rid = router.submit(PROMPT, max_new_tokens=BUDGET,
+                                on_token=lambda r, t: got.extend(
+                                    int(x) for x in t))
+            _wait(lambda: len(got) >= 6, timeout=120,
+                  msg="first streamed tokens from the child")
+            with router._lock:
+                src_idx = router._routes[rid].idx
+            victim = 1 - src_idx
+            _os.kill(procs[victim].pid, _signal.SIGKILL)
+            procs[victim].join(10)
+            moved = router._migrate_live(src_idx)
+            assert moved == 0
+            assert router._stats["migration_fallbacks"] == 1
+            assert router._stats["migrations"] == 0
+            out = router.wait(rid, timeout=120)
+            np.testing.assert_array_equal(out,
+                                          stub_tokens(PROMPT, BUDGET))
+            assert got == [int(t) for t in stub_tokens(PROMPT, BUDGET)]
+            # zero leaks on the (live) source, measured over the wire
+            bal = reps[src_idx].pool_balance()
+            assert bal is not None and bal[1] == 0, f"leaked: {bal}"
+            # the fallback is attributed on the journey...
+            timeline = router.journey(rid)
+            assert any(e["phase"] == "migrating"
+                       and e.get("fallback") for e in timeline)
+            # ...and the journey is ONE connected flow spanning the
+            # router pid and the source child pid
+            path = tmp_path / "fleet.json"
+            router.export_fleet_trace(str(path))
+            evs = _json.loads(path.read_text())["traceEvents"]
+            flows = [e for e in evs if e.get("cat") == "journey"
+                     and e.get("id") == f"r{rid}"]
+            assert len(flows) >= 2
+            assert flows[0]["ph"] == "s" and flows[-1]["ph"] == "f"
+            assert len({e["pid"] for e in flows}) >= 2
+        finally:
+            router.stop(drain=False, timeout=20, stop_replicas=False)
+            for rep in reps:
+                rep.close()
+
+
+# ===================================== seeded chaos storm determinism
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestMigrationChaosStorm:
+    """A 25% storm over every ``net.*`` + ``migrate.*`` point the
+    migration path crosses, driven SINGLE-THREADED (manual step(),
+    socketpair wire) so the fault trace is exact: same seed => same
+    trace => same tokens, different seed => different trace."""
+
+    @staticmethod
+    def _storm_run(seed):
+        fi = FaultInjector(seed=seed) \
+            .on(NET_SEND, probability=0.25, error=NetDrop) \
+            .on(NET_RECV, probability=0.25, error=NetDrop) \
+            .on(NET_PAGE_SEND, probability=0.25, error=NetDrop) \
+            .on(MIGRATE_GATHER, probability=0.25) \
+            .on(MIGRATE_RESTORE, probability=0.25)
+        kw = dict(SERVER_KW)
+        src = ContinuousBatchingServer(StubModel(), fault_injector=fi,
+                                       **kw)
+        tgt = ContinuousBatchingServer(StubModel(), fault_injector=fi,
+                                       **kw)
+        sa, sb = socket.socketpair()
+        a = Connection(sa, fault_injector=fi, peer="src-host")
+        b = Connection(sb, peer="tgt-host")
+        b._faults = fi
+        got = []
+        budget = 24
+        rid = src.submit(PROMPT, max_new_tokens=budget, seed=5,
+                         on_token=lambda r, t: got.extend(
+                             int(x) for x in t))
+
+        def step_until(srv, pred, cap=4000):
+            for _ in range(cap):
+                if pred():
+                    return
+                srv.step()
+            raise AssertionError("stepped past the cap")
+
+        step_until(src, lambda: len(got) >= 6)
+        carrier, wait_rid = src, rid
+        for _ in range(8):                      # bounded storm retries
+            try:
+                state, payloads = src.migrate_out(rid)
+            except InjectedFault:
+                continue                        # gather chaos: slot
+            #                                     untouched, try again
+            try:
+                lost = not a.send({"op": "migrate_in",
+                                   "n": len(payloads)})
+                for i, p in enumerate(payloads):
+                    arr = np.ascontiguousarray(np.stack(p))
+                    if not a.send_pages(
+                            {"i": i, "shape": list(arr.shape),
+                             "dtype": str(arr.dtype)},
+                            arr.tobytes()):
+                        lost = True
+                frames = {}
+                header = None
+                while True:
+                    try:
+                        msg = b.recv(timeout=0.1)
+                    except TimeoutError:
+                        break
+                    if "op" in msg:
+                        header = msg
+                    else:
+                        frames[int(msg["i"])] = np.frombuffer(
+                            msg["_payload"],
+                            dtype=np.dtype(msg["dtype"])) \
+                            .reshape(msg["shape"])
+                if lost or header is None \
+                        or len(frames) != len(payloads):
+                    src.migrate_abort(rid)      # frame loss: fallback
+                    continue
+                new_rid = tgt.migrate_in(
+                    state, [frames[i] for i in range(len(payloads))],
+                    on_token=lambda r, t: got.extend(
+                        int(x) for x in t))
+            except (InjectedFault, MigrationError):
+                src.migrate_abort(rid)          # restore chaos
+                continue
+            src.migrate_finish(rid)
+            carrier, wait_rid = tgt, new_rid
+            break
+        step_until(carrier, lambda: len(got) >= budget)
+        out = carrier.wait(wait_rid, timeout=5)
+        assert src.pool_balance()[1] == 0
+        assert tgt.pool_balance()[1] == 0
+        a.close()
+        b.close()
+        return list(fi.trace), [int(t) for t in out], list(got)
+
+    def test_same_seed_same_trace_same_tokens(self):
+        t1, out1, got1 = self._storm_run(13)
+        t2, out2, got2 = self._storm_run(13)
+        t3, _, _ = self._storm_run(14)
+        assert t1 == t2                      # identical fault traces
+        assert out1 == out2 == got1 == got2  # identical streams
+        assert t1 != t3                      # the seed actually steers
+        assert out1 == [int(t) for t in stub_tokens(PROMPT, 24)]
+        assert len(t1) >= 1                  # the storm actually fired
+
+
+# ======================================== sharded gather/scatter parity
+@pytest.mark.mesh
+@pytest.mark.slow
+@pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="needs >= 2 forced host devices "
+           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+class TestShardedMigration:
+    @pytest.fixture(scope="class")
+    def llama4(self):
+        """llama with 4 kv heads (divisible by mp=2) — real sampling,
+        so the restored PRNG chain is exercised for real (the stub's
+        closed-form logits cannot distinguish a mis-primed key)."""
+        import paddle_tpu as pt
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=1,
+                          num_heads=8, num_kv_heads=4,
+                          intermediate_size=128, max_seq_len=128)
+        pt.seed(21)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    @pytest.mark.parametrize("src_mp,tgt_mp", [(2, 1), (1, 2)],
+                             ids=["mp2_to_mp1", "mp1_to_mp2"])
+    def test_cross_topology_migration_bitexact(self, llama4, src_mp,
+                                               tgt_mp):
+        """Pages gathered per shard on an mp=2 mesh restore into a
+        single-device pool bit-exactly, and vice versa: the wire
+        payload is topology-neutral host arrays, so migration crosses
+        tensor-parallel layouts without a re-prefill."""
+        from jax.sharding import Mesh
+
+        def mesh(n):
+            return Mesh(np.array(jax.devices()[:n]), ("mp",)) \
+                if n > 1 else None
+
+        kw = dict(max_slots=2, max_cache_len=64, cache_backend="paged",
+                  page_size=8, num_pages=24, do_sample=True,
+                  temperature=0.8, top_k=20)
+        src = ContinuousBatchingServer(llama4, mesh=mesh(src_mp), **kw)
+        tgt = ContinuousBatchingServer(llama4, mesh=mesh(tgt_mp), **kw)
+        oracle = ContinuousBatchingServer(llama4, **kw)
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, 256, (9,)).astype(np.int32)
+        budget = 24
+        got = []
+        src.start(); tgt.start(); oracle.start()
+        try:
+            rid_o = oracle.submit(prompt, max_new_tokens=budget,
+                                  seed=31)
+            rid = src.submit(prompt, max_new_tokens=budget, seed=31,
+                             on_token=_sink(got))
+            _wait(lambda: len(got) >= 6, timeout=120,
+                  msg="first streamed tokens")
+            state, payloads = src.migrate_out(rid)
+            new_rid = tgt.migrate_in(state, payloads,
+                                     on_token=_sink(got))
+            src.migrate_finish(rid)
+            out = tgt.wait(new_rid, timeout=120)
+            ref = oracle.wait(rid_o, timeout=120)
+            np.testing.assert_array_equal(out, ref)
+            assert tgt.stats["prefill_tokens"] == 0
+            assert tgt.stats["admissions"] == 0
+            for s in (src, tgt):
+                assert s.pool_balance()[1] == 0
+        finally:
+            src.stop(); tgt.stop(); oracle.stop()

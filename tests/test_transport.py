@@ -327,3 +327,115 @@ class TestFuzzOneCallFails:
         th.start()
         th.join(5)
         assert t0.is_set()
+
+
+class TestPageFrames:
+    """Binary page frames (ISSUE 18): the migration wire format — a
+    JSON header riding ahead of raw payload bytes, sha256-verified on
+    arrival, with a per-connection ``max_frame_bytes`` so page-heavy
+    links raise their own cap without loosening every peer's guard."""
+
+    def test_page_frame_roundtrip_bitexact(self):
+        a, b = _pair()
+        page = np.arange(2 * 3 * 8 * 4 * 4, dtype=np.float32) \
+            .reshape(2, 3, 8, 4, 4)
+        assert a.send_pages({"push": "pages", "i": 0, "n": 1,
+                             "shape": list(page.shape),
+                             "dtype": str(page.dtype)},
+                            page.tobytes()) is True
+        msg = b.recv(timeout=2)
+        assert msg["push"] == "pages"
+        back = np.frombuffer(msg["_payload"],
+                             dtype=np.dtype(msg["dtype"])) \
+            .reshape(msg["shape"])
+        np.testing.assert_array_equal(back, page)
+        a.close()
+        b.close()
+
+    def test_oversized_page_frame_fails_typed_never_hangs(self):
+        """The satellite guard: a payload past ``max_frame_bytes``
+        raises ``FrameError`` BEFORE any bytes hit the wire — the
+        stream stays in sync and the conn loop keeps serving instead
+        of wedging a half-sent binary tail."""
+        raw_a, raw_b = socket.socketpair()
+        a = Connection(raw_a, peer="a", max_frame_bytes=4096)
+        b = Connection(raw_b, peer="b", max_frame_bytes=4096)
+        with pytest.raises(FrameError):
+            a.send_pages({"push": "pages", "i": 0}, b"\x00" * 5000)
+        # nothing desynced: control traffic still flows both ways
+        assert a.send({"ok": 1}) is True
+        assert b.recv(timeout=2) == {"ok": 1}
+        small = np.ones(16, dtype=np.float32)
+        a.send_pages({"push": "pages", "i": 0, "shape": [16],
+                      "dtype": "float32"}, small.tobytes())
+        msg = b.recv(timeout=2)
+        np.testing.assert_array_equal(
+            np.frombuffer(msg["_payload"], dtype=np.float32), small)
+        a.close()
+        b.close()
+
+    def test_max_frame_bytes_parameterized_per_connection(self):
+        """A page-heavy link raises its own cap: the same payload that
+        a default conn refuses sails through one constructed with a
+        bigger ``max_frame_bytes`` — and the oversize check tracks the
+        configured value, not the module constant."""
+        big = b"\x01" * (64 * 1024)
+        raw_a, raw_b = socket.socketpair()
+        small_a = Connection(raw_a, peer="a", max_frame_bytes=1024)
+        small_b = Connection(raw_b, peer="b", max_frame_bytes=1024)
+        with pytest.raises(FrameError):
+            small_a.send_pages({"i": 0}, big)
+        small_a.close()
+        small_b.close()
+        raw_c, raw_d = socket.socketpair()
+        wide_c = Connection(raw_c, peer="c",
+                            max_frame_bytes=1024 * 1024)
+        wide_d = Connection(raw_d, peer="d",
+                            max_frame_bytes=1024 * 1024)
+        assert wide_c.send_pages({"i": 0}, big) is True
+        msg = wide_d.recv(timeout=5)
+        assert msg["_payload"] == big
+        wide_c.close()
+        wide_d.close()
+
+    def test_connect_accepts_max_frame_bytes(self):
+        lst = socket.create_server(("127.0.0.1", 0))
+        try:
+            conn = transport.connect(lst.getsockname(), timeout=2,
+                                     max_frame_bytes=123456)
+            assert conn.max_frame_bytes == 123456
+            conn.close()
+        finally:
+            lst.close()
+
+    def test_sha256_mismatch_spoils_one_transfer_only(self):
+        """A corrupted payload fails its frame typed; framing held, so
+        the connection keeps serving — the migration layer above sees
+        a checksum miss and degrades to replay."""
+        raw_a, raw_b = socket.socketpair()
+        b = Connection(raw_b, peer="b")
+        blob = b"\x07" * 64
+        head = {"push": "pages", "i": 0, "_bin": len(blob),
+                "_sha256": "0" * 64}          # wrong digest
+        hb = json.dumps(head, separators=(",", ":")).encode()
+        raw_a.sendall(struct.pack("!I", len(hb)) + hb + blob)
+        with pytest.raises(FrameError):
+            b.recv(timeout=2)
+        ok = json.dumps({"fine": 1}).encode()
+        raw_a.sendall(struct.pack("!I", len(ok)) + ok)
+        assert b.recv(timeout=2) == {"fine": 1}
+        b.close()
+
+    def test_page_send_chaos_point_targets_only_page_frames(self):
+        """``net.page_send`` storms migration traffic without touching
+        control frames: a drop armed there swallows the binary frame
+        while ordinary sends keep flowing."""
+        fi = FaultInjector(seed=4).on(faults.NET_PAGE_SEND,
+                                      schedule=[0], error=NetDrop)
+        a, b = _pair(fault_injector=fi)
+        assert a.send_pages({"i": 0}, b"\x02" * 32) is False  # vanished
+        assert a.send({"ctl": 1}) is True
+        assert b.recv(timeout=2) == {"ctl": 1}
+        assert fi.fired(faults.NET_PAGE_SEND) == 1
+        a.close()
+        b.close()
