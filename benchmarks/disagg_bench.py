@@ -1,0 +1,482 @@
+"""Colocated vs disaggregated prefill/decode serving (ISSUE 20): what
+specialist replicas + the pipelined page handoff buy under mixed load.
+
+Colocated serving runs every replica as a hybrid: long-prompt prefill
+chunks and single-token decode ticks interleave on the same device, so
+a decode-heavy stream's inter-token gap (TPOT) spikes every time a
+prefill chunk lands in front of it. Disaggregated serving routes long
+prompts to a prefill specialist, streams the written KV pages to a
+decode specialist in bounded multi-frame batches as chunks complete
+(``migrate_out(partial=True)``), and commits sampler state + the tail
+pages at the cut — the decode specialist never runs a long prompt's
+prefill at all.
+
+This bench drives the SAME greedy workload — a decode-heavy stream of
+short prompts plus a steady arrival of long prompts — through both
+fleet shapes at EQUAL replica count (2 hybrids vs 1 prefill + 1
+decode) and reports, per mode:
+
+- decode TPOT p50/p99 over the short streams (wall-clock gaps between
+  streamed tokens; the ratio disagg/colocated is the tracked metric),
+- long-prompt TTFT p50,
+- handoffs completed vs fallbacks, and the fleet-wide re-prefill bill:
+  sum(prefill_tokens across replicas) - sum(prompt lens). The
+  disaggregated mode SELF-ASSERTS this is exactly 0 — any re-prefilled
+  token means the handoff fell back to replay,
+- the decode specialist's steady-state dispatch profile from the
+  flight recorder: every pure-decode tick must stay ``{"decode": 1}``
+  (one launch per tick — the handoff scatters pages off-tick).
+
+Correctness phases run before any timing and hard-assert:
+
+- greedy AND seeded-sampled empty-``emitted`` handoff parity vs a
+  single-replica oracle (zero re-prefill both ways),
+- a fused-mode decode specialist accepting a mid-prefill handoff:
+  bit-exact, steady ticks all ``{"fused": 1}``,
+- mp1<->mp2 cross-topology mid-prefill handoff on a real tiny llama
+  (skipped with a printed note when < 2 devices; run under
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=2`` to price it).
+
+Every completed stream is verified bit-exact against its oracle, so a
+mode that cheated correctness would fail before it reported a number.
+
+    python benchmarks/disagg_bench.py [--shorts N] [--longs N]
+        [--short-prompt N] [--long-prompt N] [--track]
+"""
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, os.path.join(_REPO, "scripts"))
+
+
+# ------------------------------------------------------------------ #
+# correctness phases (step-driven, deterministic)                    #
+# ------------------------------------------------------------------ #
+
+def _drain(*servers, cap=200_000):
+    for _ in range(cap):
+        busy = False
+        for s in servers:
+            if s._busy_locked():
+                s.step()
+                busy = True
+        if not busy:
+            return
+    raise AssertionError("servers never drained")
+
+
+def _parity_phase(args):
+    """Empty-``emitted`` handoff parity, greedy + seeded-sampled, plus
+    the fused-target dispatch profile. Returns the re-prefill bill
+    (asserted 0)."""
+    from _remote_stub import make_stub_server
+    from _serving_stub import stub_tokens
+    from paddle_tpu.telemetry import FlightRecorder
+
+    kw = dict(max_cache_len=64, num_pages=24, prefill_tokens_per_tick=8)
+    rng = np.random.default_rng(20)
+    prompt = rng.integers(0, 16, (24,)).astype(np.int32)
+    budget = 12
+    reprefill = 0
+
+    def handoff(src, tgt, seed=None):
+        rid = src.submit(prompt, max_new_tokens=budget, seed=seed)
+        src.step(); src.step()          # 16 of 24 prompt tokens in
+        state, payloads = src.migrate_out(rid)
+        assert state["phase"] == "prefill", state["phase"]
+        new = tgt.migrate_in(state, payloads)
+        src.migrate_finish(rid)
+        _drain(src, tgt)
+        return tgt.wait(new, timeout=30)
+
+    # greedy vs the closed-form oracle
+    src = make_stub_server(role="prefill", **kw)
+    tgt = make_stub_server(role="decode", **kw)
+    np.testing.assert_array_equal(handoff(src, tgt),
+                                  stub_tokens(prompt, budget))
+    bill = src.stats["prefill_tokens"] + tgt.stats["prefill_tokens"] \
+        - len(prompt)
+    assert bill == 0, f"greedy handoff re-prefilled {bill} tokens"
+    reprefill += bill
+
+    # seeded-sampled vs a single-replica oracle run
+    skw = dict(kw, do_sample=True, temperature=0.8, top_k=8)
+    oracle = make_stub_server(**skw)
+    orid = oracle.submit(prompt, max_new_tokens=budget, seed=5)
+    _drain(oracle)
+    src = make_stub_server(role="prefill", **skw)
+    tgt = make_stub_server(role="decode", **skw)
+    np.testing.assert_array_equal(handoff(src, tgt, seed=5),
+                                  oracle.wait(orid, timeout=5))
+    bill = src.stats["prefill_tokens"] + tgt.stats["prefill_tokens"] \
+        - len(prompt)
+    assert bill == 0, f"sampled handoff re-prefilled {bill} tokens"
+    reprefill += bill
+    for s in (src, tgt, oracle):
+        assert s.pool_balance()[1] == 0, "leaked pages"
+
+    # fused-mode decode specialist: the restored mid-prefill slot
+    # finishes its prompt inside the megakernel tick and every steady
+    # tick stays one launch
+    rec = FlightRecorder()
+    src = make_stub_server(role="prefill", **kw)
+    tgt = make_stub_server(role="decode", serving_mode="fused",
+                           prefill_mode="ragged", recorder=rec, **kw)
+    np.testing.assert_array_equal(handoff(src, tgt),
+                                  stub_tokens(prompt, budget))
+    prof = [e["dispatches"] for e in rec.events()
+            if e.get("kind") == "tick" and e.get("dispatches")]
+    assert prof and all(d == {"fused": 1} for d in prof), prof
+    print(f"parity: greedy + seeded-sampled handoff bit-exact, "
+          f"re-prefill 0; fused target steady ticks all "
+          f"{{'fused': 1}} ({len(prof)} ticks)")
+    return reprefill
+
+
+def _llama():
+    """The 4-kv-head tiny llama every serving bench prices on: real
+    matmuls, so a prefill chunk genuinely outweighs a decode tick."""
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=1,
+                      num_heads=8, num_kv_heads=4,
+                      intermediate_size=128, max_seq_len=256)
+    pt.seed(21)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _cross_topology_phase(model):
+    """Mid-prefill handoff across tensor-parallel layouts (mp2->mp1
+    and mp1->mp2) on a real tiny llama with seeded sampling. Returns
+    the re-prefill bill (0), or None when the host has < 2 devices."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        print("cross-topology: skipped (needs >= 2 devices; run under "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+        return None
+    from jax.sharding import Mesh
+
+    from paddle_tpu.inference import ContinuousBatchingServer
+
+    def mesh(n):
+        return Mesh(np.array(jax.devices()[:n]), ("mp",)) \
+            if n > 1 else None
+
+    kw = dict(max_slots=2, max_cache_len=64, cache_backend="paged",
+              page_size=8, num_pages=24, do_sample=True,
+              temperature=0.8, top_k=20, prefill_tokens_per_tick=8)
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, 256, (20,)).astype(np.int32)
+    budget = 16
+    reprefill = 0
+    for src_mp, tgt_mp in ((2, 1), (1, 2)):
+        src = ContinuousBatchingServer(model, mesh=mesh(src_mp),
+                                       role="prefill", **kw)
+        tgt = ContinuousBatchingServer(model, mesh=mesh(tgt_mp),
+                                       role="decode", **kw)
+        oracle = ContinuousBatchingServer(model, **kw)
+        orid = oracle.submit(prompt, max_new_tokens=budget, seed=31)
+        _drain(oracle)
+        rid = src.submit(prompt, max_new_tokens=budget, seed=31)
+        src.step()                      # 8 of 20 prompt tokens in
+        state, payloads = src.migrate_out(rid)
+        assert state["phase"] == "prefill"
+        new = tgt.migrate_in(state, payloads)
+        src.migrate_finish(rid)
+        _drain(src, tgt)
+        np.testing.assert_array_equal(tgt.wait(new, timeout=120),
+                                      oracle.wait(orid, timeout=5))
+        bill = src.stats["prefill_tokens"] \
+            + tgt.stats["prefill_tokens"] - len(prompt)
+        assert bill == 0, \
+            f"mp{src_mp}->mp{tgt_mp} re-prefilled {bill} tokens"
+        reprefill += bill
+        for s in (src, tgt):
+            assert s.pool_balance()[1] == 0
+        print(f"cross-topology: mp{src_mp}->mp{tgt_mp} mid-prefill "
+              f"handoff bit-exact, re-prefill 0")
+    return reprefill
+
+
+# ------------------------------------------------------------------ #
+# the timed fleet runs                                               #
+# ------------------------------------------------------------------ #
+
+def _server_kw(args):
+    return dict(max_slots=args.slots, max_cache_len=args.max_cache_len,
+                cache_backend="paged", page_size=args.page_size,
+                num_pages=args.pool_pages,
+                prefill_tokens_per_tick=args.chunk)
+
+
+def _workload(args):
+    """(key, prompt, budget) triples: a decode-heavy floor of short
+    prompts plus a steady arrival of long prompts. Distinct random
+    prompts so prefix-cache hits cannot hide a re-prefill."""
+    rng = np.random.default_rng(20)
+    reqs = []
+    for i in range(args.shorts):
+        reqs.append((("s", i),
+                     rng.integers(0, 256,
+                                  (args.short_prompt,)).astype(np.int32),
+                     args.short_budget))
+    for i in range(args.longs):
+        reqs.append((("l", i),
+                     rng.integers(0, 256,
+                                  (args.long_prompt,)).astype(np.int32),
+                     args.long_budget))
+    return reqs
+
+
+def _oracle_outputs(model, args, reqs):
+    """Greedy reference streams: every request run SOLO on a single
+    replica at the fleet geometry — the bar both fleet shapes must hit
+    bit-exactly."""
+    from paddle_tpu.inference import ContinuousBatchingServer
+
+    srv = ContinuousBatchingServer(model, **_server_kw(args))
+    exp = {}
+    try:
+        for k, p, budget in reqs:
+            rid = srv.submit(p, max_new_tokens=budget)
+            _drain(srv)
+            exp[k] = srv.wait(rid, timeout=60)
+    finally:
+        srv.stop()
+    return exp
+
+
+def _fleet(args, mode, model, reqs, expected, warm=False):
+    """One threaded fleet run at equal replica count: 2 hybrids under
+    the default affinity placement ('colocated') vs prefill + decode
+    specialists under placement='disaggregated'. Real tiny-llama
+    replicas, so a prefill chunk costs real matmul time; greedy, so
+    every stream is verified against the solo-run oracle. ``warm``
+    runs the identical shape untimed first, keeping jit compiles (the
+    handoff gather/scatter geometries especially) out of the timed
+    pass."""
+    from paddle_tpu.inference import (ContinuousBatchingServer,
+                                      ReplicaRouter)
+    from paddle_tpu.telemetry import FlightRecorder
+
+    kw = _server_kw(args)
+    rec = FlightRecorder()
+    if mode == "disaggregated":
+        reps = [ContinuousBatchingServer(model, role="prefill", **kw),
+                ContinuousBatchingServer(model, role="decode",
+                                         recorder=rec, **kw)]
+        router = ReplicaRouter(
+            reps, placement="disaggregated",
+            disagg_prefill_min_tokens=args.disagg_min_tokens)
+    else:
+        reps = [ContinuousBatchingServer(model, role="hybrid", **kw),
+                ContinuousBatchingServer(model, role="hybrid",
+                                         recorder=rec, **kw)]
+        router = ReplicaRouter(reps)
+
+    lock = threading.Lock()
+    times, toks = {}, {}
+
+    def sink(key):
+        times[key], toks[key] = [], []
+
+        def cb(_r, ts):
+            now = time.perf_counter()
+            with lock:
+                toks[key].extend(int(t) for t in ts)
+                times[key].extend([now] * len(ts))
+        return cb
+
+    submitted, rids = {}, {}
+    t0 = time.perf_counter()
+    try:
+        router.start(poll_interval=0.002)
+        # decode-heavy floor first ...
+        for k, p, budget in reqs:
+            if k[0] != "s":
+                continue
+            rids[k] = router.submit(p, max_new_tokens=budget,
+                                    on_token=sink(k))
+            submitted[k] = time.perf_counter()
+            time.sleep(0.004)
+        time.sleep(0.05)                # let the shorts reach decode
+        # ... then a steady arrival of long prompts on top of it
+        for k, p, budget in reqs:
+            if k[0] != "l":
+                continue
+            rids[k] = router.submit(p, max_new_tokens=budget,
+                                    on_token=sink(k))
+            submitted[k] = time.perf_counter()
+            time.sleep(args.long_gap_s)
+        outs = {k: router.wait(r, timeout=180)
+                for k, r in rids.items()}
+        wall = time.perf_counter() - t0
+        # settle: a stream can complete on the target while the pump
+        # is still releasing the source slot (migrate_finish) — give
+        # the fleet a beat to return every page before the leak check
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(r.pool_balance()[1] == 0 for r in reps):
+                break
+            time.sleep(0.005)
+    finally:
+        router.stop()
+
+    # correctness first: bit-exact streams, callbacks complete
+    for k, out in outs.items():
+        np.testing.assert_array_equal(out, expected[k])
+        np.testing.assert_array_equal(np.asarray(toks[k]), out)
+    # the re-prefill bill across the whole fleet: any token prefilled
+    # twice shows up as an excess over the submitted prompt tokens
+    prompt_tokens = sum(len(p) for _, p, _ in reqs)
+    reprefill = sum(r.stats["prefill_tokens"] for r in reps) \
+        - prompt_tokens
+    for r in reps:
+        assert r.pool_balance()[1] == 0, "leaked pages"
+
+    gaps = []
+    for i in range(args.shorts):
+        ts = times[("s", i)]
+        gaps.extend(np.diff(np.asarray(ts)))
+    gaps = np.asarray(gaps)
+    ttft = [times[("l", i)][0] - submitted[("l", i)]
+            for i in range(args.longs)]
+
+    out = {"mode": mode, "wall_s": wall,
+           "tpot_p50_ms": float(np.percentile(gaps, 50)) * 1e3,
+           "tpot_p99_ms": float(np.percentile(gaps, 99)) * 1e3,
+           "ttft_p50_ms": float(np.percentile(ttft, 50)) * 1e3,
+           "reprefill_tokens": int(reprefill),
+           "handoffs": router.stats.get("handoffs", 0),
+           "fallbacks": router.stats.get("handoff_fallbacks", 0)}
+    if mode == "disaggregated":
+        # the acceptance contract, asserted on every run
+        assert reprefill == 0, \
+            f"disaggregated fleet re-prefilled {reprefill} tokens"
+        if not warm:
+            assert out["handoffs"] >= 1, \
+                "no prefill->decode handoff completed"
+        # decode specialist's steady-state dispatch profile: every
+        # pure-decode tick is ONE launch — the handoff scatters pages
+        # off-tick, never as extra per-tick dispatches
+        prof = [e["dispatches"] for e in rec.events()
+                if e.get("kind") == "tick" and e.get("dispatches")]
+        steady = [d for d in prof if set(d) <= {"decode"}]
+        assert steady and all(d == {"decode": 1} for d in steady), \
+            f"decode specialist tick profile drifted: {steady[:5]}"
+        out["steady_decode_ticks"] = len(steady)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shorts", type=int, default=10,
+                    help="decode-heavy short requests")
+    ap.add_argument("--longs", type=int, default=6,
+                    help="prefill-heavy long requests")
+    ap.add_argument("--short-prompt", type=int, default=8)
+    ap.add_argument("--short-budget", type=int, default=60)
+    ap.add_argument("--long-prompt", type=int, default=128)
+    ap.add_argument("--long-budget", type=int, default=24)
+    ap.add_argument("--long-gap-s", type=float, default=0.02,
+                    help="arrival gap between long prompts")
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="prefill tokens per tick")
+    ap.add_argument("--slots", type=int, default=12)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--pool-pages", type=int, default=256)
+    ap.add_argument("--max-cache-len", type=int, default=160)
+    ap.add_argument("--disagg-min-tokens", type=int, default=32,
+                    help="prompt length that routes to a prefill "
+                         "specialist")
+    ap.add_argument("--track", action="store_true",
+                    help="append disaggregation rounds to "
+                         "BENCHLOG.jsonl")
+    args = ap.parse_args(argv)
+    if args.long_prompt + args.long_budget > args.max_cache_len:
+        ap.error("long prompt + budget must fit max_cache_len")
+    if not (args.short_prompt < args.disagg_min_tokens
+            <= args.long_prompt):
+        ap.error("disagg-min-tokens must split shorts from longs")
+
+    reprefill = _parity_phase(args)
+    model = _llama()
+    xbill = _cross_topology_phase(model)
+    if xbill is not None:
+        reprefill += xbill
+
+    reqs = _workload(args)
+    expected = _oracle_outputs(model, args, reqs)
+    print("oracle: solo-run reference streams computed "
+          f"({len(reqs)} requests)")
+    # untimed warm pass per mode: compiles (handoff gather/scatter
+    # geometries especially) must not land inside the timed run
+    _fleet(args, "colocated", model, reqs, expected, warm=True)
+    _fleet(args, "disaggregated", model, reqs, expected, warm=True)
+    colo = _fleet(args, "colocated", model, reqs, expected)
+    disagg = _fleet(args, "disaggregated", model, reqs, expected)
+    reprefill += disagg["reprefill_tokens"]
+    ratio = disagg["tpot_p99_ms"] / colo["tpot_p99_ms"]
+
+    print(f"\ndisagg bench: {args.shorts} short "
+          f"(prompt {args.short_prompt} + {args.short_budget}) + "
+          f"{args.longs} long (prompt {args.long_prompt} + "
+          f"{args.long_budget}), chunk {args.chunk}, 2 replicas "
+          f"either way")
+    hdr = (f"{'fleet':<14} {'tpot p50 ms':>12} {'tpot p99 ms':>12} "
+           f"{'ttft p50 ms':>12} {'handoffs':>9} {'re-prefill':>11} "
+           f"{'wall s':>7}")
+    print(hdr)
+    print("-" * len(hdr))
+    for m in (colo, disagg):
+        print(f"{m['mode']:<14} {m['tpot_p50_ms']:>12.2f} "
+              f"{m['tpot_p99_ms']:>12.2f} {m['ttft_p50_ms']:>12.1f} "
+              f"{m['handoffs']:>9} {m['reprefill_tokens']:>11} "
+              f"{m['wall_s']:>7.1f}")
+    print(f"decode TPOT p99 ratio (disagg/colocated): {ratio:.3f}  "
+          f"[{disagg['handoffs']} handoffs, "
+          f"{disagg['fallbacks']} fallbacks, "
+          f"{disagg['steady_decode_ticks']} steady decode ticks all "
+          f"{{'decode': 1}}]")
+    print(f"re-prefilled tokens across every handoff phase: "
+          f"{reprefill}")
+    assert reprefill == 0, f"re-prefilled {reprefill} tokens"
+
+    if args.track:
+        import bench_track
+        r = bench_track.append_round(
+            {"metric": "disagg_decode_tpot_p99_ratio",
+             "value": round(ratio, 4), "unit": "ratio",
+             "note": f"short-stream decode TPOT p99 "
+                     f"{disagg['tpot_p99_ms']:.2f} ms disaggregated "
+                     f"vs {colo['tpot_p99_ms']:.2f} ms colocated at "
+                     f"equal replica count "
+                     f"({disagg['handoffs']} handoffs, "
+                     f"{disagg['fallbacks']} fallbacks)"})
+        print(f"tracked {r['metric']} = {r['value']}")
+        r2 = bench_track.append_round(
+            {"metric": "disagg_handoff_reprefill_tokens",
+             "value": int(reprefill), "unit": "tokens",
+             "note": "tokens prefilled twice across every handoff "
+                     "phase (greedy + sampled parity, cross-topology, "
+                     "disaggregated fleet) — the handoff path must "
+                     "keep this at exactly 0"})
+        print(f"tracked {r2['metric']} = {r2['value']}")
+    return {"colocated": colo, "disaggregated": disagg,
+            "ratio": ratio, "reprefill_tokens": int(reprefill)}
+
+
+if __name__ == "__main__":
+    main()

@@ -109,6 +109,16 @@ def _run_mode(args, mode):
                 except MigrationError:
                     continue     # finished at home while its siblings
                 #                  were being gathered: nothing to move
+                if str(state.get("phase")) == "prefill":
+                    # mid-prefill slots became migratable with the
+                    # prefill->decode handoff (ISSUE 20); this bench
+                    # prices mid-DECODE drains only, so resume it at
+                    # home rather than skewing the replay accounting
+                    src.migrate_abort(rid)
+                    print(f"  note: request {i} still mid-prefill at "
+                          f"the drain point; skipped "
+                          f"(disagg_bench prices the prefill handoff)")
+                    continue
                 carried[i] = tgt.migrate_in(state, payloads,
                                             on_token=sink(i))
                 src.migrate_finish(rid)

@@ -22,7 +22,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "Tensor",
            "BatchScheduler", "ContinuousBatchingServer", "HostTier",
            "ReplicaRouter",
            "RouterSupervisor", "ReplicaHost", "RemoteReplica",
-           "spawn_replica_host", "scan_decode",
+           "spawn_replica_host", "placement", "scan_decode",
            "greedy_generate", "sample_generate", "beam_generate",
            "fsm_generate", "phrases_to_fsm", "process_logits",
            "speculative_generate", "export_decode", "load_decode",
@@ -263,6 +263,7 @@ from .kv_tier import HostTier  # noqa: E402,F401
 from .router import ReplicaRouter, RouterSupervisor  # noqa: E402,F401
 from .remote import (ReplicaHost, RemoteReplica,  # noqa: E402,F401
                      spawn_replica_host)
+from . import placement  # noqa: E402,F401  (disaggregated serving policy)
 from .speculative import speculative_generate  # noqa: E402,F401
 from .deploy_decode import (export_decode, load_decode,  # noqa: E402,F401
                             DeployedGenerator)

@@ -69,7 +69,7 @@ class _Slot:
     __slots__ = ("rid", "ids", "prompt_len", "budget", "emitted",
                  "on_token", "streamed", "deadline", "phase", "fill_pos",
                  "filled", "n_pre", "seed", "priority", "preempts",
-                 "replayed", "journey", "reprefill_upto")
+                 "replayed", "journey", "reprefill_upto", "sent_pages")
 
     def __init__(self, rid, ids, prompt_len, budget, on_token=None,
                  deadline=None):
@@ -96,6 +96,11 @@ class _Slot:
         #                               redo a registered prefix's
         #                               sub-page tail (ledger:
         #                               tail_reprefill, ragged mode)
+        self.sent_pages = 0           # pages already shipped by a
+        #                               pipelined handoff
+        #                               (migrate_out(partial=True));
+        #                               reset on migrate_abort so a
+        #                               later full handoff re-ships
         # the partial recorded BEFORE a preemption: a resumed slot
         # replays the identical chain, so the longer of (replayed,
         # emitted) is always the request's true partial — a deadline/
@@ -398,7 +403,18 @@ class ContinuousBatchingServer:
                  host_tier=None, host_tier_bytes=None,
                  max_queue=None, shed_policy="reject",
                  retry_policy=None, breaker=None, fault_injector=None,
-                 clock=None):
+                 clock=None, role="hybrid"):
+        if role not in ("prefill", "decode", "hybrid"):
+            raise ValueError(
+                "role must be 'prefill', 'decode' or 'hybrid', got "
+                f"{role!r}")
+        # disaggregated serving (ISSUE 20): the role is a PLACEMENT
+        # hint the router reads — a "prefill" specialist runs ragged
+        # prefill and hands finished prompt pages to decode replicas;
+        # its one hard rule is refusing decode-phase migrate_in (it
+        # still decodes locally when the fleet degrades to hybrid
+        # routing). "decode" is advisory only.
+        self.role = role
         self.model = model
         self.mesh = mesh
         self.max_slots = int(max_slots)
@@ -642,12 +658,20 @@ class ContinuousBatchingServer:
         # state_push dispatch ever fires on the tick path
         self._host_keys = np.zeros((self.max_slots, 2), np.uint32)
         self._preempted = []      # _Preempted records awaiting re-admission
-        self._migrating = {}      # rid -> (slot, tele t0): paused slots
-        #                           whose gathered pages are in flight to
-        #                           a sibling (migrate_out) — settled by
-        #                           migrate_finish (handoff committed,
-        #                           pages released/donated here) or
-        #                           migrate_abort (resume decoding here)
+        self._migrating = {}      # rid -> (slot, tele t0, prior phase):
+        #                           paused slots whose gathered pages are
+        #                           in flight to a sibling (migrate_out) —
+        #                           settled by migrate_finish (handoff
+        #                           committed, pages released/donated
+        #                           here) or migrate_abort (resume
+        #                           decoding — or prefilling, for an
+        #                           empty-`emitted` handoff — here)
+        self._staging = {}        # handle -> pipelined-restore slot
+        #                           (migrate_in_begin): pages scatter in
+        #                           batches while the source still
+        #                           prefills; settled by
+        #                           migrate_in_commit / migrate_in_abort
+        self._next_xfer = 1       # staged-restore handle mint
         self._priority_seen = False   # sticky: any submit(priority != 0)
         self._prefill_fifo = []   # slot ids mid-prefill, admission order
         self._prefill_used = 0    # tokens prefilled this tick
@@ -676,7 +700,12 @@ class ContinuousBatchingServer:
                       # committed as the SOURCE / degraded to
                       # evacuate+replay / restored as the TARGET
                       "migrations": 0, "migration_fallbacks": 0,
-                      "migrated_in": 0}
+                      "migrated_in": 0,
+                      # disaggregated prefill handoff accounting:
+                      # partial page batches shipped as the source
+                      # (migrate_out(partial=True)) / staged batches
+                      # landed as the target (migrate_in_pages)
+                      "handoff_pages_out": 0, "handoff_pages_in": 0}
         # telemetry (paddle_tpu.telemetry.ServerTelemetry): True builds
         # a default-enabled one; None (default) keeps the hot path at
         # a single attribute check — no locks, no clock reads
@@ -3192,9 +3221,12 @@ class ContinuousBatchingServer:
             # first question a drain/crash review asks
             "migration": {
                 "in_flight": sorted(self._migrating),
+                "staging": sorted(self._staging),
                 "migrations": self.stats["migrations"],
                 "fallbacks": self.stats["migration_fallbacks"],
-                "migrated_in": self.stats["migrated_in"]},
+                "migrated_in": self.stats["migrated_in"],
+                "handoff_pages_out": self.stats["handoff_pages_out"],
+                "handoff_pages_in": self.stats["handoff_pages_in"]},
         }
         if self._kv is not None:
             # pool_balance() is the ONE definition of the balance the
@@ -3624,26 +3656,49 @@ class ContinuousBatchingServer:
         return harvested
 
     # ------------------------------------------- live KV-page migration
-    def migrate_out(self, rid):
-        """Gather a live mid-decode request's FULL resumable state so a
-        sibling replica can continue it without re-prefilling: the
-        written pool pages (per-shard gathers on a mesh — the
-        ``_spill_payload`` path the host tier proved), the resolved
-        sampling seed, the emitted-token log, and the stream offset.
-        Returns ``(state, payloads)`` — ``state`` is a JSON-able dict
-        (page payloads carry their sha256 so the target verifies END TO
-        END, not just per wire frame), ``payloads`` is one ``[k, v]``
+    def migrate_out(self, rid, partial=False, from_page=0):
+        """Gather a live request's FULL resumable state so a sibling
+        replica can continue it without re-prefilling: the written pool
+        pages (per-shard gathers on a mesh — the ``_spill_payload``
+        path the host tier proved), the resolved sampling seed, the
+        emitted-token log, and the stream offset. Returns
+        ``(state, payloads)`` — ``state`` is a JSON-able dict (page
+        payloads carry their sha256 so the target verifies END TO END,
+        not just per wire frame), ``payloads`` is one ``[k, v]``
         host-array pair per page.
 
-        The slot is PAUSED, not torn down: decode stops stepping it and
-        its pages stay pinned until the caller settles the handoff with
-        ``migrate_finish`` (target committed — release here, donate the
-        prompt prefix as usual) or ``migrate_abort`` (anything failed —
-        resume decoding here bit-exactly). Raises ``MigrationError``
-        when the request is not migratable (unknown rid, mid-prefill,
-        dense backend, already in flight); an injected
-        ``migrate.gather`` fault fires BEFORE the pause, so a faulted
-        attempt leaves the slot decoding untouched — never a leak."""
+        Mid-DECODE slots migrate as before. A slot still mid-PREFILL
+        migrates too (ISSUE 20): a migration of a slot whose
+        ``emitted`` is empty is exactly a disaggregated prefill
+        handoff — the state ships ``phase="prefill"`` and
+        ``filled`` (rows actually written), the target scatters the
+        finished prompt pages and prefills ONLY the remainder from
+        ``fill_pos``, and its own activation samples the first token
+        from the resolved seed — bit-exact, zero re-prefilled rows.
+
+        ``partial=True`` is the non-pausing PIPELINED half: ship the
+        complete, not-yet-shipped prompt pages of a mid-prefill slot
+        as one bounded batch and keep prefilling. Returns a fragment
+        dict (``base`` page index, ``fill_pos`` progress, ``phase``)
+        plus the batch; a slot already past activation returns its
+        phase with no payloads, which tells a handoff pump to settle
+        with a full ``migrate_out``. Partial ships never pause and
+        never leak — ``migrate_abort`` resets the shipped-page cursor
+        so a later full handoff re-ships everything.
+
+        ``from_page`` skips pages the target already holds (the pump's
+        closing call after partial batches landed).
+
+        The full path PAUSES the slot, not tears it down: stepping
+        (decode) or chunking (prefill) stops and its pages stay pinned
+        until the caller settles the handoff with ``migrate_finish``
+        (target committed — release here, donate the prompt prefix as
+        usual) or ``migrate_abort`` (anything failed — resume here
+        bit-exactly). Raises ``MigrationError`` when the request is
+        not migratable (unknown rid, dense backend, already in
+        flight); an injected ``migrate.gather`` fault fires BEFORE the
+        pause, so a faulted attempt leaves the slot untouched — never
+        a leak."""
         from .kv_tier import _sha256
         with self._lock:
             if self._kv is None:
@@ -3659,29 +3714,47 @@ class ContinuousBatchingServer:
                     f"finished, or foreign rids are not migratable — "
                     f"evacuate/replay covers them)")
             st = self._slots[slot]
-            if st.phase != "decode" or not st.emitted:
+            if st.phase not in ("decode", "prefill"):
                 raise MigrationError(
-                    f"request {rid} is mid-{st.phase} — only mid-decode "
-                    f"slots migrate (a drain lets prefills finish "
-                    f"first)")
+                    f"request {rid} is mid-{st.phase} — only decoding "
+                    f"or prefilling slots migrate")
+            if st.phase == "decode" and not st.emitted:
+                # unobservable in practice (activation samples the
+                # first token atomically with the final prefill chunk)
+                # but keep the invariant typed
+                raise MigrationError(
+                    f"request {rid} has no resumable decode state yet")
             if rid in self._migrating:
                 raise MigrationError(
                     f"request {rid} already has a migration in flight")
+            if partial:
+                # non-pausing: no gather fault either — a pump polls
+                # this dozens of times per handoff and chaos belongs
+                # on the wire (net.page_send), not on every poll
+                return self._migrate_partial_locked(slot, st)
             if self._faults is not None:
                 self._faults.check(faults.MIGRATE_GATHER, rid=rid)
             t0 = self._tele.migration_started() \
                 if self._tele is not None else None
-            # the LAST emitted token is the decode program's pending
-            # input — sampled but not yet written, so the target
-            # rewrites nothing and re-prefills nothing
-            written = st.prompt_len + len(st.emitted) - 1
+            if st.phase == "decode":
+                # the LAST emitted token is the decode program's
+                # pending input — sampled but not yet written, so the
+                # target rewrites nothing and re-prefills nothing
+                written = st.prompt_len + len(st.emitted) - 1
+            else:
+                # empty-`emitted` prefill handoff: everything below
+                # `filled` is final (chunk boundaries don't change the
+                # rows); the target resumes chunking at fill_pos
+                written = st.filled
             npages = self._npages_for(written)
-            pages = self._kv.slot_pages(slot)[:npages]
+            base = max(0, min(int(from_page), npages))
+            pages = self._kv.slot_pages(slot)[base:npages]
             payloads = [self._spill_payload(p) for p in pages]
             if self._costs is not None:
                 self._charge_transfer(
                     "page_migrate",
-                    2 * npages * self._kv.page_size * self._row_nbytes())
+                    2 * len(payloads) * self._kv.page_size
+                    * self._row_nbytes())
             remaining = None if st.deadline is None else \
                 max(0.0, st.deadline - self._clock.now())
             state = {
@@ -3699,25 +3772,82 @@ class ContinuousBatchingServer:
                 "deadline_s": remaining,
                 "page_size": int(self._kv.page_size),
                 "written": int(written),
+                "phase": st.phase,
+                "fill_pos": int(st.fill_pos),
+                "filled": int(st.filled),
+                "base": int(base),
                 "sha256": [_sha256(p) for p in payloads],
             }
-            # pause: the decode tick skips inactive rows, and (split
-            # mode) the device write cursor parks on the null page like
-            # a mid-prefill row — resume re-pushes tok/t/key exactly as
-            # _activate does, so nothing the device scribbles while
-            # paused is ever read
+            # pause: the decode tick skips inactive rows, the ragged
+            # prefill planner skips slots out of the fifo, and (split
+            # mode) the device write cursor parks on the null page —
+            # resume re-pushes tok/t/key exactly as _activate does (or
+            # re-queues the fifo for a prefill slot), so nothing the
+            # device scribbles while paused is ever read
+            prior = st.phase
             self._active[slot] = False
             st.phase = "migrating"
+            if prior == "prefill" and slot in self._prefill_fifo:
+                self._prefill_fifo.remove(slot)
             if not self._fused:
                 self._pending_t[slot] = self.max_cache_len
-            self._migrating[rid] = (slot, t0)
+            self._migrating[rid] = (slot, t0, prior)
             if self._rec is not None:
-                self._rec.record("migrate_out", rid=rid, pages=npages,
+                self._rec.record("migrate_out", rid=rid,
+                                 pages=npages - base, phase=prior,
                                  tokens=len(st.emitted))
             if st.journey is not None:
-                st.journey.event("migrating", at="source", pages=npages,
-                                 tokens=len(st.emitted))
+                if prior == "prefill":
+                    st.journey.event("handoff", at="source",
+                                     pages=npages - base,
+                                     filled=int(st.filled))
+                else:
+                    st.journey.event("migrating", at="source",
+                                     pages=npages,
+                                     tokens=len(st.emitted))
             return state, payloads
+
+    def _migrate_partial_locked(self, slot, st):
+        """One bounded, NON-pausing batch of a mid-prefill slot's
+        complete, not-yet-shipped pages (``migrate_out(partial=True)``
+        body). The fragment's ``base``/``fill_pos``/``phase`` tell the
+        handoff pump where the stream stands; the slot keeps
+        prefilling throughout, so a dead pump costs nothing here."""
+        from .kv_tier import _sha256
+        frag = {"rid": int(st.rid), "partial": True,
+                "phase": st.phase,
+                "page_size": int(self._kv.page_size),
+                "prompt_len": int(st.prompt_len),
+                "fill_pos": int(st.fill_pos),
+                "filled": int(st.filled),
+                "base": int(st.sent_pages),
+                "sha256": []}
+        if st.phase != "prefill":
+            # past activation: nothing streams mid-decode — the full
+            # migrate_out ships the balance (and the page beyond
+            # sent_pages that activation may have completed)
+            return frag, []
+        whole = st.filled // self._kv.page_size
+        base = st.sent_pages
+        if whole <= base:
+            return frag, []
+        pages = self._kv.slot_pages(slot)[base:whole]
+        payloads = [self._spill_payload(p) for p in pages]
+        if self._costs is not None:
+            self._charge_transfer(
+                "page_migrate",
+                2 * len(payloads) * self._kv.page_size
+                * self._row_nbytes())
+        st.sent_pages = whole
+        self.stats["handoff_pages_out"] += len(payloads)
+        frag["sha256"] = [_sha256(p) for p in payloads]
+        if self._rec is not None:
+            self._rec.record("handoff_partial", rid=st.rid, base=base,
+                             pages=len(payloads))
+        if st.journey is not None:
+            st.journey.event("handoff", at="source", base=base,
+                             pages=len(payloads))
+        return frag, payloads
 
     def migrate_finish(self, rid):
         """Commit a migration: the target restored ``rid`` (and owns its
@@ -3733,7 +3863,7 @@ class ContinuousBatchingServer:
             if ent is None:
                 raise MigrationError(
                     f"request {rid} has no migration in flight")
-            slot, t0 = ent
+            slot, t0 = ent[0], ent[1]
             st = self._slots[slot]
             if st is not None and st.rid == rid:
                 if st.journey is not None:
@@ -3752,13 +3882,18 @@ class ContinuousBatchingServer:
             self._done_cv.notify_all()
 
     def migrate_abort(self, rid):
-        """Abort a migration and RESUME the paused slot bit-exactly:
-        re-push the pending token, write position, and the PRNG key
-        recomputed from the resolved seed (``PRNGKey(seed)`` advanced
-        one split per emitted token — the identical chain the device
-        carried), exactly as ``_activate`` primes a fresh slot. The
-        caller degrades to evacuate+replay or simply lets the slot keep
-        decoding here; either way zero pages moved and zero leaked.
+        """Abort a migration and RESUME the paused slot bit-exactly.
+        A mid-decode pause re-pushes the pending token, write position,
+        and the PRNG key recomputed from the resolved seed
+        (``PRNGKey(seed)`` advanced one split per emitted token — the
+        identical chain the device carried), exactly as ``_activate``
+        primes a fresh slot. A mid-PREFILL pause (empty-``emitted``
+        handoff) simply re-queues the slot on the ragged fifo: the
+        planner resumes chunking at ``fill_pos`` and activation fires
+        here as if no handoff was ever attempted (the shipped-page
+        cursor resets so a later handoff re-ships everything). The
+        caller degrades to evacuate+replay or simply lets the slot
+        keep going here; either way zero pages moved and zero leaked.
         Counts ``{result="fallback"}`` and freezes a postmortem (its
         ``migration`` section carries the in-flight/outcome state).
         Returns False when nothing was in flight for ``rid``."""
@@ -3766,21 +3901,31 @@ class ContinuousBatchingServer:
             ent = self._migrating.pop(rid, None)
             if ent is None:
                 return False
-            slot, t0 = ent
+            slot, t0, prior = ent
             st = self._slots[slot]
             if st is None or st.rid != rid:
                 return False   # torn down behind the pause (hard stop)
-            st.phase = "decode"
-            if not self._fused:
-                key = jax.random.PRNGKey(st.seed)
-                if self.do_sample:
-                    for _ in range(len(st.emitted)):
-                        key, _ = jax.random.split(key)
-                self._pending_key[slot] = key
-                self._pending_tok[slot] = int(st.emitted[-1])
-                self._pending_t[slot] = \
-                    st.prompt_len + len(st.emitted) - 1
-            self._active[slot] = True
+            st.sent_pages = 0
+            if prior == "prefill":
+                st.phase = "prefill"
+                if slot not in self._prefill_fifo:
+                    self._prefill_fifo.append(slot)
+                if not self._fused:
+                    self._pending_t[slot] = self.max_cache_len
+                # _active stays False until activation, like any
+                # admitted mid-prefill slot
+            else:
+                st.phase = "decode"
+                if not self._fused:
+                    key = jax.random.PRNGKey(st.seed)
+                    if self.do_sample:
+                        for _ in range(len(st.emitted)):
+                            key, _ = jax.random.split(key)
+                    self._pending_key[slot] = key
+                    self._pending_tok[slot] = int(st.emitted[-1])
+                    self._pending_t[slot] = \
+                        st.prompt_len + len(st.emitted) - 1
+                self._active[slot] = True
             self.stats["migration_fallbacks"] += 1
             if self._rec is not None:
                 self._rec.record("migrate_fallback", rid=rid)
@@ -3791,26 +3936,169 @@ class ContinuousBatchingServer:
                 self._tele.on_migration("fallback", t0)
             return True
 
+    def _check_restore_state(self, state):
+        """Shared ``migrate_in``/``migrate_in_commit`` validation:
+        page-size and role gates, phase-aware written-row accounting.
+        Returns ``(phase, emitted, prompt_len, budget, written)``;
+        every refusal is a typed ``MigrationError`` raised BEFORE any
+        allocation."""
+        if int(state.get("page_size", self.page_size)) \
+                != self.page_size:
+            raise MigrationError(
+                f"page-size mismatch: source pages are "
+                f"{state.get('page_size')} tokens, this pool's are "
+                f"{self.page_size} — migration ships pages whole")
+        emitted = [int(t) for t in state.get("emitted") or ()]
+        prompt_len = int(state["prompt_len"])
+        budget = int(state["budget"])
+        phase = str(state.get("phase") or "decode")
+        if phase == "decode":
+            if self.role == "prefill":
+                raise MigrationError(
+                    "replica role 'prefill' refuses decode-phase "
+                    "admissions — hand mid-decode state to a decode "
+                    "or hybrid replica")
+            if not emitted or len(emitted) >= budget:
+                raise MigrationError(
+                    "only mid-decode state restores (source sends "
+                    "nothing for queued/finished requests)")
+            written = prompt_len + len(emitted) - 1
+        elif phase == "prefill":
+            # the empty-`emitted` handoff (ISSUE 20): a slot still
+            # prefilling ships its written prompt prefix; the
+            # remaining rows prefill HERE and activation samples the
+            # first token from this replica's own ragged launch —
+            # bit-exact, because chunk boundaries never change the
+            # written rows and the resolved seed travels with them
+            if emitted:
+                raise MigrationError(
+                    "a prefill-phase handoff cannot carry emitted "
+                    "tokens (activation would have flipped the slot "
+                    "to decode)")
+            written = int(state.get("filled") or 0)
+            if not 0 <= written <= prompt_len:
+                raise MigrationError(
+                    f"filled={written} rows outside the prompt "
+                    f"({prompt_len} tokens)")
+        else:
+            raise MigrationError(
+                f"phase {phase!r} state does not restore (sources "
+                f"send decoding or prefilling slots only)")
+        return phase, emitted, prompt_len, budget, written
+
+    def _scatter_pages_locked(self, own, base, payloads):
+        """Scatter received page payloads into this pool's pages
+        ``own[base : base + len(payloads)]`` — one batched
+        ``.at[:, idx].set`` per k/v leaf, laid out per shard on a mesh
+        (the ``_restore_match`` mirror of the source's per-shard
+        gather). Caller holds the lock and handles rollback."""
+        idx = jnp.asarray(np.asarray(
+            own[base:base + len(payloads)], np.int32))
+        pool = dict(self._caches["pool"])
+        for j, name in enumerate(("k", "v")):
+            leaf = pool[name]
+            # [L, n, pg, kvh, hd]: page payloads stacked on a new
+            # pages axis, matching leaf[:, idx]
+            val = np.stack([p[j] for p in payloads], axis=1)
+            val = val.astype(leaf.dtype)
+            if self._pool_shards > 1:
+                try:
+                    val = jax.device_put(val, leaf.sharding)
+                except Exception:
+                    pass
+            pool[name] = leaf.at[:, idx].set(jnp.asarray(val))
+        self._caches = dict(self._caches, pool=pool)
+
+    def _restore_slot_locked(self, slot, state, phase, emitted,
+                             prompt_len, budget, written,
+                             on_token, journey):
+        """Build and prime the restored ``_Slot`` (the shared tail of
+        ``migrate_in`` and ``migrate_in_commit``): a decode-phase
+        restore resumes the chain exactly where the source paused it;
+        a prefill-phase restore re-queues the ragged fifo at
+        ``fill_pos`` so the planner finishes the prompt and activation
+        fires HERE. Returns the request's NEW rid."""
+        rid = self._next_rid
+        self._next_rid += 1
+        dl = state.get("deadline_s")
+        st = _Slot(rid, np.asarray(state["ids"], np.int32),
+                   prompt_len, budget, on_token,
+                   None if dl is None
+                   else self._clock.now() + float(dl))
+        st.seed = int(state["seed"])
+        st.emitted = list(emitted)
+        st.streamed = int(state.get("streamed", 0))
+        st.replayed = tuple(int(t) for t in
+                            state.get("replayed", ()))
+        st.preempts = int(state.get("preempts", 0))
+        st.priority = int(state.get("priority", 0))
+        st.n_pre = int(state.get("n_pre", 0))
+        st.journey = journey
+        self._slots[slot] = st
+        if phase == "prefill":
+            # remaining prompt rows prefill here; the ragged planner
+            # picks the slot up next tick and _activate samples the
+            # first token from PRNGKey(seed) — the identical chain
+            st.phase = "prefill"
+            st.fill_pos = st.filled = written
+            self._prefill_fifo.append(slot)
+            if not self._fused:
+                # park the write cursor on the null page until
+                # activation, like any admitted mid-prefill slot
+                self._pending_t[slot] = self.max_cache_len
+        else:
+            # prime the decode chain exactly where the source paused
+            # it: pending input = last emitted token, write position =
+            # the first unwritten row, PRNG key = seed advanced one
+            # split per emitted token (greedy never consumes it)
+            key = jax.random.PRNGKey(st.seed)
+            if self.do_sample:
+                for _ in range(len(emitted)):
+                    key, _ = jax.random.split(key)
+            if self._fused:
+                self._host_keys[slot] = np.asarray(key, np.uint32)
+            else:
+                self._pending_key[slot] = key
+                self._pending_tok[slot] = int(emitted[-1])
+                self._pending_t[slot] = written
+            self._active[slot] = True
+        self.stats["migrated_in"] += 1
+        if journey is not None:
+            if phase == "prefill":
+                journey.event("handoff", at="target", slot=slot,
+                              filled=written)
+            else:
+                journey.event("migrating", at="target", slot=slot,
+                              tokens=len(emitted))
+        if self._tele is not None:
+            self._pool_gauges()
+        self._done_cv.notify_all()
+        return rid
+
     def migrate_in(self, state, payloads, on_token=None, journey=None):
-        """Restore a migrated request into THIS replica and resume its
-        decode mid-chain: fresh pool pages through the normal
-        ``admit_slot`` path, one batched scatter of the received page
-        payloads (laid out per shard on a mesh — the ``_restore_match``
-        mirror of the source's per-shard gather), and the slot primed
-        exactly as ``_activate`` would have left it at this point of
-        the chain — so the token stream continues bit-exactly, greedy
-        or seeded-sampled, with ZERO re-prefill dispatches (the scatter
-        is priced as ``page_migrate`` bytes, never counted as a
-        prefill). Returns the request's NEW rid here (``wait`` on it as
-        usual).
+        """Restore a migrated request into THIS replica and resume it
+        mid-chain: fresh pool pages through the normal ``admit_slot``
+        path, one batched scatter of the received page payloads, and
+        the slot primed exactly as ``_activate`` would have left it at
+        this point of the chain — so the token stream continues
+        bit-exactly, greedy or seeded-sampled, with ZERO re-prefill
+        dispatches for the shipped rows (the scatter is priced as
+        ``page_migrate`` bytes, never counted as a prefill).
+        Decode-phase state resumes decoding; prefill-phase state (the
+        ISSUE-20 empty-``emitted`` handoff) resumes CHUNKING at
+        ``fill_pos`` — only the unshipped remainder of the prompt ever
+        prefills here. Returns the request's NEW rid (``wait`` on it
+        as usual).
 
         Every refusal is typed and leak-free: an injected
         ``migrate.restore`` fault, a page failing its end-to-end sha256
-        check, or a geometry mismatch raises ``MigrationError`` BEFORE
-        any allocation; ``OutOfPages`` (no free slot / pool exhausted)
-        propagates from the admit; a scatter failure rolls the fresh
-        pages back. The source aborts and the caller replays — never a
-        request failure."""
+        check, a geometry/role mismatch, or a pipelined-stream state
+        (``base`` > 0 restores through ``migrate_in_begin``/
+        ``migrate_in_pages``/``migrate_in_commit``) raises
+        ``MigrationError`` BEFORE any allocation; ``OutOfPages`` (no
+        free slot / pool exhausted) propagates from the admit; a
+        scatter failure rolls the fresh pages back. The source aborts
+        and the caller replays — never a request failure."""
         from .kv_tier import _sha256
         with self._lock:
             if self._kv is None:
@@ -3824,20 +4112,14 @@ class ContinuousBatchingServer:
             if self._faults is not None:
                 self._faults.check(faults.MIGRATE_RESTORE,
                                    rid=state.get("rid"))
-            if int(state.get("page_size", self.page_size)) \
-                    != self.page_size:
+            if int(state.get("base") or 0):
                 raise MigrationError(
-                    f"page-size mismatch: source pages are "
-                    f"{state.get('page_size')} tokens, this pool's are "
-                    f"{self.page_size} — migration ships pages whole")
-            emitted = [int(t) for t in state["emitted"]]
-            prompt_len = int(state["prompt_len"])
-            budget = int(state["budget"])
-            if not emitted or len(emitted) >= budget:
-                raise MigrationError(
-                    "only mid-decode state restores (source sends "
-                    "nothing for queued/finished requests)")
-            written = prompt_len + len(emitted) - 1
+                    "state carries a page base — a pipelined partial "
+                    "stream restores through migrate_in_begin/"
+                    "migrate_in_pages/migrate_in_commit, not a "
+                    "one-shot migrate_in")
+            phase, emitted, prompt_len, budget, written = \
+                self._check_restore_state(state)
             if len(payloads) != self._npages_for(written):
                 raise MigrationError(
                     f"page-count mismatch: {len(payloads)} payloads for "
@@ -3855,30 +4137,21 @@ class ContinuousBatchingServer:
                     f"no free slot for a migrated request "
                     f"(all {self.max_slots} busy)")
             remaining = budget - len(emitted)
-            own = self._kv.admit_slot(
-                slot, max(written, self._extent_tokens(written,
-                                                       remaining)))
-            try:
-                idx = jnp.asarray(np.asarray(own[:len(payloads)],
-                                             np.int32))
-                pool = dict(self._caches["pool"])
-                for j, name in enumerate(("k", "v")):
-                    leaf = pool[name]
-                    # [L, n, pg, kvh, hd]: page payloads stacked on a
-                    # new pages axis, matching leaf[:, idx]
-                    val = np.stack([p[j] for p in payloads], axis=1)
-                    val = val.astype(leaf.dtype)
-                    if self._pool_shards > 1:
-                        try:
-                            val = jax.device_put(val, leaf.sharding)
-                        except Exception:
-                            pass
-                    pool[name] = leaf.at[:, idx].set(jnp.asarray(val))
-                self._caches = dict(self._caches, pool=pool)
-            except Exception:
-                self._kv.free_slot(slot)
-                raise
-            if self._costs is not None:
+            # a prefill restore sizes its extent off the FULL prompt
+            # (the unshipped remainder still needs rows), a decode
+            # restore off the written rows — both grow as usual under
+            # optimistic admission
+            extent = self._extent_tokens(
+                prompt_len if phase == "prefill" else written,
+                remaining)
+            own = self._kv.admit_slot(slot, max(written, extent))
+            if payloads:
+                try:
+                    self._scatter_pages_locked(own, 0, payloads)
+                except Exception:
+                    self._kv.free_slot(slot)
+                    raise
+            if self._costs is not None and payloads:
                 # priced like spill/restore — bytes both ways, zero
                 # FLOPs, and NOT a prefill dispatch: the acceptance
                 # counter (stats["prefill_dispatches"]) stays frozen
@@ -3886,50 +4159,225 @@ class ContinuousBatchingServer:
                     "page_migrate",
                     2 * len(payloads) * self.page_size
                     * self._row_nbytes())
-            rid = self._next_rid
-            self._next_rid += 1
-            dl = state.get("deadline_s")
-            st = _Slot(rid, np.asarray(state["ids"], np.int32),
-                       prompt_len, budget, on_token,
-                       None if dl is None
-                       else self._clock.now() + float(dl))
-            st.seed = int(state["seed"])
-            st.emitted = list(emitted)
-            st.streamed = int(state.get("streamed", 0))
-            st.replayed = tuple(int(t) for t in
-                                state.get("replayed", ()))
-            st.preempts = int(state.get("preempts", 0))
-            st.priority = int(state.get("priority", 0))
-            st.n_pre = int(state.get("n_pre", 0))
-            st.journey = journey
-            self._slots[slot] = st
-            # prime the decode chain exactly where the source paused
-            # it: pending input = last emitted token, write position =
-            # the first unwritten row, PRNG key = seed advanced one
-            # split per emitted token (greedy never consumes it)
-            key = jax.random.PRNGKey(st.seed)
-            if self.do_sample:
-                for _ in range(len(emitted)):
-                    key, _ = jax.random.split(key)
-            if self._fused:
-                self._host_keys[slot] = np.asarray(key, np.uint32)
-            else:
-                self._pending_key[slot] = key
-                self._pending_tok[slot] = int(emitted[-1])
-                self._pending_t[slot] = written
-            self._active[slot] = True
-            self.stats["migrated_in"] += 1
+            rid = self._restore_slot_locked(
+                slot, state, phase, emitted, prompt_len, budget,
+                written, on_token, journey)
             if self._rec is not None:
                 self._rec.record("migrate_in", rid=rid,
-                                 pages=len(payloads),
+                                 pages=len(payloads), phase=phase,
                                  tokens=len(emitted))
-            if journey is not None:
-                journey.event("migrating", at="target", slot=slot,
-                              tokens=len(emitted))
+            return rid
+
+    # --------------------- pipelined (staged) prefill-handoff restore
+    def migrate_in_begin(self, state):
+        """Open a PIPELINED restore (disaggregated prefill handoff,
+        ISSUE 20): allocate the slot and its full page extent NOW so
+        page batches scatter as the source's chunks complete
+        (``migrate_in_pages``) and the first decode tick launches the
+        moment the commit lands (``migrate_in_commit``) instead of
+        after a monolithic gather. ``state`` needs ``ids``/
+        ``prompt_len``/``budget``/``page_size``/``seed`` — the
+        commit's full state re-verifies everything that matters.
+        Returns an opaque transfer handle; ``migrate_in_abort``
+        releases every page if the handoff dies mid-stream, so zero
+        leaks either way. The placeholder slot counts toward
+        ``in_flight`` (it holds real pool pages) but never ticks: it
+        is not active, not on the prefill fifo, and has no deadline
+        until commit."""
+        with self._lock:
+            if self._kv is None:
+                raise MigrationError(
+                    "cache_backend='dense' has no page pool to restore "
+                    "migrated pages into")
+            if not self._accepting:
+                raise MigrationError(
+                    "replica is draining/stopped — not accepting "
+                    "migrated requests")
+            if self._faults is not None:
+                self._faults.check(faults.MIGRATE_RESTORE,
+                                   rid=state.get("rid"))
+            if int(state.get("page_size", self.page_size)) \
+                    != self.page_size:
+                raise MigrationError(
+                    f"page-size mismatch: source pages are "
+                    f"{state.get('page_size')} tokens, this pool's "
+                    f"are {self.page_size} — migration ships pages "
+                    f"whole")
+            if self.role == "prefill" and \
+                    str(state.get("phase") or "decode") == "decode":
+                raise MigrationError(
+                    "replica role 'prefill' refuses decode-phase "
+                    "admissions — hand mid-decode state to a decode "
+                    "or hybrid replica")
+            prompt_len = int(state["prompt_len"])
+            budget = int(state["budget"])
+            slot = next((s for s in range(self.max_slots)
+                         if self._slots[s] is None), None)
+            if slot is None:
+                raise OutOfPages(
+                    f"no free slot for a staged restore "
+                    f"(all {self.max_slots} busy)")
+            own = self._kv.admit_slot(
+                slot, self._extent_tokens(prompt_len, budget))
+            rid = self._next_rid
+            self._next_rid += 1
+            st = _Slot(rid, np.asarray(state["ids"], np.int32),
+                       prompt_len, budget)
+            st.phase = "staging"
+            st.fill_pos = st.filled = 0
+            st.seed = int(state.get("seed", 0))
+            self._slots[slot] = st
+            if not self._fused:
+                self._pending_t[slot] = self.max_cache_len
+            handle = self._next_xfer
+            self._next_xfer += 1
+            self._staging[handle] = {"slot": slot, "own": list(own),
+                                     "rid": rid, "got": set()}
+            if self._rec is not None:
+                self._rec.record("handoff_begin", rid=rid, slot=slot,
+                                 pages=len(own))
             if self._tele is not None:
                 self._pool_gauges()
-            self._done_cv.notify_all()
+            return handle
+
+    def migrate_in_pages(self, handle, base, payloads, sha256=None):
+        """Scatter one pipelined page batch at page index ``base`` of
+        the staged restore ``handle`` — the target half of
+        ``migrate_out(partial=True)``. Batches may arrive in any
+        order; the commit verifies full coverage. Raises
+        ``MigrationError`` (unknown handle, sha256 failure, pages
+        outside the staged extent) with the staging KEPT — the caller
+        decides between retrying and ``migrate_in_abort``."""
+        from .kv_tier import _sha256
+        with self._lock:
+            ent = self._staging.get(handle)
+            if ent is None:
+                raise MigrationError(
+                    f"no staged restore open for handle {handle!r}")
+            if sha256:
+                for i, want in enumerate(sha256):
+                    if _sha256(payloads[i]) != want:
+                        raise MigrationError(
+                            f"staged page {int(base) + i} failed its "
+                            f"end-to-end sha256 check")
+            own = ent["own"]
+            base = int(base)
+            if base < 0 or base + len(payloads) > len(own):
+                raise MigrationError(
+                    f"staged pages [{base}, {base + len(payloads)}) "
+                    f"fall outside the slot's {len(own)}-page extent")
+            if payloads:
+                self._scatter_pages_locked(own, base, payloads)
+                if self._costs is not None:
+                    self._charge_transfer(
+                        "page_migrate",
+                        2 * len(payloads) * self.page_size
+                        * self._row_nbytes())
+                ent["got"].update(range(base, base + len(payloads)))
+                self.stats["handoff_pages_in"] += len(payloads)
+            if self._rec is not None:
+                self._rec.record("handoff_pages", rid=ent["rid"],
+                                 base=base, pages=len(payloads))
+            return len(payloads)
+
+    def migrate_in_commit(self, handle, state, payloads=(),
+                          on_token=None, journey=None):
+        """Close a pipelined restore: scatter the closing batch (the
+        full ``migrate_out(..., from_page=...)`` balance, page base in
+        ``state["base"]``), verify every page of the written extent
+        arrived, and flip the placeholder into a live slot exactly as
+        ``migrate_in`` would — prefill-phase state re-queues the
+        ragged fifo at ``fill_pos``, decode-phase state resumes the
+        chain. Returns the request's NEW rid. Any refusal (coverage
+        gap, sha256, role/geometry mismatch, ids drift from the
+        ``migrate_in_begin`` state) raises typed with the staging
+        kept, so the caller can still ``migrate_in_abort`` — zero
+        leaks."""
+        from .kv_tier import _sha256
+        with self._lock:
+            ent = self._staging.get(handle)
+            if ent is None:
+                raise MigrationError(
+                    f"no staged restore open for handle {handle!r}")
+            phase, emitted, prompt_len, budget, written = \
+                self._check_restore_state(state)
+            slot, own = ent["slot"], ent["own"]
+            ph = self._slots[slot]
+            if ph is None or ph.rid != ent["rid"]:
+                raise MigrationError(
+                    "staged slot was torn down behind the transfer "
+                    "(hard stop) — nothing to commit")
+            if prompt_len != ph.prompt_len or budget != ph.budget \
+                    or not np.array_equal(
+                        np.asarray(state["ids"], np.int32), ph.ids):
+                raise MigrationError(
+                    "commit state does not match the migrate_in_begin "
+                    "request (ids/prompt_len/budget drift)")
+            need = self._npages_for(written)
+            base = int(state.get("base") or 0)
+            if need > len(own):
+                raise MigrationError(
+                    f"{need} written pages exceed the staged "
+                    f"{len(own)}-page extent")
+            if base + len(payloads) != need:
+                raise MigrationError(
+                    f"closing batch [{base}, {base + len(payloads)}) "
+                    f"does not reach the written extent ({need} "
+                    f"pages)")
+            missing = sorted(set(range(base)) - ent["got"])
+            if missing:
+                raise MigrationError(
+                    f"staged restore incomplete: pages {missing} "
+                    f"never arrived before the commit")
+            for i, want in enumerate(state.get("sha256") or ()):
+                if _sha256(payloads[i]) != want:
+                    raise MigrationError(
+                        f"closing page {base + i} failed its "
+                        f"end-to-end sha256 check")
+            if payloads:
+                self._scatter_pages_locked(own, base, list(payloads))
+                if self._costs is not None:
+                    self._charge_transfer(
+                        "page_migrate",
+                        2 * len(payloads) * self.page_size
+                        * self._row_nbytes())
+            # flip the placeholder into the live slot: _restore_slot
+            # mints the rid the waiter sees (the placeholder rid was
+            # never returned to anyone)
+            self._slots[slot] = None
+            self._staging.pop(handle)
+            rid = self._restore_slot_locked(
+                slot, state, phase, emitted, prompt_len, budget,
+                written, on_token, journey)
+            if self._rec is not None:
+                self._rec.record("handoff_commit", rid=rid,
+                                 pages=need, phase=phase)
             return rid
+
+    def migrate_in_abort(self, handle):
+        """Tear down a staged restore that will never commit (source
+        died, pump failed, router fell back): release every staged
+        page straight back to the allocator — no donation, the rows
+        may be half-written — and drop the placeholder. Returns False
+        when nothing was staged for ``handle`` (idempotent, like
+        ``migrate_abort``)."""
+        with self._lock:
+            ent = self._staging.pop(handle, None)
+            if ent is None:
+                return False
+            slot = ent["slot"]
+            st = self._slots[slot]
+            if st is not None and st.rid == ent["rid"]:
+                self._slots[slot] = None
+                self._active[slot] = False
+                pages = self._kv.detach_slot(slot)
+                if pages:
+                    self._kv.release(pages)
+            if self._rec is not None:
+                self._rec.record("handoff_abort", rid=ent["rid"])
+            if self._tele is not None:
+                self._pool_gauges()
+            return True
 
     def kill(self, timeout=60.0):
         """Simulate a replica crash (failover drills, chaos suites):

@@ -281,6 +281,10 @@ class ReplicaHost:
                 "in_flight": int(srv.in_flight()),
                 "preempt_pressure": int(srv.preempt_pressure()),
                 "health": srv.health,
+                # disaggregated placement (ISSUE 20): the role rides
+                # every digest so the router's placement scan needs no
+                # extra RPC; pre-role servers read as "hybrid"
+                "role": str(getattr(srv, "role", "hybrid")),
                 "sketch": [int(fp) for fp in srv.prefix_sketch()],
                 "stats": jsonable(dict(srv.stats)),
                 # goodput ratio + MFU (ISSUE 13): routing-side views
@@ -448,16 +452,23 @@ class ReplicaHost:
 
     # --------------------------------------------- live KV-page migration
     def _op_migrate_out(self, conn, msg):
-        """Pause one mid-decode request and stream its KV pages BACK to
-        the calling connection as binary page frames (one frame per
-        page, K and V stacked, sha256-checked by the transport), then
-        reply with the serialized migration state. The slot stays
-        paused until the caller settles with migrate_finish /
-        migrate_abort; a failure streaming the pages aborts HERE (the
-        caller may never be able to ask) and fails the call typed."""
+        """Pause one live request (mid-decode, or mid-prefill for the
+        ISSUE-20 handoff) and stream its KV pages BACK to the calling
+        connection as binary page frames (one frame per page, K and V
+        stacked, sha256-checked by the transport), then reply with the
+        serialized migration state. The slot stays paused until the
+        caller settles with migrate_finish / migrate_abort; a failure
+        streaming the pages aborts HERE (the caller may never be able
+        to ask) and fails the call typed. ``partial=True`` is the
+        NON-pausing pipelined pull: one bounded batch of complete
+        mid-prefill pages streams back and the slot keeps chunking —
+        nothing to abort on failure."""
         rid = int(msg["rid"])
         xid = msg.get("xid")
-        state, payloads = self.server.migrate_out(rid)
+        partial = bool(msg.get("partial"))
+        state, payloads = self.server.migrate_out(
+            rid, partial=partial,
+            from_page=int(msg.get("from_page") or 0))
         try:
             for i, p in enumerate(payloads):
                 a = np.ascontiguousarray(np.stack(p))   # [2, L, pg, ...]
@@ -466,7 +477,8 @@ class ReplicaHost:
                      "n": len(payloads), "shape": list(a.shape),
                      "dtype": str(a.dtype)}, a.tobytes())
         except Exception as e:
-            self.server.migrate_abort(rid)
+            if not partial:
+                self.server.migrate_abort(rid)
             raise MigrationError(
                 f"page stream to the caller failed at frame "
                 f"{i}/{len(payloads)}: {e!r}") from e
@@ -519,6 +531,72 @@ class ReplicaHost:
             self._streamed[int(rid)] = int(state.get("streamed") or 0)
             self._streamed.move_to_end(int(rid))
         return {"rid": int(rid)}
+
+    def _op_migrate_in_begin(self, msg):
+        """Open a pipelined (staged) restore on this host's server —
+        the target half of a disaggregated prefill handoff. Replies
+        with the transfer handle the page batches and the commit key
+        off."""
+        return {"handle": int(self.server.migrate_in_begin(
+            dict(msg["state"])))}
+
+    def _op_migrate_in_pages(self, msg):
+        """Land one pipelined page batch: reassemble whatever frames of
+        the batch survived the wire (parked by ``_op_migrate_page``
+        under the transfer id) and scatter each surviving page at its
+        absolute index — holes are REPORTED, not fatal, so the pump
+        re-ships exactly what the storm ate and the commit's coverage
+        check stays the single source of truth."""
+        xid = msg.get("xid")
+        with self._dlock:
+            got = self._mig_in.pop(xid, None) or {}
+        sha = list(msg.get("sha256") or ())
+        base = int(msg.get("base") or 0)
+        handle = int(msg["handle"])
+        landed, lost = [], []
+        for i in range(len(sha)):
+            p = got.get(i)
+            if p is None:
+                lost.append(base + i)
+                continue
+            self.server.migrate_in_pages(handle, base + i, [p],
+                                         [sha[i]])
+            landed.append(base + i)
+        return {"landed": landed, "lost": lost}
+
+    def _op_migrate_in_commit(self, msg):
+        """Close a pipelined restore: reassemble the parked closing
+        frames (ALL of them must have arrived — the closing batch is
+        the commit point, holes degrade the attempt typed with the
+        staging kept), commit through the server, and continue the
+        token stream at the source's offset exactly like
+        ``_op_migrate_in``."""
+        xid = msg.get("xid")
+        state = dict(msg["state"])
+        with self._dlock:
+            got = self._mig_in.pop(xid, None) or {}
+        n = len(state.get("sha256") or ())
+        payloads = [got.get(i) for i in range(n)]
+        if any(p is None for p in payloads):
+            raise MigrationError(
+                f"closing page frames lost on the wire: "
+                f"{sum(p is not None for p in payloads)}/{n} arrived "
+                f"for transfer {xid!r}")
+        journey = None
+        tid = msg.get("tid")
+        if tid is not None:
+            journey = _WireJourney(self, tid,
+                                   msg.get("where") or "replica")
+        rid = self.server.migrate_in_commit(
+            int(msg["handle"]), state, payloads,
+            on_token=self._forwarder, journey=journey)
+        with self._dlock:
+            self._streamed[int(rid)] = int(state.get("streamed") or 0)
+            self._streamed.move_to_end(int(rid))
+        return {"rid": int(rid)}
+
+    def _op_migrate_in_abort(self, msg):
+        return bool(self.server.migrate_in_abort(int(msg["handle"])))
 
     def _op_migrate_finish(self, msg):
         rid = int(msg["rid"])
@@ -1188,17 +1266,26 @@ class RemoteReplica:
             self._next_id += 1
         return xid
 
-    def migrate_out(self, rid, retry=None):
+    def migrate_out(self, rid, retry=None, partial=False, from_page=0):
         """Pause ``rid`` on the host and pull its full resumable state
         over the wire: the serialized migration dict plus one host
         array per KV page (binary page frames, sha256-checked per
         frame by the transport and end-to-end again by the target's
         ``migrate_in``). Transient failures — a severed call, page
         frames the storm ate — RESUME the slot and retry with backoff;
-        a typed host refusal (``MigrationError``: not mid-decode,
-        dense backend) propagates immediately so the caller degrades
-        to evacuate+replay. The client mirror stays registered until
-        ``migrate_finish`` commits the handoff."""
+        a typed host refusal (``MigrationError``: unknown rid, dense
+        backend) propagates immediately so the caller degrades to
+        evacuate+replay. The client mirror stays registered until
+        ``migrate_finish`` commits the handoff.
+
+        ``partial=True`` pulls one NON-pausing pipelined batch of a
+        mid-prefill slot's complete pages (single attempt, no resume
+        needed — nothing pauses); frames the wire ate come back as
+        ``None`` holes in the payload list, so the pump re-ships
+        exactly those. ``from_page`` skips pages the target already
+        holds on the closing full pull."""
+        if partial:
+            return self._migrate_out_partial(rid)
         policy = retry if retry is not None else self.migrate_retry
         last = None
         for attempt in range(self.migrate_attempts):
@@ -1210,7 +1297,8 @@ class RemoteReplica:
             try:
                 try:
                     state = self._call("migrate_out", rid=int(rid),
-                                       xid=xid)
+                                       xid=xid,
+                                       from_page=int(from_page))
                 except MigrationError:
                     raise             # host refusal: not transient
                 except (TransportError, TimeoutError) as e:
@@ -1221,12 +1309,34 @@ class RemoteReplica:
                     got = self._mig_pages.get(xid) or {}
                 n = len(state.get("sha256") or ())
                 payloads = [got.get(i) for i in range(n)]
-                if n == 0 or any(p is None for p in payloads):
+                # zero payloads are legitimate for a prefill handoff
+                # (nothing written yet) or a closing pull whose pages
+                # all streamed ahead (from_page == written extent)
+                empty_ok = int(state.get("base") or 0) > 0 \
+                    or str(state.get("phase") or "decode") == "prefill"
+                if (n == 0 and not empty_ok) \
+                        or any(p is None for p in payloads):
                     last = MigrationError(
                         f"{self.name}: request {rid}: page frames lost "
                         f"on the wire ({len(got)}/{n} arrived)")
                     self.migrate_abort(rid)   # slot is paused: resume
                     continue
+                # the server fires token callbacks AFTER releasing its
+                # tick lock, so a cut landing in that window returns
+                # `streamed` ahead of what this wire has seen — the
+                # pushes are in flight on a live conn and the slot is
+                # paused (`streamed` is final), so wait for the mirror
+                # to catch up before snapshotting; a timeout means the
+                # push was genuinely lost (dying host) and client truth
+                # stands — the target re-streams the gap
+                srv_streamed = int(state.get("streamed") or 0)
+                catchup = time.monotonic() + 2.0
+                while time.monotonic() < catchup:
+                    with self._state_lock:
+                        m = self._mirror.get(rid)
+                        if m is None or len(m.tokens) >= srv_streamed:
+                            break
+                    time.sleep(0.002)
                 with self._state_lock:
                     m = self._mirror.get(rid)
                     if m is not None:
@@ -1295,6 +1405,117 @@ class RemoteReplica:
             raise
         return reply["rid"]
 
+    def _migrate_out_partial(self, rid):
+        """One pipelined batch pull (``migrate_out(partial=True)``):
+        single attempt — the slot never pauses, so there is nothing to
+        resume and the next poll simply re-reads progress. Lost frames
+        come back as ``None`` holes; the pump re-ships them through
+        the closing ``from_page`` pull."""
+        xid = self._mint_xid()
+        with self._state_lock:
+            self._mig_pages[xid] = {}
+        try:
+            frag = self._call("migrate_out", rid=int(rid), xid=xid,
+                              partial=True)
+            with self._state_lock:
+                got = self._mig_pages.get(xid) or {}
+            n = len(frag.get("sha256") or ())
+            return frag, [got.get(i) for i in range(n)]
+        finally:
+            with self._state_lock:
+                self._mig_pages.pop(xid, None)
+
+    def migrate_in_begin(self, state):
+        """Open a pipelined restore on the host (disaggregated prefill
+        handoff target): returns the transfer handle the page batches
+        and the commit key off. Any failure propagates — the caller
+        falls back to a one-shot ``migrate_in`` or local decode."""
+        return int(self._call("migrate_in_begin",
+                              state=jsonable(state))["handle"])
+
+    def migrate_in_pages(self, handle, base, payloads, sha256=None):
+        """Ship one pipelined page batch as binary frames and scatter
+        it at page index ``base`` of the staged restore. Returns the
+        list of ABSOLUTE page indices that actually landed (the wire
+        may eat frames mid-storm; the pump re-ships the difference) —
+        the in-process server returns a bare count instead, so pumps
+        normalize on both."""
+        conn = self._ensure_conn()
+        xid = self._mint_xid()
+        sha = list(sha256 or ())
+        for i, p in enumerate(payloads):
+            a = np.ascontiguousarray(np.stack(p) if isinstance(p, list)
+                                     else p)
+            conn.send_pages({"id": 0, "op": "migrate_page", "xid": xid,
+                             "i": i, "n": len(payloads),
+                             "shape": list(a.shape),
+                             "dtype": str(a.dtype)}, a.tobytes())
+        r = self._call("migrate_in_pages", handle=int(handle),
+                       xid=xid, base=int(base), sha256=sha)
+        return [int(i) for i in r.get("landed") or ()]
+
+    def migrate_in_commit(self, handle, state, payloads=(),
+                          on_token=None, journey=None):
+        """Close a pipelined restore: stream the closing batch, commit
+        with the full state (the reply is the COMMIT POINT — the new
+        remote rid), and register the client mirror exactly like
+        ``migrate_in`` so dead-host synthesis and gap repair keep
+        working across the handoff."""
+        conn = self._ensure_conn()
+        xid = self._mint_xid()
+        for i, p in enumerate(payloads):
+            a = np.ascontiguousarray(np.stack(p) if isinstance(p, list)
+                                     else p)
+            conn.send_pages({"id": 0, "op": "migrate_page", "xid": xid,
+                             "i": i, "n": len(payloads),
+                             "shape": list(a.shape),
+                             "dtype": str(a.dtype)}, a.tobytes())
+        tid = getattr(journey, "tid", None)
+        where = getattr(journey, "where", None)
+        if tid is not None:
+            self._journeys[tid] = journey
+        streamed = int(state.get("streamed") or 0)
+        pre = state.get("delivered")
+        if pre is None:
+            pre = (state.get("emitted") or [])[:streamed]
+        pre = [int(t) for t in pre]
+        deadline = None if state.get("deadline_s") is None \
+            else self._clock.now() + float(state["deadline_s"])
+
+        def record(reply):
+            with self._state_lock:
+                m = _Mirror(reply["rid"],
+                            np.asarray(state["ids"], np.int32),
+                            int(state["budget"]), int(state["seed"]),
+                            on_token, deadline,
+                            int(state.get("priority") or 0),
+                            journey, tid)
+                m.tokens = list(pre)
+                self._mirror[reply["rid"]] = m
+                parked = self._early_tokens.pop(reply["rid"], ())
+            for pm in parked:         # pushes that raced this reply
+                self._on_tokens(pm)
+
+        try:
+            reply = self._call("migrate_in_commit", handle=int(handle),
+                               xid=xid, state=jsonable(state), tid=tid,
+                               where=where, on_reply=record)
+        except BaseException:
+            if tid is not None:
+                self._journeys.pop(tid, None)
+            raise
+        return reply["rid"]
+
+    def migrate_in_abort(self, handle):
+        """Tear down a staged restore that will never commit
+        (best-effort, idempotent — an unreachable host's staging dies
+        with the process)."""
+        try:
+            return bool(self._call("migrate_in_abort",
+                                   handle=int(handle)))
+        except (TransportError, TimeoutError):
+            return False
+
     def migrate_finish(self, rid):
         """Settle a committed handoff on the source: drop the local
         mirror FIRST — a post-commit host crash must not let dead-wire
@@ -1339,6 +1560,16 @@ class RemoteReplica:
         if age >= self.draining_after_s:
             return DRAINING
         return self._digest.get("health", DEAD)
+
+    @property
+    def role(self):
+        """Placement role from the last heartbeat digest. Pre-ISSUE-20
+        hosts never send the key and read as ``"hybrid"`` — a
+        mixed-version fleet routes safely instead of KeyError'ing in
+        the placement scan."""
+        role = (self._digest or {}).get("role")
+        return role if role in ("prefill", "decode", "hybrid") \
+            else "hybrid"
 
     def _mirror_counts(self):
         # LOCK-FREE routing read (the router calls this per submit for

@@ -63,6 +63,7 @@ load/affinity reads served from pushed digests instead of in-process
 peeks.
 """
 import threading
+import time
 
 import numpy as np
 
@@ -73,6 +74,7 @@ from ..reliability import (CircuitBreaker, DEAD, DEGRADED, DeadlineExceeded,
                            RequestCancelled, RetryPolicy, ServerClosed,
                            faults, is_serving_state)
 from ..telemetry.clock import MonotonicClock
+from . import placement as _placement
 from .prefix_cache import prefix_fingerprints
 
 __all__ = ["ReplicaRouter", "RouterSupervisor"]
@@ -243,7 +245,9 @@ class ReplicaRouter:
                  telemetry=None, journeys=None, recorder=None,
                  slos=None, clock=None, fault_injector=None,
                  breakers=None, retry_policy=None, wait_slice=0.05,
-                 pressure_weight=2.0):
+                 pressure_weight=2.0, placement=None,
+                 disagg_prefill_min_tokens=256,
+                 disagg_handoff_at="first_token"):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         if policy not in ("affinity", "least_loaded", "round_robin"):
@@ -252,6 +256,20 @@ class ReplicaRouter:
         if pressure_weight < 0:
             raise ValueError(f"pressure_weight must be >= 0, got "
                              f"{pressure_weight}")
+        # disaggregated prefill/decode placement (ISSUE 20): None (the
+        # default) keeps the legacy load/affinity routing byte-for-byte;
+        # "disaggregated" routes fresh prompts by PHASE — long prompts
+        # to prefill specialists (then a pipelined page handoff to a
+        # decode replica), short prompts decode-local
+        self.placement = _placement.normalize_placement(placement)
+        if disagg_handoff_at not in ("first_token", "eager"):
+            raise ValueError(
+                f"disagg_handoff_at must be 'first_token' (source "
+                f"samples token 0, zero re-prefill on the target) or "
+                f"'eager' (hand off mid-prefill, target finishes the "
+                f"remainder), got {disagg_handoff_at!r}")
+        self.disagg_prefill_min_tokens = int(disagg_prefill_min_tokens)
+        self.disagg_handoff_at = disagg_handoff_at
         self.replicas = list(replicas)
         self.policy = policy
         self.pressure_weight = float(pressure_weight)
@@ -341,7 +359,13 @@ class ReplicaRouter:
                        # live KV-page migrations: mid-decode requests
                        # handed to a sibling WITH their pages / attempts
                        # degraded to the evacuate+replay path
-                       "migrations": 0, "migration_fallbacks": 0}
+                       "migrations": 0, "migration_fallbacks": 0,
+                       # disaggregated prefill handoffs: prompts a
+                       # prefill specialist shipped to a decode replica
+                       # / pump attempts degraded to local decode on
+                       # the specialist (never a request failure)
+                       "handoffs": 0, "handoff_fallbacks": 0}
+        self._pumping = set()          # rids with a live handoff pump
         self.supervisor = RouterSupervisor(self, retry=retry_policy)
         self._stop_evt = threading.Event()
         self._thread = None
@@ -490,9 +514,13 @@ class ReplicaRouter:
         return self.replicas[idx].cancel(rrid)
 
     # ----------------------------------------------------------- routing
-    def _candidates(self, ids, exclude=()):
+    def _candidates(self, ids, exclude=(), phase=None):
         """(ordered replica indices to try, {idx: affinity tokens}).
-        Serving replicas only (health + closed breaker), best first."""
+        Serving replicas only (health + closed breaker), best first.
+        Under ``placement="disaggregated"`` a ``phase`` rewrites the
+        order: prefill work prefers prefill specialists (any serving
+        replica as the degradation tail), decode work avoids them
+        while anything else serves."""
         if self._tele is not None:
             # gauge from the UNFILTERED health scan (matches .health):
             # a requeue's source exclusion must not read as a capacity
@@ -511,7 +539,11 @@ class ReplicaRouter:
             with self._lock:
                 k = self._rr % len(serving)
                 self._rr += 1
-            return serving[k:] + serving[:k], aff
+            order = serving[k:] + serving[:k]
+            if self.placement is not None and phase is not None:
+                order = _placement.order_for_phase(
+                    order, self.replicas, phase)
+            return order, aff
         # preemption pressure joins the load score, weighted ABOVE
         # plain queue depth (``pressure_weight``, default 2.0): a
         # replica thrashing its KV pool (parked preempted requests it
@@ -544,6 +576,9 @@ class ReplicaRouter:
                            key=lambda i: (-aff[i], load[i], i))
         else:                         # least_loaded
             order = sorted(serving, key=lambda i: (load[i], i))
+        if self.placement is not None and phase is not None:
+            order = _placement.order_for_phase(order, self.replicas,
+                                               phase)
         return order, aff
 
     def _dispatch(self, idx, item):
@@ -578,8 +613,13 @@ class ReplicaRouter:
         route. Raises typed when nobody takes it: ``QueueFullError``
         if every serving replica shed, ``DeadlineExceeded`` if the
         deadline ran out first, else ``ReplicaLostError``."""
+        phase = None
+        if self.placement is not None:
+            phase = _placement.request_phase(
+                item.ids, self.disagg_prefill_min_tokens)
         for _rescan in range(4):      # orphan claims force a fresh
-            order, aff = self._candidates(item.ids, exclude)   # scan
+            order, aff = self._candidates(item.ids, exclude,    # scan
+                                          phase=phase)
             last_err = None
             rescan = False
             for idx in order:
@@ -640,6 +680,13 @@ class ReplicaRouter:
                     break
                 if self._tele is not None:
                     self._tele.on_routed(idx, hit)
+                if (phase == "prefill" and not item.cancelled
+                        and _placement.replica_role(
+                            self.replicas[idx]) == "prefill"):
+                    # a long prompt landed on a prefill specialist:
+                    # start the pipelined handoff pump that streams
+                    # its pages to a decode sibling as chunks complete
+                    self._spawn_handoff(item.rid, idx)
                 return idx
             if rescan:
                 continue              # re-scan (bounded: each retry
@@ -694,7 +741,8 @@ class ReplicaRouter:
                 #             resumed); the drain path takes over
             new_rrid = None
             tdx = None
-            order, _ = self._candidates(item.ids, exclude=(idx,))
+            order, _ = self._candidates(item.ids, exclude=(idx,),
+                                        phase="decode")
             for cand in order:
                 target = self.replicas[cand]
                 if not hasattr(target, "migrate_in"):
@@ -740,6 +788,246 @@ class ReplicaRouter:
             rep.migrate_finish(rrid)
             moved += 1
         return moved
+
+    # ------------------------------------------------ prefill->decode handoff
+    def _spawn_handoff(self, rid, idx):
+        """Start the pipelined handoff pump for router request ``rid``
+        placed on prefill specialist ``idx`` (at most one pump per
+        rid)."""
+        with self._lock:
+            if rid in self._pumping:
+                return
+            self._pumping.add(rid)
+        threading.Thread(target=self._run_handoff, args=(rid, idx),
+                         daemon=True, name=f"handoff-r{rid}").start()
+
+    def _open_staging(self, item, frag, src_idx):
+        """Pick a decode-handoff target (prefix affinity, then pool
+        headroom — ``placement.order_handoff_targets``) and open a
+        staged restore on it. Returns ``(tdx, target, handle)`` or
+        ``None`` when no sibling can stage right now (the pump falls
+        back to the one-shot path, or the request just stays put)."""
+        begin_state = {
+            "rid": int(item.rid), "ids": np.asarray(item.ids),
+            "prompt_len": int(np.asarray(item.ids).shape[0]),
+            "budget": int(item.budget), "seed": item.seed,
+            "page_size": int(frag["page_size"]), "phase": "prefill",
+        }
+        order, aff = self._candidates(item.ids, exclude=(src_idx,),
+                                      phase="decode")
+        order = _placement.order_handoff_targets(order, self.replicas,
+                                                 aff)
+        for cand in order:
+            target = self.replicas[cand]
+            if not hasattr(target, "migrate_in_begin"):
+                continue
+            try:
+                handle = target.migrate_in_begin(begin_state)
+            except Exception:
+                continue    # OutOfPages / role refusal / wire down:
+            return cand, target, handle   # the next candidate may stage
+        return None
+
+    def _run_handoff(self, rid, src_idx):
+        """One pipelined prefill->decode handoff (the tentpole's
+        pipelining): poll ``migrate_out(partial=True)`` on the prefill
+        specialist and stream each completed chunk's pages to a staged
+        decode target while later chunks are still prefilling; when the
+        source reaches the cut point (first token sampled for
+        ``disagg_handoff_at="first_token"``, first shipped batch for
+        ``"eager"``) pull the closing state + unshipped tail pages with
+        ``migrate_out(from_page=k)`` and commit. Best-effort
+        throughout: any failure aborts the target staging and leaves
+        the request running on the specialist (it still decodes
+        locally — degraded, never lost), counted as a
+        ``handoff_fallback``."""
+        rep = self.replicas[src_idx]
+        t0 = self._tele.handoff_started() if self._tele is not None \
+            else None
+        tdx = target = handle = None
+        delivered = set()   # absolute page indices confirmed on target
+        attempted = False   # staged or paused: a failure is a FALLBACK
+        try:
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                with self._lock:
+                    route = self._routes.get(rid)
+                if route is None or route.idx != src_idx \
+                        or route.item.cancelled:
+                    return          # finished / evacuated / cancelled:
+                item = route.item   # nothing to hand off (not a
+                rrid = route.rrid   # fallback — the request is fine)
+                try:
+                    frag, payloads = rep.migrate_out(rrid, partial=True)
+                except MigrationError:
+                    time.sleep(0.002)   # queued, not admitted yet, or
+                    continue            # mid-activation: poll again
+                except Exception:
+                    break               # wire down: fall back
+                if str(frag.get("phase")) != "prefill":
+                    break   # first token sampled at the source — cut
+                if payloads:
+                    if handle is None:
+                        staged = self._open_staging(item, frag, src_idx)
+                        if staged is None:
+                            break   # nobody can stage: one-shot below
+                        tdx, target, handle = staged
+                        attempted = True
+                    if not self._pump_frames(target, handle, frag,
+                                             payloads, delivered):
+                        # target rejected frames (sha, staging died):
+                        # drop it and retry one-shot on the tail pull
+                        try:
+                            target.migrate_in_abort(handle)
+                        except Exception:
+                            pass
+                        tdx = target = handle = None
+                        delivered.clear()
+                        break
+                    if self.disagg_handoff_at == "eager":
+                        break   # hand off mid-prefill: the target
+                        #         finishes the remaining chunks
+                time.sleep(0.002)
+            else:
+                if attempted:   # timed out mid-pump: pages staged but
+                    self._handoff_fallback(rid, src_idx, t0)   # no cut
+                return
+            # closing pull: k = pages the target PROVABLY holds as a
+            # contiguous prefix; everything >= k rides the tail frames
+            k = 0
+            while k in delivered:
+                k += 1
+            for _attempt in range(3):
+                with self._lock:
+                    route = self._routes.get(rid)
+                if route is None or route.idx != src_idx \
+                        or route.item.cancelled:
+                    return
+                item, rrid = route.item, route.rrid
+                try:
+                    state, tail = rep.migrate_out(rrid, from_page=k)
+                except MigrationError:
+                    return      # finished / replaced at the source
+                except Exception:
+                    break
+                attempted = True
+                if any(p is None for p in tail):
+                    rep.migrate_abort(rrid)   # chaos ate tail frames:
+                    continue                  # resume, re-pull
+                journey = None
+                new_rrid = None
+                try:
+                    if handle is not None:
+                        journey = None if item.journey is None else \
+                            item.journey.at(f"replica{tdx}")
+                        new_rrid = target.migrate_in_commit(
+                            handle, state, tail,
+                            on_token=item.on_token, journey=journey)
+                    else:
+                        # nothing was pipelined (short prefill beat the
+                        # pump, or no stage-capable sibling): one-shot
+                        # handoff through the classic migrate_in
+                        staged = self._candidates(
+                            item.ids, exclude=(src_idx,),
+                            phase="decode")
+                        order = _placement.order_handoff_targets(
+                            staged[0], self.replicas, staged[1])
+                        for cand in order:
+                            tgt = self.replicas[cand]
+                            if not hasattr(tgt, "migrate_in"):
+                                continue
+                            journey = None if item.journey is None \
+                                else item.journey.at(f"replica{cand}")
+                            try:
+                                new_rrid = tgt.migrate_in(
+                                    state, tail,
+                                    on_token=item.on_token,
+                                    journey=journey)
+                            except Exception:
+                                continue
+                            tdx, target = cand, tgt
+                            break
+                        if new_rrid is None:
+                            rep.migrate_abort(rrid)
+                            break
+                except MigrationError:
+                    rep.migrate_abort(rrid)   # staging drift / missing
+                    continue                  # pages: resume, re-pull
+                except Exception:
+                    rep.migrate_abort(rrid)
+                    break
+                if new_rrid is None:
+                    continue
+                handle = None   # committed: nothing left to abort
+                # COMMIT - mirrors _migrate_live: re-home the route
+                # FIRST so a waiter never races a released source slot
+                with self._lock:
+                    self._by_replica[src_idx].pop(rrid, None)
+                    cur = self._routes.get(rid)
+                    if cur is route:
+                        route.idx, route.rrid = tdx, new_rrid
+                        route.gen += 1
+                    self._by_replica[tdx][new_rrid] = rid
+                    self._stats["handoffs"] += 1
+                if item.journey is not None:
+                    item.journey.event("handoff", at="router",
+                                       source=src_idx, target=tdx)
+                if self._rec is not None:
+                    self._rec.record("handoff", rid=rid,
+                                     source=src_idx, target=tdx,
+                                     pipelined_pages=len(delivered))
+                rep.migrate_finish(rrid)
+                if self._tele is not None:
+                    self._tele.on_handoff("ok", t0)
+                return
+            # fall through: every closing attempt failed
+            if attempted:
+                self._handoff_fallback(rid, src_idx, t0)
+        finally:
+            if handle is not None:      # staging still open: release
+                try:                    # the target's placeholder pages
+                    target.migrate_in_abort(handle)
+                except Exception:
+                    pass
+            self._pumping.discard(rid)
+
+    def _pump_frames(self, target, handle, frag, payloads, delivered):
+        """Forward one partial batch's page frames to the staged
+        target, skipping wire-lost holes (``None`` payloads — the
+        closing pull re-ships them). Updates ``delivered`` with the
+        ABSOLUTE page indices the target acknowledged. False when the
+        target refuses the staging (caller drops it)."""
+        base0 = int(frag.get("base") or 0)
+        shas = frag.get("sha256") or [None] * len(payloads)
+        i = 0
+        while i < len(payloads):
+            if payloads[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(payloads) and payloads[j] is not None:
+                j += 1
+            try:
+                got = target.migrate_in_pages(
+                    handle, base0 + i, payloads[i:j], shas[i:j])
+            except Exception:
+                return False
+            if isinstance(got, int):    # in-process server: a count
+                delivered.update(range(base0 + i, base0 + i + got))
+            else:                       # remote client: absolute
+                delivered.update(int(p) for p in got)   # landed pages
+            i = j
+        return True
+
+    def _handoff_fallback(self, rid, src_idx, t0):
+        with self._lock:
+            self._stats["handoff_fallbacks"] += 1
+            route = self._routes.get(rid)
+        if route is not None and route.item.journey is not None:
+            route.item.journey.event("handoff", at="router",
+                                     source=src_idx, fallback=True)
+        if self._tele is not None:
+            self._tele.on_handoff("fallback", t0)
 
     # ---------------------------------------------------------- failover
     def _failover(self, idx, flush_partials):
@@ -1095,6 +1383,13 @@ class ReplicaRouter:
     def _publish_health(self):
         if self._tele is not None:
             self._tele.set_health(self.health)
+            for idx, rep in enumerate(self.replicas):
+                # role rides the same publish cadence as health: a
+                # restarted host that comes back with a different role
+                # (or a pre-role build, -> "hybrid") updates within one
+                # supervisor poll
+                self._tele.set_replica_role(
+                    idx, _placement.replica_role(rep))
 
     @property
     def stats(self):

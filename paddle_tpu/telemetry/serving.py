@@ -680,6 +680,14 @@ class RouterTelemetry:
                                             traffic
     - ``router_health``                     aggregate: 0 all serving /
       1 some down / 3 none serving (same coding as ``server_health``)
+    - ``router_handoffs_total{result}``     prefill->decode handoffs
+      (disaggregated placement), ok = committed on a decode sibling /
+      fallback = the request stayed decoding on the prefill specialist
+    - ``serving_handoff_seconds``           one handoff end to end:
+      pump start (placement on the specialist) through pipelined page
+      frames to the commit on the decode target
+    - ``router_replica_role{replica}``      each replica's placement
+      role: 0 hybrid / 1 prefill / 2 decode
 
     Same conventions as ``ServerTelemetry``: every method no-ops when
     the registry is disabled, calls happen under the router's lock (or
@@ -732,6 +740,25 @@ class RouterTelemetry:
             "router_health",
             "Aggregate router health code: 0 all replicas serving / "
             "1 some down / 3 none (alert on >= 1)")
+        handoff = r.counter(
+            "router_handoffs_total",
+            "Prefill->decode handoffs under disaggregated placement, "
+            "by outcome: ok = pages + sampler state committed on a "
+            "decode sibling; fallback = staging aborted (frame loss, "
+            "no sibling with headroom, target refusal) and the "
+            "request kept decoding on the prefill specialist",
+            labelnames=("result",))
+        self._c_handoff_ok = handoff.labels(result="ok")
+        self._c_handoff_fallback = handoff.labels(result="fallback")
+        self._h_handoff = r.histogram(
+            "serving_handoff_seconds",
+            "One prefill->decode handoff end to end: pump start "
+            "through pipelined page frames to commit on the decode "
+            "target", buckets=TICK_BUCKETS)
+        self._g_role = r.gauge(
+            "router_replica_role",
+            "Replica placement role: 0 hybrid / 1 prefill / 2 decode",
+            labelnames=("replica",))
 
     def on_routed(self, replica, affinity_hit):
         if not self.enabled:
@@ -775,3 +802,27 @@ class RouterTelemetry:
             return
         from ..reliability.health import HEALTH_CODES
         self._g_health.set(HEALTH_CODES[state])
+
+    def handoff_started(self):
+        """Clock read for ``on_handoff``'s latency observation — only
+        taken when a handoff pump actually starts."""
+        return self.clock.now() if self.enabled else None
+
+    def on_handoff(self, result, started=None):
+        """One prefill->decode handoff settled: ``result`` is ``"ok"``
+        (committed on the decode target) or ``"fallback"`` (the
+        request stayed on the prefill specialist); latency observed
+        from ``started`` = ``handoff_started()``."""
+        if not self.enabled:
+            return
+        (self._c_handoff_ok if result == "ok"
+         else self._c_handoff_fallback).inc()
+        if started is not None:
+            self._h_handoff.observe(self.clock.now() - started)
+
+    def set_replica_role(self, replica, role):
+        """Publish a replica's placement role (coded: hybrid 0 /
+        prefill 1 / decode 2 — unknown values read as hybrid)."""
+        if self.enabled:
+            code = {"prefill": 1, "decode": 2}.get(role, 0)
+            self._g_role.labels(replica=str(replica)).set(code)

@@ -68,7 +68,12 @@ OPT_OUT = "no-roadmap:"
 # place: the int8 paged pool (generation.py, ROADMAP item 3) and the
 # fused tick on a mesh (continuous_batching.py, ROADMAP item 2 — the
 # megakernel's DMA schedule and sampling epilogue are still
-# single-device; split mode serves meshes).
+# single-device; split mode serves meshes). ISSUE 20 LIFTED the
+# pre-first-token migrate_out refusal (an empty-``emitted`` migration
+# IS a prefill->decode handoff now) and points the next cut instead:
+# disaggregated placement stops at one datacenter's flat network —
+# placement="cross-datacenter" (bandwidth-aware frame scheduling,
+# ROADMAP item 4 follow-on) must refuse with a pointer until it lands.
 REQUIRED_CUTS = (
     (os.path.join("paddle_tpu", "models", "generation.py"),
      "int8"),
@@ -78,6 +83,8 @@ REQUIRED_CUTS = (
      "tick_block"),
     (os.path.join("paddle_tpu", "inference", "continuous_batching.py"),
      "fused+mesh"),
+    (os.path.join("paddle_tpu", "inference", "placement.py"),
+     "cross-datacenter"),
 )
 
 
